@@ -1,0 +1,55 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle-Fluid
+capabilities (reference: /root/reference, see SURVEY.md), built on JAX/XLA.
+
+Architecture (TPU-first, not a port):
+  * static graph: Program/Block/Op IR -> whole-block XLA compilation
+    (framework/executor.py) instead of per-op kernel dispatch;
+  * autodiff: graph-transform append_backward whose grad ops replay forward
+    emitters under jax.vjp (framework/backward.py);
+  * eager "dygraph" mode with taped autograd (dygraph/);
+  * distributed: GSPMD sharding + shard_map collectives over a device Mesh
+    (parallel/), replacing NCCL rings and the SSA-graph ParallelExecutor.
+"""
+
+from . import core  # noqa: F401  (places, dtypes)
+from .core.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    cpu_places,
+    is_compiled_with_tpu,
+    tpu_places,
+)
+from .framework import (  # noqa: F401
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    in_dygraph_mode,
+    program_guard,
+    scope_guard,
+)
+from . import ops  # noqa: F401  (registers all op emitters)
+from .framework.executor import Executor  # noqa: F401
+from .framework.backward import append_backward, gradients  # noqa: F401
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+
+# `fluid`-compatible alias so code written against the reference API reads
+# naturally: `import paddle_tpu as fluid; fluid.layers.fc(...)`.
+fluid = None  # replaced below to avoid circular import confusion
+import sys as _sys
+
+fluid = _sys.modules[__name__]
+
+__version__ = "0.1.0"
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """fluid.data parity: full-shape feed declaration."""
+    return layers.data(name, shape, dtype, lod_level=lod_level)

@@ -1,0 +1,103 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm)."""
+
+from __future__ import annotations
+
+from .framework import unique_name
+
+
+class GradientClipBase:
+    def apply(self, params_grads, block):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def apply(self, params_grads, block):
+        out = []
+        for p, g in params_grads:
+            c = block.create_var(
+                name=unique_name.generate(g.name + "@CLIP"),
+                shape=g.shape, dtype=g.dtype,
+            )
+            block.append_op(
+                "clip", {"X": [g.name]}, {"Out": [c.name]},
+                {"min": self.min, "max": self.max},
+            )
+            out.append((p, c))
+        return out
+
+
+class GradientClipByNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, params_grads, block):
+        out = []
+        for p, g in params_grads:
+            c = block.create_var(
+                name=unique_name.generate(g.name + "@CLIP"),
+                shape=g.shape, dtype=g.dtype,
+            )
+            block.append_op(
+                "clip_by_norm", {"X": [g.name]}, {"Out": [c.name]},
+                {"max_norm": self.clip_norm},
+            )
+            out.append((p, c))
+        return out
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _tmp(self, block, key, shape, dtype="float32"):
+        return block.create_var(
+            name=unique_name.generate(key), shape=shape, dtype=dtype
+        )
+
+    def apply(self, params_grads, block):
+        # scale = clip_norm / max(gnorm, clip_norm); g_clipped = g * scale
+        sq_names = []
+        for _, g in params_grads:
+            full = self._tmp(block, g.name + "@SQFULL", g.shape, g.dtype)
+            block.append_op("square", {"X": [g.name]}, {"Out": [full.name]})
+            sq = self._tmp(block, g.name + "@SQ", [1], "float32")
+            block.append_op(
+                "reduce_sum", {"X": [full.name]}, {"Out": [sq.name]},
+                {"reduce_all": True},
+            )
+            sq_names.append(sq.name)
+        total = self._tmp(block, "global_norm_sq", [1])
+        block.append_op("sum", {"X": sq_names}, {"Out": [total.name]}, {})
+        gnorm = self._tmp(block, "global_norm", [1])
+        block.append_op("sqrt", {"X": [total.name]}, {"Out": [gnorm.name]})
+        max_norm = self._tmp(block, "max_norm", [1])
+        block.append_op(
+            "clip", {"X": [gnorm.name]}, {"Out": [max_norm.name]},
+            {"min": self.clip_norm, "max": 3.4e38},
+        )
+        inv = self._tmp(block, "inv_max_norm", [1])
+        block.append_op("reciprocal", {"X": [max_norm.name]}, {"Out": [inv.name]})
+        scale_v = self._tmp(block, "clip_scale", [1])
+        block.append_op(
+            "scale", {"X": [inv.name]}, {"Out": [scale_v.name]},
+            {"scale": self.clip_norm},
+        )
+        out = []
+        for p, g in params_grads:
+            c = self._tmp(block, g.name + "@CLIP", g.shape, g.dtype)
+            block.append_op(
+                "elementwise_mul",
+                {"X": [g.name], "Y": [scale_v.name]},
+                {"Out": [c.name]},
+                {},
+            )
+            out.append((p, c))
+        return out
+
+
+ErrorClipByValue = GradientClipByValue

@@ -1,0 +1,11 @@
+from .dtypes import convert_dtype, is_float, is_integer, to_numpy_dtype  # noqa: F401
+from .place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    cpu_places,
+    default_place,
+    is_compiled_with_tpu,
+    tpu_places,
+)

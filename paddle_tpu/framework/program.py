@@ -1,0 +1,331 @@
+"""Program / Block / Operator / Variable — the static-graph IR.
+
+Capability parity with the reference's ProgramDesc/BlockDesc/OpDesc/VarDesc
+(framework/framework.proto:42-216 and python/paddle/fluid/framework.py in the
+reference repo), re-designed for the XLA compilation model:
+
+* The IR is a pure Python graph (no protobuf round-trip needed on the hot
+  path): an Operator names its input/output Variables per slot; a Block is an
+  ordered op list + var map; a Program is a list of Blocks.
+* There are NO per-op kernels. Every op type registers a JAX *emitter*
+  (see registry.py); the Executor lowers a whole block to one XLA computation
+  (jit) instead of interpreting ops one-by-one (the reference's hot loop at
+  executor.cc:469-476). This is the TPU-native analogue of the reference's
+  ChooseKernel dispatch (operator.cc:1032).
+* Values live in a Scope (name -> array), exactly as the reference's
+  Scope/Variable (scope.h:46) — but buffers are jax Arrays already resident
+  on device, and the Executor donates mutated persistables back, so optimizer
+  updates are in-place at the XLA buffer level.
+
+Shapes use -1 for the batch (data-dependent) dimension at graph-build time;
+at Executor.run the feed arrays pin concrete shapes and the whole block is
+compiled static-shape (XLA requirement). Recompiles are cached per shape set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import copy
+import itertools
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype, to_numpy_dtype
+from . import unique_name
+
+
+class Variable:
+    """Graph-time variable metadata. Runtime values live in a Scope."""
+
+    def __init__(
+        self,
+        block,
+        name,
+        shape=None,
+        dtype="float32",
+        persistable=False,
+        stop_gradient=False,
+        is_data=False,
+        lod_level=0,
+        initializer=None,
+    ):
+        self.block = block
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.lod_level = lod_level  # kept for API parity; ragged data is packed at the pipeline edge
+        self.initializer = initializer
+
+    # --- fluid-style operator sugar (builds ops in the variable's block) ---
+    def _elementwise(self, other, op_type, reverse=False):
+        from .. import layers
+
+        fn = {
+            "elementwise_add": layers.elementwise_add,
+            "elementwise_sub": layers.elementwise_sub,
+            "elementwise_mul": layers.elementwise_mul,
+            "elementwise_div": layers.elementwise_div,
+        }[op_type]
+        if not isinstance(other, Variable):
+            other = layers.fill_constant([1], self.dtype, float(other))
+        a, b = (other, self) if reverse else (self, other)
+        return fn(a, b)
+
+    def __add__(self, other):
+        return self._elementwise(other, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._elementwise(other, "elementwise_sub")
+
+    def __rsub__(self, other):
+        return self._elementwise(other, "elementwise_sub", reverse=True)
+
+    def __mul__(self, other):
+        return self._elementwise(other, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._elementwise(other, "elementwise_div")
+
+    def __repr__(self):
+        return (
+            f"Variable(name={self.name!r}, shape={self.shape}, "
+            f"dtype={self.dtype}, persistable={self.persistable})"
+        )
+
+    @property
+    def grad_name(self):
+        return grad_var_name(self.name)
+
+
+class Parameter(Variable):
+    """A trainable persistable variable (framework.py:4962 in the reference)."""
+
+    def __init__(self, block, name, shape, dtype, trainable=True, **kw):
+        kw.setdefault("persistable", True)
+        kw.setdefault("stop_gradient", not trainable)
+        super().__init__(block, name, shape=shape, dtype=dtype, **kw)
+        self.trainable = trainable
+        self.regularizer = kw.get("regularizer")
+        self.need_clip = True
+
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+class Operator:
+    """One op instance: type + named input/output slots + attrs.
+
+    Slots map slot-name -> list of variable names, mirroring the reference's
+    OpDesc (framework.proto:42). Attrs must stay picklable (plain python data)
+    so Programs serialize for save_inference_model.
+    """
+
+    _uid_counter = itertools.count()
+
+    def __init__(self, block, op_type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = op_type
+        self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+        # stable identity used to derive per-op RNG keys (registry.EmitContext);
+        # survives deepcopy/clone so test-mode programs keep the same streams
+        self.uid = self.attrs.setdefault("__uid__", next(Operator._uid_counter))
+
+    def input_names(self):
+        return [n for vs in self.inputs.values() for n in vs]
+
+    def output_names(self):
+        return [n for vs in self.outputs.values() for n in vs]
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def __repr__(self):
+        ins = {k: v for k, v in self.inputs.items()}
+        outs = {k: v for k, v in self.outputs.items()}
+        return f"Op({self.type}: {ins} -> {outs})"
+
+
+class Block:
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars = {}
+        self.ops = []
+
+    @property
+    def parent_block(self):
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
+
+    def var(self, name):
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name):
+        return self._find_var_recursive(name) is not None
+
+    def _find_var_recursive(self, name):
+        blk = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = blk.parent_block
+        return None
+
+    def create_var(self, name=None, **kw):
+        if name is None:
+            name = unique_name.generate("tmp")
+        v = Variable(self, name, **kw)
+        self.vars[name] = v
+        self.program._bump()
+        return v
+
+    def create_parameter(self, name, shape, dtype, **kw):
+        p = Parameter(self, name, shape, dtype, **kw)
+        self.vars[name] = p
+        self.program._bump()
+        return p
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None, index=None):
+        op = Operator(self, type, inputs, outputs, attrs)
+        if index is None:
+            self.ops.append(op)
+        else:
+            self.ops.insert(index, op)
+        self.program._bump()
+        return op
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def infer_and_create_output(
+        self, op_type, inputs, attrs, out_name=None, out_slot="Out", **var_kw
+    ):
+        """Create the output Variable for slot `out_slot` of an op, inferring
+        shape and dtype from the op's JAX emitter (registry.infer_shapes)."""
+        from .registry import infer_shapes
+
+        out_specs = infer_shapes(op_type, self, inputs, attrs)
+        shape, dtype = out_specs[out_slot][0]
+        name = out_name or unique_name.generate(op_type)
+        return self.create_var(name=name, shape=shape, dtype=dtype, **var_kw)
+
+
+class Program:
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._version = 0
+        self._mesh = None  # set by parallel transpilers / SPMD mode
+        self._sharding = {}  # var name -> PartitionSpec-like tuple
+        self._pipeline = None  # set by PipelineOptimizer
+
+    def _bump(self):
+        self._version += 1
+
+    @property
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def create_block(self, parent_idx=None):
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        blk = Block(self, len(self.blocks), parent)
+        self.blocks.append(blk)
+        self.current_block_idx = blk.idx
+        self._bump()
+        return blk
+
+    def rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+        if self.current_block_idx < 0:
+            self.current_block_idx = 0
+
+    def all_parameters(self):
+        return [p for b in self.blocks for p in b.all_parameters()]
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    def clone(self, for_test=False):
+        """Deep copy. for_test=True flips is_test on ops that honor it
+        (dropout/batch_norm), matching fluid Program.clone semantics."""
+        p = copy.deepcopy(self)
+        if for_test:
+            for b in p.blocks:
+                for op in b.ops:
+                    if "is_test" in op.attrs:
+                        op.attrs["is_test"] = True
+        p._bump()
+        return p
+
+    def __repr__(self):
+        lines = []
+        for b in self.blocks:
+            lines.append(f"block {b.idx} (parent {b.parent_idx}):")
+            for op in b.ops:
+                lines.append(f"  {op}")
+        return "\n".join(lines)
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    old_main, old_startup = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = old_main, old_startup
+
+
+# --- dygraph mode switch (framework.py:180 in the reference) ---
+_dygraph_tracer = None
+
+
+def in_dygraph_mode() -> bool:
+    return _dygraph_tracer is not None
+
+
+def _set_dygraph_tracer(tracer):
+    global _dygraph_tracer
+    _dygraph_tracer = tracer
+
+
+def _current_tracer():
+    return _dygraph_tracer

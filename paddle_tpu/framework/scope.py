@@ -1,0 +1,72 @@
+"""Scope: hierarchical name -> value store (reference: framework/scope.h:46).
+
+Values are jax Arrays (usually already resident in TPU HBM) or numpy arrays.
+The Executor reads persistables from the scope, runs the compiled block, and
+writes mutated persistables back — donation makes that an in-place HBM update.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self.kids = []
+
+    def new_scope(self):
+        s = Scope(self)
+        self.kids.append(s)
+        return s
+
+    def drop_kids(self):
+        self.kids = []
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name):
+        return self.find_var(name) is not None
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def __contains__(self, name):
+        return self.has_var(name)
+
+
+_global_scope = Scope()
+_current_scope = _global_scope
+
+
+def global_scope() -> Scope:
+    """The active scope. scope_guard() swaps it, matching fluid semantics
+    (executor.py in the reference resolves global_scope() per run)."""
+    return _current_scope
+
+
+current_scope = global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _current_scope
+    old = _current_scope
+    _current_scope = scope
+    try:
+        yield
+    finally:
+        _current_scope = old

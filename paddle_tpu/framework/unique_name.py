@@ -1,0 +1,35 @@
+"""Unique-name generator (capability of python/paddle/fluid/unique_name.py in
+the reference repo): per-prefix counters, guard() to scope generators."""
+
+from __future__ import annotations
+
+import contextlib
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.ids = {}
+
+    def __call__(self, key: str) -> str:
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return f"{self.prefix}{key}_{n}"
+
+
+_generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+@contextlib.contextmanager
+def guard(new_prefix: str = ""):
+    global _generator
+    old = _generator
+    _generator = UniqueNameGenerator(new_prefix)
+    try:
+        yield
+    finally:
+        _generator = old

@@ -1,0 +1,156 @@
+"""Parameter initializers, emitted as startup-program ops.
+
+Reference parity: python/paddle/fluid/initializer.py (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA/Kaiming, Bilinear, NumpyArrayInitializer)
+— each appends one init op (fill_constant / gaussian_random / uniform_random)
+to the startup block, exactly the reference's pattern.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class Initializer:
+    def __call__(self, block, name, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, block, name, shape, dtype):
+        block.append_op(
+            "fill_constant",
+            {},
+            {"Out": [name]},
+            {"shape": list(shape), "dtype": dtype, "value": float(self.value)},
+        )
+
+
+class Normal(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, block, name, shape, dtype):
+        block.append_op(
+            "gaussian_random",
+            {},
+            {"Out": [name]},
+            {
+                "shape": list(shape),
+                "dtype": dtype,
+                "mean": self.loc,
+                "std": self.scale,
+                "seed": self.seed,
+            },
+        )
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, block, name, shape, dtype):
+        block.append_op(
+            "truncated_gaussian_random",
+            {},
+            {"Out": [name]},
+            {
+                "shape": list(shape),
+                "dtype": dtype,
+                "mean": self.loc,
+                "std": self.scale,
+                "seed": self.seed,
+            },
+        )
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, block, name, shape, dtype):
+        block.append_op(
+            "uniform_random",
+            {},
+            {"Out": [name]},
+            {
+                "shape": list(shape),
+                "dtype": dtype,
+                "min": self.low,
+                "max": self.high,
+                "seed": self.seed,
+            },
+        )
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * receptive if len(shape) > 2 else shape[0]
+    fan_out = shape[0] * receptive if len(shape) > 2 else shape[1]
+    return fan_in, fan_out
+
+
+class Xavier(Initializer):
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform = uniform
+        self.fan_in, self.fan_out, self.seed = fan_in, fan_out, seed
+
+    def __call__(self, block, name, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            Uniform(-limit, limit, self.seed)(block, name, shape, dtype)
+        else:
+            std = math.sqrt(2.0 / (fi + fo))
+            Normal(0.0, std, self.seed)(block, name, shape, dtype)
+
+
+class MSRA(Initializer):
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, block, name, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            Uniform(-limit, limit, self.seed)(block, name, shape, dtype)
+        else:
+            std = math.sqrt(2.0 / fi)
+            Normal(0.0, std, self.seed)(block, name, shape, dtype)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, block, name, shape, dtype):
+        block.append_op(
+            "assign_value",
+            {},
+            {"Out": [name]},
+            {
+                "shape": list(self.value.shape),
+                "dtype": dtype,
+                "values": self.value.reshape(-1).tolist(),
+            },
+        )
+
+
+ConstantInitializer = Constant
+NormalInitializer = Normal
+UniformInitializer = Uniform
+XavierInitializer = Xavier
+MSRAInitializer = MSRA
+TruncatedNormalInitializer = TruncatedNormal

@@ -1,0 +1,30 @@
+"""fluid.layers-equivalent functional API (reference:
+python/paddle/fluid/layers/ — 35k LoC across nn.py, tensor.py, loss.py...)."""
+
+from .nn import *  # noqa: F401,F403
+from .nn import _apply_act  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+from .tensor import (  # noqa: F401  (generated attrs need explicit export)
+    elementwise_add,
+    elementwise_sub,
+    elementwise_mul,
+    elementwise_div,
+    elementwise_max,
+    elementwise_min,
+    elementwise_pow,
+    elementwise_mod,
+    equal,
+    not_equal,
+    less_than,
+    less_equal,
+    greater_than,
+    greater_equal,
+    relu,
+    sigmoid,
+    tanh,
+    sqrt,
+    square,
+    exp,
+    log,
+    gelu,
+)

@@ -1,0 +1,97 @@
+"""LayerHelper: shared machinery for functional layers.
+
+Reference parity: python/paddle/fluid/layer_helper.py — creates parameters in
+both main and startup programs (init ops go to startup), creates inferred
+output vars, appends the forward op to the main program.
+"""
+
+from __future__ import annotations
+
+from ..framework import unique_name
+from ..framework.program import (
+    default_main_program,
+    default_startup_program,
+)
+from ..framework.registry import infer_shapes
+from ..initializer import Constant, Xavier
+from ..param_attr import ParamAttr
+
+
+def main_block():
+    return default_main_program().current_block()
+
+
+def startup_block():
+    return default_startup_program().global_block
+
+
+class LayerHelper:
+    def __init__(self, layer_type, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+
+    @property
+    def name(self):
+        n = self.kwargs.get("name")
+        return n or unique_name.generate(self.layer_type)
+
+    # -- parameters --------------------------------------------------------
+    def create_parameter(
+        self, attr, shape, dtype, is_bias=False, default_initializer=None
+    ):
+        attr = ParamAttr.to_attr(attr)
+        if attr is False:
+            return None
+        init = attr.initializer or default_initializer or (
+            Constant(0.0) if is_bias else Xavier()
+        )
+        name = attr.name or unique_name.generate(
+            f"{self.layer_type}_{'b' if is_bias else 'w'}"
+        )
+        mb, sb = main_block(), startup_block()
+        p = mb.create_parameter(
+            name, shape, dtype, trainable=attr.trainable
+        )
+        p.regularizer = attr.regularizer
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        if not sb.has_var(name):
+            sb.create_parameter(name, shape, dtype, trainable=attr.trainable)
+            init(sb, name, shape, dtype)
+        return p
+
+    # -- outputs -----------------------------------------------------------
+    def append_op(self, op_type=None, inputs=None, outputs=None, attrs=None):
+        return main_block().append_op(
+            op_type or self.layer_type, inputs, outputs, attrs
+        )
+
+    def create_and_append(
+        self, inputs, attrs, op_type=None, out_slots=("Out",), stop_gradient=False
+    ):
+        """Append an op, creating one output var per slot with inferred
+        shape/dtype. inputs: {slot: [Variable]}. Returns var or tuple."""
+        op_type = op_type or self.layer_type
+        blk = main_block()
+        in_names = {
+            slot: [v.name if v is not None else "" for v in vs]
+            for slot, vs in inputs.items()
+        }
+        specs = infer_shapes(op_type, blk, in_names, attrs or {})
+        outs = []
+        out_names = {}
+        for slot in out_slots:
+            slot_specs = specs.get(slot, [])
+            names, vars_ = [], []
+            for shape, dtype in slot_specs:
+                v = blk.create_var(
+                    name=unique_name.generate(f"{op_type}.{slot.lower()}"),
+                    shape=shape,
+                    dtype=dtype,
+                    stop_gradient=stop_gradient,
+                )
+                names.append(v.name)
+                vars_.append(v)
+            out_names[slot] = names
+            outs.append(vars_[0] if len(vars_) == 1 else vars_)
+        blk.append_op(op_type, in_names, out_names, attrs or {})
+        return outs[0] if len(outs) == 1 else tuple(outs)

@@ -1,0 +1,399 @@
+"""Functional layer API — the fluid.layers surface.
+
+Reference parity: python/paddle/fluid/layers/nn.py (fc :208, conv2d :1315,
+batch_norm :2614, layer_norm :3381, softmax :1183, dropout, embedding, pool2d
+...), loss.py, tensor.py. Each function appends ops to the default main
+program; parameters are created via LayerHelper with their init ops in the
+startup program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+from ..framework import unique_name
+from ..framework.program import default_main_program
+from ..initializer import Constant, Normal, Xavier
+from .helper import LayerHelper, main_block
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=False):
+    """fluid.data / layers.data: declare a feed variable.
+
+    append_batch_size=True prepends -1 (layers/io.py `data` semantics in the
+    reference); fluid.data-style full shapes are the default here."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    blk = default_main_program().global_block
+    return blk.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        is_data=True,
+        stop_gradient=True,
+        lod_level=lod_level,
+    )
+
+
+def fc(
+    input,
+    size,
+    num_flatten_dims=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("fc", name=name)
+    in_dim = int(np.prod(input.shape[num_flatten_dims:]))
+    w = helper.create_parameter(param_attr, [in_dim, size], input.dtype)
+    out = helper.create_and_append(
+        {"X": [input], "Y": [w]},
+        {"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        op_type="mul",
+    )
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [size], input.dtype, is_bias=True)
+        out = helper.create_and_append(
+            {"X": [out], "Y": [b]},
+            {"axis": num_flatten_dims},
+            op_type="elementwise_add",
+        )
+    return _apply_act(out, act)
+
+
+def _apply_act(out, act):
+    if act is None:
+        return out
+    helper = LayerHelper(act)
+    return helper.create_and_append({"X": [out]}, {}, op_type=act)
+
+
+def embedding(
+    input,
+    size,
+    is_sparse=False,
+    padding_idx=None,
+    param_attr=None,
+    dtype="float32",
+    name=None,
+):
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(
+        param_attr, list(size), dtype, default_initializer=Xavier()
+    )
+    return helper.create_and_append(
+        {"W": [w], "Ids": [input]},
+        {"padding_idx": -1 if padding_idx is None else padding_idx},
+        op_type="lookup_table_v2" if (input.shape and input.shape[-1] != 1) else "lookup_table",
+    )
+
+
+def conv2d(
+    input,
+    num_filters,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+    data_format="NCHW",
+):
+    helper = LayerHelper("conv2d", name=name)
+    k = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    groups = groups or 1
+    num_channels = input.shape[1]
+    w_shape = [num_filters, num_channels // groups, k[0], k[1]]
+    std = (2.0 / (k[0] * k[1] * num_channels)) ** 0.5
+    w = helper.create_parameter(
+        param_attr, w_shape, input.dtype, default_initializer=Normal(0.0, std)
+    )
+    attrs = {
+        "strides": list(stride) if isinstance(stride, (list, tuple)) else [stride] * 2,
+        "paddings": list(padding) if isinstance(padding, (list, tuple)) else [padding] * 2,
+        "dilations": list(dilation) if isinstance(dilation, (list, tuple)) else [dilation] * 2,
+        "groups": groups,
+        "padding_algorithm": "EXPLICIT",
+    }
+    out = helper.create_and_append(
+        {"Input": [input], "Filter": [w]}, attrs, out_slots=("Output",)
+    )
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            bias_attr, [num_filters], input.dtype, is_bias=True
+        )
+        out = helper.create_and_append(
+            {"X": [out], "Y": [b]}, {"axis": 1}, op_type="elementwise_add"
+        )
+    return _apply_act(out, act)
+
+
+def conv2d_transpose(
+    input,
+    num_filters,
+    filter_size,
+    stride=1,
+    padding=0,
+    groups=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("conv2d_transpose", name=name)
+    k = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    w_shape = [input.shape[1], num_filters // (groups or 1), k[0], k[1]]
+    w = helper.create_parameter(param_attr, w_shape, input.dtype)
+    attrs = {
+        "strides": list(stride) if isinstance(stride, (list, tuple)) else [stride] * 2,
+        "paddings": list(padding) if isinstance(padding, (list, tuple)) else [padding] * 2,
+        "groups": groups or 1,
+    }
+    out = helper.create_and_append(
+        {"Input": [input], "Filter": [w]}, attrs, out_slots=("Output",)
+    )
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype, is_bias=True)
+        out = helper.create_and_append(
+            {"X": [out], "Y": [b]}, {"axis": 1}, op_type="elementwise_add"
+        )
+    return _apply_act(out, act)
+
+
+def pool2d(
+    input,
+    pool_size=-1,
+    pool_type="max",
+    pool_stride=1,
+    pool_padding=0,
+    global_pooling=False,
+    ceil_mode=False,
+    exclusive=True,
+    name=None,
+):
+    helper = LayerHelper("pool2d", name=name)
+    attrs = {
+        "ksize": list(pool_size) if isinstance(pool_size, (list, tuple)) else [pool_size] * 2,
+        "pooling_type": pool_type,
+        "strides": list(pool_stride) if isinstance(pool_stride, (list, tuple)) else [pool_stride] * 2,
+        "paddings": list(pool_padding) if isinstance(pool_padding, (list, tuple)) else [pool_padding] * 2,
+        "global_pooling": global_pooling,
+        "exclusive": exclusive,
+    }
+    return helper.create_and_append({"X": [input]}, attrs)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="avg", name=None):
+    helper = LayerHelper("pool2d", name=name)
+    attrs = {
+        "ksize": list(pool_size) if isinstance(pool_size, (list, tuple)) else [pool_size] * 2,
+        "pooling_type": pool_type,
+        "adaptive": True,
+    }
+    return helper.create_and_append({"X": [input]}, attrs)
+
+
+def batch_norm(
+    input,
+    act=None,
+    is_test=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    data_layout="NCHW",
+    name=None,
+    use_global_stats=False,
+):
+    helper = LayerHelper("batch_norm", name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    dtype = input.dtype if input.dtype != "float16" else "float32"
+    scale = helper.create_parameter(
+        param_attr, [c], dtype, default_initializer=Constant(1.0)
+    )
+    bias = helper.create_parameter(bias_attr, [c], dtype, is_bias=True)
+    from ..param_attr import ParamAttr
+
+    mean = helper.create_parameter(
+        ParamAttr(
+            name=unique_name.generate("bn_mean"), trainable=False,
+            initializer=Constant(0.0),
+        ),
+        [c],
+        dtype,
+    )
+    var = helper.create_parameter(
+        ParamAttr(
+            name=unique_name.generate("bn_variance"), trainable=False,
+            initializer=Constant(1.0),
+        ),
+        [c],
+        dtype,
+    )
+    mean.stop_gradient = True
+    var.stop_gradient = True
+
+    blk = main_block()
+    y = blk.create_var(
+        name=unique_name.generate("batch_norm.y"), shape=input.shape, dtype=input.dtype
+    )
+    saved_mean = blk.create_var(
+        name=unique_name.generate("batch_norm.sm"), shape=[c], dtype=dtype,
+        stop_gradient=True,
+    )
+    saved_var = blk.create_var(
+        name=unique_name.generate("batch_norm.sv"), shape=[c], dtype=dtype,
+        stop_gradient=True,
+    )
+    blk.append_op(
+        "batch_norm",
+        {
+            "X": [input.name],
+            "Scale": [scale.name],
+            "Bias": [bias.name],
+            "Mean": [mean.name],
+            "Variance": [var.name],
+        },
+        {
+            "Y": [y.name],
+            "MeanOut": [mean.name],
+            "VarianceOut": [var.name],
+            "SavedMean": [saved_mean.name],
+            "SavedVariance": [saved_var.name],
+        },
+        {
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "is_test": is_test,
+            "data_layout": data_layout,
+            "use_global_stats": use_global_stats,
+        },
+    )
+    return _apply_act(y, act)
+
+
+def layer_norm(
+    input,
+    scale=True,
+    shift=True,
+    begin_norm_axis=1,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("layer_norm", name=name)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    s = (
+        helper.create_parameter(
+            param_attr, norm_shape, input.dtype, default_initializer=Constant(1.0)
+        )
+        if scale
+        else None
+    )
+    b = (
+        helper.create_parameter(bias_attr, norm_shape, input.dtype, is_bias=True)
+        if shift
+        else None
+    )
+    ins = {"X": [input]}
+    if s is not None:
+        ins["Scale"] = [s]
+    if b is not None:
+        ins["Bias"] = [b]
+    y, _, _ = helper.create_and_append(
+        ins,
+        {"begin_norm_axis": begin_norm_axis, "epsilon": epsilon},
+        out_slots=("Y", "Mean", "Variance"),
+    )
+    return _apply_act(y, act)
+
+
+def dropout(
+    x,
+    dropout_prob,
+    is_test=False,
+    seed=None,
+    dropout_implementation="downgrade_in_infer",
+    name=None,
+):
+    helper = LayerHelper("dropout", name=name)
+    out, _ = helper.create_and_append(
+        {"X": [x]},
+        {
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "dropout_implementation": dropout_implementation,
+            "seed": seed or 0,
+        },
+        out_slots=("Out", "Mask"),
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# losses & metrics
+# ---------------------------------------------------------------------------
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    return helper.create_and_append(
+        {"X": [input], "Label": [label]},
+        {"soft_label": soft_label, "ignore_index": ignore_index},
+        out_slots=("Y",),
+    )
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100, axis=-1,
+    return_softmax=False,
+):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax, loss = helper.create_and_append(
+        {"Logits": [logits], "Label": [label]},
+        {"soft_label": soft_label, "ignore_index": ignore_index, "axis": axis},
+        out_slots=("Softmax", "Loss"),
+    )
+    return (loss, softmax) if return_softmax else loss
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    return helper.create_and_append({"X": [input], "Y": [label]}, {})
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits")
+    return helper.create_and_append(
+        {"X": [x], "Label": [label]},
+        {"ignore_index": ignore_index, "normalize": normalize},
+    )
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    return helper.create_and_append({"X": [x]}, {})
+
+
+def accuracy(input, label, k=1):
+    helper = LayerHelper("accuracy")
+    topk_out, topk_idx = helper.create_and_append(
+        {"X": [input]}, {"k": k}, op_type="top_k", out_slots=("Out", "Indices"),
+        stop_gradient=True,
+    )
+    acc, _, _ = helper.create_and_append(
+        {"Out": [topk_out], "Indices": [topk_idx], "Label": [label]},
+        {},
+        op_type="accuracy",
+        out_slots=("Accuracy", "Correct", "Total"),
+        stop_gradient=True,
+    )
+    return acc

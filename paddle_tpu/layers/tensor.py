@@ -1,0 +1,348 @@
+"""Tensor-manipulation layers (fluid.layers.tensor + parts of nn).
+
+Reference parity: python/paddle/fluid/layers/tensor.py (fill_constant, cast,
+concat, assign, zeros/ones, sums, argmax...), plus reshape/transpose/etc from
+layers/nn.py. Elementwise + activation wrappers are generated from the op
+registry, mirroring the reference's layer_function_generator.py approach.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..framework import unique_name
+from .helper import LayerHelper, main_block
+
+
+def _simple(op_type, ins, attrs, out_slots=("Out",), **kw):
+    helper = LayerHelper(op_type)
+    return helper.create_and_append(ins, attrs, out_slots=out_slots, **kw)
+
+
+def fill_constant(shape, dtype, value, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    return helper.create_and_append(
+        {}, {"shape": list(shape), "dtype": dtype, "value": float(value)},
+        stop_gradient=True,
+    )
+
+
+def zeros(shape, dtype="float32"):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype="float32"):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros_like(x):
+    return _simple(
+        "fill_any_like", {"X": [x]}, {"value": 0.0}, stop_gradient=True
+    )
+
+
+def ones_like(x):
+    return _simple(
+        "fill_any_like", {"X": [x]}, {"value": 1.0}, stop_gradient=True
+    )
+
+
+def cast(x, dtype):
+    return _simple("cast", {"X": [x]}, {"out_dtype": dtype})
+
+
+def concat(input, axis=0, name=None):
+    return _simple("concat", {"X": list(input)}, {"axis": axis})
+
+
+def assign(input, output=None):
+    blk = main_block()
+    if output is None:
+        return _simple("assign", {"X": [input]}, {})
+    blk.append_op("assign", {"X": [input.name]}, {"Out": [output.name]}, {})
+    return output
+
+
+def sums(input, out=None):
+    if out is not None:
+        main_block().append_op(
+            "sum", {"X": [v.name for v in input]}, {"Out": [out.name]}, {}
+        )
+        return out
+    return _simple("sum", {"X": list(input)}, {})
+
+
+def reshape(x, shape, inplace=False, name=None):
+    out, _ = _simple(
+        "reshape2", {"X": [x]}, {"shape": list(shape)}, out_slots=("Out", "XShape")
+    )
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    out, _ = _simple(
+        "flatten2", {"X": [x]}, {"axis": axis}, out_slots=("Out", "XShape")
+    )
+    return out
+
+
+def transpose(x, perm, name=None):
+    out, _ = _simple(
+        "transpose2", {"X": [x]}, {"axis": list(perm)}, out_slots=("Out", "XShape")
+    )
+    return out
+
+
+def squeeze(input, axes, name=None):
+    out, _ = _simple(
+        "squeeze2", {"X": [input]}, {"axes": list(axes)}, out_slots=("Out", "XShape")
+    )
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    out, _ = _simple(
+        "unsqueeze2", {"X": [input]}, {"axes": list(axes)}, out_slots=("Out", "XShape")
+    )
+    return out
+
+
+def stack(x, axis=0):
+    return _simple("stack", {"X": list(x)}, {"axis": axis}, out_slots=("Y",))
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    if isinstance(num_or_sections, int):
+        attrs = {"num": num_or_sections, "axis": dim, "sections": []}
+        n = num_or_sections
+    else:
+        attrs = {"num": 0, "axis": dim, "sections": list(num_or_sections)}
+        n = len(num_or_sections)
+    helper = LayerHelper("split")
+    outs = helper.create_and_append({"X": [input]}, attrs)
+    return outs if isinstance(outs, (list, tuple)) else [outs]
+
+
+def slice(input, axes, starts, ends):
+    return _simple(
+        "slice",
+        {"Input": [input]},
+        {"axes": list(axes), "starts": list(starts), "ends": list(ends)},
+    )
+
+
+def gather(input, index, overwrite=True):
+    return _simple("gather", {"X": [input], "Index": [index]}, {})
+
+
+def gather_nd(input, index, name=None):
+    return _simple("gather_nd", {"X": [input], "Index": [index]}, {})
+
+
+def scatter(input, index, updates, overwrite=True):
+    return _simple(
+        "scatter",
+        {"X": [input], "Ids": [index], "Updates": [updates]},
+        {"overwrite": overwrite},
+    )
+
+
+def expand(x, expand_times, name=None):
+    return _simple("expand", {"X": [x]}, {"expand_times": list(expand_times)})
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    return _simple(
+        "matmul",
+        {"X": [x], "Y": [y]},
+        {"transpose_X": transpose_x, "transpose_Y": transpose_y, "alpha": alpha},
+    )
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    return _simple(
+        "mul",
+        {"X": [x], "Y": [y]},
+        {"x_num_col_dims": x_num_col_dims, "y_num_col_dims": y_num_col_dims},
+    )
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = _simple(
+        "scale",
+        {"X": [x]},
+        {"scale": scale, "bias": bias, "bias_after_scale": bias_after_scale},
+    )
+    if act:
+        from .nn import _apply_act
+
+        out = _apply_act(out, act)
+    return out
+
+
+def clip(x, min, max, name=None):
+    return _simple("clip", {"X": [x]}, {"min": float(min), "max": float(max)})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _simple("clip_by_norm", {"X": [x]}, {"max_norm": float(max_norm)})
+
+
+def topk(input, k, name=None):
+    return _simple(
+        "top_k", {"X": [input]}, {"k": k}, out_slots=("Out", "Indices"),
+        stop_gradient=True,
+    )
+
+
+def argmax(x, axis=-1):
+    return _simple("arg_max", {"X": [x]}, {"axis": axis}, stop_gradient=True)
+
+
+def argmin(x, axis=-1):
+    return _simple("arg_min", {"X": [x]}, {"axis": axis}, stop_gradient=True)
+
+
+def argsort(x, axis=-1, descending=False):
+    return _simple(
+        "argsort",
+        {"X": [x]},
+        {"axis": axis, "descending": descending},
+        out_slots=("Out", "Indices"),
+        stop_gradient=True,
+    )
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return _simple("one_hot_v2", {"X": [input]}, {"depth": depth})
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False):
+    return _simple(
+        "cumsum",
+        {"X": [x]},
+        {"axis": axis, "reverse": reverse, "exclusive": exclusive},
+    )
+
+
+def where(condition, x, y):
+    return _simple("where", {"Condition": [condition], "X": [x], "Y": [y]}, {})
+
+
+def range(start, end, step, dtype):
+    return _simple(
+        "range", {}, {"start": start, "end": end, "step": step, "dtype": dtype},
+        stop_gradient=True,
+    )
+
+
+def shape(input):
+    return _simple("shape", {"Input": [input]}, {}, stop_gradient=True)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim)
+
+
+def _reduce(op_type, input, dim, keep_dim):
+    attrs = {
+        "dim": [dim] if isinstance(dim, int) else (list(dim) if dim else [0]),
+        "keep_dim": keep_dim,
+        "reduce_all": dim is None,
+    }
+    return _simple(op_type, {"X": [input]}, attrs)
+
+
+# --- generated elementwise / comparison wrappers ---------------------------
+
+_THIS = sys.modules[__name__]
+
+
+def _make_binary(op_type):
+    def fn(x, y, axis=-1, act=None, name=None):
+        out = _simple(op_type, {"X": [x], "Y": [y]}, {"axis": axis})
+        if act:
+            from .nn import _apply_act
+
+            out = _apply_act(out, act)
+        return out
+
+    fn.__name__ = op_type
+    return fn
+
+
+for _t in [
+    "elementwise_add", "elementwise_sub", "elementwise_mul", "elementwise_div",
+    "elementwise_max", "elementwise_min", "elementwise_pow", "elementwise_mod",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal",
+]:
+    setattr(_THIS, _t, _make_binary(_t))
+
+
+def _make_unary(op_type):
+    def fn(x, name=None, **attrs):
+        return _simple(op_type, {"X": [x]}, attrs)
+
+    fn.__name__ = op_type
+    return fn
+
+
+for _t in [
+    "relu", "sigmoid", "tanh", "sqrt", "rsqrt", "square", "abs", "exp", "log",
+    "floor", "ceil", "round", "reciprocal", "sign", "sin", "cos", "gelu",
+    "leaky_relu", "elu", "softplus", "softsign", "swish", "hard_swish",
+    "hard_sigmoid", "logsigmoid", "relu6", "selu", "erf", "log_softmax",
+    "logical_not", "silu", "mish",
+]:
+    setattr(_THIS, _t, _make_unary(_t))
+
+
+def softmax(input, axis=-1, use_cudnn=False, name=None):
+    return _simple("softmax", {"X": [input]}, {"axis": axis})
+
+
+def pow(x, factor=1.0, name=None):
+    return _simple("pow", {"X": [x]}, {"factor": factor})
+
+
+def logical_and(x, y, name=None):
+    return _simple("logical_and", {"X": [x], "Y": [y]}, {})
+
+
+def logical_or(x, y, name=None):
+    return _simple("logical_or", {"X": [x], "Y": [y]}, {})
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    return _simple(
+        "uniform_random",
+        {},
+        {"shape": list(shape), "dtype": dtype, "min": min, "max": max, "seed": seed},
+        stop_gradient=True,
+    )
+
+
+def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, seed=0):
+    return _simple(
+        "gaussian_random",
+        {},
+        {"shape": list(shape), "dtype": dtype, "mean": mean, "std": std, "seed": seed},
+        stop_gradient=True,
+    )

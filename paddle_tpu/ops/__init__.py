@@ -1,0 +1,21 @@
+"""Operator library: importing this package registers every op emitter.
+
+The registry (framework.registry) is the TPU-native analogue of the
+reference's OpRegistry (op_registry.h); modules here cover the kernel surface
+of paddle/fluid/operators/ that the BASELINE workloads need.
+"""
+
+from . import (  # noqa: F401
+    _helpers,
+    activation,
+    amp_ops,
+    collective,
+    math,
+    metrics,
+    nn,
+    optimizer_ops,
+    random,
+    tensor_ops,
+)
+
+from ..framework.registry import registered_ops  # noqa: F401

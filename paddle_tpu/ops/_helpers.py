@@ -1,0 +1,68 @@
+"""Shared emitter helpers (broadcasting rules, registration sugar).
+
+Replaces the reference's operators/math library role for elementwise ops
+(operators/elementwise/ broadcast rules): fluid's `axis` broadcast semantics
+are implemented once here and shared by all elementwise emitters.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def fluid_broadcast(x, y, axis):
+    """fluid elementwise broadcasting: y's shape aligns to x starting at `axis`
+    (elementwise_op_function.h in the reference). axis=-1 means numpy rules /
+    trailing alignment."""
+    if x.ndim == y.ndim or y.ndim == 0:
+        return x, y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    # squeeze trailing size-1 dims fluid allows on y (e.g. bias [C] vs [C,1,1])
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return x, y.reshape(new_shape)
+
+
+def register_elementwise(op_type, fn):
+    @register_op(op_type, inputs=["X", "Y"], outputs=["Out"])
+    def emit(ctx, op, ins):
+        x, y = ins["X"][0], ins["Y"][0]
+        x, y = fluid_broadcast(x, y, op.attr("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+    return emit
+
+
+def register_unary(op_type, fn, differentiable=True):
+    @register_op(
+        op_type, inputs=["X"], outputs=["Out"], differentiable=differentiable
+    )
+    def emit(ctx, op, ins):
+        return {"Out": [fn(ins["X"][0], op.attrs)]}
+
+    return emit
+
+
+def reduce_axes(attrs, ndim):
+    if attrs.get("reduce_all", False):
+        return None
+    dim = attrs.get("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % ndim if ndim else 0 for d in dim)
+
+
+def register_reduce(op_type, fn):
+    @register_op(op_type, inputs=["X"], outputs=["Out"])
+    def emit(ctx, op, ins):
+        x = ins["X"][0]
+        axes = reduce_axes(op.attrs, x.ndim)
+        keep = op.attr("keep_dim", False)
+        out = fn(x, axis=axes, keepdims=keep)
+        if out.ndim == 0:
+            out = out.reshape([1])
+        return {"Out": [out]}
+
+    return emit

@@ -1,0 +1,52 @@
+"""Activation ops (reference: operators/activation_op.cc — 30+ activations).
+
+All are single jnp expressions; XLA fuses them into producer matmuls/convs,
+which is why there is no fused-activation pass here (the reference needed
+fuse_elewise_add_act_pass / fuse_bn_act_pass in ir/)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._helpers import register_unary
+
+register_unary("relu", lambda x, a: jax.nn.relu(x))
+register_unary("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+register_unary("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+register_unary("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+register_unary("tanh", lambda x, a: jnp.tanh(x))
+register_unary("gelu", lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate", False)))
+register_unary("leaky_relu", lambda x, a: jax.nn.leaky_relu(x, a.get("alpha", 0.02)))
+register_unary("elu", lambda x, a: jax.nn.elu(x, a.get("alpha", 1.0)))
+register_unary("selu", lambda x, a: jax.nn.selu(x))
+register_unary("softplus", lambda x, a: jax.nn.softplus(x))
+register_unary("softsign", lambda x, a: jax.nn.soft_sign(x))
+register_unary("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+register_unary("hard_swish", lambda x, a: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+register_unary(
+    "hard_sigmoid",
+    lambda x, a: jnp.clip(a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+)
+register_unary(
+    "hard_shrink",
+    lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+)
+register_unary(
+    "soft_shrink",
+    lambda x, a: jnp.sign(x) * jax.nn.relu(jnp.abs(x) - a.get("lambda", 0.5)),
+)
+register_unary(
+    "thresholded_relu",
+    lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0),
+)
+register_unary("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+register_unary("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
+register_unary("silu", lambda x, a: jax.nn.silu(x))
+register_unary("erf", lambda x, a: jax.scipy.special.erf(x))
+register_unary(
+    "softmax", lambda x, a: jax.nn.softmax(x, axis=a.get("axis", -1))
+)
+register_unary(
+    "log_softmax", lambda x, a: jax.nn.log_softmax(x, axis=a.get("axis", -1))
+)

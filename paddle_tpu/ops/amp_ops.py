@@ -1,0 +1,82 @@
+"""AMP support ops (reference: operators/amp/amp_check_finite_and_scale_op.cc
+and the update_loss_scaling logic used by contrib/mixed_precision/decorator.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op(
+    "amp_check_finite_and_scale",
+    inputs=["X", "Scale"],
+    outputs=["Out", "FoundInfinite"],
+    differentiable=False,
+)
+def _amp_check_finite_and_scale(ctx, op, ins):
+    scale = ins["Scale"][0].reshape(())
+    xs = [x for x in ins["X"] if x is not None]
+    found = jnp.zeros((), dtype=bool)
+    for x in xs:
+        found = found | ~jnp.all(jnp.isfinite(x))
+    outs = [x * scale for x in xs]
+    return {"Out": outs, "FoundInfinite": [found.reshape([1])]}
+
+
+@register_op(
+    "update_loss_scaling",
+    inputs=["X", "FoundInfinite", "PrevLossScaling", "InGoodSteps", "InBadSteps"],
+    outputs=["Out", "LossScaling", "OutGoodSteps", "OutBadSteps"],
+    differentiable=False,
+)
+def _update_loss_scaling(ctx, op, ins):
+    found = ins["FoundInfinite"][0].reshape(())
+    prev = ins["PrevLossScaling"][0].reshape(())
+    good = ins["InGoodSteps"][0].reshape(())
+    bad = ins["InBadSteps"][0].reshape(())
+    incr_every = op.attr("incr_every_n_steps", 1000)
+    decr_every = op.attr("decr_every_n_nan_or_inf", 2)
+    incr_ratio = op.attr("incr_ratio", 2.0)
+    decr_ratio = op.attr("decr_ratio", 0.5)
+
+    new_bad = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+    new_good = jnp.where(found, jnp.zeros_like(good), good + 1)
+    shrink = new_bad >= decr_every
+    grow = new_good >= incr_every
+    scale = jnp.where(
+        shrink,
+        jnp.maximum(prev * decr_ratio, 1.0),
+        jnp.where(grow, prev * incr_ratio, prev),
+    )
+    new_bad = jnp.where(shrink, jnp.zeros_like(new_bad), new_bad)
+    new_good = jnp.where(grow, jnp.zeros_like(new_good), new_good)
+
+    # zero out grads on overflow steps so the optimizer update is a no-op
+    xs = [x for x in ins["X"] if x is not None]
+    outs = [jnp.where(found, jnp.zeros_like(x), x / prev) for x in xs]
+    return {
+        "Out": outs,
+        "LossScaling": [scale.reshape([1])],
+        "OutGoodSteps": [new_good.reshape([1]).astype(np.int32)],
+        "OutBadSteps": [new_bad.reshape([1]).astype(np.int32)],
+    }
+
+
+@register_op(
+    "check_finite_and_unscale",
+    inputs=["X", "Scale"],
+    outputs=["Out", "FoundInfinite"],
+    differentiable=False,
+)
+def _check_finite_and_unscale(ctx, op, ins):
+    scale = ins["Scale"][0].reshape(())
+    xs = [x for x in ins["X"] if x is not None]
+    found = jnp.zeros((), dtype=bool)
+    for x in xs:
+        found = found | ~jnp.all(jnp.isfinite(x))
+    outs = [x / scale for x in xs]
+    return {"Out": outs, "FoundInfinite": [found.reshape([1])]}

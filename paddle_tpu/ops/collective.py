@@ -1,0 +1,141 @@
+"""Collective communication ops.
+
+TPU-native replacement for the reference's NCCL collective ops
+(operators/collective/c_allreduce_op.h:33-112, c_broadcast_op, c_allgather_op,
+c_reducescatter_op, collective_helper.h): each op emits an XLA collective
+(psum/all_gather/psum_scatter/ppermute/all_to_all). Under the Executor's SPMD
+mode the block runs inside jax.shard_map over a Mesh, so these lower to ICI
+collectives; ring construction/topology is XLA's job (no ring_id/comm maps).
+
+Outside a mesh (single-chip run) every collective degrades to identity /
+no-op, which is also the reference's nranks==1 behavior.
+
+The reference's ring_id attr maps to our "axis_name" attr (default "dp"): a
+named mesh axis replaces a communicator ring. c_sync_*_stream ops are no-ops:
+XLA's dataflow ordering replaces stream synchronization.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+def _axis(ctx, op):
+    """Mesh axis this collective runs over, or None when not under shard_map."""
+    name = op.attr("axis_name", "dp")
+    return name if name in ctx.mesh_axes else None
+
+
+def _register_allreduce(op_type, reducer):
+    @register_op(op_type, inputs=["X"], outputs=["Out"], differentiable=False)
+    def emit(ctx, op, ins):
+        x = ins["X"][0]
+        ax = _axis(ctx, op)
+        return {"Out": [x if ax is None else reducer(x, ax)]}
+
+    return emit
+
+
+_register_allreduce("c_allreduce_sum", lambda x, ax: lax.psum(x, ax))
+_register_allreduce("c_allreduce_max", lambda x, ax: lax.pmax(x, ax))
+_register_allreduce("c_allreduce_min", lambda x, ax: lax.pmin(x, ax))
+_register_allreduce(
+    "c_allreduce_prod", lambda x, ax: jnp.exp(lax.psum(jnp.log(x), ax))
+)
+_register_allreduce("allreduce", lambda x, ax: lax.psum(x, ax))
+
+
+@register_op("c_broadcast", inputs=["X"], outputs=["Out"], differentiable=False)
+def _c_broadcast(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": [x]}
+    root = op.attr("root", 0)
+    idx = lax.axis_index(ax)
+    src = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": [lax.psum(src, ax)]}
+
+
+@register_op("c_allgather", inputs=["X"], outputs=["Out"], differentiable=False)
+def _c_allgather(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": [x]}
+    out = lax.all_gather(x, ax)  # [nranks, ...]
+    return {"Out": [out.reshape((-1,) + x.shape[1:])]}
+
+
+@register_op(
+    "c_reducescatter", inputs=["X"], outputs=["Out"], differentiable=False
+)
+def _c_reducescatter(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": [x]}
+    return {"Out": [lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)]}
+
+
+@register_op("alltoall", inputs=["X"], outputs=["Out"], differentiable=False)
+def _alltoall(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": [x]}
+    n = lax.axis_size(ax)
+    xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    out = lax.all_to_all(xs, ax, split_axis=0, concat_axis=0, tiled=False)
+    return {"Out": [out.reshape(x.shape)]}
+
+
+@register_op(
+    "collective_permute", inputs=["X"], outputs=["Out"], differentiable=False
+)
+def _collective_permute(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": [x]}
+    n = lax.axis_size(ax)
+    shift = op.attr("shift", 1)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return {"Out": [lax.ppermute(x, ax, perm)]}
+
+
+@register_op("c_identity", inputs=["X"], outputs=["Out"])
+def _c_identity(ctx, op, ins):
+    return {"Out": [ins["X"][0]]}
+
+
+def _register_noop(op_type, io=("X", "Out")):
+    @register_op(op_type, inputs=[io[0]], outputs=[io[1]], differentiable=False)
+    def emit(ctx, op, ins):
+        vals = ins.get(io[0], [])
+        return {io[1]: list(vals)}
+
+    return emit
+
+
+# stream sync is meaningless under XLA's dataflow ordering; kept for API parity
+_register_noop("c_sync_calc_stream")
+_register_noop("c_sync_comm_stream")
+
+
+@register_op("c_comm_init_all", inputs=[], outputs=[], differentiable=False)
+def _c_comm_init_all(ctx, op, ins):
+    return {}
+
+
+@register_op("barrier", inputs=["X"], outputs=["Out"], differentiable=False)
+def _barrier(ctx, op, ins):
+    x = ins["X"][0] if ins.get("X") and ins["X"][0] is not None else jnp.zeros([1])
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": [x]}
+    return {"Out": [x + 0 * lax.psum(jnp.zeros([1], x.dtype), ax)]}

@@ -1,0 +1,63 @@
+"""Metric ops (reference: operators/metrics/ — accuracy_op.cc, auc_op.cc)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op(
+    "accuracy",
+    inputs=["Out", "Indices", "Label"],
+    outputs=["Accuracy", "Correct", "Total"],
+    differentiable=False,
+)
+def _accuracy(ctx, op, ins):
+    idx, label = ins["Indices"][0], ins["Label"][0]
+    if label.ndim == 1:
+        label = label[:, None]
+    hit = jnp.any(idx == label.astype(idx.dtype), axis=-1)
+    n = idx.shape[0]
+    correct = jnp.sum(hit.astype(np.int32))
+    return {
+        "Accuracy": [(correct.astype(np.float32) / n).reshape([1])],
+        "Correct": [correct.reshape([1])],
+        "Total": [jnp.full([1], n, dtype=np.int32)],
+    }
+
+
+@register_op(
+    "auc",
+    inputs=["Predict", "Label", "StatPos", "StatNeg"],
+    outputs=["AUC", "StatPosOut", "StatNegOut"],
+    differentiable=False,
+)
+def _auc(ctx, op, ins):
+    pred, label = ins["Predict"][0], ins["Label"][0]
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    num_thresholds = op.attr("num_thresholds", 4095)
+    if label.ndim == 2:
+        label = label[:, 0]
+    pos_prob = pred[:, -1] if pred.ndim == 2 else pred
+    bucket = jnp.clip(
+        (pos_prob * num_thresholds).astype(np.int64), 0, num_thresholds
+    )
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    pos_out = stat_pos.at[bucket].add(is_pos)
+    neg_out = stat_neg.at[bucket].add(1 - is_pos)
+    # trapezoid rule over the ROC curve built from bucket counts
+    tp = jnp.cumsum(pos_out[::-1])[::-1]
+    fp = jnp.cumsum(neg_out[::-1])[::-1]
+    tot_pos, tot_neg = tp[0], fp[0]
+    tp_next = jnp.concatenate([tp[1:], jnp.zeros([1], tp.dtype)])
+    fp_next = jnp.concatenate([fp[1:], jnp.zeros([1], fp.dtype)])
+    area = jnp.sum((fp - fp_next) * (tp + tp_next) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg), 0.0)
+    return {
+        "AUC": [auc.reshape([1]).astype(np.float64)],
+        "StatPosOut": [pos_out],
+        "StatNegOut": [neg_out],
+    }

@@ -1,0 +1,414 @@
+"""Neural-net ops: conv2d, pooling, batch/layer/instance/group norm, embedding,
+dropout, interpolation, losses.
+
+Reference parity: operators/conv_op.cc (+conv_cudnn_op.cu), pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc, lookup_table_v2_op.cc,
+cross_entropy_op.cc, softmax_with_cross_entropy_op.cc, smooth_l1_loss,
+huber_loss, squared_l2 — as XLA emitters. Convs use lax.conv_general_dilated
+(NCHW to match the fluid API; XLA:TPU relayouts to its native tiling
+internally, so no NHWC pass is needed). BatchNorm running stats are expressed
+functionally: MeanOut/VarianceOut are op outputs the Executor writes back to
+the Scope (the reference mutates them in place, batch_norm_op.cc).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v]
+
+
+def _conv_pads(paddings, algorithm, ksize, strides, dilations):
+    if algorithm == "SAME":
+        return "SAME"
+    if algorithm == "VALID":
+        return "VALID"
+    p = _pair(paddings)
+    if len(p) == 2:
+        return [(p[0], p[0]), (p[1], p[1])]
+    # [top, bottom, left, right]
+    return [(p[0], p[1]), (p[2], p[3])]
+
+
+@register_op("conv2d", inputs=["Input", "Filter"], outputs=["Output"])
+def _conv2d(ctx, op, ins):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(op.attr("strides", [1, 1]))
+    dilations = _pair(op.attr("dilations", [1, 1]))
+    pads = _conv_pads(
+        op.attr("paddings", [0, 0]),
+        op.attr("padding_algorithm", "EXPLICIT"),
+        w.shape[2:],
+        strides,
+        dilations,
+    )
+    groups = op.attr("groups", 1) or 1
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=pads,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d", inputs=["Input", "Filter"], outputs=["Output"])
+def _depthwise_conv2d(ctx, op, ins):
+    op.attrs.setdefault("groups", ins["Input"][0].shape[1])
+    return _conv2d(ctx, op, ins)
+
+
+@register_op(
+    "conv2d_transpose", inputs=["Input", "Filter"], outputs=["Output"]
+)
+def _conv2d_transpose(ctx, op, ins):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(op.attr("strides", [1, 1]))
+    p = _pair(op.attr("paddings", [0, 0]))
+    # fluid filter layout for transpose conv: [in_c, out_c/groups, kh, kw]
+    g = op.attr("groups", 1) or 1
+    in_c, oc_g, kh, kw = w.shape
+    pads = [
+        (kh - 1 - p[0], kh - 1 - p[0]),
+        (kw - 1 - p[1], kw - 1 - p[1]),
+    ]
+    # per-group swap to OIHW: [g, in_c/g, oc/g, kh, kw] -> [oc, in_c/g, kh, kw]
+    w_t = jnp.flip(w, axis=(2, 3)).reshape(g, in_c // g, oc_g, kh, kw)
+    w_t = w_t.transpose(0, 2, 1, 3, 4).reshape(g * oc_g, in_c // g, kh, kw)
+    out = lax.conv_general_dilated(
+        x,
+        w_t,
+        window_strides=[1, 1],
+        padding=pads,
+        lhs_dilation=strides,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=g,
+    )
+    return {"Output": [out]}
+
+
+@register_op("pool2d", inputs=["X"], outputs=["Out"])
+def _pool2d(ctx, op, ins):
+    x = ins["X"][0]
+    ptype = op.attr("pooling_type", "max")
+    if op.attr("global_pooling", False) or op.attr("adaptive", False) and op.attr(
+        "ksize"
+    ) == [1, 1]:
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(x, axis=(2, 3), keepdims=True)]}
+    if op.attr("adaptive", False):
+        oh, ow = _pair(op.attr("ksize"))
+        n, c, h, wd = x.shape
+        xr = x.reshape(n, c, oh, h // oh, ow, wd // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(xr, axis=(3, 5))]}
+    ksize = _pair(op.attr("ksize"))
+    strides = _pair(op.attr("strides", [1, 1]))
+    p = _pair(op.attr("paddings", [0, 0]))
+    pads = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+    dims = (1, 1, ksize[0], ksize[1])
+    strd = (1, 1, strides[0], strides[1])
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, dims, strd, pads)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, dims, strd, pads)
+        if op.attr("exclusive", True) and (p[0] or p[1]):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strd, pads)
+            out = summed / counts
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return {"Out": [out]}
+
+
+@register_op(
+    "batch_norm",
+    inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    mutates=(("MeanOut", "Mean"), ("VarianceOut", "Variance")),
+)
+def _batch_norm(ctx, op, ins):
+    x, scale, bias, mean, var = (ins[k][0] for k in ("X", "Scale", "Bias", "Mean", "Variance"))
+    eps = op.attr("epsilon", 1e-5)
+    momentum = op.attr("momentum", 0.9)
+    layout = op.attr("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
+    bshape = [1] * x.ndim
+    bshape[1 if layout == "NCHW" else -1] = x.shape[1 if layout == "NCHW" else -1]
+
+    if op.attr("is_test", False) or op.attr("use_global_stats", False):
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        cf32 = x.astype(jnp.float32)
+        use_mean = jnp.mean(cf32, axis=axes)
+        use_var = jnp.var(cf32, axis=axes)
+        mean_out = mean * momentum + use_mean.astype(mean.dtype) * (1 - momentum)
+        var_out = var * momentum + use_var.astype(var.dtype) * (1 - momentum)
+        saved_mean = use_mean
+        saved_var = use_var
+    inv = lax.rsqrt(use_var.astype(jnp.float32) + eps).reshape(bshape)
+    y = (x - use_mean.astype(x.dtype).reshape(bshape)) * inv.astype(x.dtype)
+    y = y * scale.reshape(bshape).astype(x.dtype) + bias.reshape(bshape).astype(x.dtype)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+
+
+@register_op(
+    "layer_norm",
+    inputs=["X", "Scale", "Bias"],
+    outputs=["Y", "Mean", "Variance"],
+)
+def _layer_norm(ctx, op, ins):
+    x = ins["X"][0]
+    scale = ins["Scale"][0] if ins.get("Scale") and ins["Scale"][0] is not None else None
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    eps = op.attr("epsilon", 1e-5)
+    begin = op.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = ((xf - mean) * lax.rsqrt(var + eps)).astype(x.dtype)
+    if scale is not None:
+        y = y * scale.reshape(x.shape[begin:]).astype(x.dtype)
+    if bias is not None:
+        y = y + bias.reshape(x.shape[begin:]).astype(x.dtype)
+    return {"Y": [y], "Mean": [mean.squeeze()], "Variance": [var.squeeze()]}
+
+
+@register_op("instance_norm", inputs=["X", "Scale", "Bias"], outputs=["Y"])
+def _instance_norm(ctx, op, ins):
+    x = ins["X"][0]
+    eps = op.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    bshape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if ins.get("Scale") and ins["Scale"][0] is not None:
+        y = y * ins["Scale"][0].reshape(bshape)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {"Y": [y]}
+
+
+@register_op("group_norm", inputs=["X", "Scale", "Bias"], outputs=["Y"])
+def _group_norm(ctx, op, ins):
+    x = ins["X"][0]
+    g = op.attr("groups")
+    eps = op.attr("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xr = x.reshape(n, g, c // g, *x.shape[2:])
+    axes = tuple(range(2, xr.ndim))
+    mean = jnp.mean(xr, axis=axes, keepdims=True)
+    var = jnp.var(xr, axis=axes, keepdims=True)
+    y = ((xr - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    bshape = [1, c] + [1] * (x.ndim - 2)
+    if ins.get("Scale") and ins["Scale"][0] is not None:
+        y = y * ins["Scale"][0].reshape(bshape)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {"Y": [y]}
+
+
+@register_op("lookup_table_v2", inputs=["W", "Ids"], outputs=["Out"])
+def _lookup_table_v2(ctx, op, ins):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    padding_idx = op.attr("padding_idx", -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return {"Out": [out]}
+
+
+@register_op("lookup_table", inputs=["W", "Ids"], outputs=["Out"])
+def _lookup_table(ctx, op, ins):
+    # v1 keeps a trailing [.., 1] ids dim (lookup_table_op.cc)
+    w, ids = ins["W"][0], ins["Ids"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    ins2 = {"W": [w], "Ids": [ids]}
+    return _lookup_table_v2(ctx, op, ins2)
+
+
+@register_op("dropout", inputs=["X"], outputs=["Out", "Mask"])
+def _dropout(ctx, op, ins):
+    x = ins["X"][0]
+    p = op.attr("dropout_prob", 0.5)
+    impl = op.attr("dropout_implementation", "downgrade_in_infer")
+    if op.attr("is_test", False) or ctx.is_test or p == 0.0:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": [out], "Mask": []}
+    key = ctx.key_for(op.uid)
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = jnp.where(keep, x, 0.0).astype(x.dtype)
+    return {"Out": [out], "Mask": [keep.astype(np.uint8)]}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+@register_op("cross_entropy", inputs=["X", "Label"], outputs=["Y"])
+def _cross_entropy(ctx, op, ins):
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-9
+    if op.attr("soft_label", False):
+        y = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        if label.ndim == x.ndim:
+            label = label[..., 0]
+        picked = jnp.take_along_axis(x, label[..., None].astype(np.int32), axis=-1)
+        y = -jnp.log(picked + eps)
+    return {"Y": [y]}
+
+
+@register_op(
+    "softmax_with_cross_entropy",
+    inputs=["Logits", "Label"],
+    outputs=["Softmax", "Loss"],
+)
+def _softmax_with_cross_entropy(ctx, op, ins):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = op.attr("axis", -1)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if op.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        if label.ndim == logits.ndim:
+            lbl = label
+        else:
+            lbl = label[..., None]
+        ignore = op.attr("ignore_index", -100)
+        valid = lbl != ignore
+        safe_lbl = jnp.where(valid, lbl, 0).astype(np.int32)
+        picked = jnp.take_along_axis(logp, safe_lbl, axis=axis)
+        loss = jnp.where(valid, -picked, 0.0)
+    return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+
+
+@register_op("square_error_cost", inputs=["X", "Y"], outputs=["Out"])
+def _square_error_cost(ctx, op, ins):
+    d = ins["X"][0] - ins["Y"][0]
+    return {"Out": [d * d]}
+
+
+@register_op("huber_loss", inputs=["X", "Y"], outputs=["Out", "Residual"])
+def _huber_loss(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = op.attr("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss", inputs=["X", "Y"], outputs=["Out", "Diff"])
+def _smooth_l1(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = op.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    a = jnp.abs(d)
+    val = jnp.where(a < 1.0 / s2, 0.5 * d * d * s2, a - 0.5 / s2)
+    return {"Out": [jnp.sum(val, axis=-1, keepdims=True)], "Diff": [d]}
+
+
+@register_op(
+    "sigmoid_cross_entropy_with_logits", inputs=["X", "Label"], outputs=["Out"]
+)
+def _sigmoid_ce(ctx, op, ins):
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * label + jax.nn.softplus(-jnp.abs(x))
+    ignore = op.attr("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if op.attr("normalize", False):
+        n = jnp.maximum(jnp.sum((label != ignore).astype(x.dtype)), 1.0)
+        loss = loss / n
+    return {"Out": [loss]}
+
+
+@register_op("log_loss", inputs=["Predicted", "Labels"], outputs=["Loss"])
+def _log_loss(ctx, op, ins):
+    p, l = ins["Predicted"][0], ins["Labels"][0]
+    eps = op.attr("epsilon", 1e-4)
+    return {"Loss": [-l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps)]}
+
+
+@register_op("kldiv_loss", inputs=["X", "Target"], outputs=["Loss"])
+def _kldiv(ctx, op, ins):
+    x, t = ins["X"][0], ins["Target"][0]
+    loss = jnp.where(t > 0, t * (jnp.log(t) - x), 0.0)
+    red = op.attr("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss).reshape([1])
+    elif red == "sum":
+        loss = jnp.sum(loss).reshape([1])
+    elif red == "batchmean":
+        loss = (jnp.sum(loss) / x.shape[0]).reshape([1])
+    return {"Loss": [loss]}
+
+
+@register_op("nearest_interp", inputs=["X"], outputs=["Out"])
+def _nearest_interp(ctx, op, ins):
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    oh = op.attr("out_h", 0) or int(h * op.attr("scale", 1.0))
+    ow = op.attr("out_w", 0) or int(w * op.attr("scale", 1.0))
+    return {
+        "Out": [
+            jax.image.resize(x, (n, c, oh, ow), method="nearest")
+        ]
+    }
+
+
+@register_op("bilinear_interp", inputs=["X"], outputs=["Out"])
+def _bilinear_interp(ctx, op, ins):
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    oh = op.attr("out_h", 0) or int(h * op.attr("scale", 1.0))
+    ow = op.attr("out_w", 0) or int(w * op.attr("scale", 1.0))
+    return {"Out": [jax.image.resize(x, (n, c, oh, ow), method="bilinear")]}
+
+
+@register_op("pad2d", inputs=["X"], outputs=["Out"])
+def _pad2d(ctx, op, ins):
+    x = ins["X"][0]
+    p = op.attr("paddings")  # [top, bottom, left, right]
+    mode = op.attr("mode", "constant")
+    pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        out = jnp.pad(x, pairs, constant_values=op.attr("pad_value", 0.0))
+    else:
+        out = jnp.pad(x, pairs, mode={"reflect": "reflect", "edge": "edge"}[mode])
+    return {"Out": [out]}

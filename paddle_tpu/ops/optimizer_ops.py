@@ -1,0 +1,319 @@
+"""Optimizer update ops.
+
+Reference parity: operators/optimizers/ (sgd_op, momentum_op, adam_op,
+lamb_op, lars_momentum_op, adagrad_op, rmsprop_op, adadelta_op, ftrl_op,
+adamax_op, decayed_adagrad_op, dpsgd_op, ~5.5k LoC of CUDA kernels). Here each
+is a few jnp lines; ParamOut/MomentOut reuse the *same variable names* as
+their inputs, so the Executor's write-back + XLA buffer donation makes the
+update in-place at the HBM level (the reference relied on Scope mutation).
+
+All are differentiable=False: they sit after append_backward's grad ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+def _lr(ins):
+    lr = ins["LearningRate"][0]
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@register_op(
+    "sgd",
+    inputs=["Param", "Grad", "LearningRate"],
+    outputs=["ParamOut"],
+    differentiable=False,
+)
+def _sgd(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": [(p - _lr(ins) * g.astype(p.dtype)).astype(p.dtype)]}
+
+
+@register_op(
+    "momentum",
+    inputs=["Param", "Grad", "Velocity", "LearningRate"],
+    outputs=["ParamOut", "VelocityOut"],
+    differentiable=False,
+)
+def _momentum(ctx, op, ins):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = op.attr("mu", 0.9)
+    lr = _lr(ins)
+    v_out = mu * v + g
+    if op.attr("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out.astype(p.dtype)], "VelocityOut": [v_out]}
+
+
+@register_op(
+    "adam",
+    inputs=[
+        "Param", "Grad", "LearningRate", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow",
+    ],
+    outputs=["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    differentiable=False,
+)
+def _adam(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    beta1 = op.attr("beta1", 0.9)
+    beta2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    lr = _lr(ins)
+    g = g.astype(m1.dtype)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    return {
+        "ParamOut": [p_out.astype(p.dtype)],
+        "Moment1Out": [m1_out],
+        "Moment2Out": [m2_out],
+        "Beta1PowOut": [b1p * beta1],
+        "Beta2PowOut": [b2p * beta2],
+    }
+
+
+@register_op(
+    "adamw",
+    inputs=[
+        "Param", "Grad", "LearningRate", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow",
+    ],
+    outputs=["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    differentiable=False,
+)
+def _adamw(ctx, op, ins):
+    wd = op.attr("weight_decay", 0.01)
+    out = _adam(ctx, op, ins)
+    p = ins["Param"][0]
+    lr = _lr(ins)
+    out["ParamOut"] = [(out["ParamOut"][0] - lr * wd * p).astype(p.dtype)]
+    return out
+
+
+@register_op(
+    "lamb",
+    inputs=[
+        "Param", "Grad", "LearningRate", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow",
+    ],
+    outputs=["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    differentiable=False,
+)
+def _lamb(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    beta1 = op.attr("beta1", 0.9)
+    beta2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-6)
+    wd = op.attr("weight_decay", 0.01)
+    lr = _lr(ins)
+    g = g.astype(m1.dtype)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * g * g
+    m1_hat = m1_out / (1 - b1p.reshape(()))
+    m2_hat = m2_out / (1 - b2p.reshape(()))
+    update = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+    u_norm = jnp.sqrt(jnp.sum(jnp.square(update.astype(jnp.float32))))
+    ratio = jnp.where(
+        (w_norm > 0) & (u_norm > 0), w_norm / u_norm, jnp.array(1.0, jnp.float32)
+    )
+    p_out = p - (lr * ratio) * update
+    return {
+        "ParamOut": [p_out.astype(p.dtype)],
+        "Moment1Out": [m1_out],
+        "Moment2Out": [m2_out],
+        "Beta1PowOut": [b1p * beta1],
+        "Beta2PowOut": [b2p * beta2],
+    }
+
+
+@register_op(
+    "lars_momentum",
+    inputs=["Param", "Grad", "Velocity", "LearningRate"],
+    outputs=["ParamOut", "VelocityOut"],
+    differentiable=False,
+)
+def _lars_momentum(ctx, op, ins):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = op.attr("mu", 0.9)
+    coeff = op.attr("lars_coeff", 0.001)
+    wd = op.attr("lars_weight_decay", 0.0005)
+    lr = _lr(ins)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm),
+        lr,
+    )
+    v_out = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": [(p - v_out).astype(p.dtype)], "VelocityOut": [v_out]}
+
+
+@register_op(
+    "adagrad",
+    inputs=["Param", "Grad", "Moment", "LearningRate"],
+    outputs=["ParamOut", "MomentOut"],
+    differentiable=False,
+)
+def _adagrad(ctx, op, ins):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = op.attr("epsilon", 1e-6)
+    m_out = m + g * g
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [m_out]}
+
+
+@register_op(
+    "decayed_adagrad",
+    inputs=["Param", "Grad", "Moment", "LearningRate"],
+    outputs=["ParamOut", "MomentOut"],
+    differentiable=False,
+)
+def _decayed_adagrad(ctx, op, ins):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * g * g
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [m_out]}
+
+
+@register_op(
+    "rmsprop",
+    inputs=["Param", "Grad", "Moment", "MeanSquare", "MeanGrad", "LearningRate"],
+    outputs=["ParamOut", "MomentOut", "MeanSquareOut", "MeanGradOut"],
+    differentiable=False,
+)
+def _rmsprop(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    mom, ms = ins["Moment"][0], ins["MeanSquare"][0]
+    mg = ins["MeanGrad"][0] if ins.get("MeanGrad") and ins["MeanGrad"][0] is not None else None
+    rho = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    momentum = op.attr("momentum", 0.0)
+    lr = _lr(ins)
+    ms_out = rho * ms + (1 - rho) * g * g
+    if op.attr("centered", False) and mg is not None:
+        mg_out = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(ms_out - mg_out * mg_out + eps)
+    else:
+        mg_out = mg if mg is not None else jnp.zeros_like(g)
+        denom = jnp.sqrt(ms_out + eps)
+    mom_out = momentum * mom + lr * g / denom
+    return {
+        "ParamOut": [(p - mom_out).astype(p.dtype)],
+        "MomentOut": [mom_out],
+        "MeanSquareOut": [ms_out],
+        "MeanGradOut": [mg_out],
+    }
+
+
+@register_op(
+    "adadelta",
+    inputs=["Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"],
+    outputs=["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"],
+    differentiable=False,
+)
+def _adadelta(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    asg, asu = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = op.attr("rho", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    asg_out = rho * asg + (1 - rho) * g * g
+    update = -jnp.sqrt((asu + eps) / (asg_out + eps)) * g
+    asu_out = rho * asu + (1 - rho) * update * update
+    return {
+        "ParamOut": [(p + update).astype(p.dtype)],
+        "AvgSquaredGradOut": [asg_out],
+        "AvgSquaredUpdateOut": [asu_out],
+    }
+
+
+@register_op(
+    "adamax",
+    inputs=["Param", "Grad", "LearningRate", "Moment", "InfNorm", "Beta1Pow"],
+    outputs=["ParamOut", "MomentOut", "InfNormOut", "Beta1PowOut"],
+    differentiable=False,
+)
+def _adamax(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    beta1 = op.attr("beta1", 0.9)
+    beta2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    m_out = beta1 * m + (1 - beta1) * g
+    inf_out = jnp.maximum(beta2 * inf, jnp.abs(g))
+    lr_t = _lr(ins) / (1 - b1p.reshape(()))
+    p_out = p - lr_t * m_out / (inf_out + eps)
+    return {
+        "ParamOut": [p_out.astype(p.dtype)],
+        "MomentOut": [m_out],
+        "InfNormOut": [inf_out],
+        # reference updates beta1_pow in a separate _finish_update scale op
+        # (fluid/optimizer.py:2094); folding it in here keeps one op per param
+        "Beta1PowOut": [b1p * beta1],
+    }
+
+
+@register_op(
+    "ftrl",
+    inputs=["Param", "Grad", "SquaredAccumulator", "LinearAccumulator", "LearningRate"],
+    outputs=["ParamOut", "SquaredAccumOut", "LinearAccumOut"],
+    differentiable=False,
+)
+def _ftrl(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = op.attr("l1", 0.0) + 1e-10
+    l2 = op.attr("l2", 0.0) + 1e-10
+    power = op.attr("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + g * g
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    lin_out = lin + g - sigma * p
+    if power == -0.5:
+        x = l2 + jnp.sqrt(new_sq) / lr
+    else:
+        x = l2 + jnp.power(new_sq, -power) / lr
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = pre / x
+    return {
+        "ParamOut": [p_out.astype(p.dtype)],
+        "SquaredAccumOut": [new_sq],
+        "LinearAccumOut": [lin_out],
+    }
+
+
+@register_op(
+    "dpsgd",
+    inputs=["Param", "Grad", "LearningRate"],
+    outputs=["ParamOut"],
+    differentiable=False,
+)
+def _dpsgd(ctx, op, ins):
+    # differentially-private SGD (dpsgd_op.cc): clip grad, add gaussian noise
+    p, g = ins["Param"][0], ins["Grad"][0]
+    clip = op.attr("clip", 10.0)
+    sigma = op.attr("sigma", 1.0)
+    batch_size = op.attr("batch_size", 16.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g * jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-10))
+    noise = sigma * clip * jax.random.normal(ctx.key_for(op.uid), g.shape, g.dtype)
+    update = (g + noise) / batch_size
+    return {"ParamOut": [(p - _lr(ins) * update).astype(p.dtype)]}

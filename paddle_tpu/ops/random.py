@@ -1,0 +1,86 @@
+"""Random ops. TPU-native RNG: counter-based stateless keys derived from
+(program seed, step, op uid) — see registry.EmitContext. This replaces the
+reference's per-device curand generators (gaussian_random_op.cu) and makes
+every run bitwise reproducible when program.random_seed is set.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import to_numpy_dtype
+from ..framework.registry import register_op, BATCH_SENTINEL
+
+
+def _shape_attr(op, ctx=None):
+    shape = [int(s) for s in op.attr("shape")]
+    if any(s == -1 for s in shape):
+        if ctx is not None and ctx.abstract:
+            return [BATCH_SENTINEL if s == -1 else s for s in shape]
+        raise ValueError(
+            f"{op.type} with -1 (batch) dims cannot execute; pass a concrete shape"
+        )
+    return shape
+
+
+def _key(ctx, op):
+    seed = op.attr("seed", 0)
+    if seed:
+        return jax.random.key(seed + op.uid)
+    return ctx.key_for(op.uid)
+
+
+@register_op("gaussian_random", inputs=[], outputs=["Out"], differentiable=False)
+def _gaussian_random(ctx, op, ins):
+    dtype = to_numpy_dtype(op.attr("dtype", "float32"))
+    out = op.attr("mean", 0.0) + op.attr("std", 1.0) * jax.random.normal(
+        _key(ctx, op), _shape_attr(op, ctx), dtype=jnp.float32
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op(
+    "truncated_gaussian_random", inputs=[], outputs=["Out"], differentiable=False
+)
+def _truncated_gaussian_random(ctx, op, ins):
+    dtype = to_numpy_dtype(op.attr("dtype", "float32"))
+    out = op.attr("mean", 0.0) + op.attr("std", 1.0) * jax.random.truncated_normal(
+        _key(ctx, op), -2.0, 2.0, _shape_attr(op, ctx), dtype=jnp.float32
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("uniform_random", inputs=[], outputs=["Out"], differentiable=False)
+def _uniform_random(ctx, op, ins):
+    dtype = to_numpy_dtype(op.attr("dtype", "float32"))
+    out = jax.random.uniform(
+        _key(ctx, op),
+        _shape_attr(op, ctx),
+        minval=op.attr("min", -1.0),
+        maxval=op.attr("max", 1.0),
+        dtype=jnp.float32,
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("randint", inputs=[], outputs=["Out"], differentiable=False)
+def _randint(ctx, op, ins):
+    dtype = to_numpy_dtype(op.attr("dtype", "int64"))
+    out = jax.random.randint(
+        _key(ctx, op), _shape_attr(op, ctx), op.attr("low", 0), op.attr("high")
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("randperm", inputs=[], outputs=["Out"], differentiable=False)
+def _randperm(ctx, op, ins):
+    dtype = to_numpy_dtype(op.attr("dtype", "int64"))
+    return {"Out": [jax.random.permutation(_key(ctx, op), op.attr("n")).astype(dtype)]}
+
+
+@register_op("shuffle_batch", inputs=["X"], outputs=["Out"], differentiable=False)
+def _shuffle_batch(ctx, op, ins):
+    x = ins["X"][0]
+    perm = jax.random.permutation(_key(ctx, op), x.shape[0])
+    return {"Out": [jnp.take(x, perm, axis=0)]}

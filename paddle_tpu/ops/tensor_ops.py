@@ -1,0 +1,361 @@
+"""Tensor manipulation ops: reshape/transpose/concat/split/gather/..., creation
+ops (fill_constant), cast, search ops (argmax/top_k), index ops.
+
+Reference parity: operators/reshape_op.cc, transpose_op.cc, concat_op.cc,
+split_op.cc, gather_op.cc, scatter_op.cc, cast_op.cc, fill_constant_op.cc,
+arg_max_op, top_k_op, expand_op, slice_op, stack_op, one_hot_op, cumsum_op,
+where/masked select family — all static-shape XLA emitters.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import to_numpy_dtype
+from ..framework.registry import register_op, BATCH_SENTINEL
+
+
+def _resolve_shape(shape, x_shape):
+    """fluid reshape semantics: 0 copies the input dim; a single -1 infers."""
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(x_shape[i])
+        else:
+            out.append(int(s))
+    return out
+
+
+@register_op("reshape2", inputs=["X"], outputs=["Out", "XShape"])
+def _reshape2(ctx, op, ins):
+    x = ins["X"][0]
+    shape = _resolve_shape(op.attr("shape"), x.shape)
+    return {"Out": [jnp.reshape(x, shape)], "XShape": []}
+
+
+@register_op("flatten2", inputs=["X"], outputs=["Out", "XShape"])
+def _flatten2(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", 1)
+    lead = math.prod(x.shape[:axis]) if axis > 0 else 1
+    return {"Out": [jnp.reshape(x, (lead, -1))], "XShape": []}
+
+
+@register_op("transpose2", inputs=["X"], outputs=["Out", "XShape"])
+def _transpose2(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": [jnp.transpose(x, op.attr("axis"))], "XShape": []}
+
+
+@register_op("concat", inputs=["X"], outputs=["Out"])
+def _concat(ctx, op, ins):
+    xs = [x for x in ins["X"] if x is not None]
+    return {"Out": [jnp.concatenate(xs, axis=op.attr("axis", 0))]}
+
+
+@register_op("split", inputs=["X"], outputs=["Out"])
+def _split(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", 0)
+    num = op.attr("num", 0)
+    sections = op.attr("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, num, axis=axis)
+    return {"Out": parts}
+
+
+@register_op("stack", inputs=["X"], outputs=["Y"])
+def _stack(ctx, op, ins):
+    xs = [x for x in ins["X"] if x is not None]
+    return {"Y": [jnp.stack(xs, axis=op.attr("axis", 0))]}
+
+
+@register_op("unstack", inputs=["X"], outputs=["Y"])
+def _unstack(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", 0)
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(p, axis=axis) for p in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("squeeze2", inputs=["X"], outputs=["Out", "XShape"])
+def _squeeze2(ctx, op, ins):
+    x = ins["X"][0]
+    axes = op.attr("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+        out = jnp.squeeze(x, axis=axes) if axes else x
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": [out], "XShape": []}
+
+
+@register_op("unsqueeze2", inputs=["X"], outputs=["Out", "XShape"])
+def _unsqueeze2(ctx, op, ins):
+    x = ins["X"][0]
+    out = x
+    for a in sorted(op.attr("axes")):
+        out = jnp.expand_dims(out, axis=a)
+    return {"Out": [out], "XShape": []}
+
+
+@register_op("slice", inputs=["Input"], outputs=["Out"])
+def _slice(ctx, op, ins):
+    x = ins["Input"][0]
+    axes = op.attr("axes")
+    starts = op.attr("starts")
+    ends = op.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    for a in sorted(op.attr("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, axis=a)
+    return {"Out": [out]}
+
+
+@register_op("strided_slice", inputs=["Input"], outputs=["Out"])
+def _strided_slice(ctx, op, ins):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(
+        op.attr("axes"), op.attr("starts"), op.attr("ends"), op.attr("strides")
+    ):
+        idx[a] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("expand", inputs=["X"], outputs=["Out"])
+def _expand(ctx, op, ins):
+    x = ins["X"][0]
+    times = op.attr("expand_times")
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("expand_as", inputs=["X", "target_tensor"], outputs=["Out"])
+def _expand_as(ctx, op, ins):
+    x, t = ins["X"][0], ins["target_tensor"][0]
+    reps = [ts // xs for ts, xs in zip(t.shape, x.shape)]
+    return {"Out": [jnp.tile(x, reps)]}
+
+
+@register_op("tile", inputs=["X"], outputs=["Out"])
+def _tile(ctx, op, ins):
+    return {"Out": [jnp.tile(ins["X"][0], op.attr("repeat_times"))]}
+
+
+@register_op("gather", inputs=["X", "Index"], outputs=["Out"])
+def _gather(ctx, op, ins):
+    x, idx = ins["X"][0], ins["Index"][0]
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx[:, 0]
+    return {"Out": [jnp.take(x, idx, axis=op.attr("axis", 0))]}
+
+
+@register_op("gather_nd", inputs=["X", "Index"], outputs=["Out"])
+def _gather_nd(ctx, op, ins):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+@register_op("scatter", inputs=["X", "Ids", "Updates"], outputs=["Out"])
+def _scatter(ctx, op, ins):
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    if ids.ndim == 2 and ids.shape[1] == 1:
+        ids = ids[:, 0]
+    if op.attr("overwrite", True):
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].add(upd)
+    return {"Out": [out]}
+
+
+@register_op("cast", inputs=["X"], outputs=["Out"])
+def _cast(ctx, op, ins):
+    return {"Out": [ins["X"][0].astype(to_numpy_dtype(op.attr("out_dtype")))]}
+
+
+@register_op("fill_constant", inputs=[], outputs=["Out"])
+def _fill_constant(ctx, op, ins):
+    shape = list(op.attr("shape"))
+    if any(s == -1 for s in shape):
+        if ctx.abstract:  # shape-inference pass only
+            shape = [BATCH_SENTINEL if s == -1 else s for s in shape]
+        else:
+            raise ValueError(
+                "fill_constant with -1 (batch) dims cannot execute; use "
+                "fill_any_like / fill_constant_batch_size_like instead"
+            )
+    dtype = to_numpy_dtype(op.attr("dtype", "float32"))
+    return {"Out": [jnp.full(shape, op.attr("value", 0.0), dtype=dtype)]}
+
+
+@register_op("fill_any_like", inputs=["X"], outputs=["Out"], differentiable=False)
+def _fill_any_like(ctx, op, ins):
+    x = ins["X"][0]
+    dtype = op.attr("dtype", None)
+    dtype = x.dtype if dtype is None else to_numpy_dtype(dtype)
+    return {"Out": [jnp.full(x.shape, op.attr("value", 0.0), dtype=dtype)]}
+
+
+@register_op("fill_constant_batch_size_like", inputs=["Input"], outputs=["Out"])
+def _fill_constant_bsl(ctx, op, ins):
+    x = ins["Input"][0]
+    shape = list(op.attr("shape"))
+    in_idx = op.attr("input_dim_idx", 0)
+    out_idx = op.attr("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    dtype = to_numpy_dtype(op.attr("dtype", "float32"))
+    return {"Out": [jnp.full(shape, op.attr("value", 0.0), dtype=dtype)]}
+
+
+@register_op("assign", inputs=["X"], outputs=["Out"])
+def _assign(ctx, op, ins):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("assign_value", inputs=[], outputs=["Out"])
+def _assign_value(ctx, op, ins):
+    dtype = to_numpy_dtype(op.attr("dtype", "float32"))
+    vals = np.array(op.attr("values"), dtype=dtype).reshape(op.attr("shape"))
+    return {"Out": [jnp.asarray(vals)]}
+
+
+@register_op("shape", inputs=["Input"], outputs=["Out"], differentiable=False)
+def _shape(ctx, op, ins):
+    return {"Out": [jnp.array(ins["Input"][0].shape, dtype=np.int32)]}
+
+
+@register_op("arg_max", inputs=["X"], outputs=["Out"], differentiable=False)
+def _arg_max(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": [jnp.argmax(x, axis=op.attr("axis", -1)).astype(np.int64)]}
+
+
+@register_op("arg_min", inputs=["X"], outputs=["Out"], differentiable=False)
+def _arg_min(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": [jnp.argmin(x, axis=op.attr("axis", -1)).astype(np.int64)]}
+
+
+@register_op("argsort", inputs=["X"], outputs=["Out", "Indices"], differentiable=False)
+def _argsort(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    if op.attr("descending", False):
+        idx = jnp.flip(idx, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(np.int64)]}
+
+
+@register_op("top_k", inputs=["X"], outputs=["Out", "Indices"], differentiable=False)
+def _top_k(ctx, op, ins):
+    x = ins["X"][0]
+    vals, idx = jax.lax.top_k(x, op.attr("k", 1))
+    return {"Out": [vals], "Indices": [idx.astype(np.int64)]}
+
+
+@register_op("one_hot_v2", inputs=["X"], outputs=["Out"], differentiable=False)
+def _one_hot(ctx, op, ins):
+    x = ins["X"][0]
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return {"Out": [jax.nn.one_hot(x, op.attr("depth"), dtype=np.float32)]}
+
+
+@register_op("cumsum", inputs=["X"], outputs=["Out"])
+def _cumsum(ctx, op, ins):
+    x = ins["X"][0]
+    if op.attr("flatten", False):
+        x = x.reshape(-1)
+    axis = op.attr("axis", -1)
+    if op.attr("reverse", False):
+        x = jnp.flip(x, axis=axis)
+    if op.attr("exclusive", False):
+        out = jnp.cumsum(x, axis=axis) - x
+    else:
+        out = jnp.cumsum(x, axis=axis)
+    if op.attr("reverse", False):
+        out = jnp.flip(out, axis=axis)
+    return {"Out": [out]}
+
+
+@register_op("where_index", inputs=["Condition"], outputs=["Out"], differentiable=False)
+def _where_index(ctx, op, ins):
+    # dynamic-size output: not representable in XLA static shapes; tests only
+    cond = np.asarray(ins["Condition"][0])
+    return {"Out": [jnp.asarray(np.argwhere(cond).astype(np.int64))]}
+
+
+@register_op("where", inputs=["Condition", "X", "Y"], outputs=["Out"])
+def _where(ctx, op, ins):
+    return {"Out": [jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("range", inputs=[], outputs=["Out"], differentiable=False)
+def _range(ctx, op, ins):
+    dtype = to_numpy_dtype(op.attr("dtype", "int64"))
+    return {
+        "Out": [
+            jnp.arange(
+                op.attr("start", 0), op.attr("end"), op.attr("step", 1), dtype=dtype
+            )
+        ]
+    }
+
+
+@register_op("linspace", inputs=[], outputs=["Out"])
+def _linspace(ctx, op, ins):
+    dtype = to_numpy_dtype(op.attr("dtype", "float32"))
+    return {
+        "Out": [
+            jnp.linspace(
+                op.attr("start"), op.attr("stop"), op.attr("num"), dtype=dtype
+            )
+        ]
+    }
+
+
+@register_op("flip", inputs=["X"], outputs=["Out"])
+def _flip(ctx, op, ins):
+    return {"Out": [jnp.flip(ins["X"][0], axis=op.attr("axis"))]}
+
+
+@register_op("roll", inputs=["X"], outputs=["Out"])
+def _roll(ctx, op, ins):
+    return {
+        "Out": [jnp.roll(ins["X"][0], op.attr("shifts"), axis=op.attr("axis", None))]
+    }
+
+
+@register_op("pad", inputs=["X"], outputs=["Out"])
+def _pad(ctx, op, ins):
+    x = ins["X"][0]
+    p = op.attr("paddings")  # flat [before0, after0, before1, after1, ...]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {
+        "Out": [jnp.pad(x, pairs, constant_values=op.attr("pad_value", 0.0))]
+    }
+
+
+@register_op("take_along_axis", inputs=["Input", "Index"], outputs=["Result"])
+def _take_along_axis(ctx, op, ins):
+    return {
+        "Result": [
+            jnp.take_along_axis(
+                ins["Input"][0], ins["Index"][0], axis=op.attr("Axis", 0)
+            )
+        ]
+    }

@@ -1,0 +1,532 @@
+"""Optimizer zoo: op-emitting optimizers, fluid-style.
+
+Reference parity: python/paddle/fluid/optimizer.py (4,304 LoC; SGD :842,
+Momentum :936, Adagrad :1600, Adam :1716, Adamax :1982, Dpsgd :2154,
+DecayedAdagrad :2249, Adadelta :2359, RMSProp :2478, Ftrl :2666, Lamb :2825,
+LarsMomentum :1486). Each optimizer emits one update op per parameter into
+the main program; minimize() = append_backward + regularization + clip +
+update ops — identical pipeline shape to the reference's
+Optimizer.minimize (optimizer.py:796) / apply_gradients (:683).
+
+The meta-optimizers (Recompute/Pipeline/DGC/EMA/ModelAverage/Lookahead) live
+in incubate modules and wrap these.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .framework import unique_name
+from .framework.backward import append_backward
+from .framework.program import (
+    Variable,
+    default_main_program,
+    default_startup_program,
+)
+from .initializer import Constant
+
+
+class Optimizer:
+    def __init__(
+        self,
+        learning_rate,
+        parameter_list=None,
+        regularization=None,
+        grad_clip=None,
+        name=None,
+    ):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self._lr_var = None
+        self._accumulators = {}  # (acc_name, param_name) -> Variable
+        self.type = type(self).__name__.lower()
+
+    # -- learning rate ----------------------------------------------------
+    def _create_lr(self, block):
+        if isinstance(self._learning_rate, Variable):
+            return self._learning_rate
+        if self._lr_var is not None:
+            return self._lr_var
+        name = unique_name.generate("learning_rate")
+        main = block.program.global_block
+        startup = default_startup_program().global_block
+        self._lr_var = main.create_parameter(
+            name, [1], "float32", trainable=False
+        )
+        self._lr_var.stop_gradient = True
+        startup.create_parameter(name, [1], "float32", trainable=False)
+        Constant(float(self._learning_rate))(startup, name, [1], "float32")
+        return self._lr_var
+
+    def set_lr(self, value, scope=None):
+        """Runtime LR override (dygraph/static parity helper)."""
+        from .framework.scope import global_scope
+        import jax.numpy as jnp
+
+        self._learning_rate = float(value)
+        if self._lr_var is not None:
+            (scope or global_scope()).set_var(
+                self._lr_var.name, jnp.full([1], float(value), dtype=jnp.float32)
+            )
+
+    # -- accumulators ------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype=None):
+        key = (name, param.name)
+        if key in self._accumulators:
+            return self._accumulators[key]
+        shape = list(shape if shape is not None else param.shape)
+        dtype = dtype or "float32"
+        vname = unique_name.generate(f"{param.name}_{name}")
+        main = param.block.program.global_block
+        startup = default_startup_program().global_block
+        v = main.create_parameter(vname, shape, dtype, trainable=False)
+        v.stop_gradient = True
+        startup.create_parameter(vname, shape, dtype, trainable=False)
+        Constant(fill_value)(startup, vname, shape, dtype)
+        self._accumulators[key] = v
+        return v
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[(name, param.name)]
+
+    # -- pipeline ----------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        return append_backward(
+            loss, parameter_list or self._parameter_list, no_grad_set
+        )
+
+    def apply_gradients(self, params_grads):
+        if params_grads:
+            # anchor to the params' own program, not the ambient default
+            block = params_grads[0][0].block.program.global_block
+        else:
+            block = default_main_program().global_block
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip.apply(params_grads, block)
+        processed = []
+        for p, g in params_grads:
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is not None:
+                g = reg.append_regularization_op(p, g, block)
+            processed.append((p, g))
+        self._create_accumulators(block, [p for p, _ in processed])
+        ops = [self._append_optimize_op(block, pg) for pg in processed]
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(
+        self, loss, startup_program=None, parameter_list=None, no_grad_set=None
+    ):
+        # ops must land in the loss's program even if minimize() is called
+        # outside its program_guard (fluid wraps minimize the same way)
+        from .framework.program import program_guard
+
+        with program_guard(
+            loss.block.program, startup_program or default_startup_program()
+        ):
+            params_grads = self.backward(
+                loss, startup_program, parameter_list, no_grad_set
+            )
+            ops = self.apply_gradients(params_grads)
+        return ops, params_grads
+
+    # -- per-optimizer hooks ----------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        lr = self._create_lr(block)
+        return block.append_op(
+            "sgd",
+            {"Param": [p.name], "Grad": [g.name], "LearningRate": [lr.name]},
+            {"ParamOut": [p.name]},
+            {},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        lr = self._create_lr(block)
+        return block.append_op(
+            "momentum",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Velocity": [v.name],
+                "LearningRate": [lr.name],
+            },
+            {"ParamOut": [p.name], "VelocityOut": [v.name]},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(
+        self, learning_rate, momentum=0.9, lars_coeff=0.001,
+        lars_weight_decay=0.0005, **kw,
+    ):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        lr = self._create_lr(block)
+        return block.append_op(
+            "lars_momentum",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Velocity": [v.name],
+                "LearningRate": [lr.name],
+            },
+            {"ParamOut": [p.name], "VelocityOut": [v.name]},
+            {
+                "mu": self._momentum,
+                "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay,
+            },
+        )
+
+
+class _AdamBase(Optimizer):
+    op_type = "adam"
+
+    def __init__(
+        self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw
+    ):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow", p, self._beta2, shape=[1])
+
+    def _extra_attrs(self):
+        return {}
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        lr = self._create_lr(block)
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        b2p = self._get_accumulator("beta2_pow", p)
+        return block.append_op(
+            self.op_type,
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "LearningRate": [lr.name],
+                "Moment1": [m1.name],
+                "Moment2": [m2.name],
+                "Beta1Pow": [b1p.name],
+                "Beta2Pow": [b2p.name],
+            },
+            {
+                "ParamOut": [p.name],
+                "Moment1Out": [m1.name],
+                "Moment2Out": [m2.name],
+                "Beta1PowOut": [b1p.name],
+                "Beta2PowOut": [b2p.name],
+            },
+            {
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                **self._extra_attrs(),
+            },
+        )
+
+
+class AdamOptimizer(_AdamBase):
+    op_type = "adam"
+
+
+class AdamWOptimizer(_AdamBase):
+    op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self._weight_decay = weight_decay
+
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
+
+
+class LambOptimizer(_AdamBase):
+    op_type = "lamb"
+
+    def __init__(
+        self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+        beta2=0.999, epsilon=1e-6, **kw,
+    ):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, self._init_acc)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        lr = self._create_lr(block)
+        return block.append_op(
+            "adagrad",
+            {
+                "Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                "LearningRate": [lr.name],
+            },
+            {"ParamOut": [p.name], "MomentOut": [m.name]},
+            {"epsilon": self._epsilon},
+        )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        lr = self._create_lr(block)
+        return block.append_op(
+            "decayed_adagrad",
+            {
+                "Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                "LearningRate": [lr.name],
+            },
+            {"ParamOut": [p.name], "MomentOut": [m.name]},
+            {"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(
+        self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+        centered=False, **kw,
+    ):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum_acc", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        lr = self._create_lr(block)
+        return block.append_op(
+            "rmsprop",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment": [self._get_accumulator("momentum_acc", p).name],
+                "MeanSquare": [self._get_accumulator("mean_square", p).name],
+                "MeanGrad": [self._get_accumulator("mean_grad", p).name],
+                "LearningRate": [lr.name],
+            },
+            {
+                "ParamOut": [p.name],
+                "MomentOut": [self._get_accumulator("momentum_acc", p).name],
+                "MeanSquareOut": [self._get_accumulator("mean_square", p).name],
+                "MeanGradOut": [self._get_accumulator("mean_grad", p).name],
+            },
+            {
+                "decay": self._rho,
+                "epsilon": self._epsilon,
+                "momentum": self._momentum,
+                "centered": self._centered,
+            },
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "adadelta",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "AvgSquaredGrad": [self._get_accumulator("avg_squared_grad", p).name],
+                "AvgSquaredUpdate": [
+                    self._get_accumulator("avg_squared_update", p).name
+                ],
+            },
+            {
+                "ParamOut": [p.name],
+                "AvgSquaredGradOut": [
+                    self._get_accumulator("avg_squared_grad", p).name
+                ],
+                "AvgSquaredUpdateOut": [
+                    self._get_accumulator("avg_squared_update", p).name
+                ],
+            },
+            {"rho": self._rho, "epsilon": self._epsilon},
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(
+        self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw
+    ):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow", p, self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        lr = self._create_lr(block)
+        return block.append_op(
+            "adamax",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "LearningRate": [lr.name],
+                "Moment": [self._get_accumulator("moment", p).name],
+                "InfNorm": [self._get_accumulator("inf_norm", p).name],
+                "Beta1Pow": [self._get_accumulator("beta1_pow", p).name],
+            },
+            {
+                "ParamOut": [p.name],
+                "MomentOut": [self._get_accumulator("moment", p).name],
+                "InfNormOut": [self._get_accumulator("inf_norm", p).name],
+                "Beta1PowOut": [self._get_accumulator("beta1_pow", p).name],
+            },
+            {
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+            },
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        lr = self._create_lr(block)
+        return block.append_op(
+            "ftrl",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "SquaredAccumulator": [self._get_accumulator("squared", p).name],
+                "LinearAccumulator": [self._get_accumulator("linear", p).name],
+                "LearningRate": [lr.name],
+            },
+            {
+                "ParamOut": [p.name],
+                "SquaredAccumOut": [self._get_accumulator("squared", p).name],
+                "LinearAccumOut": [self._get_accumulator("linear", p).name],
+            },
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class DpsgdOptimizer(Optimizer):
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        lr = self._create_lr(block)
+        return block.append_op(
+            "dpsgd",
+            {"Param": [p.name], "Grad": [g.name], "LearningRate": [lr.name]},
+            {"ParamOut": [p.name]},
+            {
+                "clip": self._clip,
+                "batch_size": self._batch_size,
+                "sigma": self._sigma,
+            },
+        )
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Dpsgd = DpsgdOptimizer

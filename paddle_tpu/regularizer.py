@@ -1,0 +1,73 @@
+"""Weight-decay regularizers (reference: python/paddle/fluid/regularizer.py):
+appended to gradients in Optimizer.apply_gradients, as in the reference."""
+
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def append_regularization_op(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad, block):
+        from .framework import unique_name
+
+        scaled = block.create_var(
+            name=unique_name.generate(param.name + "@L2"),
+            shape=param.shape,
+            dtype=param.dtype,
+        )
+        block.append_op(
+            "scale", {"X": [param.name]}, {"Out": [scaled.name]},
+            {"scale": self.coeff},
+        )
+        out = block.create_var(
+            name=unique_name.generate(grad.name + "@REG"),
+            shape=grad.shape,
+            dtype=grad.dtype,
+        )
+        block.append_op(
+            "sum", {"X": [grad.name, scaled.name]}, {"Out": [out.name]}, {}
+        )
+        return out
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad, block):
+        from .framework import unique_name
+
+        sign = block.create_var(
+            name=unique_name.generate(param.name + "@SIGN"),
+            shape=param.shape,
+            dtype=param.dtype,
+        )
+        block.append_op("sign", {"X": [param.name]}, {"Out": [sign.name]}, {})
+        scaled = block.create_var(
+            name=unique_name.generate(param.name + "@L1"),
+            shape=param.shape,
+            dtype=param.dtype,
+        )
+        block.append_op(
+            "scale", {"X": [sign.name]}, {"Out": [scaled.name]},
+            {"scale": self.coeff},
+        )
+        out = block.create_var(
+            name=unique_name.generate(grad.name + "@REG"),
+            shape=grad.shape,
+            dtype=grad.dtype,
+        )
+        block.append_op(
+            "sum", {"X": [grad.name, scaled.name]}, {"Out": [out.name]}, {}
+        )
+        return out
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

@@ -1,0 +1,19 @@
+"""Test config: force an 8-device virtual CPU mesh so multi-chip sharding
+paths run without TPU hardware (mirrors the reference's strategy of testing
+distributed modes on localhost, test_dist_base.py:506).
+
+Note: the axon sitecustomize imports jax at interpreter startup, so env vars
+alone are too late — jax.config.update is required to switch platforms.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,150 @@
+"""Declarative op-test harness.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py:170 — a test
+declares op_type / inputs / attrs / expected outputs as numpy; check_output
+builds a one-op program and compares; check_grad compares analytic gradients
+(append_backward over the op) against a central-difference numeric Jacobian
+(reference: tests/unittests/gradient_checker.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import unique_name
+from paddle_tpu.framework.registry import infer_shapes
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+class OpTest:
+    op_type: str = ""
+
+    def setup(self):
+        """Subclasses set self.inputs / self.outputs / self.attrs here."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        self.attrs = getattr(self, "attrs", {})
+        main, startup = fluid.Program(), fluid.Program()
+        scope = Scope()
+        ctx = (fluid.program_guard(main, startup), scope_guard(scope),
+               unique_name.guard())
+        for c in ctx:
+            c.__enter__()
+        self._ctx = ctx
+
+        blk = main.global_block
+        feed = {}
+        in_names = {}
+        self._in_vars = {}
+        for slot, val in self.inputs.items():
+            vals = val if isinstance(val, list) else [val]
+            names = []
+            for i, v in enumerate(vals):
+                v = np.asarray(v)
+                name = f"{slot.lower()}_{i}"
+                var = blk.create_var(
+                    name=name, shape=v.shape, dtype=v.dtype, is_data=True,
+                    stop_gradient=not np.issubdtype(v.dtype, np.floating),
+                )
+                feed[name] = v
+                names.append(name)
+                self._in_vars[(slot, i)] = var
+            in_names[slot] = names
+
+        out_specs = infer_shapes(self.op_type, blk, in_names, self.attrs)
+        out_names = {}
+        self._out_vars = {}
+        for slot, specs in out_specs.items():
+            names = []
+            for i, (shape, dtype) in enumerate(specs):
+                name = f"out_{slot.lower()}_{i}"
+                var = blk.create_var(name=name, shape=shape, dtype=dtype)
+                names.append(name)
+                self._out_vars[(slot, i)] = var
+            out_names[slot] = names
+        blk.append_op(self.op_type, in_names, out_names, self.attrs)
+        return main, startup, feed
+
+    def _teardown(self):
+        for c in reversed(self._ctx):
+            c.__exit__(None, None, None)
+
+    # ------------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        self.setup()
+        main, startup, feed = self._build()
+        try:
+            exe = fluid.Executor()
+            exe.run(startup)
+            for slot, expect in self.outputs.items():
+                expects = expect if isinstance(expect, list) else [expect]
+                for i, e in enumerate(expects):
+                    if e is None:
+                        continue
+                    (got,) = exe.run(
+                        main, feed=feed, fetch_list=[self._out_vars[(slot, i)]]
+                    )
+                    np.testing.assert_allclose(
+                        got.astype(np.float64)
+                        if got.dtype != np.bool_
+                        else got,
+                        np.asarray(e),
+                        atol=atol,
+                        rtol=rtol,
+                        err_msg=f"{self.op_type} output {slot}[{i}]",
+                    )
+        finally:
+            self._teardown()
+
+    # ------------------------------------------------------------------
+    def check_grad(
+        self, inputs_to_check, output_slot=None, delta=1e-3, rtol=1e-2,
+        atol=1e-4,
+    ):
+        """Compare analytic grad of mean(output) vs numeric central diff."""
+        self.setup()
+        main, startup, feed = self._build()
+        try:
+            if output_slot is None:
+                output_slot = sorted(self._out_vars)[0][0]
+            out_var = self._out_vars[(output_slot, 0)]
+            loss = fluid.layers.mean(
+                fluid.layers.cast(out_var, "float32")
+                if out_var.dtype != "float32"
+                else out_var
+            )
+            check_vars = [
+                self._in_vars[(slot, 0)] for slot in inputs_to_check
+            ]
+            grads = fluid.gradients(loss, check_vars)
+            exe = fluid.Executor()
+            exe.run(startup)
+            analytic = exe.run(main, feed=feed, fetch_list=grads)
+
+            def scalar(feed_override):
+                (o,) = exe.run(main, feed=feed_override, fetch_list=[loss])
+                return float(np.asarray(o).reshape(-1)[0])
+
+            for slot, g in zip(inputs_to_check, analytic):
+                base = np.asarray(feed[f"{slot.lower()}_0"], dtype=np.float64)
+                num = np.zeros_like(base)
+                flat = base.reshape(-1)
+                for j in range(flat.size):
+                    for sgn in (+1, -1):
+                        pert = flat.copy()
+                        pert[j] += sgn * delta
+                        f2 = dict(feed)
+                        f2[f"{slot.lower()}_0"] = pert.reshape(base.shape).astype(
+                            feed[f"{slot.lower()}_0"].dtype
+                        )
+                        num.reshape(-1)[j] += sgn * scalar(f2)
+                num /= 2 * delta
+                np.testing.assert_allclose(
+                    np.asarray(g), num, rtol=rtol, atol=atol,
+                    err_msg=f"{self.op_type} grad wrt {slot}",
+                )
+        finally:
+            self._teardown()

@@ -1,0 +1,181 @@
+"""Core framework tests: program construction, executor, backward, optimizers.
+
+Modeled on the reference's framework/behavior unittests
+(python/paddle/fluid/tests/unittests/test_executor_*, test_backward*,
+tests/book/test_fit_a_line.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import unique_name
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Isolate each test in its own programs + scope."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+def test_program_build():
+    x = fluid.data("x", [-1, 4])
+    y = fluid.layers.fc(x, 8, act="relu")
+    assert y.shape == (-1, 8)
+    main = fluid.default_main_program()
+    assert [op.type for op in main.global_block.ops] == [
+        "mul", "elementwise_add", "relu",
+    ]
+    assert len(main.all_parameters()) == 2
+
+
+def test_executor_forward():
+    x = fluid.data("x", [-1, 4])
+    y = fluid.layers.fc(x, 3)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(5, 4).astype(np.float32)
+    (out,) = exe.run(feed={"x": xv}, fetch_list=[y])
+    assert out.shape == (5, 3)
+
+
+def test_backward_matches_numeric():
+    x = fluid.data("x", [2, 3])
+    w_init = np.random.rand(3, 4).astype(np.float32)
+    y = fluid.layers.fc(
+        x, 4, param_attr=fluid.ParamAttr(
+            name="w0", initializer=fluid.initializer.NumpyArrayInitializer(w_init)
+        ),
+        bias_attr=False,
+    )
+    loss = fluid.layers.mean(fluid.layers.square(y))
+    pairs = fluid.append_backward(loss)
+    assert len(pairs) == 1
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(2, 3).astype(np.float32)
+    (gw,) = exe.run(feed={"x": xv}, fetch_list=[pairs[0][1]])
+    # analytic: d/dw mean((xw)^2) = 2 x^T (xw) / numel
+    ref = 2.0 * xv.T @ (xv @ w_init) / (2 * 4)
+    np.testing.assert_allclose(gw, ref, rtol=1e-5)
+
+
+def test_grad_accumulation_multi_use():
+    """A var consumed twice must receive summed gradient contributions."""
+    x = fluid.data("x", [3])
+    x.stop_gradient = False
+    a = fluid.layers.scale(x, scale=2.0)
+    b = fluid.layers.elementwise_add(a, a)  # uses `a` twice
+    loss = fluid.layers.mean(b)
+    grads = fluid.gradients(loss, [x])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (gx,) = exe.run(feed={"x": np.ones(3, np.float32)}, fetch_list=[grads[0]])
+    np.testing.assert_allclose(gx, np.full(3, 4.0 / 3.0), rtol=1e-6)
+
+
+def test_fit_a_line_converges():
+    """End-to-end: linear regression must converge (reference:
+    tests/book/test_fit_a_line.py)."""
+    np.random.seed(0)
+    true_w = np.array([[2.0], [-3.4]], np.float32)
+    true_b = 4.2
+
+    x = fluid.data("x", [-1, 2])
+    label = fluid.data("label", [-1, 1])
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, label))
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(400):
+        xv = np.random.rand(16, 2).astype(np.float32)
+        yv = xv @ true_w + true_b + 0.01 * np.random.randn(16, 1).astype(np.float32)
+        (lv,) = exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+        losses.append(float(lv[0]))
+    assert losses[-1] < 0.01, f"did not converge: {losses[::80]}"
+
+
+def test_adam_and_accumulators():
+    x = fluid.data("x", [-1, 4])
+    y = fluid.layers.fc(x, 2, bias_attr=False)
+    loss = fluid.layers.mean(fluid.layers.square(y))
+    opt = fluid.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = fluid.framework.scope.current_scope()
+    p = fluid.default_main_program().all_parameters()[0]
+    first = np.asarray(scope.find_var(p.name)).copy()
+    for _ in range(3):
+        exe.run(feed={"x": np.random.rand(4, 4).astype(np.float32)},
+                fetch_list=[loss])
+    after = np.asarray(scope.find_var(p.name))
+    assert not np.allclose(first, after)
+
+
+def test_dropout_train_vs_test():
+    x = fluid.data("x", [100, 100])
+    out = fluid.layers.dropout(x, 0.5, dropout_implementation="upscale_in_train")
+    main = fluid.default_main_program()
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.ones((100, 100), np.float32)
+    (train_out,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    zeros = (train_out == 0).mean()
+    assert 0.3 < zeros < 0.7
+    (test_out,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(test_out, xv)
+
+
+def test_batch_norm_updates_stats():
+    x = fluid.data("x", [8, 3, 4, 4])
+    y = fluid.layers.batch_norm(x)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = fluid.framework.scope.current_scope()
+    mean_name = [
+        v.name for v in fluid.default_main_program().global_block.vars.values()
+        if "bn_mean" in v.name
+    ][0]
+    xv = (5.0 + np.random.randn(8, 3, 4, 4)).astype(np.float32)
+    exe.run(feed={"x": xv}, fetch_list=[y])
+    m = np.asarray(scope.find_var(mean_name))
+    assert np.all(m > 0.1), m  # moved toward batch mean of ~5
+
+
+def test_mnist_mlp_converges():
+    """Small classification net on synthetic separable data (reference:
+    tests/book/test_recognize_digits.py shape)."""
+    np.random.seed(1)
+    img = fluid.data("img", [-1, 64])
+    label = fluid.data("label", [-1, 1], dtype="int64")
+    h = fluid.layers.fc(img, 32, act="relu")
+    logits = fluid.layers.fc(h, 4)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label)
+    )
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    opt = fluid.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    centers = np.random.randn(4, 64).astype(np.float32) * 3
+    accs = []
+    for _ in range(60):
+        lbl = np.random.randint(0, 4, (32, 1))
+        xv = centers[lbl[:, 0]] + np.random.randn(32, 64).astype(np.float32)
+        lv, av = exe.run(
+            feed={"img": xv.astype(np.float32), "label": lbl.astype(np.int64)},
+            fetch_list=[loss, acc],
+        )
+        accs.append(float(av))
+    assert np.mean(accs[-10:]) > 0.9, accs[::10]

@@ -1,0 +1,449 @@
+"""Op unit tests against numpy references (reference pattern:
+tests/unittests/test_*_op.py files using OpTest)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(3).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def setup(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        y = np.random.rand(5, 3).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setup(self):
+        x = np.random.rand(5, 4).astype(np.float32)
+        y = np.random.rand(3, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": x.T @ y.T}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setup(self):
+        x = np.random.rand(3, 7).astype(np.float32)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestRelu(OpTest):
+    op_type = "relu"
+
+    def setup(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        x[np.abs(x) < 0.05] = 0.1  # keep away from the kink for numeric grad
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.maximum(x, 0)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def setup(self):
+        x = np.random.rand(2, 6).astype(np.float32)
+        scale = np.random.rand(6).astype(np.float32)
+        bias = np.random.rand(6).astype(np.float32)
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+        self.outputs = {"Y": y}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Scale", "Bias"], output_slot="Y")
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 5, 5).astype(np.float32)
+        w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+        # naive conv reference
+        out = np.zeros((2, 4, 3, 3), np.float32)
+        for n in range(2):
+            for o in range(4):
+                for i in range(3):
+                    for j in range(3):
+                        patch = x[n, :, i : i + 3, j : j + 3]
+                        out[n, o, i, j] = (patch * w[o]).sum()
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0]}
+        self.outputs = {"Output": out}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+
+
+class TestConv2dGrad(OpTest):
+    op_type = "conv2d"
+
+    def setup(self):
+        self.inputs = {
+            "Input": np.random.rand(1, 2, 4, 4).astype(np.float32),
+            "Filter": np.random.rand(2, 2, 3, 3).astype(np.float32),
+        }
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1]}
+        self.outputs = {}
+
+    def test(self):
+        self.check_grad(["Input", "Filter"], output_slot="Output")
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        out = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {
+            "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+            "pooling_type": "max",
+        }
+        self.outputs = {"Out": out}
+
+    def test(self):
+        self.check_output()
+
+
+class TestPool2dAvg(OpTest):
+    op_type = "pool2d"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        out = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {
+            "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+            "pooling_type": "avg",
+        }
+        self.outputs = {"Out": out}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def setup(self):
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False}
+        self.outputs = {"Out": x.sum(1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestReshape(OpTest):
+    op_type = "reshape2"
+
+    def setup(self):
+        x = np.random.rand(2, 6).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [3, 4]}
+        self.outputs = {"Out": x.reshape(3, 4)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose2"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [2, 0, 1]}
+        self.outputs = {"Out": x.transpose(2, 0, 1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def setup(self):
+        xs = [np.random.rand(2, 3).astype(np.float32) for _ in range(3)]
+        self.inputs = {"X": xs}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate(xs, 1)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSplit(OpTest):
+    op_type = "split"
+
+    def setup(self):
+        x = np.random.rand(4, 6).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"num": 3, "axis": 1, "sections": []}
+        self.outputs = {"Out": [x[:, 0:2], x[:, 2:4], x[:, 4:6]]}
+
+    def test(self):
+        self.check_output()
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table_v2"
+
+    def setup(self):
+        w = np.random.rand(10, 4).astype(np.float32)
+        ids = np.array([[1, 2], [3, 9]], np.int64)
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids]}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["W"])
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def setup(self):
+        x = np.random.rand(3, 5).astype(np.float32)
+        x /= x.sum(-1, keepdims=True)
+        label = np.array([[0], [2], [4]], np.int64)
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {
+            "Y": -np.log(x[np.arange(3), label[:, 0]] + 1e-9)[:, None]
+        }
+
+    def test(self):
+        self.check_output()
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup(self):
+        logits = np.random.rand(4, 6).astype(np.float32)
+        label = np.array([[0], [5], [2], [1]], np.int64)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(4), label[:, 0]])[:, None]
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["Logits"], output_slot="Loss")
+
+
+class TestBatchNormInference(OpTest):
+    op_type = "batch_norm"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 2, 2).astype(np.float32)
+        scale = np.random.rand(3).astype(np.float32)
+        bias = np.random.rand(3).astype(np.float32)
+        mean = np.random.rand(3).astype(np.float32)
+        var = np.random.rand(3).astype(np.float32) + 0.5
+        y = (
+            (x - mean.reshape(1, 3, 1, 1))
+            / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-5)
+        ) * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {
+            "X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var,
+        }
+        self.attrs = {"is_test": True, "epsilon": 1e-5}
+        self.outputs = {"Y": y}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def setup(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+        self.outputs = {"Out": 2.5 * x + 1.0}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def setup(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "int32"}
+        self.outputs = {"Out": x.astype(np.int32)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestGather(OpTest):
+    op_type = "gather"
+
+    def setup(self):
+        x = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4], np.int64)
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def setup(self):
+        x = np.array([[1.0, 3.0, 2.0], [5.0, 4.0, 6.0]], np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"k": 2}
+        self.outputs = {
+            "Out": np.array([[3.0, 2.0], [6.0, 5.0]], np.float32),
+            "Indices": np.array([[1, 2], [2, 0]], np.int64),
+        }
+
+    def test(self):
+        self.check_output()
+
+
+class TestSigmoidCE(OpTest):
+    op_type = "sigmoid_cross_entropy_with_logits"
+
+    def setup(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        label = np.random.randint(0, 2, (3, 4)).astype(np.float32)
+        loss = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": loss}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestDropoutTestMode(OpTest):
+    op_type = "dropout"
+
+    def setup(self):
+        x = np.random.rand(4, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {
+            "dropout_prob": 0.35, "is_test": True,
+            "dropout_implementation": "downgrade_in_infer",
+        }
+        self.outputs = {"Out": x * 0.65}
+
+    def test(self):
+        self.check_output()
+
+
+class TestClip(OpTest):
+    op_type = "clip"
+
+    def setup(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"min": -0.5, "max": 0.5}
+        self.outputs = {"Out": np.clip(x, -0.5, 0.5)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(12, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": (x.reshape(2, 12) @ y).reshape(2, 5)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestSum(OpTest):
+    op_type = "sum"
+
+    def setup(self):
+        xs = [np.random.rand(3, 4).astype(np.float32) for _ in range(3)]
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": sum(xs)}
+
+    def test(self):
+        self.check_output()
