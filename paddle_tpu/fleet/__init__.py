@@ -1,0 +1,27 @@
+"""Fleet: the distributed-training facade (reference incubate/fleet/).
+
+Collective mode is the TPU mainline (mesh + SPMD, fleet/collective.py).
+Role makers mirror the reference's env-driven discovery (role_maker.py).
+"""
+
+from .collective import (  # noqa: F401
+    CollectiveOptimizer,
+    DistributedStrategy,
+    Fleet,
+    TrainStatus,
+    fleet,
+)
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker,
+    Role,
+    RoleMakerBase,
+    UserDefinedRoleMaker,
+)
+
+init = fleet.init
+distributed_optimizer = fleet.distributed_optimizer
+worker_num = fleet.worker_num
+worker_index = fleet.worker_index
+is_first_worker = fleet.is_first_worker
+is_worker = fleet.is_worker
+is_server = fleet.is_server

@@ -1,0 +1,109 @@
+"""Role makers: who am I in the distributed job?
+
+Reference parity: incubate/fleet/base/role_maker.py (RoleMakerBase :68,
+PaddleCloudRoleMaker env-based, UserDefinedRoleMaker). TPU-native changes:
+  * the process unit is a HOST (each host drives its local chips via one
+    JAX process), not a GPU — so worker_num == number of host processes;
+  * rendezvous is jax.distributed's coordination service (the analog of the
+    reference's gen_nccl_id RPC server, c_gen_nccl_id_op.cc:87-108, and the
+    http_server.py KV store): coordinator address + process id from env.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._generated = False
+        self._trainer_id = 0
+        self._trainers_num = 1
+        self._role = Role.WORKER
+        self._worker_endpoints = []
+        self._server_endpoints = []
+
+    def generate_role(self):
+        self._generated = True
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._trainer_id == 0
+
+    def worker_index(self):
+        return self._trainer_id
+
+    def worker_num(self):
+        return self._trainers_num
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var role maker (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+    PADDLE_TRAINER_ENDPOINTS), the variables set by our launch module and by
+    the reference's launcher (launch.py:193-227)."""
+
+    def __init__(self, is_collective=True):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        if self._generated:
+            return
+        env = os.environ
+        self._trainer_id = int(env.get("PADDLE_TRAINER_ID", 0))
+        eps = env.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in eps.split(",") if e]
+        self._trainers_num = int(
+            env.get("PADDLE_TRAINERS_NUM", max(1, len(self._worker_endpoints)))
+        )
+        self._server_endpoints = [
+            e for e in env.get("PADDLE_PSERVER_ENDPOINTS", "").split(",") if e
+        ]
+        if env.get("TRAINING_ROLE", "TRAINER") == "PSERVER":
+            self._role = Role.SERVER
+        # multi-host: bring up the JAX coordination service so every host's
+        # chips join one global device set (replaces ncclUniqueId exchange)
+        if self._trainers_num > 1 and self._worker_endpoints:
+            coordinator = env.get(
+                "PADDLE_COORDINATOR", self._worker_endpoints[0]
+            )
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=self._trainers_num,
+                    process_id=self._trainer_id,
+                )
+            except (RuntimeError, ValueError):
+                pass  # already initialized (tests) or single-process fallback
+        self._generated = True
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._trainer_id = current_id
+        self._role = role
+        self._trainers_num = worker_num
+        self._server_endpoints = server_endpoints or []

@@ -170,7 +170,7 @@ class Executor:
                     raise RuntimeError(
                         f"op {op.type} references undefined variable {e}"
                     ) from None
-            fetches = [env[n] for n in fetch_names]
+            fetches = tuple(env[n] for n in fetch_names)
             new_state = {n: env[n] for n in write_back if n in env}
             return fetches, new_state
 
@@ -178,7 +178,8 @@ class Executor:
             from ..parallel.spmd import wrap_shard_map
 
             fn = wrap_shard_map(
-                traced, program, mesh, state_ro, state_mut, write_back
+                traced, program, mesh, state_ro, state_mut, write_back,
+                fetch_names,
             )
         else:
             fn = jax.jit(traced, donate_argnums=(1,))

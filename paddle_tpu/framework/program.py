@@ -131,8 +131,6 @@ class Operator:
     so Programs serialize for save_inference_model.
     """
 
-    _uid_counter = itertools.count()
-
     def __init__(self, block, op_type, inputs=None, outputs=None, attrs=None):
         self.block = block
         self.type = op_type
@@ -140,8 +138,10 @@ class Operator:
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
         # stable identity used to derive per-op RNG keys (registry.EmitContext);
-        # survives deepcopy/clone so test-mode programs keep the same streams
-        self.uid = self.attrs.setdefault("__uid__", next(Operator._uid_counter))
+        # per-Program (not global) so two identically-built programs get
+        # identical RNG streams; survives deepcopy/clone so test-mode
+        # programs keep the same streams
+        self.uid = self.attrs.setdefault("__uid__", block.program._next_uid())
 
     def input_names(self):
         return [n for vs in self.inputs.values() for n in vs]
@@ -237,6 +237,10 @@ class Program:
         self._mesh = None  # set by parallel transpilers / SPMD mode
         self._sharding = {}  # var name -> PartitionSpec-like tuple
         self._pipeline = None  # set by PipelineOptimizer
+        self._op_uid = itertools.count()
+
+    def _next_uid(self):
+        return next(self._op_uid)
 
     def _bump(self):
         self._version += 1

@@ -1,0 +1,21 @@
+"""Parallelism: mesh management, SPMD execution, program transpilers.
+
+Replaces the reference's ParallelExecutor + NCCL stack (SURVEY.md §2.1
+rows: ParallelExecutor, details/, BuildStrategy, collective ops, NCCL
+helpers) with GSPMD over `jax.sharding.Mesh`.
+"""
+
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    current_mesh,
+    make_mesh,
+    mesh_guard,
+    set_global_mesh,
+    spec,
+)
+from .spmd import device_put_sharded, shard_program, spec_for  # noqa: F401
+from .transpiler import GradAllReduce, LocalSGD  # noqa: F401

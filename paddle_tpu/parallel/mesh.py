@@ -1,0 +1,81 @@
+"""Device-mesh management: the TPU-native replacement for communicator rings.
+
+The reference manages NCCL communicators keyed by (ring_id, device)
+(platform/collective_helper.h:62, nccl_helper.h:91) and builds flat +
+hierarchical rings by hand (nccl_helper.h:180). On TPU, topology is the
+compiler's job: the framework only names logical mesh axes ("dp", "mp",
+"pp", "sp", "ep") over `jax.sharding.Mesh`, and XLA lays collectives onto
+ICI (intra-slice) / DCN (inter-slice) links itself.
+
+A named axis replaces a ring_id; `replica_groups` are derived from the mesh
+by XLA. Hierarchical allreduce (build_strategy.h:135) needs no framework
+code at all — a 2D (dcn, ici) mesh expresses it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, PartitionSpec
+
+# Canonical axis names used across the framework.
+DATA_AXIS = "dp"  # data parallel (batch sharding, grad allreduce)
+MODEL_AXIS = "mp"  # tensor/model parallel (weight sharding)
+PIPE_AXIS = "pp"  # pipeline stages
+SEQ_AXIS = "sp"  # sequence/context parallel (ring attention)
+EXPERT_AXIS = "ep"  # expert parallel (MoE all_to_all)
+
+_current_mesh = None
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh from {axis_name: size}. Sizes must multiply to the device
+    count; an axis size of -1 absorbs the remainder (like a reshape -1).
+
+    make_mesh() with no args -> 1-axis "dp" mesh over all devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {DATA_AXIS: n}
+    names, sizes = list(axes.keys()), [int(s) for s in axes.values()]
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError(f"cannot infer -1 axis: {n} devices, known {known}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices, have {n}"
+        )
+    dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def current_mesh():
+    return _current_mesh
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh):
+    global _current_mesh
+    old = _current_mesh
+    _current_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _current_mesh = old
+
+
+def set_global_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def spec(*axes) -> PartitionSpec:
+    """PartitionSpec shorthand: spec("dp") == P("dp"); spec() == replicated."""
+    return PartitionSpec(*axes)

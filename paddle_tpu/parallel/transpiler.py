@@ -1,0 +1,99 @@
+"""Collective program rewriters: DP grad-allreduce, LocalSGD.
+
+TPU-native analog of the reference's transpiler/collective.py:
+  * GradAllReduce (:178): scale loss grad by 1/nranks (:190) and insert
+    `c_allreduce_sum` per gradient (:209). Here the collectives are emitted
+    as ops whose emitters call lax.psum under shard_map — no c_gen_nccl_id /
+    c_comm_init startup rewrite (:99-132) is needed: mesh construction
+    replaces communicator bootstrap.
+  * LocalSGD (:270): periodic parameter averaging across the dp axis.
+
+The reference also had to pin a deterministic allreduce order
+(all_reduce_deps_pass.cc) and fuse gradient buffers
+(coalesce_grad_tensor_pass.cc) by hand; XLA's latency-hiding scheduler and
+collective combiner do both automatically.
+"""
+
+from __future__ import annotations
+
+from .mesh import DATA_AXIS
+
+
+def _insert_pos_after(block, names):
+    """Index just after the last op producing any of `names`."""
+    pos = 0
+    names = set(names)
+    for i, op in enumerate(block.ops):
+        if names & set(op.output_names()):
+            pos = i + 1
+    return pos
+
+
+class GradAllReduce:
+    """Insert per-gradient allreduce into a trained program (DP mode)."""
+
+    def __init__(self, nranks, axis_name=DATA_AXIS):
+        self.nranks = nranks
+        self.axis_name = axis_name
+
+    def transpile(self, program, params_grads):
+        block = program.global_block
+        for _, g in params_grads:
+            gname = g.name if hasattr(g, "name") else str(g)
+            pos = _insert_pos_after(block, [gname])
+            # mean-reduce: scale by 1/nranks then psum — identical math to the
+            # reference's loss-grad scaling (transpiler/collective.py:190)
+            block.append_op(
+                "scale",
+                inputs={"X": [gname]},
+                outputs={"Out": [gname]},
+                attrs={"scale": 1.0 / self.nranks, "bias": 0.0},
+                index=pos,
+            )
+            block.append_op(
+                "c_allreduce_sum",
+                inputs={"X": [gname]},
+                outputs={"Out": [gname]},
+                attrs={"axis_name": self.axis_name},
+                index=pos + 1,
+            )
+        return program
+
+
+class LocalSGD:
+    """Periodic model averaging (transpiler/collective.py:270).
+
+    Emits a `c_allreduce_sum` + scale over each parameter; the caller runs
+    the returned averaging program every k steps.
+    """
+
+    def __init__(self, nranks, axis_name=DATA_AXIS):
+        self.nranks = nranks
+        self.axis_name = axis_name
+
+    def build_average_program(self, main_program):
+        from ..framework.program import Program
+
+        avg = Program()
+        avg._mesh = main_program._mesh
+        avg._sharding = dict(main_program._sharding)
+        block = avg.global_block
+        for p in main_program.all_parameters():
+            if not getattr(p, "trainable", False):
+                continue
+            block.create_var(
+                name=p.name, shape=p.shape, dtype=p.dtype, persistable=True
+            )
+            block.append_op(
+                "scale",
+                inputs={"X": [p.name]},
+                outputs={"Out": [p.name]},
+                attrs={"scale": 1.0 / self.nranks, "bias": 0.0},
+            )
+            block.append_op(
+                "c_allreduce_sum",
+                inputs={"X": [p.name]},
+                outputs={"Out": [p.name]},
+                attrs={"axis_name": self.axis_name},
+            )
+        return avg
