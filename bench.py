@@ -1,0 +1,79 @@
+"""Benchmark driver: BERT-base MLM train step, tokens/sec on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no numbers (BASELINE.md), so vs_baseline is reported
+against the recorded target in BASELINE.json once filled; until then 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.models import BertConfig, bert_pretrain
+    from paddle_tpu.optimizer import Adam
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    b, s = (32, 128) if on_accel else (4, 64)
+    cfg = BertConfig.base() if on_accel else BertConfig.tiny()
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main_prog, startup):
+        ids = fluid.data("ids", [b, s], "int64")
+        types = fluid.data("types", [b, s], "int64")
+        mask = fluid.data("mask", [b, s], "float32")
+        labels = fluid.data("labels", [b, s], "int64")
+        loss = bert_pretrain(ids, types, mask, labels, cfg)
+        Adam(1e-4).minimize(loss, startup)
+
+    scope = Scope()
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "ids": rng.randint(0, cfg.vocab_size, (b, s)).astype("int32"),
+        "types": rng.randint(0, cfg.type_vocab_size, (b, s)).astype("int32"),
+        "mask": np.ones((b, s), "float32"),
+        "labels": rng.randint(0, cfg.vocab_size, (b, s)).astype("int32"),
+    }
+
+    # warmup: compile + first dispatch
+    for _ in range(2):
+        exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
+
+    n_steps = 20 if on_accel else 5
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        (lv,) = exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
+    lv = float(np.asarray(lv).reshape(-1)[0])  # blocks on the last step
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = n_steps * b * s / dt
+    assert np.isfinite(lv), "loss went non-finite during benchmark"
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_mlm_train_tokens_per_sec"
+                if on_accel
+                else "bert_tiny_mlm_train_tokens_per_sec_cpu",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

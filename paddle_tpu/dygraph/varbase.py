@@ -1,0 +1,140 @@
+"""VarBase: the eager-mode tensor (reference: imperative/layer.h VarBase,
+python varbase_patch_methods.py).
+
+TPU-native design: a VarBase wraps a jax Array resident on device. Autograd
+is a per-tracer tape of vjp closures (tracer.py) — the analog of the
+reference's OpBase grad-node graph (imperative/tracer.cc:80) walked by
+BasicEngine (imperative/basic_engine.cc:159); here each tape entry's vjp_fn
+carries its residuals as device arrays, so backward is a reverse sweep
+calling jax closures, with gradient accumulation by addition (the
+reference's SortedGradientAccumulator collapses to `+=`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.dtypes import convert_dtype
+from ..framework import unique_name
+
+
+class VarBase:
+    def __init__(self, value, name=None, persistable=False, stop_gradient=True):
+        self._value = value if hasattr(value, "dtype") else jnp.asarray(value)
+        self.name = name or unique_name.generate("eager_tmp")
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self._grad = None  # accumulated gradient (jax array)
+
+    # -- value access -------------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return convert_dtype(self._value.dtype)
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def set_value(self, v):
+        self._value = v if hasattr(v, "dtype") else jnp.asarray(v)
+
+    def detach(self):
+        return VarBase(self._value, stop_gradient=True)
+
+    def astype(self, dtype):
+        from .tracer import trace_op
+
+        return trace_op("cast", {"X": [self]}, {"in_dtype": self.dtype,
+                                                "out_dtype": dtype})
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, retain_graph=False):
+        from .tracer import _require_tracer
+
+        _require_tracer().run_backward(self, retain_graph=retain_graph)
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def clear_gradient(self):
+        self._grad = None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # -- operator sugar (mirrors static Variable) ----------------------------
+    def _binary(self, other, op_type, reverse=False):
+        from .tracer import trace_op
+
+        if not isinstance(other, VarBase):
+            other = VarBase(jnp.asarray(other, dtype=self._value.dtype))
+        x, y = (other, self) if reverse else (self, other)
+        return trace_op(op_type, {"X": [x], "Y": [y]}, {"axis": -1})
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    def __neg__(self):
+        from .tracer import trace_op
+
+        return trace_op("scale", {"X": [self]}, {"scale": -1.0, "bias": 0.0})
+
+    def __matmul__(self, o):
+        from .tracer import trace_op
+
+        return trace_op("matmul", {"X": [self], "Y": [o]}, {})
+
+    def __getitem__(self, idx):
+        out = self._value[idx]
+        from .tracer import _current, _record_getitem
+
+        tr = _current()
+        res = VarBase(out, stop_gradient=self.stop_gradient)
+        if tr is not None and tr.enable_grad and not self.stop_gradient:
+            res.stop_gradient = False
+            _record_getitem(tr, self, idx, res)
+        return res
+
+    def __len__(self):
+        return int(self._value.shape[0])
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape}, dtype={self.dtype})\n{self._value}"
+
+
+class ParamBase(VarBase):
+    """Trainable eager parameter (reference framework.py:5064)."""
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(
+            value, name=name, persistable=True, stop_gradient=not trainable
+        )
+        self.trainable = trainable
+        self.regularizer = None
+        self.need_clip = True

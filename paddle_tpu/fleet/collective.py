@@ -56,6 +56,7 @@ class Fleet:
         self._role_maker = None
         self._inited = False
         self._mesh = None
+        self._mesh_key = None
         self._strategy = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -104,9 +105,11 @@ class Fleet:
         return CollectiveOptimizer(self, optimizer, self._strategy)
 
     def mesh(self, strategy=None):
-        if self._mesh is None:
-            axes = (strategy or self._strategy or DistributedStrategy()).mesh_axes
+        axes = (strategy or self._strategy or DistributedStrategy()).mesh_axes
+        key = tuple(sorted(axes.items())) if axes else None
+        if self._mesh is None or key != self._mesh_key:
             self._mesh = make_mesh(axes)
+            self._mesh_key = key
         return self._mesh
 
     # -- io (delegates; first-worker gated like the reference) -------------
@@ -182,8 +185,14 @@ class CollectiveOptimizer:
                 loss, startup, parameter_list, no_grad_set
             )
             mesh = self._fleet.mesh(strategy)
-            nranks = int(np.prod(list(mesh.shape.values())))
-            dp = mesh.shape.get(DATA_AXIS, nranks)
+            if strategy.local_sgd:
+                raise NotImplementedError(
+                    "strategy.local_sgd: parameters are replicated under "
+                    "synchronous SPMD, so LocalSGD has no effect; use "
+                    "parallel.LocalSGD explicitly for multi-copy setups"
+                )
+            # no dp axis in the mesh -> pure model parallel, no grad allreduce
+            dp = mesh.shape.get(DATA_AXIS, 1)
             if dp > 1:
                 GradAllReduce(dp).transpile(main, params_grads)
             ops = inner.apply_gradients(params_grads)

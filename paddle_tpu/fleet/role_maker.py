@@ -94,8 +94,11 @@ class PaddleCloudRoleMaker(RoleMakerBase):
                     num_processes=self._trainers_num,
                     process_id=self._trainer_id,
                 )
-            except (RuntimeError, ValueError):
-                pass  # already initialized (tests) or single-process fallback
+            except RuntimeError as e:
+                # tolerate only re-init; a failed rendezvous must NOT silently
+                # degrade a multi-host job to independent single-host training
+                if "already initialized" not in str(e).lower():
+                    raise
         self._generated = True
 
 

@@ -155,7 +155,14 @@ class Executor:
         )
         ops = list(block.ops)
         mesh = program._mesh
-        mesh_axes = tuple(mesh.axis_names) if mesh is not None else ()
+        spmd_mode = getattr(program, "_spmd_mode", "shard_map")
+        # under gspmd there is no axis binding: collectives degrade to
+        # identity and XLA derives cross-shard comms from shardings instead
+        mesh_axes = (
+            tuple(mesh.axis_names)
+            if (mesh is not None and spmd_mode == "shard_map")
+            else ()
+        )
 
         def traced(feeds, smut, sro, step_key):
             env = {}
@@ -175,9 +182,10 @@ class Executor:
             return fetches, new_state
 
         if mesh is not None:
-            from ..parallel.spmd import wrap_shard_map
+            from ..parallel.spmd import wrap_gspmd, wrap_shard_map
 
-            fn = wrap_shard_map(
+            wrap = wrap_gspmd if spmd_mode == "gspmd" else wrap_shard_map
+            fn = wrap(
                 traced, program, mesh, state_ro, state_mut, write_back,
                 fetch_names,
             )

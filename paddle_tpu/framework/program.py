@@ -236,6 +236,10 @@ class Program:
         self._version = 0
         self._mesh = None  # set by parallel transpilers / SPMD mode
         self._sharding = {}  # var name -> PartitionSpec-like tuple
+        # "shard_map": explicit collective ops see mesh axis names (the
+        # transpiled/fleet path). "gspmd": sharding annotations only, XLA
+        # inserts collectives by propagation (the TP/auto path).
+        self._spmd_mode = "shard_map"
         self._pipeline = None  # set by PipelineOptimizer
         self._op_uid = itertools.count()
 

@@ -22,6 +22,7 @@ are mapped through a prime sentinel.
 from __future__ import annotations
 
 import itertools
+import zlib
 
 import numpy as np
 
@@ -56,10 +57,18 @@ class EmitContext:
         # substitute BATCH_SENTINEL for -1 dims; at run time -1 is an error
         self.abstract = abstract
 
-    def key_for(self, op_uid: int):
-        if self.step_key is None:
-            return jax.random.key(op_uid)
-        return jax.random.fold_in(self.step_key, op_uid)
+    def key_for(self, op_uid: int, op_type: str = ""):
+        # salt by op type: uids are per-Program, so two programs sharing a
+        # random_seed (e.g. main + startup built together) could otherwise
+        # collide at (seed, step=0, uid) — gaussian init correlating with a
+        # dropout mask. Same-structure programs still get identical streams.
+        salt = zlib.crc32(op_type.encode()) & 0x7FFFFFFF
+        base = (
+            jax.random.key(op_uid)
+            if self.step_key is None
+            else jax.random.fold_in(self.step_key, op_uid)
+        )
+        return jax.random.fold_in(base, salt)
 
 
 class OpView:

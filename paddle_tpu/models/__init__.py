@@ -1,0 +1,11 @@
+"""Model zoo: static-graph builders for the reference's benchmark models
+(BASELINE.md: ResNet-50 ImageNet, BERT-base, plus small book-test models).
+
+Each builder appends ops into the current default program (fluid style) and
+returns the variables a training loop needs. Models are written against the
+public layers API only — they double as end-to-end tests of the framework
+(the reference's tests/book/ strategy, SURVEY.md §4.3).
+"""
+
+from .resnet import resnet  # noqa: F401
+from .bert import BertConfig, bert_encoder, bert_pretrain  # noqa: F401

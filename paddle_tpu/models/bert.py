@@ -1,0 +1,197 @@
+"""BERT — transformer encoder flagship (BERT-base benchmark in BASELINE.md).
+
+Built entirely on the public layers API; equivalent in coverage to the
+reference's ERNIE/BERT workloads (its fused ops multihead_matmul
+operators/fused/multihead_matmul_op.cu and bert_encoder_functor.cu exist
+only because CUDA needed hand fusion — on TPU, XLA fuses the unfused graph,
+so the model is written in plain ops).
+
+Tensor-parallel ready: every projection weight has a deterministic name, and
+`bert_tp_shardings` returns Megatron-style GSPMD annotations over the "mp"
+mesh axis (column-parallel QKV / FFN-in, row-parallel attn-out / FFN-out),
+consumed by the executor's gspmd mode (parallel/spmd.py:wrap_gspmd).
+"""
+
+from __future__ import annotations
+
+import math
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+class BertConfig:
+    def __init__(
+        self,
+        vocab_size=30522,
+        hidden_size=768,
+        num_layers=12,
+        num_heads=12,
+        intermediate_size=3072,
+        max_position=512,
+        type_vocab_size=2,
+        hidden_dropout=0.1,
+        attention_dropout=0.1,
+        initializer_range=0.02,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout = hidden_dropout
+        self.attention_dropout = attention_dropout
+        self.initializer_range = initializer_range
+
+    @classmethod
+    def base(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        """For tests / dry runs: 2 layers, 128 hidden."""
+        return cls(
+            vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+            intermediate_size=512, max_position=128,
+        )
+
+
+def _init(cfg):
+    from ..initializer import Normal
+
+    return Normal(0.0, cfg.initializer_range)
+
+
+def _dense(x, size, name, cfg, act=None):
+    return layers.fc(
+        x,
+        size=size,
+        num_flatten_dims=2,
+        act=act,
+        param_attr=ParamAttr(name=f"{name}_w", initializer=_init(cfg)),
+        bias_attr=ParamAttr(name=f"{name}_b"),
+    )
+
+
+def _attention(x, attn_bias, cfg, prefix, is_test):
+    b, s, h = x.shape
+    nh, dh = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    qkv = _dense(x, 3 * h, f"{prefix}_qkv", cfg)  # [B,S,3H] one fused matmul
+    qkv = layers.reshape(qkv, [b, s, 3, nh, dh])
+    qkv = layers.transpose(qkv, [2, 0, 3, 1, 4])  # [3,B,nh,S,dh]
+    q = layers.squeeze(layers.slice(qkv, [0], [0], [1]), [0])
+    k = layers.squeeze(layers.slice(qkv, [0], [1], [2]), [0])
+    v = layers.squeeze(layers.slice(qkv, [0], [2], [3]), [0])
+    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(dh))
+    if attn_bias is not None:
+        scores = scores + attn_bias  # [B,1,1,S] additive mask broadcast
+    probs = layers.softmax(scores, axis=-1)
+    probs = layers.dropout(
+        probs, dropout_prob=cfg.attention_dropout, is_test=is_test
+    )
+    ctxv = layers.matmul(probs, v)  # [B,nh,S,dh]
+    ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
+    ctxv = layers.reshape(ctxv, [b, s, h])
+    return _dense(ctxv, h, f"{prefix}_out", cfg)
+
+
+def _encoder_layer(x, attn_bias, cfg, prefix, is_test):
+    attn = _attention(x, attn_bias, cfg, f"{prefix}_attn", is_test)
+    attn = layers.dropout(attn, cfg.hidden_dropout, is_test=is_test)
+    x = layers.layer_norm(
+        x + attn,
+        begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{prefix}_ln1_scale"),
+        bias_attr=ParamAttr(name=f"{prefix}_ln1_bias"),
+    )
+    ffn = _dense(x, cfg.intermediate_size, f"{prefix}_ffn_in", cfg, act="gelu")
+    ffn = _dense(ffn, cfg.hidden_size, f"{prefix}_ffn_out", cfg)
+    ffn = layers.dropout(ffn, cfg.hidden_dropout, is_test=is_test)
+    return layers.layer_norm(
+        x + ffn,
+        begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{prefix}_ln2_scale"),
+        bias_attr=ParamAttr(name=f"{prefix}_ln2_bias"),
+    )
+
+
+def bert_encoder(input_ids, token_type_ids, input_mask, cfg, is_test=False):
+    """input_ids/token_type_ids: [B,S] int64; input_mask: [B,S] float32.
+    Returns sequence output [B,S,H]."""
+    b, s = input_ids.shape
+    word_emb = layers.embedding(
+        input_ids,
+        size=[cfg.vocab_size, cfg.hidden_size],
+        param_attr=ParamAttr(name="word_embedding", initializer=_init(cfg)),
+    )
+    pos_ids = layers.reshape(
+        layers.range(0, s, 1, "int64"), [1, s]
+    )
+    pos_emb = layers.embedding(
+        pos_ids,
+        size=[cfg.max_position, cfg.hidden_size],
+        param_attr=ParamAttr(name="pos_embedding", initializer=_init(cfg)),
+    )
+    type_emb = layers.embedding(
+        token_type_ids,
+        size=[cfg.type_vocab_size, cfg.hidden_size],
+        param_attr=ParamAttr(name="type_embedding", initializer=_init(cfg)),
+    )
+    emb = word_emb + pos_emb + type_emb
+    emb = layers.layer_norm(
+        emb,
+        begin_norm_axis=2,
+        param_attr=ParamAttr(name="emb_ln_scale"),
+        bias_attr=ParamAttr(name="emb_ln_bias"),
+    )
+    emb = layers.dropout(emb, cfg.hidden_dropout, is_test=is_test)
+
+    # additive attention bias [B,1,1,S]: 0 keep, -1e4 mask (bf16-safe)
+    mask = layers.reshape(input_mask, [b, 1, 1, s])
+    attn_bias = layers.scale(mask, scale=1e4, bias=-1e4)
+
+    x = emb
+    for i in range(cfg.num_layers):
+        x = _encoder_layer(x, attn_bias, cfg, f"bert_l{i}", is_test)
+    return x
+
+
+def bert_pretrain(input_ids, token_type_ids, input_mask, mlm_labels, cfg,
+                  is_test=False):
+    """Masked-LM pretraining loss over all positions; mlm_labels [B,S] int64
+    with ignore_index -100 on unmasked positions."""
+    seq = bert_encoder(input_ids, token_type_ids, input_mask, cfg, is_test)
+    b, s, h = seq.shape
+    seq2 = layers.reshape(seq, [b * s, h])
+    logits = layers.fc(
+        seq2,
+        size=cfg.vocab_size,
+        param_attr=ParamAttr(name="mlm_out_w", initializer=_init(cfg)),
+        bias_attr=ParamAttr(name="mlm_out_b"),
+    )
+    labels = layers.reshape(mlm_labels, [b * s, 1])
+    loss = layers.softmax_with_cross_entropy(logits, labels, ignore_index=-100)
+    return layers.reduce_mean(loss)
+
+
+def bert_tp_shardings(cfg, axis="mp"):
+    """Megatron-style tensor-parallel GSPMD annotations for every encoder
+    layer: QKV & FFN-in column-parallel (shard output features), attn-out &
+    FFN-out row-parallel (shard input features); XLA propagation inserts the
+    reduce where row-parallel outputs merge. Vocab-sharded embedding/MLM head
+    included (vocab dim over `axis`)."""
+    sh = {
+        "word_embedding": (axis, None),
+        "mlm_out_w": (None, axis),
+    }
+    for i in range(cfg.num_layers):
+        p = f"bert_l{i}"
+        sh[f"{p}_attn_qkv_w"] = (None, axis)
+        sh[f"{p}_attn_qkv_b"] = (axis,)
+        sh[f"{p}_attn_out_w"] = (axis, None)
+        sh[f"{p}_ffn_in_w"] = (None, axis)
+        sh[f"{p}_ffn_in_b"] = (axis,)
+        sh[f"{p}_ffn_out_w"] = (axis, None)
+    return sh

@@ -1,0 +1,82 @@
+"""ResNet v1.5 (18/34/50/101/152) — the image-classification flagship.
+
+Capability parity with the reference's book-test image classification model
+(python/paddle/fluid/tests/book/test_image_classification.py) scaled to the
+ResNet-50 ImageNet benchmark config in BASELINE.md. TPU notes:
+  * NCHW layout at the API (fluid parity); XLA re-layouts for the MXU.
+  * conv + batch_norm pairs fuse in XLA (the reference needed
+    conv_bn_fuse_pass, ir/conv_bn_fuse_pass.cc — here it is free).
+"""
+
+from __future__ import annotations
+
+from .. import layers
+
+_DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def _conv_bn(x, num_filters, filter_size, stride=1, act=None, is_test=False):
+    conv = layers.conv2d(
+        x,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        stride=stride,
+        padding=(filter_size - 1) // 2,
+        bias_attr=False,
+    )
+    return layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def _shortcut(x, num_filters, stride, is_test):
+    if x.shape[1] != num_filters or stride != 1:
+        return _conv_bn(x, num_filters, 1, stride, is_test=is_test)
+    return x
+
+
+def _basic_block(x, num_filters, stride, is_test):
+    y = _conv_bn(x, num_filters, 3, stride, act="relu", is_test=is_test)
+    y = _conv_bn(y, num_filters, 3, 1, is_test=is_test)
+    short = _shortcut(x, num_filters, stride, is_test)
+    return layers.relu(y + short)
+
+
+def _bottleneck_block(x, num_filters, stride, is_test):
+    y = _conv_bn(x, num_filters, 1, 1, act="relu", is_test=is_test)
+    y = _conv_bn(y, num_filters, 3, stride, act="relu", is_test=is_test)
+    y = _conv_bn(y, num_filters * 4, 1, 1, is_test=is_test)
+    short = _shortcut(x, num_filters * 4, stride, is_test)
+    return layers.relu(y + short)
+
+
+def resnet(image, class_num=1000, depth=50, is_test=False):
+    """Build ResNet; returns logits. image: NCHW float var."""
+    if depth not in _DEPTH_CFG:
+        raise ValueError(f"unsupported depth {depth}; pick {sorted(_DEPTH_CFG)}")
+    block_kind, counts = _DEPTH_CFG[depth]
+    block = _basic_block if block_kind == "basic" else _bottleneck_block
+
+    x = _conv_bn(image, 64, 7, stride=2, act="relu", is_test=is_test)
+    x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1,
+                      pool_type="max")
+    num_filters = [64, 128, 256, 512]
+    for stage, n_blocks in enumerate(counts):
+        for i in range(n_blocks):
+            stride = 2 if i == 0 and stage > 0 else 1
+            x = block(x, num_filters[stage], stride, is_test)
+    x = layers.pool2d(x, global_pooling=True, pool_type="avg")
+    return layers.fc(x, size=class_num)
+
+
+def resnet_train_net(image, label, depth=50, class_num=1000):
+    """logits -> (avg softmax-CE loss, top-1 accuracy)."""
+    logits = resnet(image, class_num=class_num, depth=depth)
+    loss = layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = layers.reduce_mean(loss)
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return avg_loss, acc

@@ -265,7 +265,7 @@ def _dropout(ctx, op, ins):
     if op.attr("is_test", False) or ctx.is_test or p == 0.0:
         out = x if impl == "upscale_in_train" else x * (1.0 - p)
         return {"Out": [out], "Mask": []}
-    key = ctx.key_for(op.uid)
+    key = ctx.key_for(op.uid, op.type)
     keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
     if impl == "upscale_in_train":
         out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)

@@ -314,6 +314,6 @@ def _dpsgd(ctx, op, ins):
     batch_size = op.attr("batch_size", 16.0)
     norm = jnp.sqrt(jnp.sum(jnp.square(g)))
     g = g * jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-10))
-    noise = sigma * clip * jax.random.normal(ctx.key_for(op.uid), g.shape, g.dtype)
+    noise = sigma * clip * jax.random.normal(ctx.key_for(op.uid, op.type), g.shape, g.dtype)
     update = (g + noise) / batch_size
     return {"ParamOut": [(p - _lr(ins) * update).astype(p.dtype)]}

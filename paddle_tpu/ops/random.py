@@ -28,7 +28,7 @@ def _key(ctx, op):
     seed = op.attr("seed", 0)
     if seed:
         return jax.random.key(seed + op.uid)
-    return ctx.key_for(op.uid)
+    return ctx.key_for(op.uid, op.type)
 
 
 @register_op("gaussian_random", inputs=[], outputs=["Out"], differentiable=False)

@@ -66,19 +66,48 @@ def wrap_shard_map(
     return fn
 
 
+def wrap_gspmd(
+    traced, program, mesh, state_ro, state_mut, write_back, fetch_names
+):
+    """GSPMD mode: no explicit collectives, no shard_map. Inputs are committed
+    to the mesh per their annotations; jax.jit + the XLA SPMD partitioner
+    propagate shardings through the whole block and insert ICI collectives
+    where the dataflow demands them (e.g. the psum after a row-parallel
+    matmul in tensor parallelism). This is the design the reference could
+    never reach with NCCL op handles: sharding is declared, not programmed.
+    """
+
+    jitted = jax.jit(traced, donate_argnums=(1,))
+
+    def put(k, v):
+        return jax.device_put(v, NamedSharding(mesh, spec_for(program, k)))
+
+    def fn(feeds, smut, sro, step_key):
+        feeds = {k: put(k, v) for k, v in feeds.items()}
+        smut = {k: put(k, v) for k, v in smut.items()}
+        sro = {k: put(k, v) for k, v in sro.items()}
+        return jitted(feeds, smut, sro, step_key)
+
+    return fn
+
+
 def device_put_sharded(x, mesh, pspec):
     """Commit a host array onto the mesh with the given PartitionSpec."""
     return jax.device_put(x, NamedSharding(mesh, pspec))
 
 
-def shard_program(program, mesh, shardings=None):
+def shard_program(program, mesh, shardings=None, mode="shard_map"):
     """Attach a mesh + sharding annotations to a Program (SPMD mode switch).
 
     shardings: {var_name: tuple_of_axis_names_per_dim}. E.g. a data-parallel
     feed image of rank 4 -> {"image": ("dp", None, None, None)} (in practice
     only leading axes need naming: ("dp",) suffices as a prefix spec).
+
+    mode: "shard_map" (explicit collective ops, fleet/transpiled programs) or
+    "gspmd" (annotation-only, XLA-propagated — use for tensor parallelism).
     """
     program._mesh = mesh
+    program._spmd_mode = mode
     if shardings:
         program._sharding.update(
             {k: tuple(v) for k, v in shardings.items()}
