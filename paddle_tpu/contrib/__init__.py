@@ -1,0 +1,3 @@
+"""contrib: mixed precision (AMP), quantization-aware training (slim), etc."""
+
+from . import mixed_precision  # noqa: F401

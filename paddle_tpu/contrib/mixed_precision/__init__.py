@@ -1,0 +1,3 @@
+from .decorator import OptimizerWithMixedPrecision, decorate  # noqa: F401
+from .fp16_lists import AutoMixedPrecisionLists  # noqa: F401
+from .fp16_utils import rewrite_program  # noqa: F401

@@ -1,0 +1,176 @@
+"""AMP optimizer decorator (reference: contrib/mixed_precision/decorator.py:
+decorate :218, OptimizerWithMixedPrecision :27).
+
+Pipeline (matching the reference's): rewrite forward program with casts →
+scale loss → backward → check grads finite → unscale/zero grads → update
+the dynamic loss scale → inner optimizer update.
+
+TPU default is bfloat16 compute where loss scaling is unnecessary (same
+exponent range as fp32); pass dest_dtype="float16" + dynamic scaling for
+strict reference parity.
+"""
+
+from __future__ import annotations
+
+from ...framework import unique_name
+from ...framework.program import default_startup_program, program_guard
+from ...initializer import Constant
+from .fp16_lists import AutoMixedPrecisionLists
+from .fp16_utils import rewrite_program
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(
+        self,
+        optimizer,
+        amp_lists=None,
+        init_loss_scaling=2.0**15,
+        use_dynamic_loss_scaling=True,
+        incr_every_n_steps=1000,
+        decr_every_n_nan_or_inf=2,
+        incr_ratio=2.0,
+        decr_ratio=0.5,
+        dest_dtype="bfloat16",
+    ):
+        self._inner = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._init_loss_scaling = init_loss_scaling
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._dest_dtype = dest_dtype
+        self._loss_scaling = None
+
+    def _make_state(self, main, startup):
+        blk, sblk = main.global_block, startup.global_block
+
+        def persist(name, shape, dtype, value):
+            v = blk.create_parameter(name, shape, dtype, trainable=False)
+            v.stop_gradient = True
+            sblk.create_parameter(name, shape, dtype, trainable=False)
+            Constant(value)(sblk, name, shape, dtype)
+            return v
+
+        self._loss_scaling = persist(
+            unique_name.generate("loss_scaling"), [1], "float32",
+            self._init_loss_scaling,
+        )
+        if self._use_dynamic:
+            self._good_steps = persist(
+                unique_name.generate("good_steps"), [1], "int32", 0
+            )
+            self._bad_steps = persist(
+                unique_name.generate("bad_steps"), [1], "int32", 0
+            )
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        main = loss.block.program
+        startup = startup_program or default_startup_program()
+        rewrite_program(main, self._amp_lists, self._dest_dtype)
+        with program_guard(main, startup):
+            self._make_state(main, startup)
+            scaled = loss * self._loss_scaling
+            params_grads = self._inner.backward(
+                scaled, startup, parameter_list, no_grad_set
+            )
+            blk = main.global_block
+            gnames = [g.name for _, g in params_grads]
+            found = blk.create_var(
+                name=unique_name.generate("found_inf"), shape=(1,), dtype="bool"
+            )
+            # FoundInfinite only; the unscale outputs land in fresh names the
+            # update op ignores (update_loss_scaling unscales X itself)
+            blk.append_op(
+                "check_finite_and_unscale",
+                {"X": gnames, "Scale": [self._loss_scaling.name]},
+                {
+                    "Out": [
+                        blk.create_var(
+                            name=unique_name.generate(n + "@UNS")
+                        ).name
+                        for n in gnames
+                    ],
+                    "FoundInfinite": [found.name],
+                },
+                {},
+            )
+            if self._use_dynamic:
+                blk.append_op(
+                    "update_loss_scaling",
+                    {
+                        "X": gnames,
+                        "FoundInfinite": [found.name],
+                        "PrevLossScaling": [self._loss_scaling.name],
+                        "InGoodSteps": [self._good_steps.name],
+                        "InBadSteps": [self._bad_steps.name],
+                    },
+                    {
+                        "Out": gnames,
+                        "LossScaling": [self._loss_scaling.name],
+                        "OutGoodSteps": [self._good_steps.name],
+                        "OutBadSteps": [self._bad_steps.name],
+                    },
+                    {
+                        "incr_every_n_steps": self._incr_every,
+                        "decr_every_n_nan_or_inf": self._decr_every,
+                        "incr_ratio": self._incr_ratio,
+                        "decr_ratio": self._decr_ratio,
+                    },
+                )
+            else:
+                # static scale: plain unscale (zeroing on overflow included)
+                for n in gnames:
+                    blk.append_op(
+                        "scale",
+                        {"X": [n]},
+                        {"Out": [n]},
+                        {"scale": 1.0 / self._init_loss_scaling, "bias": 0.0},
+                    )
+        return params_grads
+
+    def apply_gradients(self, params_grads):
+        return self._inner.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        main = loss.block.program
+        with program_guard(main, startup_program or default_startup_program()):
+            params_grads = self.backward(
+                loss, startup_program, parameter_list, no_grad_set
+            )
+            ops = self.apply_gradients(params_grads)
+        return ops, params_grads
+
+
+def decorate(
+    optimizer,
+    amp_lists=None,
+    init_loss_scaling=2.0**15,
+    use_dynamic_loss_scaling=True,
+    incr_every_n_steps=1000,
+    decr_every_n_nan_or_inf=2,
+    incr_ratio=2.0,
+    decr_ratio=0.5,
+    dest_dtype="bfloat16",
+):
+    """fluid.contrib.mixed_precision.decorate parity (decorator.py:218)."""
+    return OptimizerWithMixedPrecision(
+        optimizer,
+        amp_lists=amp_lists,
+        init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        incr_ratio=incr_ratio,
+        decr_ratio=decr_ratio,
+        dest_dtype=dest_dtype,
+    )

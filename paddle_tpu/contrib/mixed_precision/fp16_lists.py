@@ -1,0 +1,50 @@
+"""AMP op lists (reference: contrib/mixed_precision/fp16_lists.py:28-39).
+
+white: compute in the low-precision dtype (MXU-bound ops — matmuls/convs).
+black: always compute in fp32 (reductions/losses/normalizations, where
+low-precision accumulation visibly hurts).
+gray (everything else): follow their inputs.
+
+On TPU the default low dtype is bfloat16 — same exponent range as fp32, so
+(unlike the reference's fp16 CUDA path) loss scaling is optional.
+"""
+
+WHITE_LIST = {
+    "matmul",
+    "mul",
+    "bmm",
+    "conv2d",
+    "depthwise_conv2d",
+    "conv2d_transpose",
+}
+
+BLACK_LIST = {
+    "softmax_with_cross_entropy",
+    "cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "mean",
+    "reduce_mean",
+    "reduce_sum",
+    "sum",
+    "exp",
+    "log",
+    "square",
+    "layer_norm",
+    "batch_norm",
+    "group_norm",
+    "instance_norm",
+    "softmax",
+    "log_softmax",
+}
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)

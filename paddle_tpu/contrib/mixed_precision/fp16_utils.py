@@ -1,0 +1,72 @@
+"""Program rewriting for AMP (reference: fp16_utils.py rewrite_program).
+
+Walks the forward ops and inserts `cast` ops so white-list ops consume the
+low-precision dtype and black-list ops consume fp32. Parameters stay fp32
+masters in the Scope; the per-use casts are fused into the consuming matmul
+by XLA (on TPU a bf16 cast is free on the MXU path). Must run BEFORE
+append_backward so the casts get differentiated (grad of cast casts back).
+"""
+
+from __future__ import annotations
+
+from ...core.dtypes import is_float
+from ...framework import unique_name
+from .fp16_lists import AutoMixedPrecisionLists
+
+
+def _insert_cast(block, op_idx, op, name, dest_dtype, force=False):
+    """Insert cast(name)->new var before op_idx; rewire op's input.
+
+    force=True casts even when the var's *declared* dtype already matches:
+    after white-op rewriting, declared dtypes can lag the runtime dtype, so
+    black-list fp32 casts are emitted unconditionally (a f32->f32 cast is
+    free in XLA)."""
+    src = block._find_var_recursive(name)
+    if src is None or not is_float(src.dtype):
+        return 0
+    if src.dtype == dest_dtype and not force:
+        return 0
+    cast_name = unique_name.generate(f"{name}.cast_{dest_dtype}")
+    block.create_var(
+        name=cast_name, shape=src.shape, dtype=dest_dtype,
+        stop_gradient=src.stop_gradient,
+    )
+    block.append_op(
+        "cast",
+        {"X": [name]},
+        {"Out": [cast_name]},
+        {"in_dtype": src.dtype, "out_dtype": dest_dtype},
+        index=op_idx,
+    )
+    for slot, names in op.inputs.items():
+        op.inputs[slot] = [cast_name if n == name else n for n in names]
+    return 1
+
+
+def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
+    """Insert casts per black/white lists into the (forward-only) program."""
+    amp_lists = amp_lists or AutoMixedPrecisionLists()
+    block = program.global_block
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type in amp_lists.white_list:
+            target, force = dest_dtype, False
+        elif op.type in amp_lists.black_list:
+            target, force = "float32", True
+        else:
+            i += 1
+            continue
+        inserted = 0
+        for name in list(dict.fromkeys(op.input_names())):
+            inserted += _insert_cast(block, i, op, name, target, force)
+        if target == dest_dtype:
+            # declared output dtypes follow the compute dtype so later
+            # white-op cast checks see the truth
+            for n in op.output_names():
+                v = block._find_var_recursive(n)
+                if v is not None and is_float(v.dtype):
+                    v.dtype = dest_dtype
+        i += 1 + inserted
+    program._bump()
+    return program

@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import contextlib
 import copy
-import itertools
 
 import numpy as np
 
@@ -241,10 +240,12 @@ class Program:
         # inserts collectives by propagation (the TP/auto path).
         self._spmd_mode = "shard_map"
         self._pipeline = None  # set by PipelineOptimizer
-        self._op_uid = itertools.count()
+        self._op_uid = 0
 
     def _next_uid(self):
-        return next(self._op_uid)
+        uid = self._op_uid
+        self._op_uid += 1
+        return uid
 
     def _bump(self):
         self._version += 1
@@ -275,6 +276,13 @@ class Program:
     def list_vars(self):
         for b in self.blocks:
             yield from b.vars.values()
+
+    def __getstate__(self):
+        # the Mesh holds live device handles — never serialized; a loaded
+        # Program is re-attached to a mesh by the caller (shard_program)
+        state = self.__dict__.copy()
+        state["_mesh"] = None
+        return state
 
     def clone(self, for_test=False):
         """Deep copy. for_test=True flips is_test on ops that honor it

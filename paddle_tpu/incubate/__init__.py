@@ -1,0 +1,4 @@
+"""incubate: meta-optimizers and experimental features (reference
+incubate/: fleet lives at paddle_tpu.fleet; recompute here)."""
+
+from .recompute import RecomputeOptimizer, apply_recompute  # noqa: F401

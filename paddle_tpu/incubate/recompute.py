@@ -1,0 +1,170 @@
+"""Recompute / activation checkpointing (reference: RecomputeOptimizer
+optimizer.py:3858 + _append_backward_ops_with_checkpoints_ backward.py:629).
+
+TPU-native design: instead of re-emitting forward ops into the backward
+program (the reference re-runs segments between user checkpoints), the ops
+of each segment are folded into ONE `recompute_segment` op whose emitter
+runs them under `jax.checkpoint` (rematerialization). jax.vjp of a
+checkpointed function saves only the segment inputs and recomputes the
+segment in the backward pass — XLA schedules the recompute right where the
+reference's re-inserted ops would run, but with compiler-chosen overlap.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..framework.program import default_startup_program, program_guard
+from ..framework.registry import OpView, get_op_def, register_op
+
+
+@register_op("recompute_segment", inputs=["X"], outputs=["Out"])
+def _recompute_segment(ctx, op, ins):
+    sub_ops = op.attr("sub_ops")  # [(type, inputs, outputs, attrs), ...]
+    in_names = op.attr("in_names")
+    out_names = op.attr("out_names")
+
+    def seg(*vals):
+        env = dict(zip(in_names, vals))
+        for op_type, op_ins, op_outs, op_attrs in sub_ops:
+            view = OpView(op_type, op_attrs, op_ins, op_outs)
+            sub_def = get_op_def(op_type)
+            sin = {
+                slot: [env[n] if n else None for n in names]
+                for slot, names in op_ins.items()
+            }
+            souts = sub_def.emit(ctx, view, sin)
+            for slot, names in op_outs.items():
+                vals_ = souts.get(slot, [])
+                for n, v in zip(names, vals_):
+                    if n and v is not None:
+                        env[n] = v
+        return tuple(env[n] for n in out_names)
+
+    outs = jax.checkpoint(seg)(*ins["X"])
+    return {"Out": list(outs)}
+
+
+def _segment_io(ops, block, later_reads):
+    """External inputs & outputs of an op-span."""
+    produced, read = set(), []
+    read_seen = set()
+    for op in ops:
+        for n in op.input_names():
+            if n and n not in produced and n not in read_seen:
+                read.append(n)
+                read_seen.add(n)
+        produced.update(n for n in op.output_names() if n)
+    outs = []
+    for op in ops:
+        for n in op.output_names():
+            if not n or n in outs:
+                continue
+            v = block._find_var_recursive(n)
+            if n in later_reads or (v is not None and v.persistable):
+                outs.append(n)
+    return read, outs
+
+
+def apply_recompute(program, checkpoint_names):
+    """Fold ops between consecutive checkpoints into recompute_segment ops.
+
+    checkpoint_names: ordered var names marking segment boundaries. Ops up to
+    the producer of checkpoint[0] form segment 1, ... The tail after the last
+    checkpoint stays as-is (its activations feed backward immediately —
+    reference behavior)."""
+    block = program.global_block
+    ops = list(block.ops)
+    # index just past the producer of each checkpoint
+    bounds = []
+    for cname in checkpoint_names:
+        pos = None
+        for i, op in enumerate(ops):
+            if cname in op.output_names():
+                pos = i + 1
+        if pos is not None:
+            bounds.append(pos)
+    bounds = sorted(set(bounds))
+    if not bounds:
+        return program
+
+    # reads occurring after a position (for segment output computation)
+    segments = []
+    start = 0
+    for b in bounds:
+        if b - start >= 2:  # folding a single op gains nothing
+            segments.append((start, b))
+        start = b
+
+    new_ops = []
+    cursor = 0
+    for start, end in segments:
+        new_ops.extend(ops[cursor:start])
+        span = ops[start:end]
+        later_reads = set()
+        for op in ops[end:]:
+            later_reads.update(op.input_names())
+        in_names, out_names = _segment_io(span, block, later_reads)
+        sub = [
+            (o.type, {k: list(v) for k, v in o.inputs.items()},
+             {k: list(v) for k, v in o.outputs.items()}, dict(o.attrs))
+            for o in span
+        ]
+        from ..framework.program import Operator
+
+        seg_op = Operator(
+            block,
+            "recompute_segment",
+            {"X": in_names},
+            {"Out": out_names},
+            {
+                "sub_ops": sub,
+                "in_names": list(in_names),
+                "out_names": list(out_names),
+            },
+        )
+        new_ops.append(seg_op)
+        cursor = end
+    new_ops.extend(ops[cursor:])
+    block.ops = new_ops
+    program._bump()
+    return program
+
+
+class RecomputeOptimizer:
+    """Wrap any optimizer; checkpoints set via _set_checkpoints (reference
+    API shape, optimizer.py:3858)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        self._checkpoints = []
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = [
+            c.name if hasattr(c, "name") else str(c) for c in (checkpoints or [])
+        ]
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        main = loss.block.program
+        if self._checkpoints:
+            apply_recompute(main, self._checkpoints)
+        return self._inner.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+
+    def apply_gradients(self, params_grads):
+        return self._inner.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        main = loss.block.program
+        with program_guard(main, startup_program or default_startup_program()):
+            params_grads = self.backward(
+                loss, startup_program, parameter_list, no_grad_set
+            )
+            ops = self.apply_gradients(params_grads)
+        return ops, params_grads

@@ -1,0 +1,194 @@
+"""Model persistence (reference: python/paddle/fluid/io.py — save_params
+:372, save_persistables :597, load_persistables :902, save_inference_model
+:1093, load_inference_model :1303, unified fluid.save/load :1598/:1662).
+
+TPU-native storage: parameters are jax Arrays in the Scope; serialization is
+one .npz per directory (save_params/persistables) or a single pickled
+payload (save/load), fetched through a single host sync. The reference runs
+generated save/load *ops* through the Executor; here persistence is pure
+host-side IO — there is nothing device-specific about a checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .framework.program import Parameter, Program, default_main_program
+from .framework.scope import global_scope
+
+__all__ = [
+    "save_params",
+    "save_persistables",
+    "load_params",
+    "load_persistables",
+    "save",
+    "load",
+    "save_inference_model",
+    "load_inference_model",
+    "prune",
+]
+
+
+def _collect(program, scope, predicate):
+    out = {}
+    for var in program.list_vars():
+        if not predicate(var):
+            continue
+        val = scope.find_var(var.name)
+        if val is not None:
+            out[var.name] = np.asarray(val)
+    return out
+
+
+def _is_persistable(v):
+    return bool(getattr(v, "persistable", False)) and not getattr(v, "is_data", False)
+
+
+def _is_parameter(v):
+    return isinstance(v, Parameter)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    _save_vars(dirname, main_program, _is_parameter, filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    _save_vars(dirname, main_program, _is_persistable, filename)
+
+
+def _save_vars(dirname, main_program, predicate, filename):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    arrays = _collect(program, scope, predicate)
+    os.makedirs(dirname, exist_ok=True)
+    np.savez(os.path.join(dirname, filename or "__params__.npz"), **arrays)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    _load_vars(dirname, main_program, filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    _load_vars(dirname, main_program, filename)
+
+
+def _load_vars(dirname, main_program, filename):
+    import jax.numpy as jnp
+
+    scope = global_scope()
+    path = os.path.join(dirname, filename or "__params__.npz")
+    with np.load(path, allow_pickle=False) as data:
+        for name in data.files:
+            scope.set_var(name, jnp.asarray(data[name]))
+
+
+def save(program, model_path):
+    """fluid.save parity (io.py:1598): one combined file with params +
+    optimizer state (all persistables), plus the serialized program."""
+    scope = global_scope()
+    payload = {
+        "params": _collect(program, scope, _is_parameter),
+        "opt": _collect(
+            program, scope, lambda v: _is_persistable(v) and not _is_parameter(v)
+        ),
+    }
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    with open(model_path + ".pdmodel", "wb") as f:
+        pickle.dump(program, f, protocol=4)
+
+
+def load(program, model_path, var_list=None):
+    """fluid.load parity (io.py:1662)."""
+    import jax.numpy as jnp
+
+    scope = global_scope()
+    with open(model_path + ".pdparams", "rb") as f:
+        payload = pickle.load(f)
+    wanted = {v.name for v in var_list} if var_list else None
+    for group in ("params", "opt"):
+        for name, arr in payload.get(group, {}).items():
+            if wanted is None or name in wanted:
+                scope.set_var(name, jnp.asarray(arr))
+
+
+def prune(program, targets, feeds=()):
+    """Backward-slice the program to ops needed for `targets`
+    (reference framework/prune.cc + Executor prune-by-fetch)."""
+    target_names = {t.name if hasattr(t, "name") else str(t) for t in targets}
+    feed_names = set(feeds)
+    block = program.global_block
+    needed = set(target_names)
+    keep = []
+    for op in reversed(block.ops):
+        if any(n in needed for n in op.output_names()):
+            keep.append(op)
+            needed.update(n for n in op.input_names() if n)
+    keep.reverse()
+
+    pruned = program.clone()
+    pblock = pruned.global_block
+    keep_ids = {id(op) for op in keep}
+    # ops were deep-copied in clone; map by position
+    pblock.ops = [
+        pop
+        for op, pop in zip(block.ops, pblock.ops)
+        if id(op) in keep_ids
+    ]
+    pruned._bump()
+    return pruned
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names,
+    target_vars,
+    executor=None,
+    main_program=None,
+    model_filename=None,
+    params_filename=None,
+):
+    """Prune to the feed→fetch subgraph in test mode and save program+params
+    (reference io.py:1093)."""
+    program = main_program or default_main_program()
+    test_prog = program.clone(for_test=True)
+    # names survive clone, so prune on the cloned program
+    targets = [
+        test_prog.global_block.var(v.name if hasattr(v, "name") else str(v))
+        for v in target_vars
+    ]
+    pruned = prune(test_prog, targets, feeds=feeded_var_names)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "program": pruned,
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [t.name for t in targets],
+    }
+    with open(os.path.join(dirname, model_filename or "__model__"), "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+    scope = global_scope()
+    arrays = _collect(pruned, scope, _is_persistable)
+    np.savez(
+        os.path.join(dirname, params_filename or "__params__.npz"), **arrays
+    )
+    return [t.name for t in targets]
+
+
+def load_inference_model(dirname, executor=None, model_filename=None,
+                         params_filename=None):
+    """Returns (program, feed_names, fetch_names); params land in the global
+    scope (reference io.py:1303)."""
+    import jax.numpy as jnp
+
+    with open(os.path.join(dirname, model_filename or "__model__"), "rb") as f:
+        meta = pickle.load(f)
+    scope = global_scope()
+    path = os.path.join(dirname, params_filename or "__params__.npz")
+    with np.load(path, allow_pickle=False) as data:
+        for name in data.files:
+            scope.set_var(name, jnp.asarray(data[name]))
+    return meta["program"], meta["feed_names"], meta["fetch_names"]

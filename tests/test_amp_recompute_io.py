@@ -1,0 +1,156 @@
+"""AMP, recompute, and io round-trip tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.mixed_precision import decorate
+from paddle_tpu.incubate import RecomputeOptimizer
+from paddle_tpu.optimizer import Adam, SGD
+
+
+def _mlp(img, label, hidden=32):
+    h1 = layers.fc(img, size=hidden, act="relu")
+    h2 = layers.fc(h1, size=hidden, act="relu")
+    pred = layers.fc(h2, size=10)
+    loss = layers.reduce_mean(
+        layers.softmax_with_cross_entropy(pred, label)
+    )
+    return loss, (h1, h2)
+
+
+def _feed(rng, bs=8):
+    return {
+        "img": rng.randn(bs, 16).astype("float32"),
+        "label": rng.randint(0, 10, (bs, 1)).astype("int64"),
+    }
+
+
+def _build(opt_factory, wrap=None):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [-1, 16], "float32")
+        label = fluid.data("label", [-1, 1], "int64")
+        loss, hs = _mlp(img, label)
+        opt = opt_factory()
+        if wrap:
+            opt = wrap(opt, hs)
+        opt.minimize(loss, startup)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, steps=4):
+    exe = fluid.Executor()
+    scope = fluid.framework.scope.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    f = _feed(rng)
+    out = []
+    for _ in range(steps):
+        (lv,) = exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+        out.append(float(np.asarray(lv).reshape(-1)[0]))
+    return out
+
+
+def test_amp_bf16_trains_close_to_fp32():
+    ref = _train(*_build(lambda: SGD(0.1)))
+    amp = _train(
+        *_build(
+            lambda: SGD(0.1),
+            wrap=lambda o, hs: decorate(o, use_dynamic_loss_scaling=False,
+                                        init_loss_scaling=1.0),
+        )
+    )
+    assert amp[-1] < amp[0]
+    np.testing.assert_allclose(ref, amp, rtol=0.1, atol=0.05)  # bf16 tolerance
+
+
+def test_amp_program_has_casts():
+    main, _, _ = _build(
+        lambda: SGD(0.1),
+        wrap=lambda o, hs: decorate(o, use_dynamic_loss_scaling=False),
+    )
+    types = [op.type for op in main.global_block.ops]
+    assert "cast" in types
+
+
+def test_amp_dynamic_loss_scaling_fp16_style():
+    main, startup, loss = _build(
+        lambda: SGD(0.05),
+        wrap=lambda o, hs: decorate(
+            o, init_loss_scaling=2.0**10, use_dynamic_loss_scaling=True,
+            incr_every_n_steps=2, dest_dtype="float32",
+        ),
+    )
+    vals = _train(main, startup, loss, steps=6)
+    assert vals[-1] < vals[0] and np.isfinite(vals).all()
+
+
+def test_recompute_matches_plain_backward():
+    ref = _train(*_build(lambda: SGD(0.1)))
+
+    def wrap(o, hs):
+        r = RecomputeOptimizer(o)
+        r._set_checkpoints(list(hs))
+        return r
+
+    rec = _train(*_build(lambda: SGD(0.1), wrap=wrap))
+    np.testing.assert_allclose(ref, rec, rtol=1e-4, atol=1e-5)
+
+
+def test_recompute_folds_segments():
+    main, _, _ = _build(
+        lambda: SGD(0.1),
+        wrap=lambda o, hs: (
+            lambda r: (r._set_checkpoints(list(hs)), r)[1]
+        )(RecomputeOptimizer(o)),
+    )
+    types = [op.type for op in main.global_block.ops]
+    assert "recompute_segment" in types
+
+
+def test_save_load_roundtrip(tmp_path):
+    main, startup, loss = _build(lambda: Adam(1e-2))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.global_scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        f = _feed(rng)
+        exe.run(main, feed=f, fetch_list=[loss])
+        path = str(tmp_path / "model")
+        fluid.io.save(main, path)
+        (before,) = exe.run(main, feed=f, fetch_list=[loss])
+        # clobber params, reload, expect same loss
+        for p in main.all_parameters():
+            fluid.global_scope().set_var(
+                p.name, np.zeros([int(s) for s in p.shape], "float32")
+            )
+        fluid.io.load(main, path)
+        (after,) = exe.run(main, feed=f, fetch_list=[loss])
+    np.testing.assert_allclose(
+        np.asarray(before), np.asarray(after), rtol=1e-5
+    )
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [-1, 16], "float32")
+        label = fluid.data("label", [-1, 1], "int64")
+        loss, _ = _mlp(img, label)  # forward-only: params must not mutate
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.global_scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        f = _feed(rng)
+        (ref,) = exe.run(main, feed=f, fetch_list=[loss])
+        d = str(tmp_path / "infer")
+        fluid.io.save_inference_model(d, ["img", "label"], [loss], exe, main)
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        (out,) = exe.run(prog, feed=f, fetch_list=fetches)
+    types = [op.type for op in prog.global_block.ops]
+    assert "__vjp__" not in types
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-5)
