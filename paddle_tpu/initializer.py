@@ -13,9 +13,20 @@ import math
 import numpy as np
 
 
+_np_rng = np.random.RandomState(90210)
+
+
 class Initializer:
     def __call__(self, block, name, shape, dtype):
         raise NotImplementedError
+
+    def numpy_init(self, shape, dtype):
+        """Eager-mode init: materialize the value host-side (dygraph Layers
+        create parameters immediately instead of emitting startup ops)."""
+        raise NotImplementedError
+
+    def _rng(self):
+        return np.random.RandomState(self.seed) if getattr(self, "seed", 0) else _np_rng
 
 
 class Constant(Initializer):
@@ -29,6 +40,9 @@ class Constant(Initializer):
             {"Out": [name]},
             {"shape": list(shape), "dtype": dtype, "value": float(self.value)},
         )
+
+    def numpy_init(self, shape, dtype):
+        return np.full(shape, self.value, dtype=dtype)
 
 
 class Normal(Initializer):
@@ -49,6 +63,9 @@ class Normal(Initializer):
             },
         )
 
+    def numpy_init(self, shape, dtype):
+        return self._rng().normal(self.loc, self.scale, shape).astype(dtype)
+
 
 class TruncatedNormal(Initializer):
     def __init__(self, loc=0.0, scale=1.0, seed=0):
@@ -68,6 +85,16 @@ class TruncatedNormal(Initializer):
             },
         )
 
+    def numpy_init(self, shape, dtype):
+        r = self._rng()
+        vals = r.normal(self.loc, self.scale, shape)
+        lo, hi = self.loc - 2 * self.scale, self.loc + 2 * self.scale
+        bad = (vals < lo) | (vals > hi)
+        while bad.any():
+            vals[bad] = r.normal(self.loc, self.scale, bad.sum())
+            bad = (vals < lo) | (vals > hi)
+        return vals.astype(dtype)
+
 
 class Uniform(Initializer):
     def __init__(self, low=-1.0, high=1.0, seed=0):
@@ -86,6 +113,9 @@ class Uniform(Initializer):
                 "seed": self.seed,
             },
         )
+
+    def numpy_init(self, shape, dtype):
+        return self._rng().uniform(self.low, self.high, shape).astype(dtype)
 
 
 def _fans(shape):
@@ -115,6 +145,17 @@ class Xavier(Initializer):
             std = math.sqrt(2.0 / (fi + fo))
             Normal(0.0, std, self.seed)(block, name, shape, dtype)
 
+    def numpy_init(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return Uniform(-limit, limit, self.seed).numpy_init(shape, dtype)
+        return Normal(0.0, math.sqrt(2.0 / (fi + fo)), self.seed).numpy_init(
+            shape, dtype
+        )
+
 
 class MSRA(Initializer):
     def __init__(self, uniform=True, fan_in=None, seed=0):
@@ -129,6 +170,16 @@ class MSRA(Initializer):
         else:
             std = math.sqrt(2.0 / fi)
             Normal(0.0, std, self.seed)(block, name, shape, dtype)
+
+    def numpy_init(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return Uniform(-limit, limit, self.seed).numpy_init(shape, dtype)
+        return Normal(0.0, math.sqrt(2.0 / fi), self.seed).numpy_init(
+            shape, dtype
+        )
 
 
 class NumpyArrayInitializer(Initializer):
@@ -146,6 +197,9 @@ class NumpyArrayInitializer(Initializer):
                 "values": self.value.reshape(-1).tolist(),
             },
         )
+
+    def numpy_init(self, shape, dtype):
+        return self.value.astype(dtype)
 
 
 ConstantInitializer = Constant

@@ -49,6 +49,8 @@ def reduce_axes(attrs, ndim):
     if attrs.get("reduce_all", False):
         return None
     dim = attrs.get("dim", [0])
+    if dim is None:
+        return None
     if isinstance(dim, int):
         dim = [dim]
     return tuple(d % ndim if ndim else 0 for d in dim)

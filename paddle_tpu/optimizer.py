@@ -122,6 +122,13 @@ class Optimizer:
     def minimize(
         self, loss, startup_program=None, parameter_list=None, no_grad_set=None
     ):
+        from .dygraph.varbase import VarBase
+
+        if isinstance(loss, VarBase):
+            # eager mode: loss.backward() has populated param._grad; apply
+            # updates in place (reference dygraph minimize semantics)
+            return self._eager_minimize(parameter_list), []
+
         # ops must land in the loss's program even if minimize() is called
         # outside its program_guard (fluid wraps minimize the same way)
         from .framework.program import program_guard
@@ -134,6 +141,44 @@ class Optimizer:
             )
             ops = self.apply_gradients(params_grads)
         return ops, params_grads
+
+    # -- eager (dygraph) path ---------------------------------------------
+    def _eager_lr(self):
+        lr = self._learning_rate
+        return float(lr() if callable(lr) else lr)
+
+    def _eager_acc(self, name, p, fill=0.0, shape=None):
+        import jax.numpy as jnp
+
+        key = (name, p.name)
+        store = self.__dict__.setdefault("_eager_accs", {})
+        if key not in store:
+            shp = list(shape if shape is not None else p.shape)
+            store[key] = jnp.full(shp, fill, dtype=jnp.float32)
+        return store[key]
+
+    def _set_eager_acc(self, name, p, value):
+        self._eager_accs[(name, p.name)] = value
+
+    def _eager_minimize(self, parameter_list=None):
+        params = parameter_list or self._parameter_list or []
+        updated = []
+        for p in params:
+            if not getattr(p, "trainable", True) or p._grad is None:
+                continue
+            g = p._grad
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is not None and getattr(reg, "_coeff", 0.0):
+                g = g + reg._coeff * p.value
+            self._eager_update(p, g)
+            updated.append(p)
+        return updated
+
+    def _eager_update(self, p, g):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no eager-mode update yet; "
+            "use the static-graph path"
+        )
 
     # -- per-optimizer hooks ----------------------------------------------
     def _create_accumulators(self, block, parameters):
@@ -153,6 +198,9 @@ class SGDOptimizer(Optimizer):
             {"ParamOut": [p.name]},
             {},
         )
+
+    def _eager_update(self, p, g):
+        p.set_value(p.value - self._eager_lr() * g)
 
 
 class MomentumOptimizer(Optimizer):
@@ -180,6 +228,16 @@ class MomentumOptimizer(Optimizer):
             {"ParamOut": [p.name], "VelocityOut": [v.name]},
             {"mu": self._momentum, "use_nesterov": self._use_nesterov},
         )
+
+    def _eager_update(self, p, g):
+        lr = self._eager_lr()
+        v = self._eager_acc("velocity", p)
+        v_new = self._momentum * v + g
+        if self._use_nesterov:
+            p.set_value(p.value - lr * (g + self._momentum * v_new))
+        else:
+            p.set_value(p.value - lr * v_new)
+        self._set_eager_acc("velocity", p, v_new)
 
 
 class LarsMomentumOptimizer(Optimizer):
@@ -272,6 +330,33 @@ class _AdamBase(Optimizer):
 
 class AdamOptimizer(_AdamBase):
     op_type = "adam"
+
+
+def _adam_eager(opt, p, g, weight_decay=0.0):
+    import jax.numpy as jnp
+
+    lr = opt._eager_lr()
+    b1, b2, eps = opt._beta1, opt._beta2, opt._epsilon
+    m1 = opt._eager_acc("moment1", p)
+    m2 = opt._eager_acc("moment2", p)
+    b1p = opt._eager_acc("beta1_pow", p, opt._beta1, shape=[1])
+    b2p = opt._eager_acc("beta2_pow", p, opt._beta2, shape=[1])
+    m1 = b1 * m1 + (1 - b1) * g
+    m2 = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    upd = lr_t * m1 / (jnp.sqrt(m2) + eps)
+    if weight_decay:
+        upd = upd + lr * weight_decay * p.value
+    p.set_value(p.value - upd.reshape(p.value.shape))
+    opt._set_eager_acc("moment1", p, m1)
+    opt._set_eager_acc("moment2", p, m2)
+    opt._set_eager_acc("beta1_pow", p, b1p * b1)
+    opt._set_eager_acc("beta2_pow", p, b2p * b2)
+
+
+_AdamBase._eager_update = lambda self, p, g: _adam_eager(
+    self, p, g, getattr(self, "_weight_decay", 0.0)
+)
 
 
 class AdamWOptimizer(_AdamBase):
