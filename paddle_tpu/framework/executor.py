@@ -169,7 +169,11 @@ class Executor:
             env.update(sro)
             env.update(smut)
             env.update(feeds)
-            ctx = EmitContext(step_key=step_key, is_test=False, mesh_axes=mesh_axes)
+            axis_sizes = dict(mesh.shape) if mesh is not None else {}
+            ctx = EmitContext(
+                step_key=step_key, is_test=False, mesh_axes=mesh_axes,
+                axis_sizes=axis_sizes,
+            )
             for op in ops:
                 try:
                     run_op(ctx, op, env)

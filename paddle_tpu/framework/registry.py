@@ -47,11 +47,14 @@ class EmitContext:
 
     def __init__(
         self, step_key=None, is_test=False, mesh_axes=(), scope=None,
-        abstract=False,
+        abstract=False, axis_sizes=None,
     ):
         self.step_key = step_key
         self.is_test = is_test
         self.mesh_axes = tuple(mesh_axes)  # axis names visible inside shard_map
+        # static axis sizes {name: size} (ring collectives need the step
+        # count at trace time; mesh topology is static under SPMD)
+        self.axis_sizes = dict(axis_sizes or {})
         self.scope = scope
         # True only during infer_shapes' eval_shape pass: emitters may then
         # substitute BATCH_SENTINEL for -1 dims; at run time -1 is an error

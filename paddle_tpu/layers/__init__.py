@@ -3,6 +3,12 @@ python/paddle/fluid/layers/ — 35k LoC across nn.py, tensor.py, loss.py...)."""
 
 from .nn import *  # noqa: F401,F403
 from .nn import _apply_act  # noqa: F401
+from .attention import (  # noqa: F401
+    moe_ffn,
+    moe_shardings,
+    ring_attention,
+    ulysses_attention,
+)
 from .tensor import *  # noqa: F401,F403
 from .tensor import (  # noqa: F401  (generated attrs need explicit export)
     elementwise_add,

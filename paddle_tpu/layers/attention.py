@@ -1,0 +1,85 @@
+"""Attention + MoE layer wrappers (sequence-parallel/expert-parallel aware).
+
+No reference equivalent (SURVEY.md §5: long-context parallelism absent
+upstream) — these are the user-facing entry points for the SP/CP/EP
+machinery in paddle_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+from ..framework import unique_name
+from ..initializer import Normal
+from .helper import LayerHelper
+
+
+def _attn(op_type, q, k, v, axis_name, causal, scale, name):
+    helper = LayerHelper(op_type, name=name)
+    return helper.create_and_append(
+        {"Q": [q], "K": [k], "V": [v]},
+        {"axis_name": axis_name, "causal": causal, "scale": scale},
+    )
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
+                   name=None):
+    """q,k,v: [B, H, S, D] with S sharded over `axis_name` under SPMD."""
+    return _attn("ring_attention", q, k, v, axis_name, causal, scale, name)
+
+
+def ulysses_attention(q, k, v, axis_name="sp", causal=False, scale=None,
+                      name=None):
+    return _attn("ulysses_attention", q, k, v, axis_name, causal, scale, name)
+
+
+def moe_ffn(
+    x,
+    num_experts,
+    hidden_dim,
+    axis_name="ep",
+    capacity_factor=2.0,
+    param_attr_prefix=None,
+    name=None,
+):
+    """Top-2 gated expert FFN over x [B,S,H]. Returns (out, aux_loss).
+
+    Expert weights are created FULL-SIZE ([E, H, F]); annotate them over the
+    "ep" axis (program._sharding[w1] = ("ep", None, None)) to shard. The
+    helper `moe_shardings` below returns those annotations."""
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("moe_ffn", name=name)
+    h = x.shape[-1]
+    prefix = param_attr_prefix or unique_name.generate("moe")
+    mk = lambda nm, shape, init_std: helper.create_parameter(  # noqa: E731
+        ParamAttr(name=f"{prefix}_{nm}", initializer=Normal(0.0, init_std)),
+        list(shape),
+        x.dtype,
+    )
+    gate_w = mk("gate_w", [h, num_experts], 0.02)
+    w1 = mk("w1", [num_experts, h, hidden_dim], 0.02)
+    b1 = mk("b1", [num_experts, hidden_dim], 0.0)
+    w2 = mk("w2", [num_experts, hidden_dim, h], 0.02)
+    b2 = mk("b2", [num_experts, h], 0.0)
+    out, aux = helper.create_and_append(
+        {
+            "X": [x],
+            "GateW": [gate_w],
+            "W1": [w1],
+            "B1": [b1],
+            "W2": [w2],
+            "B2": [b2],
+        },
+        {"axis_name": axis_name, "capacity_factor": capacity_factor},
+        out_slots=("Out", "AuxLoss"),
+    )
+    return out, aux
+
+
+def moe_shardings(prefix, axis="ep"):
+    """GSPMD/shard_map annotations for a moe_ffn's expert weights."""
+    return {
+        f"{prefix}_w1": (axis, None, None),
+        f"{prefix}_b1": (axis, None),
+        f"{prefix}_w2": (axis, None, None),
+        f"{prefix}_b2": (axis, None),
+    }

@@ -18,4 +18,8 @@ from . import (  # noqa: F401
     tensor_ops,
 )
 
+# parallelism ops live beside their collectives implementation
+from ..parallel import moe as _moe_ops  # noqa: F401,E402
+from ..parallel import ring_attention as _ring_ops  # noqa: F401,E402
+
 from ..framework.registry import registered_ops  # noqa: F401

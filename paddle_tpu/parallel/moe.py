@@ -1,0 +1,126 @@
+"""Mixture-of-Experts with expert parallelism (EP) — GShard-style.
+
+Absent from the reference (SURVEY.md §2.3: "no MoE ops"); designed
+TPU-first: experts shard over the "ep" mesh axis, token dispatch/return are
+two lax.all_to_all exchanges over ICI, expert FFNs run as one batched
+einsum on the MXU. Top-2 gating with capacity dropping + the standard
+load-balancing auxiliary loss (mean(fraction * prob) * E).
+
+Without a mesh (or no "ep" axis) the same math runs dense on one chip —
+the dispatch einsums are identical, only the all_to_alls drop out.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+def _top2_dispatch(gates, capacity):
+    """gates [T, E] softmax-ed. Returns dispatch [T, E, C] (0/1), combine
+    [T, E, C] (weights), aux load-balance loss (scalar)."""
+    t, e = gates.shape
+    c = int(capacity)
+
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=gates.dtype)  # [T,E]
+    gates2 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=gates.dtype)
+
+    # aux loss on first-choice assignment (GShard eq. 4)
+    density = mask1.mean(axis=0)  # fraction of tokens per expert
+    density_proxy = gates.mean(axis=0)  # mean router prob per expert
+    aux = (density * density_proxy).sum() * e
+
+    # position of each token within its expert's capacity buffer
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1  # [T,E]
+    keep1 = mask1 * (pos1 < c)
+    # second choices queue behind ALL first choices of that expert
+    count1 = mask1.sum(axis=0, keepdims=True)
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + count1
+    keep2 = mask2 * (pos2 < c)
+
+    g1 = (gates * keep1).sum(axis=-1, keepdims=True)
+    g2 = (gates * keep2).sum(axis=-1, keepdims=True)
+    denom = jnp.clip(g1 + g2, 1e-9, None)
+    w1 = g1 / denom
+    w2 = g2 / denom
+
+    oh_pos1 = jax.nn.one_hot(
+        (pos1 * mask1).sum(axis=-1).astype(jnp.int32), c, dtype=gates.dtype
+    )  # [T,C]
+    oh_pos2 = jax.nn.one_hot(
+        (pos2 * mask2).sum(axis=-1).astype(jnp.int32), c, dtype=gates.dtype
+    )
+    dispatch = (
+        keep1[:, :, None] * oh_pos1[:, None, :]
+        + keep2[:, :, None] * oh_pos2[:, None, :]
+    )
+    combine = (
+        (keep1 * w1)[:, :, None] * oh_pos1[:, None, :]
+        + (keep2 * w2)[:, :, None] * oh_pos2[:, None, :]
+    )
+    return dispatch, combine, aux
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, axis_name=None, axis_size=1,
+            capacity_factor=2.0, activation=jax.nn.gelu):
+    """x [B, S, H] (local shard). w1 [E_local, H, F], w2 [E_local, F, H]
+    (expert-sharded over `axis_name` when set; full E otherwise).
+    Returns (y [B,S,H], aux_loss scalar)."""
+    b, s, h = x.shape
+    n = int(axis_size) if axis_name else 1
+    e_local = w1.shape[0]
+    e = e_local * n
+    tokens = x.reshape(b * s, h)
+    t = tokens.shape[0]
+    capacity = max(1, int(capacity_factor * t * 2 / e))
+
+    logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)  # [T, E] over GLOBAL experts
+    dispatch, combine, aux = _top2_dispatch(gates, capacity)
+
+    expert_in = jnp.einsum(
+        "tec,th->ech", dispatch.astype(x.dtype), tokens
+    )  # [E, C, H]
+    if n > 1:
+        # token exchange: each device keeps its E_local experts' buffers and
+        # receives the matching slices from every peer
+        expert_in = lax.all_to_all(
+            expert_in, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )  # [E_local, n*C, H]
+    hmid = activation(
+        jnp.einsum("ekh,ehf->ekf", expert_in, w1) + b1[:, None, :]
+    )
+    expert_out = jnp.einsum("ekf,efh->ekh", hmid, w2) + b2[:, None, :]
+    if n > 1:
+        expert_out = lax.all_to_all(
+            expert_out, axis_name, split_axis=1, concat_axis=0, tiled=True
+        )  # [E, C, H]
+    y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+    return y.reshape(b, s, h), aux.astype(jnp.float32)
+
+
+@register_op(
+    "moe_ffn",
+    inputs=["X", "GateW", "W1", "B1", "W2", "B2"],
+    outputs=["Out", "AuxLoss"],
+)
+def _moe_ffn_op(ctx, op, ins):
+    x, gate_w, w1, b1, w2, b2 = (
+        ins[k][0] for k in ("X", "GateW", "W1", "B1", "W2", "B2")
+    )
+    axis = op.attr("axis_name", "ep")
+    cf = op.attr("capacity_factor", 2.0)
+    if axis in ctx.mesh_axes:
+        y, aux = moe_ffn(
+            x, gate_w, w1, b1, w2, b2, axis_name=axis,
+            axis_size=ctx.axis_sizes[axis], capacity_factor=cf,
+        )
+    else:
+        y, aux = moe_ffn(x, gate_w, w1, b1, w2, b2, capacity_factor=cf)
+    return {"Out": [y], "AuxLoss": [aux.reshape([1])]}

@@ -1,0 +1,175 @@
+"""Sequence/context parallelism: ring attention and Ulysses.
+
+The reference has NO long-context parallelism (SURVEY.md §5: "absent") —
+this is greenfield, designed TPU-first:
+
+* Ring attention: the sequence axis is sharded over the "sp" mesh axis;
+  each device keeps its Q shard resident and K/V shards rotate around the
+  ring via lax.ppermute (ICI neighbor exchange), overlapping the blockwise
+  attention compute of step i with the transfer of step i+1 (XLA's
+  latency-hiding scheduler pipelines the ppermute against the matmuls).
+  Softmax is computed online (running max/denominator), so no S×S matrix
+  ever materializes — O(S_local × S_block) memory.
+
+* Ulysses: all_to_all reshard from sequence-sharded to head-sharded,
+  full local attention, all_to_all back. One pair of all_to_alls per layer
+  vs n_ring ppermutes; better when heads ≥ mesh axis size.
+
+Both are differentiable through the generic vjp path (ppermute/all_to_all
+transpose to their inverses under jax.vjp).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+_NEG = -1e30  # finite stand-in for -inf: keeps exp() NaN-free on fully
+# masked blocks (a ring step where every key is causally ahead of this query
+# shard) — p is zeroed through `valid` instead of relying on exp(-inf)
+
+
+def _online_block(q, k, v, valid, m, l, acc, scale):
+    """One blockwise-attention accumulation step (online softmax).
+
+    q [B,H,Sq,D], k/v [B,H,Sk,D], valid broadcastable bool [Sq,Sk] or None,
+    m/l running max/denominator [B,H,Sq,1], acc [B,H,Sq,D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if valid is not None:
+        s = jnp.where(valid, s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1, keepdims=True)
+    acc_new = acc * correction + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
+    """q,k,v: LOCAL shards [B, H, S_local, D] inside shard_map.
+
+    Returns [B, H, S_local, D]. Rotates K/V around the ring; at step t this
+    device (index i) processes the K/V shard originating at (i + t) mod n.
+    """
+    n = int(axis_size)
+    b, h, s_local, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    idx = lax.axis_index(axis_name)
+
+    m = jnp.full((b, h, s_local, 1), _NEG, dtype=jnp.float32)
+    l = jnp.zeros((b, h, s_local, 1), dtype=jnp.float32)
+    acc = jnp.zeros((b, h, s_local, d), dtype=jnp.float32)
+    qf = q.astype(jnp.float32)
+
+    perm = [(i, (i - 1) % n) for i in range(n)]  # send to left neighbor
+    kt, vt = k, v
+    for t in range(n):
+        src = (idx + t) % n  # which shard kt/vt currently holds
+        if causal:
+            # global positions: rows i*s_local + r, cols src*s_local + c
+            rows = idx * s_local + jnp.arange(s_local)[:, None]
+            cols = src * s_local + jnp.arange(s_local)[None, :]
+            valid = rows >= cols
+        else:
+            valid = None
+        m, l, acc = _online_block(
+            qf, kt.astype(jnp.float32), vt.astype(jnp.float32), valid, m, l,
+            acc, scale,
+        )
+        if t != n - 1:
+            kt = lax.ppermute(kt, axis_name, perm)
+            vt = lax.ppermute(vt, axis_name, perm)
+
+    out = acc / jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
+    """q,k,v: LOCAL shards [B, H, S_local, D]; H must divide axis_size.
+
+    all_to_all: seq-sharded -> head-sharded, dense local attention over the
+    FULL sequence, all_to_all back (head-sharded -> seq-sharded).
+    """
+    n = int(axis_size)
+    b, h, s_local, d = q.shape
+    if h % n:
+        raise ValueError(f"ulysses: heads {h} not divisible by axis size {n}")
+
+    def to_heads(x):  # [B,H,Sl,D] -> [B,H/n,S,D]
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def to_seq(x):  # [B,H/n,S,D] -> [B,H,Sl,D]
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    s_full = qh.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32)) * scale
+    if causal:
+        rows = jnp.arange(s_full)[:, None]
+        cols = jnp.arange(s_full)[None, :]
+        s = jnp.where(rows >= cols, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+    return to_seq(out.astype(q.dtype))
+
+
+# ---------------------------------------------------------------------------
+# op registrations (static graph)
+# ---------------------------------------------------------------------------
+
+from ..framework.registry import register_op  # noqa: E402
+
+
+def _attention_fallback(q, k, v, causal, scale):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        n = s.shape[-1]
+        mask = jnp.arange(n)[:, None] >= jnp.arange(n)[None, :]
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@register_op("ring_attention", inputs=["Q", "K", "V"], outputs=["Out"])
+def _ring_attention_op(ctx, op, ins):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    axis = op.attr("axis_name", "sp")
+    causal = op.attr("causal", False)
+    scale = op.attr("scale", None)
+    if axis in ctx.mesh_axes:
+        out = ring_attention(
+            q, k, v, axis, ctx.axis_sizes[axis], causal=causal, scale=scale
+        )
+    else:  # single-shard: dense attention (nranks==1 degradation)
+        out = _attention_fallback(q, k, v, causal, scale)
+    return {"Out": [out]}
+
+
+@register_op("ulysses_attention", inputs=["Q", "K", "V"], outputs=["Out"])
+def _ulysses_attention_op(ctx, op, ins):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    axis = op.attr("axis_name", "sp")
+    causal = op.attr("causal", False)
+    scale = op.attr("scale", None)
+    if axis in ctx.mesh_axes:
+        out = ulysses_attention(
+            q, k, v, axis, ctx.axis_sizes[axis], causal=causal, scale=scale
+        )
+    else:
+        out = _attention_fallback(q, k, v, causal, scale)
+    return {"Out": [out]}
