@@ -57,13 +57,12 @@ class OptimizerWithMixedPrecision:
             unique_name.generate("loss_scaling"), [1], "float32",
             self._init_loss_scaling,
         )
-        if self._use_dynamic:
-            self._good_steps = persist(
-                unique_name.generate("good_steps"), [1], "int32", 0
-            )
-            self._bad_steps = persist(
-                unique_name.generate("bad_steps"), [1], "int32", 0
-            )
+        self._good_steps = persist(
+            unique_name.generate("good_steps"), [1], "int32", 0
+        )
+        self._bad_steps = persist(
+            unique_name.generate("bad_steps"), [1], "int32", 0
+        )
 
     def get_loss_scaling(self):
         return self._loss_scaling
@@ -100,38 +99,34 @@ class OptimizerWithMixedPrecision:
                 },
                 {},
             )
-            if self._use_dynamic:
-                blk.append_op(
-                    "update_loss_scaling",
-                    {
-                        "X": gnames,
-                        "FoundInfinite": [found.name],
-                        "PrevLossScaling": [self._loss_scaling.name],
-                        "InGoodSteps": [self._good_steps.name],
-                        "InBadSteps": [self._bad_steps.name],
-                    },
-                    {
-                        "Out": gnames,
-                        "LossScaling": [self._loss_scaling.name],
-                        "OutGoodSteps": [self._good_steps.name],
-                        "OutBadSteps": [self._bad_steps.name],
-                    },
-                    {
-                        "incr_every_n_steps": self._incr_every,
-                        "decr_every_n_nan_or_inf": self._decr_every,
-                        "incr_ratio": self._incr_ratio,
-                        "decr_ratio": self._decr_ratio,
-                    },
-                )
-            else:
-                # static scale: plain unscale (zeroing on overflow included)
-                for n in gnames:
-                    blk.append_op(
-                        "scale",
-                        {"X": [n]},
-                        {"Out": [n]},
-                        {"scale": 1.0 / self._init_loss_scaling, "bias": 0.0},
-                    )
+            # Static scaling reuses the same op with ratios pinned to 1.0:
+            # the scale never moves, but non-finite grads are still zeroed
+            # so the optimizer update is a no-op on overflow steps (the
+            # reference's static path keeps the check too).
+            incr_ratio = self._incr_ratio if self._use_dynamic else 1.0
+            decr_ratio = self._decr_ratio if self._use_dynamic else 1.0
+            blk.append_op(
+                "update_loss_scaling",
+                {
+                    "X": gnames,
+                    "FoundInfinite": [found.name],
+                    "PrevLossScaling": [self._loss_scaling.name],
+                    "InGoodSteps": [self._good_steps.name],
+                    "InBadSteps": [self._bad_steps.name],
+                },
+                {
+                    "Out": gnames,
+                    "LossScaling": [self._loss_scaling.name],
+                    "OutGoodSteps": [self._good_steps.name],
+                    "OutBadSteps": [self._bad_steps.name],
+                },
+                {
+                    "incr_every_n_steps": self._incr_every,
+                    "decr_every_n_nan_or_inf": self._decr_every,
+                    "incr_ratio": incr_ratio,
+                    "decr_ratio": decr_ratio,
+                },
+            )
         return params_grads
 
     def apply_gradients(self, params_grads):

@@ -72,6 +72,10 @@ class DataParallel(Layer):
         ]
         if not grads:
             return
+        # Each *process* contributes one gradient, but the mesh spans every
+        # device and host-replicated inputs make each process's value appear
+        # once per local device — so the psum over-counts by
+        # local_device_count; divide it back out to get the per-process sum.
         n_local = jax.local_device_count()
         mesh = jax.sharding.Mesh(np.array(jax.devices()), ("hosts",))
 
@@ -80,7 +84,9 @@ class DataParallel(Layer):
         @jax.jit
         def _psum_all(vs):
             f = jax.shard_map(
-                lambda x: [jax.lax.psum(v, "hosts") for v in x],
+                lambda x: [
+                    jax.lax.psum(v, "hosts") / n_local for v in x
+                ],
                 mesh=mesh,
                 in_specs=jax.sharding.PartitionSpec(),
                 out_specs=jax.sharding.PartitionSpec(),

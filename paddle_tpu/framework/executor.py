@@ -79,10 +79,16 @@ def _analyze_block(block, feed_names, fetch_names):
 class Executor:
     """fluid.Executor parity (python/paddle/fluid/executor.py:890)."""
 
+    # bound on cached executables; eviction is LRU. The reference's
+    # ExecutorPrepareContext cache had the same unbounded-growth hazard — a
+    # cap keeps long-lived executors (many programs / shape buckets) sane.
+    CACHE_CAPACITY = 128
+
     def __init__(self, place=None):
+        from collections import OrderedDict
+
         self.place = place if place is not None else default_place()
-        self._cache = {}
-        self._step = 0
+        self._cache = OrderedDict()
 
     def close(self):
         self._cache.clear()
@@ -118,13 +124,22 @@ class Executor:
             compiled = self._compile(program, block, set(feed_arrays), fetch_names, scope)
             if use_program_cache:
                 self._cache[key] = compiled
+                while len(self._cache) > self.CACHE_CAPACITY:
+                    self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
 
         state_ro = {n: self._from_scope(scope, n, block) for n in compiled.state_ro}
         state_mut = {n: self._from_scope(scope, n, block) for n in compiled.state_mut}
 
+        # Per-step RNG folds in the program's own run counter: a fixed
+        # random_seed pins the *sequence* (deterministic re-runs from a fresh
+        # Program), while dropout masks still vary step to step — matching
+        # the reference, which is deterministic per seed but advances its
+        # generator every op execution.
         seed = program.random_seed or 0
-        self._step += 1
-        step = 0 if program.random_seed else self._step
+        step = program._rng_step
+        program._rng_step += 1
         step_key = jax.random.fold_in(jax.random.key(seed), step)
 
         fetches, new_state = compiled.fn(feed_arrays, state_mut, state_ro, step_key)

@@ -241,6 +241,9 @@ class Program:
         self._spmd_mode = "shard_map"
         self._pipeline = None  # set by PipelineOptimizer
         self._op_uid = 0
+        # per-program run counter folded into the step RNG key; advances on
+        # every Executor.run so seeded programs still vary dropout per step
+        self._rng_step = 0
 
     def _next_uid(self):
         uid = self._op_uid
@@ -283,6 +286,13 @@ class Program:
         state = self.__dict__.copy()
         state["_mesh"] = None
         return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        # fields added after a model file was saved get their defaults
+        self.__dict__.setdefault("_rng_step", 0)
+        self.__dict__.setdefault("_spmd_mode", "shard_map")
+        self.__dict__.setdefault("_pipeline", None)
 
     def clone(self, for_test=False):
         """Deep copy. for_test=True flips is_test on ops that honor it

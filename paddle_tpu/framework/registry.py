@@ -143,10 +143,22 @@ def registered_ops():
 
 
 def _spec_from_var(var):
-    shape = tuple(
-        BATCH_SENTINEL if s in (-1, None) else int(s) for s in (var.shape or ())
-    )
-    return jax.ShapeDtypeStruct(shape, to_numpy_dtype(var.dtype))
+    shape = []
+    for s in var.shape or ():
+        if s in (-1, None):
+            shape.append(BATCH_SENTINEL)
+        else:
+            s = int(s)
+            if s != 0 and s % BATCH_SENTINEL == 0:
+                # a real dim that is a multiple of the sentinel would silently
+                # round-trip to -1 in _shape_back; refuse instead of corrupting
+                raise ValueError(
+                    f"variable {var.name!r} has dim {s}, a multiple of the "
+                    f"internal batch sentinel {BATCH_SENTINEL}; pad the dim "
+                    "by one or use explicit infer_shape for this op"
+                )
+            shape.append(s)
+    return jax.ShapeDtypeStruct(tuple(shape), to_numpy_dtype(var.dtype))
 
 
 def _shape_back(shape):

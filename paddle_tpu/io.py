@@ -125,9 +125,18 @@ def prune(program, targets, feeds=()):
     needed = set(target_names)
     keep = []
     for op in reversed(block.ops):
-        if any(n in needed for n in op.output_names()):
+        outs = [n for n in op.output_names() if n]
+        if any(n in needed for n in outs):
+            # an op whose only outputs are feeds exists to *produce* the feed
+            # (e.g. a reader); the caller will supply it, so cut it out
+            if outs and all(n in feed_names for n in outs):
+                continue
             keep.append(op)
-            needed.update(n for n in op.input_names() if n)
+            # the slice stops at feed variables: their producers are replaced
+            # by the runtime feed, exactly like the reference's prune.cc
+            needed.update(
+                n for n in op.input_names() if n and n not in feed_names
+            )
     keep.reverse()
 
     pruned = program.clone()

@@ -173,7 +173,14 @@ def bert_pretrain(input_ids, token_type_ids, input_mask, mlm_labels, cfg,
     )
     labels = layers.reshape(mlm_labels, [b * s, 1])
     loss = layers.softmax_with_cross_entropy(logits, labels, ignore_index=-100)
-    return layers.reduce_mean(loss)
+    # average over the *masked* positions only: ignored positions contribute
+    # zero loss, so a plain mean would scale loss/grads by the masking ratio
+    ignore = layers.fill_constant([b * s, 1], "int64", -100)
+    valid = layers.cast(layers.not_equal(labels, ignore), "float32")
+    denom = layers.elementwise_max(
+        layers.reduce_sum(valid), layers.fill_constant([1], "float32", 1.0)
+    )
+    return layers.elementwise_div(layers.reduce_sum(loss), denom)
 
 
 def bert_tp_shardings(cfg, axis="mp"):

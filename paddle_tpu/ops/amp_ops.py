@@ -47,9 +47,12 @@ def _update_loss_scaling(ctx, op, ins):
     new_good = jnp.where(found, jnp.zeros_like(good), good + 1)
     shrink = new_bad >= decr_every
     grow = new_good >= incr_every
+    # shrink floor: never *raise* the scale through the shrink branch — a
+    # plain max(.., 1.0) would silently bump a sub-1.0 (static) scale up
+    floor = jnp.minimum(prev, 1.0)
     scale = jnp.where(
         shrink,
-        jnp.maximum(prev * decr_ratio, 1.0),
+        jnp.maximum(prev * decr_ratio, floor),
         jnp.where(grow, prev * incr_ratio, prev),
     )
     new_bad = jnp.where(shrink, jnp.zeros_like(new_bad), new_bad)

@@ -19,11 +19,19 @@ from __future__ import annotations
 from .mesh import DATA_AXIS
 
 
+# AMP bookkeeping ops rewrite grads in place *after* they are produced; the
+# DP allreduce must land before them so every shard's FoundInfinite /
+# loss-scale state is computed from identical (globally reduced) gradients.
+_AMP_CHECK_OPS = frozenset({"check_finite_and_unscale", "update_loss_scaling"})
+
+
 def _insert_pos_after(block, names):
-    """Index just after the last op producing any of `names`."""
+    """Index just after the last non-AMP op producing any of `names`."""
     pos = 0
     names = set(names)
     for i, op in enumerate(block.ops):
+        if op.type in _AMP_CHECK_OPS:
+            continue
         if names & set(op.output_names()):
             pos = i + 1
     return pos

@@ -136,6 +136,32 @@ def test_dropout_train_vs_test():
     np.testing.assert_allclose(test_out, xv)
 
 
+def test_seeded_dropout_varies_per_step_but_reruns_deterministically():
+    """A fixed random_seed pins the run *sequence*, not a single frozen mask:
+    step k of run A == step k of run B, while step 0 != step 1 within a run
+    (the reference advances its generator every execution)."""
+
+    def run_twice():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [64, 64])
+            out = fluid.layers.dropout(
+                x, 0.5, dropout_implementation="upscale_in_train"
+            )
+        exe = fluid.Executor()
+        xv = np.ones((64, 64), np.float32)
+        a = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        b = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        return a, b
+
+    a0, a1 = run_twice()
+    b0, b1 = run_twice()
+    assert not np.allclose(a0, a1), "dropout mask frozen across steps"
+    np.testing.assert_allclose(a0, b0)
+    np.testing.assert_allclose(a1, b1)
+
+
 def test_batch_norm_updates_stats():
     x = fluid.data("x", [8, 3, 4, 4])
     y = fluid.layers.batch_norm(x)
