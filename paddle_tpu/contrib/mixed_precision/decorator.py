@@ -14,7 +14,6 @@ from __future__ import annotations
 
 from ...framework import unique_name
 from ...framework.program import default_startup_program, program_guard
-from ...initializer import Constant
 from .fp16_lists import AutoMixedPrecisionLists
 from .fp16_utils import rewrite_program
 
@@ -44,14 +43,13 @@ class OptimizerWithMixedPrecision:
         self._loss_scaling = None
 
     def _make_state(self, main, startup):
-        blk, sblk = main.global_block, startup.global_block
+        from ...framework.state import create_persistable_var
 
         def persist(name, shape, dtype, value):
-            v = blk.create_parameter(name, shape, dtype, trainable=False)
-            v.stop_gradient = True
-            sblk.create_parameter(name, shape, dtype, trainable=False)
-            Constant(value)(sblk, name, shape, dtype)
-            return v
+            return create_persistable_var(
+                name, shape, dtype, value, unique=False,
+                main=main, startup=startup,
+            )
 
         self._loss_scaling = persist(
             unique_name.generate("loss_scaling"), [1], "float32",

@@ -25,3 +25,15 @@ from .nn import (  # noqa: F401
 from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
 from .tracer import Tracer  # noqa: F401
 from .varbase import ParamBase, VarBase  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    CosineDecay,
+    ExponentialDecay,
+    InverseTimeDecay,
+    LearningRateDecay,
+    LinearLrWarmup,
+    NaturalExpDecay,
+    NoamDecay,
+    PiecewiseDecay,
+    PolynomialDecay,
+    ReduceLROnPlateau,
+)

@@ -137,7 +137,9 @@ class Executor:
         # Program), while dropout masks still vary step to step — matching
         # the reference, which is deterministic per seed but advances its
         # generator every op execution.
-        seed = program.random_seed or 0
+        # seed 0 = nondeterministic (fluid semantics): fall back to the
+        # program's own nonce so unseeded Programs are mutually decorrelated
+        seed = program.random_seed or program._rng_nonce
         step = program._rng_step
         program._rng_step += 1
         step_key = jax.random.fold_in(jax.random.key(seed), step)

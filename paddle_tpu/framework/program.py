@@ -244,6 +244,13 @@ class Program:
         # per-program run counter folded into the step RNG key; advances on
         # every Executor.run so seeded programs still vary dropout per step
         self._rng_step = 0
+        # fluid treats random_seed=0 as "nondeterministic": unseeded programs
+        # draw a per-instance nonce so independent Programs (and restarted
+        # processes) get decorrelated RNG streams. clone() deep-copies the
+        # nonce, so a for_test clone keeps its parent's streams.
+        import random as _random
+
+        self._rng_nonce = _random.SystemRandom().getrandbits(31) | 1
 
     def _next_uid(self):
         uid = self._op_uid
@@ -291,6 +298,10 @@ class Program:
         self.__dict__.update(state)
         # fields added after a model file was saved get their defaults
         self.__dict__.setdefault("_rng_step", 0)
+        if "_rng_nonce" not in self.__dict__:
+            import random as _random
+
+            self._rng_nonce = _random.SystemRandom().getrandbits(31) | 1
         self.__dict__.setdefault("_spmd_mode", "shard_map")
         self.__dict__.setdefault("_pipeline", None)
 

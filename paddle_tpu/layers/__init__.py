@@ -34,3 +34,13 @@ from .tensor import (  # noqa: F401  (generated attrs need explicit export)
     log,
     gelu,
 )
+from .learning_rate_scheduler import (  # noqa: F401
+    cosine_decay,
+    exponential_decay,
+    inverse_time_decay,
+    linear_lr_warmup,
+    natural_exp_decay,
+    noam_decay,
+    piecewise_decay,
+    polynomial_decay,
+)

@@ -69,8 +69,22 @@ class LayerHelper:
         self, inputs, attrs, op_type=None, out_slots=("Out",), stop_gradient=False
     ):
         """Append an op, creating one output var per slot with inferred
-        shape/dtype. inputs: {slot: [Variable]}. Returns var or tuple."""
+        shape/dtype. inputs: {slot: [Variable]}. Returns var or tuple.
+
+        In dygraph mode the op executes eagerly through the tracer instead
+        (reference parity: fluid.layers.* are usable under dygraph.guard via
+        the in_dygraph_mode fast path in each layer fn, framework.py:180)."""
         op_type = op_type or self.layer_type
+        from ..framework.program import _current_tracer
+
+        tracer = _current_tracer()
+        if tracer is not None:
+            outs = tracer.trace_op(op_type, inputs, attrs or {})
+            vals = [
+                (vs[0] if len(vs) == 1 else vs)
+                for slot, vs in ((s, outs.get(s, [])) for s in out_slots)
+            ]
+            return vals[0] if len(vals) == 1 else tuple(vals)
         blk = main_block()
         in_names = {
             slot: [v.name if v is not None else "" for v in vs]

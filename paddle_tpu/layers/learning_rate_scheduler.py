@@ -1,0 +1,149 @@
+"""Learning-rate schedules, built *in-graph* from existing ops.
+
+Reference parity: python/paddle/fluid/layers/learning_rate_scheduler.py
+(noam_decay :58, exponential_decay :114, natural_exp_decay :167,
+inverse_time_decay :218, polynomial_decay :272, piecewise_decay :339,
+cosine_decay :407, linear_lr_warmup :447) and the global step counter
+(layers/tensor.py _decay_step_counter in the reference).
+
+TPU-native design: the schedule is a handful of scalar ops appended to the
+main program — they trace into the same XLA computation as the train step, so
+the LR math fuses to nothing and the step counter lives on device (a [1]
+float32 persistable bumped by an `increment` op). The reference instead ran
+these as real kernels per step. No host round-trip, no recompile per step.
+"""
+
+from __future__ import annotations
+
+from ..framework.program import default_main_program
+from ..framework.state import create_step_counter
+from . import tensor
+
+LR_COUNTER_NAME = "@LR_DECAY_COUNTER@"
+
+
+def _decay_step_counter(begin=0):
+    """Global step var, bumped once per executor step (in-graph). The
+    increment op precedes the decay math, so the counter is initialized to
+    begin-1 and the first run observes exactly `begin`. Storage is int32
+    (a float32 counter saturates at 2^24 steps, reference uses int64);
+    schedulers get a float32 cast for the decay math."""
+    prog = default_main_program()
+    main = prog.global_block
+    if not main.has_var(LR_COUNTER_NAME):
+        create_step_counter(LR_COUNTER_NAME, init=float(begin) - 1.0, unique=False)
+        prog._lr_counter_begin = int(begin)
+    # one counter per program; schedulers composing (warmup over decay)
+    # share the same step — matching the reference's single counter. A
+    # scheduler whose `begin` differs from the counter's gets a constant
+    # offset so e.g. noam (begin=1) after exponential (begin=0) still
+    # observes 1 on the first run instead of 0 (-> inf lr).
+    step = tensor.cast(main.var(LR_COUNTER_NAME), "float32")
+    delta = int(begin) - getattr(prog, "_lr_counter_begin", int(begin))
+    if delta:
+        step = step + float(delta)
+    return step
+
+
+def _f(value, like=None):
+    return tensor.fill_constant([1], "float32", float(value))
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """lr = learning_rate * d_model^-0.5 * min(step^-0.5, step*warmup^-1.5)."""
+    step = _decay_step_counter(begin=1)
+    a = tensor.pow(step, factor=-0.5)
+    b = step * float(warmup_steps) ** -1.5
+    return (
+        tensor.elementwise_min(a, b)
+        * (float(learning_rate) * float(d_model) ** -0.5)
+    )
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = tensor.floor(div)
+    return float(learning_rate) * tensor.elementwise_pow(
+        _f(decay_rate), div
+    )
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = tensor.floor(div)
+    return float(learning_rate) * tensor.exp(div * -float(decay_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = tensor.floor(div)
+    denom = div * float(decay_rate) + 1.0
+    return _f(learning_rate) / denom
+
+
+def polynomial_decay(
+    learning_rate, decay_steps, end_learning_rate=0.0001, power=1.0, cycle=False
+):
+    step = _decay_step_counter()
+    if cycle:
+        # decay_steps stretches by ceil(step / decay_steps) each cycle
+        ratio = tensor.ceil(step / float(decay_steps))
+        # step == 0 must give ratio 1, not 0 (reference :306-311)
+        zero = tensor.cast(tensor.equal(step, _f(0.0)), "float32")
+        ratio = ratio + zero
+        steps = ratio * float(decay_steps)
+    else:
+        steps = _f(decay_steps)
+        step = tensor.elementwise_min(step, steps)
+    frac = tensor.pow(1.0 - step / steps, factor=float(power))
+    return (float(learning_rate) - float(end_learning_rate)) * frac + float(
+        end_learning_rate
+    )
+
+
+def piecewise_decay(boundaries, values):
+    """values[i] while step < boundaries[i]; values[-1] after the last."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError("len(values) must be len(boundaries) + 1")
+    step = _decay_step_counter()
+    lr = _f(values[-1])
+    # fold from the right: select(step < b_i, values[i], lr_so_far).
+    # XLA folds this mask chain into a couple of selects — cheaper than the
+    # reference's per-boundary cond ops (learning_rate_scheduler.py:339).
+    for b, v in reversed(list(zip(boundaries, values[:-1]))):
+        m = tensor.cast(tensor.less_than(step, _f(b)), "float32")
+        lr = m * float(v) + (1.0 - m) * lr
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _decay_step_counter()
+    epoch = tensor.floor(step / float(step_each_epoch))
+    import math
+
+    return (
+        0.5
+        * float(learning_rate)
+        * (tensor.cos(epoch * (math.pi / float(epochs))) + 1.0)
+    )
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Linear ramp start_lr→end_lr for warmup_steps, then `learning_rate`
+    (a float or another schedule's Variable) after."""
+    step = _decay_step_counter()
+    from ..framework.program import Variable
+
+    if not isinstance(learning_rate, Variable):
+        learning_rate = _f(learning_rate)
+    ramp = float(start_lr) + (float(end_lr) - float(start_lr)) * (
+        step / float(warmup_steps)
+    )
+    m = tensor.cast(tensor.less_than(step, _f(warmup_steps)), "float32")
+    return m * ramp + (1.0 - m) * learning_rate

@@ -50,10 +50,16 @@ def _update_loss_scaling(ctx, op, ins):
     # shrink floor: never *raise* the scale through the shrink branch — a
     # plain max(.., 1.0) would silently bump a sub-1.0 (static) scale up
     floor = jnp.minimum(prev, 1.0)
+    # grow guard: keep the previous scale if doubling overflows to inf
+    # (reference update_loss_scaling_op.h keeps prev when the incremented
+    # scale is non-finite) — otherwise a long always-finite run saturates
+    # the scale at inf and silently zeroes every gradient from then on
+    grown = prev * incr_ratio
+    grown = jnp.where(jnp.isfinite(grown), grown, prev)
     scale = jnp.where(
         shrink,
         jnp.maximum(prev * decr_ratio, floor),
-        jnp.where(grow, prev * incr_ratio, prev),
+        jnp.where(grow, grown, prev),
     )
     new_bad = jnp.where(shrink, jnp.zeros_like(new_bad), new_bad)
     new_good = jnp.where(grow, jnp.zeros_like(new_good), new_good)

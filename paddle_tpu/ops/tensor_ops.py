@@ -359,3 +359,12 @@ def _take_along_axis(ctx, op, ins):
             )
         ]
     }
+
+
+@register_op("increment", inputs=["X"], outputs=["Out"], differentiable=False)
+def _increment(ctx, op, ins):
+    """In-place counter bump (reference operators/increment_op.cc). Emitting
+    Out under the same variable name as X makes the executor's persistable
+    write-back + donation update the counter buffer in place."""
+    x = ins["X"][0]
+    return {"Out": [x + jnp.asarray(op.attr("step", 1.0), dtype=x.dtype)]}

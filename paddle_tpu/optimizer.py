@@ -144,6 +144,11 @@ class Optimizer:
 
     # -- eager (dygraph) path ---------------------------------------------
     def _eager_lr(self):
+        # a schedule callable advances its step on every call, so it must be
+        # invoked once per minimize (cached below), not once per parameter
+        cached = getattr(self, "_eager_lr_value", None)
+        if cached is not None:
+            return cached
         lr = self._learning_rate
         return float(lr() if callable(lr) else lr)
 
@@ -163,15 +168,20 @@ class Optimizer:
     def _eager_minimize(self, parameter_list=None):
         params = parameter_list or self._parameter_list or []
         updated = []
-        for p in params:
-            if not getattr(p, "trainable", True) or p._grad is None:
-                continue
-            g = p._grad
-            reg = getattr(p, "regularizer", None) or self.regularization
-            if reg is not None and getattr(reg, "_coeff", 0.0):
-                g = g + reg._coeff * p.value
-            self._eager_update(p, g)
-            updated.append(p)
+        self._eager_lr_value = None
+        self._eager_lr_value = self._eager_lr()  # advance schedule ONCE
+        try:
+            for p in params:
+                if not getattr(p, "trainable", True) or p._grad is None:
+                    continue
+                g = p._grad
+                reg = getattr(p, "regularizer", None) or self.regularization
+                if reg is not None and getattr(reg, "_coeff", 0.0):
+                    g = g + reg._coeff * p.value
+                self._eager_update(p, g)
+                updated.append(p)
+        finally:
+            self._eager_lr_value = None
         return updated
 
     def _eager_update(self, p, g):
@@ -615,3 +625,288 @@ Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
 Dpsgd = DpsgdOptimizer
+
+
+# ---------------------------------------------------------------------------
+# meta-optimizers: EMA / ModelAverage / Lookahead
+# (reference python/paddle/fluid/optimizer.py: ModelAverage :2997, EMA :3306,
+# LookaheadOptimizer :4150)
+# ---------------------------------------------------------------------------
+
+import contextlib as _contextlib
+
+from .framework.state import create_persistable_var, create_step_counter
+
+
+def _make_counter(name_hint, init=0.0, dtype="float32"):
+    return create_persistable_var(name_hint, [1], dtype, init)
+
+
+def _make_state_like(param, name_hint, init=0.0, dtype=None, shape=None):
+    return create_persistable_var(
+        name_hint,
+        list(shape if shape is not None else param.shape),
+        dtype or param.dtype,
+        init,
+    )
+
+
+class _SwappingAverager:
+    """Shared apply()/restore() scope-swap machinery for EMA/ModelAverage.
+
+    The swap phases run between train steps, off the hot path, so host-side
+    scope mutation (a couple of device round-trips) is the right tool — the
+    reference built dedicated apply/restore Programs instead
+    (optimizer.py:3306 area)."""
+
+    def __init__(self):
+        self._backup = {}
+
+    def _averaged_value(self, scope, pname):
+        raise NotImplementedError
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        from .framework.scope import global_scope
+
+        scope = global_scope()
+        self._backup = {}
+        for pname in self._param_names():
+            self._backup[pname] = scope.find_var(pname)
+            scope.set_var(pname, self._averaged_value(scope, pname))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        from .framework.scope import global_scope
+
+        scope = global_scope()
+        for pname, val in self._backup.items():
+            scope.set_var(pname, val)
+        self._backup = {}
+
+
+class ExponentialMovingAverage(_SwappingAverager):
+    """EMA of trainable parameters, updated in-graph each step.
+
+    update() appends `ema = decay_t * ema + (1-decay_t) * param` ops to the
+    main program (they fuse into the train step's XLA computation — the
+    reference ran separate kernels, optimizer.py:3306). With thres_steps the
+    decay ramps as min(decay, (1+step)/(10+step)). apply() swaps in the
+    bias-corrected average ema / (1 - prod(decay_t)); restore() swaps back.
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        super().__init__()
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._name = name or "ema"
+        self._pairs = {}  # param_name -> ema_name
+        self._decay_pow_name = None
+
+    def _param_names(self):
+        return list(self._pairs)
+
+    def update(self):
+        from .framework.program import default_main_program
+        from . import layers
+
+        main = default_main_program()
+        params = [p for p in main.all_parameters() if p.trainable]
+        blk = main.global_block
+
+        if self._thres_steps is not None:
+            # reference semantics (optimizer.py:3306): thres_steps is the
+            # caller's step Variable and the decay ramps as
+            # min(decay, (1+t)/(10+t)); a numeric thres clamps an internal
+            # counter (created only for this branch)
+            if isinstance(self._thres_steps, Variable):
+                t = layers.cast(self._thres_steps, "float32")
+            else:
+                step_v = create_step_counter(self._name + "_step")
+                t = layers.elementwise_min(
+                    layers.cast(step_v, "float32"),
+                    layers.fill_constant(
+                        [1], "float32", float(self._thres_steps)
+                    ),
+                )
+            decay_t = layers.elementwise_min(
+                layers.fill_constant([1], "float32", self._decay),
+                (t + 1.0) / (t + 10.0),
+            )
+        else:
+            decay_t = layers.fill_constant([1], "float32", self._decay)
+
+        # running product of decay_t, for bias correction at apply()
+        pow_v = _make_counter(self._name + "_decay_pow", init=1.0)
+        prod = layers.elementwise_mul(pow_v, decay_t)
+        blk.append_op("assign", {"X": [prod.name]}, {"Out": [pow_v.name]}, {})
+        self._decay_pow_name = pow_v.name
+
+        for p in params:
+            ema = _make_state_like(p, p.name + "_" + self._name)
+            new = layers.elementwise_add(
+                layers.elementwise_mul(ema, decay_t),
+                layers.elementwise_mul(p, 1.0 - decay_t),
+            )
+            blk.append_op("assign", {"X": [new.name]}, {"Out": [ema.name]}, {})
+            self._pairs[p.name] = ema.name
+
+    def _averaged_value(self, scope, pname):
+        pow_t = np.asarray(scope.find_var(self._decay_pow_name))
+        debias = max(1.0 - float(pow_t.reshape(-1)[0]), 1e-12)
+        ema_val = scope.find_var(self._pairs[pname])
+        return (ema_val / debias).astype(ema_val.dtype)
+
+
+class ModelAverage(_SwappingAverager):
+    """Windowed average of parameters (reference optimizer.py:2997).
+
+    Two-tier accumulation mirroring the reference's rotating partial sums:
+    (sum_cur, cnt_cur) accumulate every step; when cnt_cur reaches the
+    effective window clip(average_window_rate * num_updates,
+    min_average_window, max_average_window) the current tier shifts to
+    (sum_old, cnt_old) and restarts — so apply() always averages over at
+    least one full window once warm (never a fresh-restart handful of
+    samples). All in-graph mask-selects, no host control flow. apply()
+    swaps params for (sum_cur+sum_old)/(cnt_cur+cnt_old).
+    """
+
+    def __init__(
+        self,
+        average_window_rate=0.15,
+        min_average_window=10000,
+        max_average_window=10000,
+        name=None,
+    ):
+        super().__init__()
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._name = name or "model_avg"
+        self._state = {}  # param_name -> (sum_cur, cnt_cur, sum_old, cnt_old)
+        self._build()
+
+    def _param_names(self):
+        return list(self._state)
+
+    def _build(self):
+        from .framework.program import default_main_program
+        from . import layers
+
+        main = default_main_program()
+        blk = main.global_block
+        # shared step counter: effective window scales with total updates
+        # (reference semantics: window = clip(rate * num_updates, min, max))
+        g = create_step_counter(self._name + "_num_updates")
+        eff_window = layers.elementwise_min(
+            layers.fill_constant([1], "float32", float(self.max_average_window)),
+            layers.elementwise_max(
+                layers.fill_constant([1], "float32", float(self.min_average_window)),
+                layers.cast(g, "float32") * self.average_window,
+            ),
+        )
+        one = layers.fill_constant([1], "int32", 1)
+        for p in [q for q in main.all_parameters() if q.trainable]:
+            sum_cur = _make_state_like(p, p.name + "_avg_sum", dtype="float32")
+            cnt_cur = _make_counter(p.name + "_avg_cnt", dtype="int32")
+            sum_old = _make_state_like(p, p.name + "_avg_sum_old", dtype="float32")
+            cnt_old = _make_counter(p.name + "_avg_cnt_old", dtype="int32")
+            cond = layers.greater_equal(
+                layers.cast(cnt_cur, "float32"), eff_window
+            )
+            shift = layers.cast(cond, "float32")
+            keep = 1.0 - shift
+            new_sum_old = layers.elementwise_add(
+                layers.elementwise_mul(sum_cur, shift, axis=0),
+                layers.elementwise_mul(sum_old, keep, axis=0),
+            )
+            # counters stay int32 end-to-end (float32 math would stall at
+            # 2^24); select with `where` instead of mask arithmetic
+            new_cnt_old = layers.where(cond, cnt_cur, cnt_old)
+            new_sum_cur = layers.elementwise_add(
+                layers.elementwise_mul(sum_cur, keep, axis=0),
+                layers.cast(p, "float32"),
+            )
+            zero = layers.fill_constant([1], "int32", 0)
+            new_cnt_cur = layers.elementwise_add(
+                layers.where(cond, zero, cnt_cur), one
+            )
+            for new, tgt in (
+                (new_sum_old, sum_old), (new_cnt_old, cnt_old),
+                (new_sum_cur, sum_cur), (new_cnt_cur, cnt_cur),
+            ):
+                blk.append_op("assign", {"X": [new.name]}, {"Out": [tgt.name]}, {})
+            self._state[p.name] = (
+                sum_cur.name, cnt_cur.name, sum_old.name, cnt_old.name
+            )
+
+    def _averaged_value(self, scope, pname):
+        sc, cc, so, co = self._state[pname]
+        s = scope.find_var(sc) + scope.find_var(so)
+        c = int(np.asarray(scope.find_var(cc)).reshape(-1)[0]) + int(
+            np.asarray(scope.find_var(co)).reshape(-1)[0]
+        )
+        c = max(c, 1.0)
+        orig = self._backup[pname]
+        return (s / c).astype(orig.dtype).reshape(orig.shape)
+
+
+class LookaheadOptimizer:
+    """Lookahead (k slow-weight sync, reference optimizer.py:4150): wraps an
+    inner optimizer; every k steps slow += alpha*(fast-slow), fast = slow.
+    The k-step condition is a mask-select in-graph (no host branch)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert k >= 1 and isinstance(k, int)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        from . import layers
+        from .framework.program import program_guard
+
+        ops, params_grads = self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        main = loss.block.program
+        blk = main.global_block
+        startup = (startup_program or default_startup_program()).global_block
+
+        with program_guard(main, startup_program or default_startup_program()):
+            step_v = create_step_counter("lookahead_step")
+            # int mod: a float32 counter would lose exactness past 2^24 steps
+            kf = layers.fill_constant([1], "int32", self.k)
+            rem = layers.elementwise_mod(step_v, kf)
+            sync = layers.cast(
+                layers.equal(rem, layers.fill_constant([1], "int32", 0)),
+                "float32",
+            )
+            for p, _ in params_grads:
+                slow = blk.create_parameter(
+                    unique_name.generate(p.name + "_slow"), p.shape, p.dtype,
+                    trainable=False,
+                )
+                slow.stop_gradient = True
+                startup.create_parameter(slow.name, p.shape, p.dtype, trainable=False)
+                # slow starts equal to fast: copy the initialized param value
+                # (runs after the param's init ops in the startup program)
+                startup.append_op("assign", {"X": [p.name]}, {"Out": [slow.name]}, {})
+                merged = p * self.alpha + slow * (1.0 - self.alpha)
+                new_slow = layers.elementwise_add(
+                    layers.elementwise_mul(merged, sync, axis=0),
+                    layers.elementwise_mul(slow, 1.0 - sync, axis=0),
+                )
+                new_fast = layers.elementwise_add(
+                    layers.elementwise_mul(new_slow, sync, axis=0),
+                    layers.elementwise_mul(p, 1.0 - sync, axis=0),
+                )
+                blk.append_op("assign", {"X": [new_slow.name]}, {"Out": [slow.name]}, {})
+                blk.append_op("assign", {"X": [new_fast.name]}, {"Out": [p.name]}, {})
+        return ops, params_grads
