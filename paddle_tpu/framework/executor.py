@@ -142,7 +142,11 @@ class Executor:
         seed = program.random_seed or program._rng_nonce
         step = program._rng_step
         program._rng_step += 1
-        step_key = jax.random.fold_in(jax.random.key(seed), step)
+        from ..core.dtypes import prng_impl
+
+        step_key = jax.random.fold_in(
+            jax.random.key(seed, impl=prng_impl()), step
+        )
 
         fetches, new_state = compiled.fn(feed_arrays, state_mut, state_ro, step_key)
         for n, v in new_state.items():

@@ -79,11 +79,16 @@ def _attention(x, attn_bias, cfg, prefix, is_test):
     b, s, h = x.shape
     nh, dh = cfg.num_heads, cfg.hidden_size // cfg.num_heads
     qkv = _dense(x, 3 * h, f"{prefix}_qkv", cfg)  # [B,S,3H] one fused matmul
-    qkv = layers.reshape(qkv, [b, s, 3, nh, dh])
-    qkv = layers.transpose(qkv, [2, 0, 3, 1, 4])  # [3,B,nh,S,dh]
-    q = layers.squeeze(layers.slice(qkv, [0], [0], [1]), [0])
-    k = layers.squeeze(layers.slice(qkv, [0], [1], [2]), [0])
-    v = layers.squeeze(layers.slice(qkv, [0], [2], [3]), [0])
+    # slice along the feature dim + per-tensor [B,nh,S,dh] transposes: XLA
+    # folds the slices into the producing matmul and the three small
+    # transposes fuse with their consuming dots, unlike a single 5-D
+    # [3,B,nh,S,dh] megatranspose which materializes a full copy
+    def head(t):
+        return layers.transpose(layers.reshape(t, [b, s, nh, dh]), [0, 2, 1, 3])
+
+    q = head(layers.slice(qkv, [2], [0], [h]))
+    k = head(layers.slice(qkv, [2], [h], [2 * h]))
+    v = head(layers.slice(qkv, [2], [2 * h], [3 * h]))
     scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(dh))
     if attn_bias is not None:
         scores = scores + attn_bias  # [B,1,1,S] additive mask broadcast

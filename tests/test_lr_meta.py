@@ -214,10 +214,6 @@ def test_lookahead_matches_numpy():
 
     fast = np.asarray(scope.find_var(pname)).copy()
     slow = fast.copy()
-    g = np.full_like(fast, 0.5)  # d(mean(x@w))/dw for x=ones(2,2): 1/2*sum over batch... computed below
-
-    # numpy replica: grad of mean over batch of (x @ w) wrt w is mean of x rows
-    g = np.ones_like(fast) * 1.0  # x rows are ones; d/dw mean_b sum_j? see check below
     # derive the true grad once from the first step instead of hand-computing
     exe.run(feed=feed, fetch_list=[loss])
     after1 = np.asarray(scope.find_var(pname))
