@@ -43,6 +43,8 @@ from . import contrib  # noqa: F401
 from . import incubate  # noqa: F401
 from . import dygraph  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
+from . import dataloader  # noqa: F401
+from .reader import DataLoader  # noqa: F401
 
 # `fluid`-compatible alias so code written against the reference API reads
 # naturally: `import paddle_tpu as fluid; fluid.layers.fc(...)`.

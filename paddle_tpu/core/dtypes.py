@@ -72,19 +72,3 @@ def is_float(dtype) -> bool:
 def is_integer(dtype) -> bool:
     return convert_dtype(dtype) in INT_DTYPES
 
-
-def prng_impl():
-    """PRNG implementation for per-step keys. TPU defaults to "rbg"
-    (counter-based, ~an order of magnitude cheaper than threefry for the
-    per-op dropout masks and natively partitionable under SPMD); override
-    with PADDLE_TPU_PRNG=threefry for threefry streams everywhere.
-    The reference has no analogous contract — its dropout uses curand
-    Philox per kernel launch (dropout_op.cu)."""
-    import os
-
-    import jax
-
-    env = os.environ.get("PADDLE_TPU_PRNG")
-    if env:
-        return env
-    return "rbg" if jax.default_backend() == "tpu" else "threefry2x32"

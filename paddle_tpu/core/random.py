@@ -1,0 +1,20 @@
+"""PRNG policy (key implementation selection for per-step streams)."""
+
+from __future__ import annotations
+
+import os
+
+
+def prng_impl():
+    """PRNG implementation for per-step keys. TPU defaults to "rbg"
+    (counter-based, ~an order of magnitude cheaper than threefry for the
+    per-op dropout masks and natively partitionable under SPMD); override
+    with PADDLE_TPU_PRNG=threefry2x32 for threefry streams everywhere.
+    The reference has no analogous contract — its dropout uses curand
+    Philox per kernel launch (dropout_op.cu)."""
+    import jax
+
+    env = os.environ.get("PADDLE_TPU_PRNG")
+    if env:
+        return env
+    return "rbg" if jax.default_backend() == "tpu" else "threefry2x32"

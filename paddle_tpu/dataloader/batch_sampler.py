@@ -1,0 +1,148 @@
+"""Samplers (reference python/paddle/fluid/dataloader/batch_sampler.py:24).
+
+BatchSampler yields lists of dataset indices per batch; Sequence/Random
+samplers yield single indices. DistributedBatchSampler shards batches across
+data-parallel ranks (the reference kept this in incubate; here it is the
+front door for multi-host input pipelines — each host feeds its own shard,
+matching the per-process feed model of jax.distributed).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator  # np.random.RandomState or seed int
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def _rng(self):
+        g = self.generator
+        if isinstance(g, np.random.RandomState):
+            return g
+        return np.random.RandomState(g)  # None -> OS entropy
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = self._rng()
+        if self.replacement:
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """Groups sampler indices into batches (reference :97 signature)."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        if bool(dataset is None) == bool(sampler is None):
+            raise ValueError("provide exactly one of dataset / sampler")
+        if sampler is not None:
+            self.sampler = sampler
+        else:
+            self.sampler = (
+                RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+            )
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.batch_size = int(batch_size)
+        self.drop_last = bool(drop_last)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Each rank sees a disjoint 1/nranks slice of every epoch
+    (reference incubate distributed batch sampler semantics)."""
+
+    def __init__(self, dataset, batch_size, nranks=None, rank=None,
+                 shuffle=False, drop_last=False, seed=0):
+        import os
+
+        self.nranks = nranks if nranks is not None else int(
+            os.environ.get("PADDLE_TRAINERS_NUM", 1)
+        )
+        self.rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", 0)
+        )
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        super().__init__(
+            sampler=SequenceSampler(dataset), batch_size=batch_size,
+            drop_last=drop_last,
+        )
+
+    def set_epoch(self, epoch):
+        self.epoch = int(epoch)
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + self.epoch).permutation(n)
+        else:
+            order = np.arange(n)
+        # pad so every rank gets the same number of samples
+        per_rank = (n + self.nranks - 1) // self.nranks
+        padded = np.resize(order, per_rank * self.nranks)
+        mine = padded[self.rank::self.nranks]
+        batch = []
+        for idx in mine.tolist():
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.dataset)
+        per_rank = (n + self.nranks - 1) // self.nranks
+        if self.drop_last:
+            return per_rank // self.batch_size
+        return (per_rank + self.batch_size - 1) // self.batch_size

@@ -1,0 +1,121 @@
+"""Dataset abstractions (reference python/paddle/fluid/dataloader/dataset.py).
+
+Map-style `Dataset` (indexable) and `IterableDataset` (stream), plus
+`TensorDataset` and `ChainDataset` conveniences. Samples are host-side numpy
+structures; device staging happens in the DataLoader's prefetcher, never here.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+
+class Dataset:
+    """Map-style dataset: implement __getitem__ and __len__."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError(
+            "'{}' not implement in class {}".format(
+                "__getitem__", self.__class__.__name__
+            )
+        )
+
+    def __len__(self):
+        raise NotImplementedError(
+            "'{}' not implement in class {}".format(
+                "__len__", self.__class__.__name__
+            )
+        )
+
+
+class IterableDataset(Dataset):
+    """Stream dataset: implement __iter__; no random access, no len."""
+
+    def __iter__(self):
+        raise NotImplementedError(
+            "'{}' not implement in class {}".format(
+                "__iter__", self.__class__.__name__
+            )
+        )
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset has no random access")
+
+    def __len__(self):
+        # TypeError so list(ds) treats it as "no length hint" instead of
+        # propagating out of operator.length_hint
+        raise TypeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    """Wrap equal-length arrays; sample i = tuple of row i of each array."""
+
+    def __init__(self, tensors):
+        self.tensors = [np.asarray(t) for t in tensors]
+        n = len(self.tensors[0])
+        for t in self.tensors:
+            if len(t) != n:
+                raise ValueError("all tensors must have the same first dim")
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self.cum.append(total)
+
+    def __len__(self):
+        return self.cum[-1] if self.cum else 0
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        di = bisect.bisect_right(self.cum, idx)
+        prev = self.cum[di - 1] if di else 0
+        return self.datasets[di][idx - prev]
+
+
+class ChainDataset(IterableDataset):
+    """Chain several iterable datasets end to end."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, seed=None):
+    if sum(lengths) != len(dataset):
+        raise ValueError("sum of lengths must equal dataset length")
+    rng = np.random.RandomState(seed)
+    perm = rng.permutation(len(dataset))
+    out, off = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[off:off + n].tolist()))
+        off += n
+    return out

@@ -142,7 +142,7 @@ class Executor:
         seed = program.random_seed or program._rng_nonce
         step = program._rng_step
         program._rng_step += 1
-        from ..core.dtypes import prng_impl
+        from ..core.random import prng_impl
 
         step_key = jax.random.fold_in(
             jax.random.key(seed, impl=prng_impl()), step

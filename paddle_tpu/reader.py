@@ -1,0 +1,273 @@
+"""DataLoader (reference python/paddle/fluid/reader.py: DataLoader :100,
+from_generator :360, GeneratorLoader :952).
+
+Two front doors, same as the reference:
+  * DataLoader(dataset, ...) — map/iterable Dataset + BatchSampler +
+    worker prefetch + device double-buffer (dataloader/dataloader_iter.py).
+  * DataLoader.from_generator(feed_list, capacity) — the fluid-style loader
+    bound to feed Variables; set_sample_generator / set_sample_list_generator
+    / set_batch_generator, then iterate to get feed dicts for Executor.run.
+
+The reference's non-iterable mode injected a create_py_reader op and a
+blocking queue into the program (reader.py:952, operators/reader/py_reader);
+under whole-block XLA compilation the program stays pure and feeding is the
+host's job, so both modes here yield feed dicts — `iterable=False` only
+changes start()/reset() bookkeeping for API compatibility.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from .dataloader import BatchSampler, Dataset, IterableDataset
+from .dataloader.dataloader_iter import (
+    _MultiWorkerIter,
+    _SingleProcessIter,
+    default_collate_fn,
+)
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        feed_list=None,
+        places=None,
+        return_list=False,
+        batch_sampler=None,
+        batch_size=1,
+        shuffle=False,
+        drop_last=False,
+        collate_fn=None,
+        num_workers=0,
+        use_buffer_reader=True,
+        use_shared_memory=False,  # accepted for parity; threads share memory
+        timeout=0,
+        worker_init_fn=None,
+    ):
+        if not isinstance(dataset, Dataset):
+            raise TypeError("dataset must be a paddle_tpu Dataset")
+        self.dataset = dataset
+        self.feed_list = feed_list
+        self.return_list = return_list
+        self.collate_fn = collate_fn
+        self.num_workers = int(num_workers)
+        self.use_buffer_reader = use_buffer_reader
+        self.prefetch_factor = 2
+        self.worker_init_fn = worker_init_fn
+
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+            self.drop_last = getattr(batch_sampler, "drop_last", drop_last)
+        elif isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last,
+            )
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            # TypeError so list(loader) treats it as "no length hint"
+            raise TypeError("IterableDataset loader has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self.num_workers > 0:
+            it = _MultiWorkerIter(self)
+        else:
+            it = _SingleProcessIter(self)
+        if self.feed_list and not self.return_list:
+            names = [
+                v if isinstance(v, str) else v.name for v in self.feed_list
+            ]
+
+            def as_feed(batch):
+                # a single-array collate is ONE column, not an iterable of
+                # columns — wrap so zip pairs names with whole batches
+                cols = (
+                    list(batch) if isinstance(batch, (list, tuple)) else [batch]
+                )
+                if len(cols) != len(names):
+                    raise ValueError(
+                        f"feed_list has {len(names)} variables but each "
+                        f"sample yields {len(cols)} columns"
+                    )
+                return dict(zip(names, cols))
+
+            return (as_feed(b) for b in it)
+        return it
+
+    def __call__(self):
+        return self.__iter__()
+
+    @staticmethod
+    def from_generator(
+        feed_list=None,
+        capacity=64,
+        use_double_buffer=True,
+        iterable=True,
+        return_list=False,
+        use_multiprocess=False,
+        drop_last=True,
+    ):
+        return GeneratorLoader(
+            feed_list=feed_list,
+            capacity=capacity,
+            use_double_buffer=use_double_buffer,
+            iterable=iterable,
+            return_list=return_list,
+            drop_last=drop_last,
+        )
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        """Thin adaptor for paddle_tpu.dataset.* (PS-style datasets)."""
+        return dataset
+
+
+class GeneratorLoader:
+    """fluid GeneratorLoader parity (reader.py:952): bind feed Variables,
+    feed from a python generator with a background prefetch thread +
+    device staging."""
+
+    def __init__(self, feed_list, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False, drop_last=True):
+        self._feed_list = feed_list or []
+        self._names = [
+            v if isinstance(v, str) else v.name for v in self._feed_list
+        ]
+        self._capacity = int(capacity)
+        self._use_double_buffer = use_double_buffer
+        self._iterable = iterable
+        self._return_list = return_list
+        self._drop_last = drop_last
+        self._source = None  # () -> iterator of batches (list/tuple per var)
+
+    # -- data source setters (reference :1022-1095) ------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batched():
+            batch = []
+            for sample in reader():
+                if not isinstance(sample, (list, tuple)):
+                    sample = (sample,)
+                batch.append(sample)
+                if len(batch) == batch_size:
+                    yield default_collate_fn(batch)
+                    batch = []
+            if batch and not drop_last:
+                yield default_collate_fn(batch)
+
+        self._source = batched
+        self._drop_last = drop_last
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def batched():
+            for sample_list in reader():
+                yield default_collate_fn(
+                    [tuple(s) if isinstance(s, (list, tuple)) else (s,)
+                     for s in sample_list]
+                )
+
+        self._source = batched
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._source = reader
+        return self
+
+    # -- iteration ---------------------------------------------------------
+    def _stage(self, arrays):
+        if not self._use_double_buffer:
+            return arrays
+        from .dataloader.dataloader_iter import stage_to_device
+
+        return [stage_to_device(a) for a in arrays]
+
+    def _prefetching_iter(self):
+        if self._source is None:
+            raise RuntimeError(
+                "no data source: call set_sample_generator / "
+                "set_sample_list_generator / set_batch_generator first"
+            )
+        q: queue.Queue = queue.Queue(maxsize=self._capacity)
+        DONE = object()
+        stop = threading.Event()
+
+        def put(item):
+            # bounded put that gives up when the consumer abandoned the
+            # iteration — otherwise the thread (and its staged device
+            # buffers) would be pinned forever on a full queue
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for batch in self._source():
+                    if not isinstance(batch, (list, tuple)):
+                        batch = (batch,)
+                    if not put(self._stage(list(batch))):
+                        return
+            except BaseException as e:
+                put(e)
+                return
+            put(DONE)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                if self._return_list or not self._names:
+                    yield item
+                else:
+                    yield {n: v for n, v in zip(self._names, item)}
+        finally:
+            # runs on break/exception/GC of the generator: release producer
+            stop.set()
+
+    def __iter__(self):
+        return self._prefetching_iter()
+
+    def __call__(self):
+        return self.__iter__()
+
+    # non-iterable mode compatibility (reference start/reset protocol)
+    _started = None
+
+    def start(self):
+        self._started = self._prefetching_iter()
+        return self
+
+    def next(self):
+        if self._started is None:
+            raise RuntimeError(
+                "GeneratorLoader is not started: call start() first "
+                "(non-iterable mode protocol, reference reader.py:952)"
+            )
+        return next(self._started)
+
+    def reset(self):
+        if self._started is not None:
+            self._started.close()  # triggers the producer shutdown path
+        self._started = None
