@@ -193,7 +193,7 @@ class Executor:
             axis_sizes = dict(mesh.shape) if mesh is not None else {}
             ctx = EmitContext(
                 step_key=step_key, is_test=False, mesh_axes=mesh_axes,
-                axis_sizes=axis_sizes,
+                axis_sizes=axis_sizes, program=program,
             )
             for op in ops:
                 try:

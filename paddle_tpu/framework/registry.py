@@ -47,7 +47,7 @@ class EmitContext:
 
     def __init__(
         self, step_key=None, is_test=False, mesh_axes=(), scope=None,
-        abstract=False, axis_sizes=None,
+        abstract=False, axis_sizes=None, program=None,
     ):
         self.step_key = step_key
         self.is_test = is_test
@@ -59,6 +59,17 @@ class EmitContext:
         # True only during infer_shapes' eval_shape pass: emitters may then
         # substitute BATCH_SENTINEL for -1 dims; at run time -1 is an error
         self.abstract = abstract
+        # the Program being traced: control-flow emitters resolve their
+        # sub_block attr through it (while/cond/scan_block, ops/control_flow.py)
+        self.program = program
+
+    def with_key(self, new_key):
+        """Shallow copy with a different step_key (loop bodies fold the
+        iteration index in so dropout masks vary across iterations)."""
+        c = EmitContext.__new__(EmitContext)
+        c.__dict__.update(self.__dict__)
+        c.step_key = new_key
+        return c
 
     def key_for(self, op_uid: int, op_type: str = ""):
         # salt by op type: uids are per-Program, so two programs sharing a
@@ -187,7 +198,9 @@ def infer_shapes(op_type, block, inputs, attrs):
         for slot, names in (inputs or {}).items()
     }
     fake_op = Operator(block, op_type, inputs, {}, attrs)
-    ctx = EmitContext(step_key=None, is_test=True, abstract=True)
+    ctx = EmitContext(
+        step_key=None, is_test=True, abstract=True, program=block.program
+    )
 
     def absfn(specs):
         return op_def.emit(ctx, fake_op, specs)

@@ -44,3 +44,12 @@ from .learning_rate_scheduler import (  # noqa: F401
     piecewise_decay,
     polynomial_decay,
 )
+from .control_flow import (  # noqa: F401
+    StaticRNN,
+    Switch,
+    While,
+    case,
+    cond,
+    increment,
+    switch_case,
+)

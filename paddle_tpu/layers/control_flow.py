@@ -1,0 +1,462 @@
+"""Control-flow layer API (reference python/paddle/fluid/layers/
+control_flow.py, 3,820 LoC: While :1038, cond :2334, case :2860,
+switch_case :3082, StaticRNN :414, Switch :3235, increment :1497,
+array_write/array_read :1560/:1682).
+
+Builds sub-block Programs consumed by the control-flow emitters in
+ops/control_flow.py (lax.cond / lax.while_loop / lax.scan lowering).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import unique_name
+from ..framework.program import Variable, default_main_program
+from . import tensor
+
+
+def _external_reads(block, produced_extra=()):
+    """Names read by block ops, resolved in an ancestor block (captures)."""
+    produced = set(produced_extra)
+    reads = []
+    for op in block.ops:
+        for n in op.input_names():
+            if n and n not in produced and n not in reads:
+                if n not in block.vars:  # resolved in a parent block
+                    reads.append(n)
+        for n in op.output_names():
+            if n:
+                produced.add(n)
+    return reads
+
+
+def _written_outer(block):
+    """Names written by block ops that pre-exist OUTSIDE the sub-block
+    (fluid in-place write-back semantics)."""
+    out = []
+    for op in block.ops:
+        for n in op.output_names():
+            if n and n not in block.vars and n not in out:
+                out.append(n)
+    return out
+
+
+def increment(x, value=1.0, in_place=True):
+    """reference control_flow.py increment :1497. Appends to the CURRENT
+    block (x may live in an ancestor — inside a While body the op must land
+    in the sub-block)."""
+    blk = default_main_program().current_block()
+    if in_place:
+        blk.append_op(
+            "increment", {"X": [x.name]}, {"Out": [x.name]}, {"step": value}
+        )
+        return x
+    out = blk.create_var(
+        name=unique_name.generate("increment"), shape=x.shape, dtype=x.dtype
+    )
+    blk.append_op(
+        "increment", {"X": [x.name]}, {"Out": [out.name]}, {"step": value}
+    )
+    return out
+
+
+class While:
+    """fluid.layers.While parity (control_flow.py:1038).
+
+        i = fill_constant([1], "int32", 0)
+        n = fill_constant([1], "int32", 10)
+        cond = less_than(i, n)
+        w = While(cond)
+        with w.block():
+            ... ops writing loop vars ...
+            increment(i)
+            assign(less_than(i, n), cond)   # body must refresh cond
+
+    Lowered to one `while` op running lax.while_loop (ops/control_flow.py).
+    Non-differentiable (data-dependent trip count) — use StaticRNN for
+    trainable loops.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        if not isinstance(cond, Variable):
+            raise TypeError("While cond must be a bool Variable")
+        self.cond_var = cond
+        self._prog = default_main_program()
+
+    @contextlib.contextmanager
+    def block(self):
+        parent = self._prog.current_block()
+        sub = self._prog.create_block()
+        try:
+            yield
+        finally:
+            self._prog.rollback()
+        written = _written_outer(sub)
+        if self.cond_var.name not in written:
+            raise ValueError(
+                "While body never writes the condition variable "
+                f"{self.cond_var.name!r}; the loop would not terminate. "
+                "Refresh it, e.g. layers.assign(new_cond, cond)."
+            )
+        carry = [n for n in written if n != self.cond_var.name]
+        # captures that are only read still ride the carry unchanged
+        for n in _external_reads(sub):
+            if n not in carry and n != self.cond_var.name:
+                carry.append(n)
+        parent.append_op(
+            "while",
+            {"Condition": [self.cond_var.name], "X": list(carry)},
+            {"Out": list(carry)},
+            {
+                "sub_block": sub.idx,
+                "carry_names": list(carry),
+                "cond_name": self.cond_var.name,
+            },
+        )
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """fluid.layers.cond parity (control_flow.py:2334): functional two-branch
+    conditional; both branches must return matching Variables."""
+    prog = default_main_program()
+    parent = prog.current_block()
+
+    def build(fn):
+        sub = prog.create_block()
+        try:
+            out = fn() if fn is not None else None
+        finally:
+            prog.rollback()
+        outs = (
+            list(out) if isinstance(out, (list, tuple))
+            else ([] if out is None else [out])
+        )
+        for v in outs:
+            if not isinstance(v, Variable):
+                raise TypeError("branch functions must return Variables")
+        return sub, outs
+
+    t_blk, t_outs = build(true_fn)
+    f_blk, f_outs = build(false_fn)
+    for side, blk in (("true_fn", t_blk), ("false_fn", f_blk)):
+        written = _written_outer(blk)
+        if written:
+            raise ValueError(
+                f"cond() {side} writes outer variables {written}: branches "
+                "are functional (lax.cond) — return new values instead of "
+                "assigning to outer vars (use layers.Switch for "
+                "assignment-style branching)"
+            )
+    if len(t_outs) != len(f_outs):
+        raise ValueError(
+            f"true_fn returns {len(t_outs)} values, false_fn {len(f_outs)}"
+        )
+    for a, b in zip(t_outs, f_outs):
+        if (tuple(a.shape or ()) != tuple(b.shape or ())
+                or a.dtype != b.dtype):
+            raise ValueError(
+                f"branch outputs mismatch: {a.name}:{a.shape}/{a.dtype} vs "
+                f"{b.name}:{b.shape}/{b.dtype} (lax.cond requires identical "
+                "shapes/dtypes)"
+            )
+
+    t_in = _external_reads(t_blk)
+    f_in = _external_reads(f_blk)
+    # a branch may return an outer var untouched (pass-through): it is not
+    # read by any in-block op, so add it to the captures explicitly
+    for in_list, blk, branch_outs in (
+        (t_in, t_blk, t_outs), (f_in, f_blk, f_outs)
+    ):
+        produced = {n for op_ in blk.ops for n in op_.output_names()}
+        for v in branch_outs:
+            if v.name not in produced and v.name not in in_list:
+                in_list.append(v.name)
+    outs = [
+        parent.create_var(
+            name=unique_name.generate("cond_out"),
+            shape=v.shape, dtype=v.dtype,
+        )
+        for v in t_outs
+    ]
+    parent.append_op(
+        "cond",
+        {"Cond": [pred.name], "TrueIn": t_in, "FalseIn": f_in},
+        {"Out": [v.name for v in outs]},
+        {
+            "true_block": t_blk.idx,
+            "false_block": f_blk.idx,
+            "true_in_names": t_in,
+            "false_in_names": f_in,
+            "true_out_names": [v.name for v in t_outs],
+            "false_out_names": [v.name for v in f_outs],
+        },
+    )
+    if not outs:
+        return None
+    return outs[0] if len(outs) == 1 else outs
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """fluid.layers.case parity (:2860): first true pred wins."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if rest:
+        return cond(pred, fn, lambda: case(rest, default))
+    if default is None:
+        # fluid: last fn is the fallback when no default given
+        return cond(pred, fn, fn)
+    return cond(pred, fn, default)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """fluid.layers.switch_case parity (:3082)."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = list(enumerate(branch_fns))
+    preds = [
+        (tensor.equal(branch_index,
+                      tensor.fill_constant([1], branch_index.dtype, float(i))),
+         fn)
+        for i, fn in pairs
+    ]
+    if default is None:
+        default = pairs[-1][1]
+    return case(preds, default)
+
+
+class Switch:
+    """fluid.layers.Switch parity (:3235) — imperative-style sugar that
+    collects (cond, block) pairs and lowers to nested `cond` ops. Supported
+    pattern: assignments to pre-created vars via layers.assign inside each
+    case block."""
+
+    def __init__(self, name=None):
+        self._cases = []  # (pred_var_or_None, sub_block)
+        self._prog = default_main_program()
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        sub = self._prog.create_block()
+        try:
+            yield
+        finally:
+            self._prog.rollback()
+        self._cases.append((condition, sub))
+
+    @contextlib.contextmanager
+    def default(self):
+        sub = self._prog.create_block()
+        try:
+            yield
+        finally:
+            self._prog.rollback()
+        self._cases.append((None, sub))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        parent = self._prog.current_block()
+        # first-match-wins: a running "no case matched yet" flag gates each
+        # case block (reference Switch semantics, control_flow.py:3235)
+        unmatched = tensor.fill_constant([1], "bool", True)
+        for pred, sub in self._cases:
+            written = _written_outer(sub)
+            reads = _external_reads(sub)
+            # cond op: true branch = the case block, false branch = identity
+            # over the written vars (empty block whose inputs pass through)
+            f_blk = self._prog.create_block()
+            self._prog.rollback()
+            outs = [
+                parent.create_var(
+                    name=unique_name.generate("switch_out"),
+                    shape=parent.var(n).shape,
+                    dtype=parent.var(n).dtype,
+                )
+                for n in written
+            ]
+            if pred is None:  # default: fires iff nothing matched before
+                eff = unmatched
+            else:
+                eff = tensor.logical_and(unmatched, pred)
+                unmatched = tensor.logical_and(
+                    unmatched, tensor.logical_not(pred)
+                )
+            parent.append_op(
+                "cond",
+                {"Cond": [eff.name], "TrueIn": reads, "FalseIn": written},
+                {"Out": [v.name for v in outs]},
+                {
+                    "true_block": sub.idx,
+                    "false_block": f_blk.idx,
+                    "true_in_names": reads,
+                    "false_in_names": written,
+                    "true_out_names": written,
+                    "false_out_names": written,
+                },
+            )
+            for n, v in zip(written, outs):
+                parent.append_op("assign", {"X": [v.name]}, {"Out": [n]}, {})
+        return False
+
+
+class StaticRNN:
+    """fluid.layers.StaticRNN parity (control_flow.py:414): fixed-length
+    recurrence over axis 0 of its step inputs, lowered to one differentiable
+    `scan_block` op (lax.scan; BPTT via the generic vjp machinery).
+
+        rnn = StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)        # x: [T, B, D] -> x_t: [B, D]
+            h_prev = rnn.memory(init=h0)   # or shape/value form
+            h = layers.fc(concat([x_t, h_prev]), D)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        outs = rnn()                       # [T, B, D]
+    """
+
+    def __init__(self, name=None):
+        self._prog = default_main_program()
+        self._sub = None
+        self._seq = []  # (outer_name, inblock_var)
+        self._mems = []  # (init_outer_name, mem_var, update_name)
+        self._outs = []  # in-block step output vars
+        self._built = False
+
+    @contextlib.contextmanager
+    def step(self):
+        self._sub = self._prog.create_block()
+        try:
+            yield
+        except BaseException:
+            self._prog.rollback()
+            raise  # user error from the step body, not a build problem
+        else:
+            self._prog.rollback()
+            self._build()
+
+    def _require_in_step(self):
+        if self._sub is None or self._prog.current_block() is not self._sub:
+            raise RuntimeError("call inside `with rnn.step():`")
+
+    def step_input(self, x):
+        self._require_in_step()
+        if x.shape is None or len(x.shape) < 1:
+            raise ValueError("step_input needs a [T, ...] variable")
+        v = self._sub.create_var(
+            name=unique_name.generate(x.name + "@step"),
+            shape=tuple(x.shape[1:]), dtype=x.dtype,
+        )
+        self._seq.append((x.name, v))
+        return v
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1, dtype="float32"):
+        self._require_in_step()
+        if init is None:
+            if shape is None:
+                raise ValueError("memory() needs init= or shape=")
+            # the init constant must live OUTSIDE the loop body: emit its
+            # fill op into the parent block (a proper initial carry); the
+            # dtype must match what update_memory will carry (lax.scan
+            # requires identical init/next dtypes)
+            parent = self._prog.blocks[self._sub.parent_idx]
+            name = unique_name.generate("rnn_mem_init")
+            init = parent.create_var(
+                name=name, shape=tuple(shape), dtype=dtype
+            )
+            parent.append_op(
+                "fill_constant",
+                {},
+                {"Out": [name]},
+                {"shape": list(shape), "dtype": dtype,
+                 "value": float(init_value)},
+            )
+        v = self._sub.create_var(
+            name=unique_name.generate("rnn_mem"),
+            shape=init.shape, dtype=init.dtype,
+        )
+        self._mems.append([init.name, v, None])
+        return v
+
+    def update_memory(self, mem, value):
+        self._require_in_step()
+        for m in self._mems:
+            if m[1] is mem:
+                m[2] = value.name
+                return
+        raise ValueError("update_memory: unknown memory variable")
+
+    def step_output(self, o):
+        self._require_in_step()
+        self._outs.append(o)
+
+    output = step_output
+
+    def _build(self):
+        for m in self._mems:
+            if m[2] is None:
+                raise RuntimeError(
+                    f"memory {m[1].name!r} was never update_memory()'d"
+                )
+        if not self._seq:
+            raise ValueError("StaticRNN needs at least one step_input")
+        parent = self._prog.current_block()
+        sub = self._sub
+        inblock_produced = (
+            [v.name for _, v in self._seq] + [m[1].name for m in self._mems]
+        )
+        caps = _external_reads(sub, produced_extra=inblock_produced)
+        t_dim = parent.var(self._seq[0][0]).shape[0]
+        self._result = []
+        out_vars = []
+        for o in self._outs:
+            ov = parent.create_var(
+                name=unique_name.generate("rnn_out"),
+                shape=(t_dim,) + tuple(o.shape or ()),
+                dtype=o.dtype,
+            )
+            out_vars.append(ov)
+        last_mems = [
+            parent.create_var(
+                name=unique_name.generate("rnn_last_mem"),
+                shape=m[1].shape, dtype=m[1].dtype,
+            )
+            for m in self._mems
+        ]
+        parent.append_op(
+            "scan_block",
+            {
+                "SeqIn": [n for n, _ in self._seq],
+                "InitMem": [m[0] for m in self._mems],
+                "Captured": list(caps),
+            },
+            {
+                "Out": [v.name for v in out_vars],
+                "LastMem": [v.name for v in last_mems],
+            },
+            {
+                "sub_block": sub.idx,
+                "seq_names": [v.name for _, v in self._seq],
+                "mem_names": [m[1].name for m in self._mems],
+                "mem_update_names": [m[2] for m in self._mems],
+                "out_names": [o.name for o in self._outs],
+                "cap_names": list(caps),
+            },
+        )
+        self._result = out_vars
+        self._last_mems = last_mems
+        self._built = True
+
+    def __call__(self):
+        if not self._built:
+            raise RuntimeError("StaticRNN block was never built")
+        if len(self._result) == 1:
+            return self._result[0]
+        return self._result

@@ -48,7 +48,11 @@ class LayerHelper:
         name = attr.name or unique_name.generate(
             f"{self.layer_type}_{'b' if is_bias else 'w'}"
         )
-        mb, sb = main_block(), startup_block()
+        # parameters ALWAYS live in the global block, even when the layer is
+        # built inside a control-flow sub-block (fluid layer_helper_base
+        # create_parameter does the same) — so the executor state analysis
+        # sees them and sub-blocks capture them as external reads
+        mb, sb = default_main_program().global_block, startup_block()
         p = mb.create_parameter(
             name, shape, dtype, trainable=attr.trainable
         )

@@ -10,6 +10,7 @@ from . import (  # noqa: F401
     activation,
     amp_ops,
     collective,
+    control_flow,
     math,
     metrics,
     nn,

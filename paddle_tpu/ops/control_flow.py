@@ -1,0 +1,207 @@
+"""Control-flow ops: sub-block programs lowered to XLA structured control
+flow.
+
+Reference parity: paddle/fluid/operators/controlflow/ (~3.5k LoC:
+while_op.cc, conditional_block_op.cc, recurrent_op.cc) and the grad variants
+(while_grad, conditional_block_grad, recurrent_grad) synthesized by
+backward.py:843's sub-block recursion.
+
+TPU-native re-design: a sub-block is not interpreted op-by-op against a
+Scope — its ops are *traced into* lax.cond / lax.while_loop / lax.scan
+inside the same XLA computation as the rest of the program, so the loop body
+is compiled once, fused, and runs entirely on device (the reference's
+while_op re-entered the C++ executor per iteration, executor.cc:432).
+
+Gradients: `cond` and `scan_block` are ordinary differentiable emitters —
+append_backward's generic __vjp__ replays them under jax.vjp, and JAX's
+reverse-mode through lax.cond/lax.scan produces exactly the structured grad
+programs the reference hand-built (conditional_block_grad / the
+recurrent_grad backward scan). `while` (data-dependent trip count) is
+non-differentiable, as reverse-mode through an unbounded while requires
+taping — the reference's while_grad relied on per-iteration scope stacks;
+here the differentiable-loop story is scan_block (use StaticRNN for training
+loops, While for inference-style loops).
+
+Carried-state contract (enforced by the Python layer in
+layers/control_flow.py): every var written in the sub-block that pre-exists
+outside it is carried; shapes/dtypes must be loop-invariant (XLA static
+shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op, run_op
+
+
+def _sub_block(ctx, op, attr_name="sub_block"):
+    if ctx.program is None:
+        raise RuntimeError(
+            f"op {op.type!r} needs a Program on the EmitContext to resolve "
+            "its sub-block; control flow is only available through the "
+            "Executor (not the eager tracer — use python control flow there)"
+        )
+    return ctx.program.blocks[op.attr(attr_name)]
+
+
+def _run_block(ctx, block, env):
+    for sub_op in block.ops:
+        run_op(ctx, sub_op, env)
+    return env
+
+
+def _loop_ctx(ctx, iteration):
+    """Fold the iteration index into the RNG stream so dropout masks vary
+    across loop iterations (the executor already folds the step)."""
+    if ctx.step_key is None:
+        return ctx
+    return ctx.with_key(jax.random.fold_in(ctx.step_key, iteration))
+
+
+def _cond_infer(block, inputs, attrs):
+    prog = block.program
+    tb = prog.blocks[attrs["true_block"]]
+    specs = []
+    for n in attrs["true_out_names"]:
+        v = tb.var(n)
+        specs.append((tuple(v.shape or ()), v.dtype))
+    return {"Out": specs}
+
+
+@register_op(
+    "cond", inputs=["Cond", "TrueIn", "FalseIn"], outputs=["Out"],
+    infer_shape=_cond_infer,
+)
+def _cond(ctx, op, ins):
+    """lax.cond over two sub-blocks (reference conditional_block_op.cc).
+
+    TrueIn/FalseIn: external reads of each branch, in attr-recorded order
+    (true_in_names / false_in_names). Both branches must produce outputs of
+    identical shape/dtype (checked at build time by layers.cond)."""
+    pred = ins["Cond"][0].reshape(()).astype(bool)
+    t_names = op.attr("true_in_names")
+    f_names = op.attr("false_in_names")
+    t_vals = ins.get("TrueIn", [])
+    f_vals = ins.get("FalseIn", [])
+
+    def make_branch(block_idx, in_names, out_names, vals_idx):
+        blk = ctx.program.blocks[block_idx]
+
+        def branch(operands):
+            env = dict(zip(in_names, operands[vals_idx]))
+            _run_block(ctx, blk, env)
+            return tuple(env[n] for n in out_names)
+
+        return branch
+
+    true_f = make_branch(
+        op.attr("true_block"), t_names, op.attr("true_out_names"), 0
+    )
+    false_f = make_branch(
+        op.attr("false_block"), f_names, op.attr("false_out_names"), 1
+    )
+    outs = lax.cond(pred, true_f, false_f, (tuple(t_vals), tuple(f_vals)))
+    return {"Out": list(outs)}
+
+
+def _while_infer(block, inputs, attrs):
+    specs = []
+    for n in inputs.get("X", []):
+        v = block.var(n)
+        specs.append((tuple(v.shape or ()), v.dtype))
+    return {"Out": specs}
+
+
+@register_op(
+    "while", inputs=["Condition", "X"], outputs=["Out"],
+    differentiable=False, infer_shape=_while_infer,
+)
+def _while(ctx, op, ins):
+    """lax.while_loop over a sub-block (reference while_op.cc).
+
+    X: carried vars (attr carry_names, in-block names == outer names, fluid
+    in-place semantics); Condition: bool var, recomputed by the body (the
+    body must write it — layers.While enforces this). Out re-binds the same
+    outer names, so ops after the loop see final values."""
+    blk = _sub_block(ctx, op)
+    names = op.attr("carry_names")
+    cond_name = op.attr("cond_name")
+    init = tuple(ins["X"])
+    cond0 = ins["Condition"][0]
+
+    def cond_fun(carry):
+        i, vals, c = carry
+        return c.reshape(()).astype(bool)
+
+    def body_fun(carry):
+        i, vals, c = carry
+        env = dict(zip(names, vals))
+        env[cond_name] = c
+        _run_block(_loop_ctx(ctx, i), blk, env)
+        return (i + 1, tuple(env[n] for n in names), env[cond_name])
+
+    _, final, _ = lax.while_loop(
+        cond_fun, body_fun, (jnp.zeros((), jnp.int32), init, cond0)
+    )
+    return {"Out": list(final)}
+
+
+def _scan_infer(block, inputs, attrs):
+    prog = block.program
+    sb = prog.blocks[attrs["sub_block"]]
+    seq_outer = inputs.get("SeqIn", [])
+    t_dim = None
+    if seq_outer:
+        v = block.var(seq_outer[0])
+        t_dim = (v.shape or (None,))[0]
+    outs = []
+    for n in attrs["out_names"]:
+        v = sb.var(n)
+        outs.append(((t_dim,) + tuple(v.shape or ()), v.dtype))
+    last = []
+    for n in attrs["mem_names"]:
+        v = sb.var(n)
+        last.append((tuple(v.shape or ()), v.dtype))
+    return {"Out": outs, "LastMem": last}
+
+
+@register_op(
+    "scan_block",
+    inputs=["SeqIn", "InitMem", "Captured"],
+    outputs=["Out", "LastMem"],
+    infer_shape=_scan_infer,
+)
+def _scan_block(ctx, op, ins):
+    """lax.scan over a sub-block: the differentiable loop (reference
+    recurrent_op.cc / StaticRNN). Sequence inputs are consumed along axis 0;
+    memories carry across steps; step outputs stack along a new axis 0.
+    jax.vjp through this emitter IS the recurrent_grad program — BPTT comes
+    from the __vjp__ machinery with no sub-block backward recursion."""
+    blk = _sub_block(ctx, op)
+    seq_names = op.attr("seq_names")  # in-block per-step var names
+    mem_names = op.attr("mem_names")  # in-block memory var names
+    upd_names = op.attr("mem_update_names")  # var holding next-step memory
+    out_names = op.attr("out_names")
+    cap_names = op.attr("cap_names")
+
+    seq_vals = tuple(ins.get("SeqIn", []))
+    mem0 = tuple(ins.get("InitMem", []))
+    caps = dict(zip(cap_names, ins.get("Captured", [])))
+
+    def step(carry, xs):
+        i, mems = carry
+        env = dict(caps)
+        env.update(zip(seq_names, xs))
+        env.update(zip(mem_names, mems))
+        _run_block(_loop_ctx(ctx, i), blk, env)
+        new_mems = tuple(env[n] for n in upd_names)
+        outs = tuple(env[n] for n in out_names)
+        return (i + 1, new_mems), outs
+
+    (_, last_mems), stacked = lax.scan(
+        step, (jnp.zeros((), jnp.int32), mem0), seq_vals
+    )
+    return {"Out": list(stacked), "LastMem": list(last_mems)}

@@ -1,0 +1,302 @@
+"""Control flow: While / cond / case / switch_case / Switch / StaticRNN.
+
+Modeled on the reference's test_while_op.py, test_cond.py, test_case.py,
+test_switch.py, test_recurrent_op.py — including the StaticRNN
+train-and-match-numpy requirement (VERDICT item 4: a StaticRNN-style loop
+model trains and matches a numpy reference).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+def _run(fetch, feed=None):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed or {}, fetch_list=fetch)
+
+
+# -- While ------------------------------------------------------------------
+
+
+def test_while_sums_to_ten():
+    i = layers.fill_constant([1], "int32", 0)
+    n = layers.fill_constant([1], "int32", 10)
+    acc = layers.fill_constant([1], "float32", 0.0)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        layers.assign(acc + 1.0, acc)
+        layers.increment(i)
+        layers.assign(layers.less_than(i, n), cond)
+    (out,) = _run([acc])
+    np.testing.assert_allclose(np.asarray(out), [10.0])
+
+
+def test_while_requires_cond_update():
+    i = layers.fill_constant([1], "int32", 0)
+    n = layers.fill_constant([1], "int32", 10)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with pytest.raises(ValueError, match="condition variable"):
+        with w.block():
+            layers.increment(i)
+
+
+def test_while_data_dependent_trip_count():
+    """Trip count depends on a fed value — the thing static unrolling
+    cannot do and lax.while_loop exists for."""
+    limit = fluid.data("limit", [1], "int32")
+    i = layers.fill_constant([1], "int32", 0)
+    acc = layers.fill_constant([1], "float32", 1.0)
+    cond = layers.less_than(i, limit)
+    w = layers.While(cond)
+    with w.block():
+        layers.assign(acc * 2.0, acc)
+        layers.increment(i)
+        layers.assign(layers.less_than(i, limit), cond)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for k in (3, 7):
+        (out,) = exe.run(
+            feed={"limit": np.asarray([k], np.int32)}, fetch_list=[acc]
+        )
+        assert float(np.asarray(out).reshape(-1)[0]) == 2.0 ** k
+
+
+# -- cond / case / switch ---------------------------------------------------
+
+
+def test_cond_selects_branch():
+    x = fluid.data("x", [1], "float32")
+    big = layers.greater_than(x, layers.fill_constant([1], "float32", 0.0))
+    out = layers.cond(big, lambda: x * 2.0, lambda: x - 5.0)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (a,) = exe.run(feed={"x": np.asarray([3.0], np.float32)}, fetch_list=[out])
+    (b,) = exe.run(feed={"x": np.asarray([-1.0], np.float32)}, fetch_list=[out])
+    assert float(np.asarray(a)[0]) == 6.0
+    assert float(np.asarray(b)[0]) == -6.0
+
+
+def test_cond_is_differentiable():
+    """grad flows through the taken branch only (lax.cond vjp)."""
+    x = fluid.data("x", [1], "float32")
+    x.stop_gradient = False
+    pred = layers.greater_than(x, layers.fill_constant([1], "float32", 0.0))
+    y = layers.cond(pred, lambda: x * 3.0, lambda: x * 7.0)
+    loss = layers.reduce_sum(y)
+    (gx,) = fluid.gradients(loss, [x])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (g,) = exe.run(feed={"x": np.asarray([2.0], np.float32)}, fetch_list=[gx])
+    assert float(np.asarray(g)[0]) == 3.0
+    (g,) = exe.run(feed={"x": np.asarray([-2.0], np.float32)}, fetch_list=[gx])
+    assert float(np.asarray(g)[0]) == 7.0
+
+
+def test_case_and_switch_case():
+    x = fluid.data("x", [1], "float32")
+    one = layers.fill_constant([1], "float32", 1.0)
+    two = layers.fill_constant([1], "float32", 2.0)
+    out = layers.case(
+        [
+            (layers.less_than(x, one), lambda: x * 10.0),
+            (layers.less_than(x, two), lambda: x * 100.0),
+        ],
+        default=lambda: x * 1000.0,
+    )
+    idx = fluid.data("idx", [1], "int32")
+    sw = layers.switch_case(
+        idx, {0: lambda: x + 1.0, 2: lambda: x + 3.0},
+        default=lambda: x + 9.0,
+    )
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    def f(xv, iv=0):
+        a, b = exe.run(
+            feed={"x": np.asarray([xv], np.float32),
+                  "idx": np.asarray([iv], np.int32)},
+            fetch_list=[out, sw],
+        )
+        return float(np.asarray(a)[0]), float(np.asarray(b)[0])
+
+    assert f(0.5)[0] == 5.0
+    assert f(1.5)[0] == 150.0
+    assert f(5.0)[0] == 5000.0
+    assert f(1.0, 0)[1] == 2.0
+    assert f(1.0, 2)[1] == 4.0
+    assert f(1.0, 1)[1] == 10.0
+
+
+def test_switch_context_manager():
+    lr = layers.fill_constant([1], "float32", 0.0)
+    step = fluid.data("step", [1], "float32")
+    thresh = layers.fill_constant([1], "float32", 100.0)
+    with layers.Switch() as sw:
+        with sw.case(layers.less_than(step, thresh)):
+            layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+        with sw.default():
+            layers.assign(layers.fill_constant([1], "float32", 0.01), lr)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (a,) = exe.run(feed={"step": np.asarray([5.0], np.float32)}, fetch_list=[lr])
+    (b,) = exe.run(feed={"step": np.asarray([500.0], np.float32)}, fetch_list=[lr])
+    assert float(np.asarray(a)[0]) == pytest.approx(0.1)
+    assert float(np.asarray(b)[0]) == pytest.approx(0.01)
+
+
+# -- StaticRNN --------------------------------------------------------------
+
+
+def test_static_rnn_forward_matches_numpy():
+    T, B, D = 5, 2, 3
+    x = fluid.data("x", [T, B, D], "float32")
+    h0 = fluid.data("h0", [B, D], "float32")
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_prev = rnn.memory(init=h0)
+        h = layers.tanh(x_t + h_prev)
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()
+    rng = np.random.RandomState(0)
+    xv = rng.randn(T, B, D).astype(np.float32)
+    h0v = rng.randn(B, D).astype(np.float32)
+    (got,) = _run([out], feed={"x": xv, "h0": h0v})
+    want = []
+    h = h0v
+    for t in range(T):
+        h = np.tanh(xv[t] + h)
+        want.append(h)
+    np.testing.assert_allclose(np.asarray(got), np.stack(want), rtol=2e-5)
+
+
+def test_static_rnn_trains_and_matches_numpy():
+    """An Elman RNN regression trained by BPTT through scan_block matches a
+    hand-written numpy forward; loss decreases (VERDICT item 4 done-bar)."""
+    T, B, D = 4, 8, 3
+    x = fluid.data("x", [T, B, D], "float32")
+    y = fluid.data("y", [B, D], "float32")
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_prev = rnn.memory(shape=[B, D])
+        h = layers.tanh(
+            layers.fc(x_t, D, bias_attr=False,
+                      param_attr=fluid.ParamAttr(name="w_x"))
+            + layers.fc(h_prev, D, bias_attr=False,
+                        param_attr=fluid.ParamAttr(name="w_h"))
+        )
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    outs = rnn()
+    last = layers.squeeze(
+        layers.slice(outs, [0], [T - 1], [T]), [0]
+    )  # [B, D] final step
+    loss = layers.reduce_mean(layers.square_error_cost(last, y))
+    fluid.optimizer.Adam(0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = fluid.framework.scope.global_scope()
+    rng = np.random.RandomState(1)
+    xv = rng.randn(T, B, D).astype(np.float32)
+    # teacher targets from a ground-truth RNN => realizable, converges to ~0
+    twx = rng.randn(D, D).astype(np.float32) * 0.5
+    twh = rng.randn(D, D).astype(np.float32) * 0.5
+    ht = np.zeros((B, D), np.float32)
+    for t in range(T):
+        ht = np.tanh(xv[t] @ twx + ht @ twh)
+    yv = ht
+
+    # numpy forward with the *initialized* weights must match the graph
+    wx = np.asarray(scope.find_var("w_x"))
+    wh = np.asarray(scope.find_var("w_h"))
+    h = np.zeros((B, D), np.float32)
+    for t in range(T):
+        h = np.tanh(xv[t] @ wx + h @ wh)
+    (first_loss,) = exe.run(
+        feed={"x": xv, "y": yv}, fetch_list=[loss]
+    )
+    np.testing.assert_allclose(
+        float(np.asarray(first_loss).reshape(-1)[0]),
+        np.mean((h - yv) ** 2),
+        rtol=1e-4,
+    )
+
+    losses = [float(np.asarray(first_loss).reshape(-1)[0])]
+    for _ in range(150):
+        (lv,) = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_static_rnn_last_memory_and_multiple_outputs():
+    T, B = 3, 2
+    x = fluid.data("x", [T, B], "float32")
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        s = rnn.memory(shape=[B])
+        new_s = s + x_t
+        rnn.update_memory(s, new_s)
+        rnn.step_output(new_s)
+        rnn.step_output(x_t * 2.0)
+    o1, o2 = rnn()
+    xv = np.arange(T * B, dtype=np.float32).reshape(T, B)
+    (g1, g2) = _run([o1, o2], feed={"x": xv})
+    np.testing.assert_allclose(np.asarray(g1), np.cumsum(xv, axis=0))
+    np.testing.assert_allclose(np.asarray(g2), xv * 2)
+
+
+def test_cond_pass_through_output():
+    """A branch returning a captured var untouched must still work
+    (regression: pass-through names missing from the capture list)."""
+    x = fluid.data("x", [1], "float32")
+    pred = layers.greater_than(x, layers.fill_constant([1], "float32", 0.0))
+    out = layers.cond(pred, lambda: x, lambda: x * 2.0)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (a,) = exe.run(feed={"x": np.asarray([3.0], np.float32)}, fetch_list=[out])
+    (b,) = exe.run(feed={"x": np.asarray([-3.0], np.float32)}, fetch_list=[out])
+    assert float(np.asarray(a)[0]) == 3.0
+    assert float(np.asarray(b)[0]) == -6.0
+
+
+def test_cond_rejects_outer_writes():
+    flag = layers.fill_constant([1], "float32", 0.0)
+    x = fluid.data("x", [1], "float32")
+    pred = layers.greater_than(x, layers.fill_constant([1], "float32", 0.0))
+    with pytest.raises(ValueError, match="functional"):
+        layers.cond(
+            pred,
+            lambda: layers.assign(
+                layers.fill_constant([1], "float32", 1.0), flag
+            ),
+            lambda: flag,
+        )
+
+
+def test_static_rnn_step_body_error_propagates():
+    x = fluid.data("x", [3, 2], "float32")
+    rnn = layers.StaticRNN()
+    with pytest.raises(KeyError, match="user bug"):
+        with rnn.step():
+            rnn.step_input(x)
+            raise KeyError("user bug")
