@@ -28,6 +28,7 @@ from .framework import (  # noqa: F401
     global_scope,
     in_dygraph_mode,
     program_guard,
+    device_guard,
     scope_guard,
 )
 from . import ops  # noqa: F401  (registers all op emitters)

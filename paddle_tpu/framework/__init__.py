@@ -9,6 +9,7 @@ from .program import (  # noqa: F401
     Variable,
     default_main_program,
     default_startup_program,
+    device_guard,
     grad_var_name,
     in_dygraph_mode,
     program_guard,

@@ -136,6 +136,11 @@ class Operator:
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        # ambient placement annotation (reference op_device attr honored at
+        # operator.cc:1050-1075; set by device_guard, consumed by
+        # PipelineOptimizer's stage slicing)
+        if _current_device is not None and "op_device" not in self.attrs:
+            self.attrs["op_device"] = _current_device
         # stable identity used to derive per-op RNG keys (registry.EmitContext);
         # per-Program (not global) so two identically-built programs get
         # identical RNG streams; survives deepcopy/clone so test-mode
@@ -349,6 +354,24 @@ def program_guard(main_program, startup_program=None):
         yield
     finally:
         _main_program, _startup_program = old_main, old_startup
+
+
+# --- device_guard (reference fluid.device_guard; op_device attr) ---
+_current_device = None
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Annotate appended ops with a placement string. For pipeline
+    parallelism use "pipeline:K" stage tags (reference PipelineOptimizer
+    contract, optimizer.py:3556)."""
+    global _current_device
+    old = _current_device
+    _current_device = device
+    try:
+        yield
+    finally:
+        _current_device = old
 
 
 # --- dygraph mode switch (framework.py:180 in the reference) ---

@@ -62,6 +62,16 @@ class EmitContext:
         # the Program being traced: control-flow emitters resolve their
         # sub_block attr through it (while/cond/scan_block, ops/control_flow.py)
         self.program = program
+        # >1 only while tracing inside pipeline_block stage bodies: runtime
+        # batches are 1/divisor of graph-build shapes (microbatching), and
+        # batch-shape-baking ops (reshape2) may re-derive their leading dim
+        self.batch_divisor = 1
+
+    def with_batch_divisor(self, divisor):
+        c = EmitContext.__new__(EmitContext)
+        c.__dict__.update(self.__dict__)
+        c.batch_divisor = int(divisor)
+        return c
 
     def with_key(self, new_key):
         """Shallow copy with a different step_key (loop bodies fold the

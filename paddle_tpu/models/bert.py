@@ -122,9 +122,29 @@ def _encoder_layer(x, attn_bias, cfg, prefix, is_test):
     )
 
 
-def bert_encoder(input_ids, token_type_ids, input_mask, cfg, is_test=False):
+def _attn_bias(input_mask):
+    """[B,S] float mask -> additive attention bias [B,1,1,S]
+    (0 keep, -1e4 mask; bf16-safe)."""
+    b, s = input_mask.shape
+    mask = layers.reshape(input_mask, [b, 1, 1, s])
+    return layers.scale(mask, scale=1e4, bias=-1e4)
+
+
+def bert_encoder_layers(x, input_mask, cfg, start=0, end=None, is_test=False):
+    """Run encoder layers [start, end) over [B,S,H] input — the unit of
+    pipeline-stage splitting (device_guard slices the layer stack)."""
+    attn_bias = _attn_bias(input_mask)
+    end = cfg.num_layers if end is None else end
+    for i in range(start, end):
+        x = _encoder_layer(x, attn_bias, cfg, f"bert_l{i}", is_test)
+    return x
+
+
+def bert_encoder(input_ids, token_type_ids, input_mask, cfg, is_test=False,
+                 num_layers=None):
     """input_ids/token_type_ids: [B,S] int64; input_mask: [B,S] float32.
-    Returns sequence output [B,S,H]."""
+    Returns sequence output [B,S,H]. num_layers limits the stack (pipeline
+    stage 0 = embeddings + first half; see bert_encoder_layers)."""
     b, s = input_ids.shape
     word_emb = layers.embedding(
         input_ids,
@@ -152,22 +172,13 @@ def bert_encoder(input_ids, token_type_ids, input_mask, cfg, is_test=False):
         bias_attr=ParamAttr(name="emb_ln_bias"),
     )
     emb = layers.dropout(emb, cfg.hidden_dropout, is_test=is_test)
-
-    # additive attention bias [B,1,1,S]: 0 keep, -1e4 mask (bf16-safe)
-    mask = layers.reshape(input_mask, [b, 1, 1, s])
-    attn_bias = layers.scale(mask, scale=1e4, bias=-1e4)
-
-    x = emb
-    for i in range(cfg.num_layers):
-        x = _encoder_layer(x, attn_bias, cfg, f"bert_l{i}", is_test)
-    return x
+    n = cfg.num_layers if num_layers is None else num_layers
+    return bert_encoder_layers(emb, input_mask, cfg, 0, n, is_test)
 
 
-def bert_pretrain(input_ids, token_type_ids, input_mask, mlm_labels, cfg,
-                  is_test=False):
-    """Masked-LM pretraining loss over all positions; mlm_labels [B,S] int64
-    with ignore_index -100 on unmasked positions."""
-    seq = bert_encoder(input_ids, token_type_ids, input_mask, cfg, is_test)
+def bert_mlm_head(seq, mlm_labels, cfg):
+    """Masked-LM loss head over [B,S,H] sequence output; mlm_labels [B,S]
+    int64 with ignore_index -100 on unmasked positions."""
     b, s, h = seq.shape
     seq2 = layers.reshape(seq, [b * s, h])
     logits = layers.fc(
@@ -179,13 +190,22 @@ def bert_pretrain(input_ids, token_type_ids, input_mask, mlm_labels, cfg,
     labels = layers.reshape(mlm_labels, [b * s, 1])
     loss = layers.softmax_with_cross_entropy(logits, labels, ignore_index=-100)
     # average over the *masked* positions only: ignored positions contribute
-    # zero loss, so a plain mean would scale loss/grads by the masking ratio
-    ignore = layers.fill_constant([b * s, 1], "int64", -100)
+    # zero loss, so a plain mean would scale loss/grads by the masking ratio.
+    # [1]-shaped constant broadcasts, so the head stays batch-size agnostic
+    # (pipeline microbatching shrinks the runtime batch)
+    ignore = layers.fill_constant([1], "int64", -100)
     valid = layers.cast(layers.not_equal(labels, ignore), "float32")
     denom = layers.elementwise_max(
         layers.reduce_sum(valid), layers.fill_constant([1], "float32", 1.0)
     )
     return layers.elementwise_div(layers.reduce_sum(loss), denom)
+
+
+def bert_pretrain(input_ids, token_type_ids, input_mask, mlm_labels, cfg,
+                  is_test=False):
+    """End-to-end MLM pretraining loss (encoder + head)."""
+    seq = bert_encoder(input_ids, token_type_ids, input_mask, cfg, is_test)
+    return bert_mlm_head(seq, mlm_labels, cfg)
 
 
 def bert_tp_shardings(cfg, axis="mp"):

@@ -34,7 +34,19 @@ def _resolve_shape(shape, x_shape):
 @register_op("reshape2", inputs=["X"], outputs=["Out", "XShape"])
 def _reshape2(ctx, op, ins):
     x = ins["X"][0]
-    shape = _resolve_shape(op.attr("shape"), x.shape)
+    shape = list(_resolve_shape(op.attr("shape"), x.shape))
+    M = getattr(ctx, "batch_divisor", 1)
+    if (
+        M > 1
+        and -1 not in shape
+        and shape
+        and shape[0] % M == 0
+        and math.prod(shape) == math.prod(x.shape) * M
+    ):
+        # inside a pipeline stage: graph-build shapes are full-batch but the
+        # runtime tensor is a microbatch (1/M); shrink the leading dim.
+        # Everywhere else a size mismatch still fails inside jnp.reshape.
+        shape[0] //= M
     return {"Out": [jnp.reshape(x, shape)], "XShape": []}
 
 
