@@ -1,0 +1,134 @@
+"""Fleet parameter-server mode (reference incubate/fleet/parameter_server/:
+DistributedTranspiler(Fleet) :41, TranspilerOptimizer :353, the pslib
+DownpourOptimizer :867 and the Sync/Async/HalfAsync/Geo strategies).
+
+TPU-native position: the reference's PS stack exists to hold tables bigger
+than one accelerator and to ship grads over gRPC to per-shard optimize
+blocks (listen_and_serv_op.cc, communicator.h:237). On a TPU mesh the same
+capability is the row-sharded in-HBM table + ICI lookup of ops/sparse.py —
+no RPC runtime, no async staleness, and the per-shard optimizer locality
+comes from sharding the accumulators (parallel/sparse.py). The async/geo
+modes trade consistency for bandwidth the ICI fabric does not need; they
+are intentionally absent, and `DistributedStrategy(mode=...)` documents
+that degrade. This module provides the fleet-PS API surface over that
+design: init / distributed_optimizer / minimize / init_server / init_worker
+/ save_persistables keep their reference signatures.
+"""
+
+from __future__ import annotations
+
+from ..framework.program import default_main_program
+from ..parallel.mesh import make_mesh
+from ..parallel.sparse import shard_sparse_tables, sparse_table_names
+from ..parallel.spmd import shard_program
+
+
+class DistributedStrategy:
+    """reference parameter_server/distributed_strategy.py factory modes."""
+
+    def __init__(self, mode="sync"):
+        if mode not in ("sync", "async", "half_async", "geo"):
+            raise ValueError(f"unknown PS mode {mode!r}")
+        # async/half_async/geo traded staleness for gRPC bandwidth; on ICI
+        # the sync path is strictly faster, so every mode runs sync.
+        self.mode = mode
+
+
+class StrategyFactory:
+    @staticmethod
+    def create_sync_strategy():
+        return DistributedStrategy("sync")
+
+    @staticmethod
+    def create_async_strategy():
+        return DistributedStrategy("async")
+
+    @staticmethod
+    def create_half_async_strategy():
+        return DistributedStrategy("half_async")
+
+    @staticmethod
+    def create_geo_strategy(update_frequency=100):
+        return DistributedStrategy("geo")
+
+
+class ParameterServerOptimizer:
+    """TranspilerOptimizer parity: wraps the inner optimizer; after minimize
+    it row-shards every sparse table (+grad+accumulators) over the "ps"
+    axis and attaches the mesh."""
+
+    def __init__(self, optimizer, strategy=None, fleet=None):
+        self._inner = optimizer
+        self._strategy = strategy or DistributedStrategy("sync")
+        self._fleet = fleet
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, params_grads = self._inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        program = loss.block.program
+        mesh = self._fleet._mesh if self._fleet else make_mesh({"ps": -1})
+        program._mesh = mesh  # so shard_sparse_tables can validate rows%n
+        tables = shard_sparse_tables(program, axis="ps")
+        if not tables:
+            raise ValueError(
+                "PS mode but the program has no sparse tables; use "
+                "layers.sparse_embedding (or fleet collective mode for "
+                "dense-only models)"
+            )
+        shard_program(program, mesh)
+        return ops, params_grads
+
+
+class ParameterServerFleet:
+    """Fleet PS facade (reference DistributedTranspiler(Fleet))."""
+
+    def __init__(self):
+        self._role = None
+        self._mesh = None
+
+    def init(self, role_maker=None, mesh=None):
+        self._role = role_maker
+        self._mesh = mesh if mesh is not None else make_mesh({"ps": -1})
+        return self
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return ParameterServerOptimizer(optimizer, strategy, fleet=self)
+
+    # every process is both trainer and table shard owner on a TPU mesh:
+    # the reference's server/worker split collapses
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def init_worker(self):
+        pass
+
+    def run_server(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    def is_server(self):
+        return True
+
+    def is_worker(self):
+        return True
+
+    def worker_num(self):
+        return len(self._mesh.devices.flat) if self._mesh is not None else 1
+
+    def server_num(self):
+        return self.worker_num()
+
+    def sparse_table_names(self, program=None):
+        return sparse_table_names(program or default_main_program())
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .. import io
+
+        io.save_persistables(executor, dirname, main_program)
+
+
+fleet = ParameterServerFleet()

@@ -397,3 +397,25 @@ def accuracy(input, label, k=1):
         stop_gradient=True,
     )
     return acc
+
+
+def sparse_embedding(
+    input, size, param_attr=None, dtype="float32", axis="ps",
+    pad_to_multiple=8, is_sparse=True,
+):
+    """Row-sharded (huge) embedding lookup — the PS-table capability
+    (reference distributed_lookup_table_op.cc / fluid sparse embedding).
+    `size=[vocab, dim]`; vocab is padded up so any mesh axis size dividing
+    `pad_to_multiple` shards evenly. See ops/sparse.py + parallel/sparse.py.
+    """
+    vocab, dim = size
+    padded = ((vocab + pad_to_multiple - 1) // pad_to_multiple) * pad_to_multiple
+    helper = LayerHelper("sparse_embedding")
+    w = helper.create_parameter(
+        param_attr, [padded, dim], dtype, default_initializer=Xavier()
+    )
+    return helper.create_and_append(
+        {"Ids": [input], "W": [w]},
+        {"axis_name": axis},
+        op_type="distributed_lookup_table",
+    )

@@ -9,3 +9,4 @@ public layers API only — they double as end-to-end tests of the framework
 
 from .resnet import resnet  # noqa: F401
 from .bert import BertConfig, bert_encoder, bert_pretrain  # noqa: F401
+from .deepfm import DeepFMConfig, deepfm  # noqa: F401

@@ -1,0 +1,70 @@
+"""DeepFM CTR model (the sparse/PS workload from BASELINE.md).
+
+Reference workload shape: huge sparse id features -> first-order weights +
+FM second-order factor interactions + a deep MLP tower, trained with
+row-sharded embedding tables (the reference used pserver-resident tables,
+distributed_lookup_table_op.cc; here tables shard over the "ps" mesh axis,
+ops/sparse.py).
+"""
+
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+class DeepFMConfig:
+    def __init__(self, vocab_size=100000, num_fields=10, embed_dim=16,
+                 mlp_sizes=(64, 32)):
+        self.vocab_size = vocab_size
+        self.num_fields = num_fields
+        self.embed_dim = embed_dim
+        self.mlp_sizes = tuple(mlp_sizes)
+
+
+def deepfm(feat_ids, label, cfg, axis="ps"):
+    """feat_ids: [B, F] int64 global feature ids; label: [B, 1] float32.
+    Returns (avg_logloss, predict)."""
+    b, f = feat_ids.shape
+
+    # first-order: sharded [V, 1] table
+    w1 = layers.sparse_embedding(
+        feat_ids, [cfg.vocab_size, 1],
+        param_attr=ParamAttr(name="deepfm_w1"), axis=axis,
+    )  # [B, F, 1]
+    first = layers.reduce_sum(layers.reshape(w1, [b, f]), 1, keep_dim=True)
+
+    # factor embeddings: sharded [V, D] table
+    emb = layers.sparse_embedding(
+        feat_ids, [cfg.vocab_size, cfg.embed_dim],
+        param_attr=ParamAttr(name="deepfm_emb"), axis=axis,
+    )  # [B, F, D]
+
+    # FM second order: 0.5 * sum_d((sum_f v)^2 - sum_f v^2)
+    sum_f = layers.reduce_sum(emb, 1)  # [B, D]
+    sum_sq = layers.square(sum_f)
+    sq_sum = layers.reduce_sum(layers.square(emb), 1)
+    fm = layers.scale(
+        layers.reduce_sum(sum_sq - sq_sum, 1, keep_dim=True), scale=0.5
+    )
+
+    # deep tower
+    deep = layers.reshape(emb, [b, f * cfg.embed_dim])
+    for i, sz in enumerate(cfg.mlp_sizes):
+        deep = layers.fc(
+            deep, sz, act="relu",
+            param_attr=ParamAttr(name=f"deepfm_mlp{i}_w"),
+            bias_attr=ParamAttr(name=f"deepfm_mlp{i}_b"),
+        )
+    deep = layers.fc(
+        deep, 1,
+        param_attr=ParamAttr(name="deepfm_out_w"),
+        bias_attr=ParamAttr(name="deepfm_out_b"),
+    )
+
+    logit = first + fm + deep
+    predict = layers.sigmoid(logit)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label)
+    )
+    return loss, predict

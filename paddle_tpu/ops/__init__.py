@@ -16,6 +16,7 @@ from . import (  # noqa: F401
     nn,
     optimizer_ops,
     random,
+    sparse,
     tensor_ops,
 )
 

@@ -1,0 +1,55 @@
+"""Sparse / huge-embedding ops (the parameter-server capability).
+
+Reference: operators/distributed_ops/distributed_lookup_table_op.cc +
+operators/distributed/parameter_prefetch.cc — trainer sends ids to the
+pserver holding each row-shard over gRPC, the pserver gathers and replies;
+gradients flow back as send ops into per-shard optimize blocks
+(listen_and_serv_op.cc). The huge-embedding capability (tables larger than
+one device) lived entirely in that RPC machinery (plus pslib/BoxPS caches,
+fleet_wrapper.h:86).
+
+TPU-native re-design: the table is ROW-SHARDED over a mesh axis ("ps") and
+stays resident in device HBM; a lookup is one fused gather + masked-select +
+psum over ICI — no RPC, no host round-trip, and the backward pass
+(scatter-add of row gradients into the owning shard) falls out of the
+generic __vjp__ machinery instead of a hand-written send/optimize-block
+protocol. Block sharding: row r lives on shard r // (vocab/N).
+
+Under no mesh (or the axis absent) the op degrades to a plain local gather,
+matching the reference's non-distributed lookup_table fallback.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+@register_op("distributed_lookup_table", inputs=["Ids", "W"], outputs=["Out"])
+def _distributed_lookup_table(ctx, op, ins):
+    ids = ins["Ids"][0]
+    w = ins["W"][0]  # local row-shard under shard_map; full table otherwise
+    axis = op.attr("axis_name", "ps")
+    if ids.ndim and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    ids = ids.astype(jnp.int32)
+    if axis not in ctx.mesh_axes:
+        return {"Out": [w[ids]]}
+    n = ctx.axis_sizes[axis]
+    k = lax.axis_index(axis)
+    rows_local = w.shape[0]  # the local row-shard (global_rows // n)
+    local = ids - k * rows_local
+    owned = jnp.logical_and(local >= 0, local < rows_local)
+    safe = jnp.clip(local, 0, rows_local - 1)
+    vals = jnp.where(owned[..., None], w[safe], 0)
+    # each row is owned by exactly one shard: the psum assembles the full
+    # batch of embeddings on every device (ICI all-reduce of [B..., D]).
+    out = lax.psum(vals, axis)
+    # psum transposes to psum under shard_map: the N replicated downstream
+    # losses each seed a unit cotangent, which would scatter N-times-too-
+    # large row gradients into the owning shard. Rescale the GRADIENT only
+    # (value unchanged) — same correction as pipeline_block's loss psum.
+    out = out / n + lax.stop_gradient(out * (n - 1) / n)
+    return {"Out": [out]}

@@ -20,3 +20,4 @@ from .mesh import (  # noqa: F401
 from .spmd import device_put_sharded, shard_program, spec_for  # noqa: F401
 from .transpiler import GradAllReduce, LocalSGD  # noqa: F401
 from .pipeline import PipelineOptimizer  # noqa: F401  (registers pipeline_block)
+from .sparse import shard_sparse_tables, sparse_table_names  # noqa: F401

@@ -1,0 +1,71 @@
+"""Sharded-table bookkeeping for the sparse/PS path.
+
+Reference: the DistributeTranspiler sliced each table into per-pserver
+blocks and rewired the trainer program with prefetch/send ops
+(transpiler/distribute_transpiler.py:1675, ps_dispatcher.py). Here the
+"transpile" is pure metadata: mark every sparse table (and its grad +
+optimizer accumulators) as row-sharded over the mesh axis, then let
+shard_map place the shards. See ops/sparse.py for the lookup kernel.
+"""
+
+from __future__ import annotations
+
+from ..framework.program import grad_var_name
+
+
+def sparse_table_names(program):
+    """Names of every table consumed by a distributed_lookup_table op."""
+    names = []
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type == "distributed_lookup_table":
+                w = op.inputs["W"][0]
+                if w not in names:
+                    names.append(w)
+    return names
+
+
+def shard_sparse_tables(program, axis="ps"):
+    """Row-shard every sparse table + grad + optimizer state over `axis`.
+
+    Call AFTER optimizer.minimize (so accumulator vars exist) and before
+    shard_program. Optimizer accumulators are matched by their name prefix
+    (Optimizer._add_accumulator generates f"{param}_{acc}"); their leading
+    dim equals the table's rows, so row-sharding them keeps Adam/SGD state
+    local to the owning shard — the reference's per-pserver optimize blocks
+    (listen_and_serv_op.cc) achieved the same locality over RPC.
+    """
+    tables = sparse_table_names(program)
+    blk = program.global_block
+    for t in tables:
+        rows = blk.var(t).shape[0]
+        program._sharding[t] = (axis,)
+        # divisibility is NOT auto-padded at this layer: fail loudly at
+        # build time instead of an opaque shard_map error at run time
+        # (sparse_embedding's pad_to_multiple should cover the mesh size)
+        if program._mesh is not None and axis in program._mesh.shape:
+            n = program._mesh.shape[axis]
+            if rows % n:
+                raise ValueError(
+                    f"table {t!r} has {rows} rows, not divisible by mesh "
+                    f"axis {axis!r} size {n}; raise pad_to_multiple on "
+                    "sparse_embedding"
+                )
+        program._sharding[grad_var_name(t)] = (axis,)
+        for name, v in blk.vars.items():
+            if (
+                name.startswith(t + "_")
+                and v.persistable
+                and v.shape
+                and len(v.shape) >= 1
+                and v.shape[0] == rows
+            ):
+                program._sharding[name] = (axis,)
+    for blk_ in program.blocks:
+        for op in blk_.ops:
+            if op.type == "distributed_lookup_table":
+                # unconditional: a stale axis_name from build time would
+                # shard storage over one axis but psum over another
+                op.attrs["axis_name"] = axis
+    program._bump()
+    return tables

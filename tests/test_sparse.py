@@ -1,0 +1,177 @@
+"""Sparse / PS path: row-sharded embedding tables over the "ps" axis.
+
+VERDICT item 6 done-bar: a CTR model with an embedding bigger than one
+device's share trains on the virtual mesh. Modeled on the reference's
+dist_fleet_ctr / test_dist_ctr suites (which compared distributed vs local
+losses for a sparse model).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+from paddle_tpu.models import DeepFMConfig, deepfm
+from paddle_tpu.parallel import shard_program, shard_sparse_tables
+from paddle_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+def _lookup_program(vocab, dim, b):
+    ids = fluid.data("ids", [b], "int64")
+    out = layers.sparse_embedding(
+        ids, [vocab, dim], param_attr=fluid.ParamAttr(name="table"),
+        pad_to_multiple=8,
+    )
+    return ids, out
+
+
+def test_sharded_lookup_matches_local():
+    """distributed_lookup_table over ps=8 returns the same rows as the
+    unsharded gather."""
+    vocab, dim, b = 64, 4, 16
+    rng = np.random.RandomState(0)
+    idv = rng.randint(0, vocab, b).astype(np.int64)
+
+    outs = {}
+    for mode in ("local", "sharded"):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        scope = fluid.framework.scope.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+                unique_name.guard():
+            _, out = _lookup_program(vocab, dim, b)
+            if mode == "sharded":
+                shard_sparse_tables(main)
+                shard_program(main, make_mesh({"ps": 8}))
+            exe = fluid.Executor()
+            exe.run(startup)
+            (v,) = exe.run(feed={"ids": idv}, fetch_list=[out])
+            outs[mode] = np.asarray(v)
+    np.testing.assert_allclose(outs["local"], outs["sharded"], rtol=1e-6)
+
+
+def test_sharded_lookup_grads_match_local():
+    """Backward through the psum-gather scatter-adds into the owning shard
+    with the same magnitude as the local gather."""
+    vocab, dim, b = 32, 4, 8
+    rng = np.random.RandomState(0)
+    idv = rng.randint(0, vocab, b).astype(np.int64)
+
+    grads = {}
+    for mode in ("local", "sharded"):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        scope = fluid.framework.scope.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+                unique_name.guard():
+            _, out = _lookup_program(vocab, dim, b)
+            loss = layers.reduce_sum(layers.square(out))
+            fluid.optimizer.SGD(0.0).minimize(loss)  # lr 0: params frozen
+            if mode == "sharded":
+                shard_sparse_tables(main)
+                shard_program(main, make_mesh({"ps": 8}))
+            exe = fluid.Executor()
+            exe.run(startup)
+            (g,) = exe.run(feed={"ids": idv}, fetch_list=["table@GRAD"])
+            grads[mode] = np.asarray(g)
+    np.testing.assert_allclose(grads["local"], grads["sharded"], rtol=1e-5)
+
+
+def test_table_state_is_actually_sharded():
+    """Each device holds only vocab/8 rows of the table and its Adam
+    moments (the huge-embedding property)."""
+    vocab, dim, b = 80, 8, 4
+    ids, out = _lookup_program(vocab, dim, b)
+    loss = layers.reduce_sum(out)
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    shard_sparse_tables(fluid.default_main_program())
+    shard_program(fluid.default_main_program(), make_mesh({"ps": 8}))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={"ids": np.arange(b).astype(np.int64)}, fetch_list=[loss])
+    scope = fluid.framework.scope.global_scope()
+    table = scope.find_var("table")
+    shards = table.addressable_shards
+    assert len(shards) == 8
+    assert shards[0].data.shape[0] == table.shape[0] // 8
+    # Adam moment accumulators sharded the same way
+    m1 = scope.find_var(
+        [n for n in fluid.default_main_program().global_block.vars
+         if n.startswith("table_moment1")][0]
+    )
+    assert m1.addressable_shards[0].data.shape[0] == table.shape[0] // 8
+
+
+def test_deepfm_trains_on_virtual_mesh():
+    """DeepFM with sharded tables learns a separable CTR toy problem."""
+    cfg = DeepFMConfig(vocab_size=4096, num_fields=6, embed_dim=8,
+                       mlp_sizes=(32,))
+    b = 32
+    ids = fluid.data("feat_ids", [b, cfg.num_fields], "int64")
+    label = fluid.data("label", [b, 1], "float32")
+    loss, predict = deepfm(ids, label, cfg)
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    shard_sparse_tables(fluid.default_main_program())
+    shard_program(fluid.default_main_program(), make_mesh({"ps": 8}))
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    # clickiness is determined by whether field-0's id is even
+    def batch():
+        idv = rng.randint(0, cfg.vocab_size, (b, cfg.num_fields))
+        lab = (idv[:, :1] % 2 == 0).astype(np.float32)
+        return {"feat_ids": idv.astype(np.int64), "label": lab}
+
+    losses = []
+    feeds = [batch() for _ in range(8)]
+    for epoch in range(30):
+        for f in feeds:
+            (lv,) = exe.run(feed=f, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_fleet_ps_mode_api():
+    """Fleet PS facade: init -> distributed_optimizer -> minimize shards the
+    tables and trains (reference test_dist_fleet_base shape)."""
+    from paddle_tpu.fleet.parameter_server import StrategyFactory, fleet
+
+    cfg = DeepFMConfig(vocab_size=1024, num_fields=4, embed_dim=4,
+                       mlp_sizes=(16,))
+    b = 16
+    ids = fluid.data("feat_ids", [b, cfg.num_fields], "int64")
+    label = fluid.data("label", [b, 1], "float32")
+    loss, _ = deepfm(ids, label, cfg)
+    fleet.init()
+    opt = fleet.distributed_optimizer(
+        fluid.optimizer.Adam(0.02), StrategyFactory.create_sync_strategy()
+    )
+    opt.minimize(loss)
+    assert fleet.worker_num() == 8
+    assert fleet.sparse_table_names() == ["deepfm_w1", "deepfm_emb"]
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    idv = rng.randint(0, cfg.vocab_size, (b, cfg.num_fields))
+    feed = {"feat_ids": idv.astype(np.int64),
+            "label": (idv[:, :1] % 2 == 0).astype(np.float32)}
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5
