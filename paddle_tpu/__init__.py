@@ -45,6 +45,8 @@ from . import incubate  # noqa: F401
 from . import dygraph  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from . import dataloader  # noqa: F401
+from . import profiler  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
 from .reader import DataLoader  # noqa: F401
 
 # `fluid`-compatible alias so code written against the reference API reads

@@ -1,0 +1,96 @@
+"""Process-level flag/config system.
+
+Reference: 26 core gflags in platform/flags.cc:33-471, initialized from
+FLAGS_* env vars via core.init_gflags (pybind.cc:1529) and read/written at
+runtime through global_value_getter_setter.cc, exposed to Python as
+fluid.set_flags / fluid.get_flags.
+
+Same contract here: flags declare a name + default + doc; FLAGS_<name> env
+vars override defaults at import; set_flags/get_flags read-write at runtime.
+Flags that controlled CUDA allocator/stream behavior have no TPU meaning
+and are intentionally not declared — XLA owns memory and scheduling.
+"""
+
+from __future__ import annotations
+
+import os
+
+_FLAGS: dict[str, object] = {}
+_DOCS: dict[str, str] = {}
+
+
+def _declare(name, default, doc):
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes", "on")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    else:
+        value = default
+    _FLAGS[name] = value
+    _DOCS[name] = doc
+
+
+# --- declared flags (TPU-meaningful subset of platform/flags.cc) -----------
+_declare(
+    "check_nan_inf", False,
+    "After every op, scan float outputs for NaN/Inf inside the compiled "
+    "step and raise host-side naming the first offending op "
+    "(reference flags.cc:44 -> details/nan_inf_utils_detail.cc).",
+)
+_declare(
+    "op_provenance", True,
+    "Record the user code location creating each op so trace-time errors "
+    "name the Python line (reference framework/op_call_stack.cc).",
+)
+_declare(
+    "paddle_tpu_prng", "",
+    "PRNG implementation for per-step keys ('rbg'/'threefry2x32'); empty = "
+    "rbg on TPU, threefry2x32 elsewhere (core/random.py).",
+)
+_declare(
+    "eager_delete_tensor_gb", 0.0,
+    "Accepted for parity; XLA buffer assignment subsumes eager deletion "
+    "(reference flags.cc eager_delete_tensor_gb).",
+)
+_declare(
+    "benchmark", False,
+    "Accepted for parity; per-op timing comes from the profiler module "
+    "instead (reference flags.cc:33).",
+)
+
+
+def get_flags(flags):
+    """fluid.get_flags parity: str or list -> {name: value}."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        if f.startswith("FLAGS_"):
+            f = f[len("FLAGS_"):]
+        if f not in _FLAGS:
+            raise ValueError(f"unknown flag {f!r}")
+        out["FLAGS_" + f] = _FLAGS[f]
+    return out
+
+
+def set_flags(flags_dict):
+    """fluid.set_flags parity: {\"FLAGS_name\": value}."""
+    for k, v in flags_dict.items():
+        name = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if name not in _FLAGS:
+            raise ValueError(f"unknown flag {name!r}")
+        _FLAGS[name] = v
+
+
+def flag(name):
+    return _FLAGS[name]
+
+
+def flag_docs():
+    return dict(_DOCS)

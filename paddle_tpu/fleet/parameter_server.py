@@ -68,7 +68,9 @@ class ParameterServerOptimizer:
             loss, startup_program, parameter_list, no_grad_set
         )
         program = loss.block.program
-        mesh = self._fleet._mesh if self._fleet else make_mesh({"ps": -1})
+        mesh = getattr(self._fleet, "_mesh", None) if self._fleet else None
+        if mesh is None:  # fleet.init() not called (or no fleet): default mesh
+            mesh = make_mesh({"ps": -1})
         program._mesh = mesh  # so shard_sparse_tables can validate rows%n
         tables = shard_sparse_tables(program, axis="ps")
         if not tables:

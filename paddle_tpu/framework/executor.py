@@ -37,13 +37,16 @@ from .scope import global_scope
 
 
 class _Compiled:
-    __slots__ = ("fn", "state_ro", "state_mut", "fetch_names")
+    __slots__ = ("fn", "state_ro", "state_mut", "fetch_names", "nan_ops")
 
-    def __init__(self, fn, state_ro, state_mut, fetch_names):
+    def __init__(self, fn, state_ro, state_mut, fetch_names, nan_ops=None):
         self.fn = fn
         self.state_ro = state_ro
         self.state_mut = state_mut
         self.fetch_names = fetch_names
+        # ops list compiled with per-op NaN/Inf checks (FLAGS_check_nan_inf);
+        # the extra trailing fetch indexes into this to name the offender
+        self.nan_ops = nan_ops
 
 
 def _analyze_block(block, feed_names, fetch_names):
@@ -116,9 +119,12 @@ class Executor:
         feed_sig = tuple(
             (k, tuple(a.shape), str(a.dtype)) for k, a in sorted(feed_arrays.items())
         )
+        from ..flags import flag
+
+        check_nan = bool(flag("check_nan_inf"))
         # keying on the Program object (identity hash, strong ref) rather than
         # id() prevents stale hits when a freed Program's id is recycled
-        key = (program, program._version, feed_sig, fetch_names)
+        key = (program, program._version, feed_sig, fetch_names, check_nan)
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = self._compile(program, block, set(feed_arrays), fetch_names, scope)
@@ -149,6 +155,19 @@ class Executor:
         )
 
         fetches, new_state = compiled.fn(feed_arrays, state_mut, state_ro, step_key)
+        if compiled.nan_ops is not None:
+            bad = np.asarray(fetches[-1])
+            fetches = fetches[:-1]
+            if bad.any():
+                idx = int(np.argmax(bad))
+                op = compiled.nan_ops[idx]
+                raise RuntimeError(
+                    f"NaN/Inf detected in outputs of op #{idx} "
+                    f"{op.type!r} (created at "
+                    f"{op.attr('__loc__', '<unknown>')}); outputs: "
+                    f"{op.output_names()} — FLAGS_check_nan_inf mode "
+                    "(reference details/nan_inf_utils_detail.cc)"
+                )
         for n, v in new_state.items():
             scope.set_var(n, v)
         if return_numpy:
@@ -171,6 +190,9 @@ class Executor:
         return v
 
     def _compile(self, program, block, feed_names, fetch_names, scope):
+        from ..flags import flag
+
+        check_nan = bool(flag("check_nan_inf"))
         state_ro, state_mut, write_back = _analyze_block(
             block, feed_names, fetch_names
         )
@@ -195,14 +217,44 @@ class Executor:
                 step_key=step_key, is_test=False, mesh_axes=mesh_axes,
                 axis_sizes=axis_sizes, program=program,
             )
-            for op in ops:
+            nan_flags = []
+            for i, op in enumerate(ops):
                 try:
                     run_op(ctx, op, env)
-                except KeyError as e:  # pragma: no cover - authoring errors
+                except KeyError as e:
                     raise RuntimeError(
-                        f"op {op.type} references undefined variable {e}"
+                        f"op #{i} {op.type!r} (created at "
+                        f"{op.attr('__loc__', '<unknown>')}) references "
+                        f"undefined variable {e}"
                     ) from None
+                except Exception as e:
+                    # attach op provenance to trace-time failures
+                    # (reference framework/op_call_stack.cc); add_note keeps
+                    # the original exception intact — many jax error classes
+                    # cannot be reconstructed from a single message string
+                    e.add_note(
+                        f"[while tracing op #{i} {op.type!r} created at "
+                        f"{op.attr('__loc__', '<unknown>')}]"
+                    )
+                    raise
+                if check_nan:
+                    bad = jnp.zeros((), bool)
+                    for n in op.output_names():
+                        v = env.get(n)
+                        if v is not None and jnp.issubdtype(
+                            jnp.asarray(v).dtype, jnp.inexact
+                        ):
+                            bad = bad | ~jnp.all(jnp.isfinite(v))
+                    nan_flags.append(bad)
             fetches = tuple(env[n] for n in fetch_names)
+            if check_nan and nan_flags:
+                flags_arr = jnp.stack(nan_flags).astype(jnp.int32)
+                # a NaN may live on one shard only (e.g. a row-sharded
+                # table): reduce over every mesh axis so the replicated
+                # fetch sees it regardless of which device it hit
+                for ax in mesh_axes:
+                    flags_arr = jax.lax.pmax(flags_arr, ax)
+                fetches = fetches + (flags_arr,)
             new_state = {n: env[n] for n in write_back if n in env}
             return fetches, new_state
 
@@ -210,13 +262,22 @@ class Executor:
             from ..parallel.spmd import wrap_gspmd, wrap_shard_map
 
             wrap = wrap_gspmd if spmd_mode == "gspmd" else wrap_shard_map
+            # the nan-check mode appends one extra (replicated) fetch; the
+            # wrapper's out_specs must match the traced arity
+            wrapped_fetches = (
+                fetch_names + ("__nan_flags__",)
+                if (check_nan and ops) else fetch_names
+            )
             fn = wrap(
                 traced, program, mesh, state_ro, state_mut, write_back,
-                fetch_names,
+                wrapped_fetches,
             )
         else:
             fn = jax.jit(traced, donate_argnums=(1,))
-        return _Compiled(fn, state_ro, state_mut, fetch_names)
+        return _Compiled(
+            fn, state_ro, state_mut, fetch_names,
+            nan_ops=ops if (check_nan and ops) else None,
+        )
 
 
 # fluid-parity helper: exe.run on the startup program is the "init" step;

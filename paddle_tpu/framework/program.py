@@ -115,6 +115,24 @@ class Parameter(Variable):
         self.need_clip = True
 
 
+def _user_frame():
+    """file:line of the first stack frame outside paddle_tpu (cheap: walks
+    raw frames, no traceback formatting). Disabled by FLAGS_op_provenance."""
+    from ..flags import flag
+
+    if not flag("op_provenance"):
+        return None
+    import sys
+
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if "paddle_tpu" not in fn:
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return None
+
+
 GRAD_SUFFIX = "@GRAD"
 
 
@@ -141,6 +159,12 @@ class Operator:
         # PipelineOptimizer's stage slicing)
         if _current_device is not None and "op_device" not in self.attrs:
             self.attrs["op_device"] = _current_device
+        # creation provenance: the user frame that built this op, attached
+        # to trace/runtime errors (reference framework/op_call_stack.cc)
+        if "__loc__" not in self.attrs:
+            loc = _user_frame()
+            if loc:
+                self.attrs["__loc__"] = loc
         # stable identity used to derive per-op RNG keys (registry.EmitContext);
         # per-Program (not global) so two identically-built programs get
         # identical RNG streams; survives deepcopy/clone so test-mode

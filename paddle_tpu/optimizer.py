@@ -84,6 +84,9 @@ class Optimizer:
         startup = default_startup_program().global_block
         v = main.create_parameter(vname, shape, dtype, trainable=False)
         v.stop_gradient = True
+        # tag for sharding bookkeeping: parallel/sparse.shard_sparse_tables
+        # row-shards exactly the accumulators of sharded tables
+        v._accum_of = param.name
         startup.create_parameter(vname, shape, dtype, trainable=False)
         Constant(fill_value)(startup, vname, shape, dtype)
         self._accumulators[key] = v

@@ -53,9 +53,10 @@ def shard_sparse_tables(program, axis="ps"):
                 )
         program._sharding[grad_var_name(t)] = (axis,)
         for name, v in blk.vars.items():
+            # exact match on the optimizer's accumulator tag (row-shaped
+            # only; scalar state like beta powers stays replicated)
             if (
-                name.startswith(t + "_")
-                and v.persistable
+                getattr(v, "_accum_of", None) == t
                 and v.shape
                 and len(v.shape) >= 1
                 and v.shape[0] == rows
