@@ -14,7 +14,9 @@ def prng_impl():
     Philox per kernel launch (dropout_op.cu)."""
     import jax
 
-    env = os.environ.get("PADDLE_TPU_PRNG")
-    if env:
-        return env
+    from ..flags import flag
+
+    choice = flag("paddle_tpu_prng") or os.environ.get("PADDLE_TPU_PRNG")
+    if choice:
+        return choice
     return "rbg" if jax.default_backend() == "tpu" else "threefry2x32"

@@ -1,0 +1,1 @@
+from . import launch  # noqa: F401

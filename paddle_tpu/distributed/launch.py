@@ -1,0 +1,131 @@
+"""Multi-process launcher: `python -m paddle_tpu.distributed.launch ...`.
+
+Reference: python/paddle/distributed/launch.py:193-227 — builds the cluster
+model from --cluster_node_ips / PaddleCloud env, spawns one process per GPU
+with PADDLE_TRAINER_ID / PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS, and supervises the children
+(utils.py watch_local_trainers: abort the pod when a child dies).
+
+TPU-native changes:
+  * the process unit is a HOST, not an accelerator: one JAX process drives
+    all local chips, so --nproc_per_node defaults to 1 and exists mainly
+    for localhost simulation (reference test_dist_base.py:506 pattern);
+  * rank 0's endpoint doubles as the JAX coordination-service address
+    (PADDLE_COORDINATOR), replacing the reference's gen_nccl_id RPC server;
+  * when simulating several processes on one host, children are forced onto
+    the CPU platform with gloo cross-process collectives — a real pod sets
+    neither and each host claims its TPU chips.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--cluster_node_ips", type=str, default="127.0.0.1",
+                   help="comma-separated host ips")
+    p.add_argument("--node_ip", type=str, default="127.0.0.1",
+                   help="this host's ip")
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 for real TPU hosts; >1 "
+                        "simulates a cluster on localhost over CPU)")
+    p.add_argument("--simulate_cpu", action="store_true",
+                   help="force children onto the CPU platform with gloo "
+                        "collectives (localhost cluster simulation)")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def build_cluster(args):
+    ips = [ip for ip in args.cluster_node_ips.split(",") if ip]
+    endpoints = []
+    for ip in ips:
+        for i in range(args.nproc_per_node):
+            endpoints.append(f"{ip}:{args.started_port + i}")
+    if args.node_ip not in ips:
+        raise ValueError(
+            f"--node_ip {args.node_ip} not in --cluster_node_ips {ips}"
+        )
+    node_idx = ips.index(args.node_ip)
+    local_ranks = [
+        node_idx * args.nproc_per_node + i for i in range(args.nproc_per_node)
+    ]
+    return endpoints, local_ranks
+
+
+def start_local_trainers(args, endpoints, local_ranks):
+    procs = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for rank in local_ranks:
+        env = dict(os.environ)
+        env.update(
+            PADDLE_TRAINER_ID=str(rank),
+            PADDLE_TRAINERS_NUM=str(len(endpoints)),
+            PADDLE_TRAINER_ENDPOINTS=",".join(endpoints),
+            PADDLE_CURRENT_ENDPOINT=endpoints[rank],
+            PADDLE_COORDINATOR=endpoints[0],
+        )
+        if args.simulate_cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        cmd = [sys.executable, args.training_script] + args.training_script_args
+        out = (
+            open(os.path.join(args.log_dir, f"worker_{rank}.log"), "w")
+            if args.log_dir
+            else None
+        )
+        procs.append(
+            subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
+        )
+    return procs
+
+
+def watch_local_trainers(procs):
+    """Supervise: if any child fails, terminate the pod and propagate
+    (reference utils.py watch_local_trainers / launch.py:219-226)."""
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    raise RuntimeError(
+                        f"trainer (pid {p.pid}) exited with code {rc}; "
+                        "pod aborted"
+                    )
+            if not alive:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        raise
+
+
+def launch(argv=None):
+    args = parse_args(argv)
+    endpoints, local_ranks = build_cluster(args)
+    procs = start_local_trainers(args, endpoints, local_ranks)
+    return watch_local_trainers(procs)
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -155,6 +155,11 @@ class Executor:
         )
 
         fetches, new_state = compiled.fn(feed_arrays, state_mut, state_ro, step_key)
+        # write-back FIRST: state_mut buffers were donated, so skipping the
+        # write-back on error would leave the scope holding deleted arrays
+        # (params irretrievably lost right when the user wants to inspect)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
         if compiled.nan_ops is not None:
             bad = np.asarray(fetches[-1])
             fetches = fetches[:-1]
@@ -168,8 +173,6 @@ class Executor:
                     f"{op.output_names()} — FLAGS_check_nan_inf mode "
                     "(reference details/nan_inf_utils_detail.cc)"
                 )
-        for n, v in new_state.items():
-            scope.set_var(n, v)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)

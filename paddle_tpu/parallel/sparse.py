@@ -29,11 +29,13 @@ def shard_sparse_tables(program, axis="ps"):
     """Row-shard every sparse table + grad + optimizer state over `axis`.
 
     Call AFTER optimizer.minimize (so accumulator vars exist) and before
-    shard_program. Optimizer accumulators are matched by their name prefix
-    (Optimizer._add_accumulator generates f"{param}_{acc}"); their leading
-    dim equals the table's rows, so row-sharding them keeps Adam/SGD state
-    local to the owning shard — the reference's per-pserver optimize blocks
-    (listen_and_serv_op.cc) achieved the same locality over RPC.
+    shard_program. Optimizer accumulators are matched by the exact
+    `_accum_of` tag Optimizer._add_accumulator stamps on each accumulator
+    Variable (row-shaped ones only; scalar state like beta powers stays
+    replicated) — row-sharding them keeps Adam/SGD state local to the
+    owning shard, the locality the reference's per-pserver optimize blocks
+    (listen_and_serv_op.cc) achieved over RPC. Custom state created outside
+    _add_accumulator is NOT auto-sharded; tag it with `_accum_of` yourself.
     """
     tables = sparse_table_names(program)
     blk = program.global_block

@@ -27,6 +27,43 @@ def spec_for(program, name) -> P:
     return P(*s)
 
 
+def _spans_processes(mesh):
+    """True when the mesh includes devices of other processes (multi-host
+    SPMD: every participating process runs the same program)."""
+    return any(
+        d.process_index != jax.process_index() for d in mesh.devices.flat
+    )
+
+
+def stage_global(x, mesh, pspec, multiproc=None, local_is_full=False):
+    """Make `x` a global array on the mesh.
+
+    Single-process: plain device_put. Multi-process: assemble the global
+    view with jax.make_array_from_process_local_data — the TPU-native
+    replacement for the reference's per-trainer feed +
+    BCastParamsToDevices bootstrap. Two local-data conventions:
+      * feeds (local_is_full=False): each process holds only ITS shard
+        (dp input pipeline), global shape is inferred by concatenation;
+      * state (local_is_full=True): each process holds the FULL value
+        (startup ran locally); global_shape=x.shape makes
+        make_array_from_process_local_data slice out this process's part —
+        required for cross-process-sharded state like ps tables.
+    """
+    import numpy as np
+
+    sharding = NamedSharding(mesh, pspec)
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return x  # already a global array (e.g. written-back state)
+    if multiproc is None:
+        multiproc = _spans_processes(mesh)
+    if multiproc:
+        arr = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            sharding, arr, global_shape=arr.shape if local_is_full else None
+        )
+    return jax.device_put(x, sharding)
+
+
 def wrap_shard_map(
     traced, program, mesh, state_ro, state_mut, write_back, fetch_names
 ):
@@ -57,10 +94,29 @@ def wrap_shard_map(
         return sm(feeds, smut, sro, step_key)
 
     jitted = jax.jit(run, donate_argnums=(1,))
+    multiproc = _spans_processes(mesh)
 
     def fn(feeds, smut, sro, step_key):
-        feeds = {k: device_put_sharded(v, mesh, spec_for(program, k))
-                 for k, v in feeds.items()}
+        feeds = {
+            k: stage_global(v, mesh, spec_for(program, k), multiproc)
+            for k, v in feeds.items()
+        }
+        if multiproc:
+            # state must be global arrays too; each process's scope holds
+            # the FULL value (startup ran locally), so local_is_full slices
+            # out this process's part for cross-process-sharded state
+            smut = {
+                k: stage_global(
+                    v, mesh, spec_for(program, k), True, local_is_full=True
+                )
+                for k, v in smut.items()
+            }
+            sro = {
+                k: stage_global(
+                    v, mesh, spec_for(program, k), True, local_is_full=True
+                )
+                for k, v in sro.items()
+            }
         return jitted(feeds, smut, sro, step_key)
 
     return fn

@@ -1,0 +1,63 @@
+"""Worker script for the multi-process fleet DP test (launched by
+paddle_tpu.distributed.launch; reference pattern: dist_mnist.py +
+TestDistRunnerBase, tests/unittests/test_dist_base.py:62).
+
+Trains fit-a-line with fleet collective DP; rank-dependent data slices;
+writes per-step (globally averaged) losses to <out_dir>/losses_<rank>.json.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.fleet import collective as fleet_mod
+
+
+def make_feed(rank, step, b_local):
+    """Deterministic slice: global batch = concat over ranks."""
+    rng = np.random.RandomState(100 + step)
+    xg = rng.randn(2 * b_local, 4).astype(np.float32)
+    w = np.arange(4, dtype=np.float32).reshape(4, 1)
+    yg = xg @ w
+    lo = rank * b_local
+    return {"x": xg[lo:lo + b_local], "y": yg[lo:lo + b_local]}
+
+
+def main():
+    out_dir = sys.argv[1]
+    steps, b_local = 5, 8
+
+    fleet = fleet_mod.fleet
+    fleet.init()
+    rank = fleet.worker_index()
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.data("x", [b_local, 4])
+        y = fluid.data("y", [b_local, 1])
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=fluid.ParamAttr(name="b"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.1))
+        opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses = []
+    for step in range(steps):
+        (lv,) = exe.run(
+            main_prog, feed=make_feed(rank, step, b_local), fetch_list=[loss]
+        )
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+
+    with open(os.path.join(out_dir, f"losses_{rank}.json"), "w") as f:
+        json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    main()

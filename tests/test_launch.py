@@ -1,0 +1,102 @@
+"""Multi-process launcher + fleet DP across REAL processes.
+
+Reference pattern: TestDistBase launches trainers as subprocesses on
+localhost and asserts distributed losses match single-process losses
+(tests/unittests/test_dist_base.py:506, _run_cluster_nccl2 :847).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield
+
+
+def _single_process_baseline(steps=5, b_local=8):
+    """Same model on the full (2x) batch in one process."""
+    sys.path.insert(0, HERE)
+    try:
+        from dist_fleet_worker import make_feed
+    finally:
+        sys.path.pop(0)
+    b = 2 * b_local
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 17
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main_prog, startup), \
+            fluid.scope_guard(scope), unique_name.guard():
+        x = fluid.data("x", [b, 4])
+        y = fluid.data("y", [b, 1])
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=fluid.ParamAttr(name="b"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for step in range(steps):
+            f0 = make_feed(0, step, b_local)
+            f1 = make_feed(1, step, b_local)
+            feed = {k: np.concatenate([f0[k], f1[k]]) for k in f0}
+            (lv,) = exe.run(main_prog, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    return losses
+
+
+def test_launch_two_process_fleet_dp(tmp_path):
+    """2 real processes (gloo CPU collectives) match the single-process
+    global-batch run step for step."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node=2", "--started_port=19411",
+            "--simulate_cpu",
+            os.path.join(HERE, "dist_fleet_worker.py"), str(tmp_path),
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=540,
+    )
+    assert proc.returncode == 0, f"stdout:{proc.stdout}\nstderr:{proc.stderr}"
+    l0 = json.load(open(tmp_path / "losses_0.json"))
+    l1 = json.load(open(tmp_path / "losses_1.json"))
+    # the fetched loss is globally averaged: both ranks see the same value
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    baseline = _single_process_baseline()
+    np.testing.assert_allclose(l0, baseline, rtol=2e-4)
+    assert baseline[-1] < baseline[0]  # fixed w target: loss decreases
+
+
+def test_launcher_aborts_pod_on_child_failure(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys, os\nsys.exit(3 if os.environ['PADDLE_TRAINER_ID']=='1' else 0)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node=2", "--started_port=19431",
+            str(bad), "x",
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "pod aborted" in proc.stderr
