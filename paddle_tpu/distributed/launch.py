@@ -63,6 +63,27 @@ def build_cluster(args):
     return endpoints, local_ranks
 
 
+def _terminate_pod(procs, grace=10.0):
+    """SIGTERM everyone, reap with a deadline, escalate to SIGKILL — a child
+    blocked in a native collective often defers SIGTERM forever and would
+    otherwise be orphaned holding its port."""
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + grace
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+    for p in procs:
+        out = getattr(p, "_paddle_log", None)
+        if out is not None:
+            out.close()
+
+
 def start_local_trainers(args, endpoints, local_ranks):
     procs = []
     if args.log_dir:
@@ -86,9 +107,9 @@ def start_local_trainers(args, endpoints, local_ranks):
             if args.log_dir
             else None
         )
-        procs.append(
-            subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
-        )
+        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
+        proc._paddle_log = out
+        procs.append(proc)
     return procs
 
 
@@ -103,20 +124,17 @@ def watch_local_trainers(procs):
                 if rc is None:
                     alive = True
                 elif rc != 0:
-                    for q in procs:
-                        if q.poll() is None:
-                            q.send_signal(signal.SIGTERM)
+                    _terminate_pod(procs)
                     raise RuntimeError(
                         f"trainer (pid {p.pid}) exited with code {rc}; "
                         "pod aborted"
                     )
             if not alive:
+                _terminate_pod(procs)  # reaps + closes log handles
                 return 0
             time.sleep(0.2)
     except KeyboardInterrupt:
-        for q in procs:
-            if q.poll() is None:
-                q.send_signal(signal.SIGTERM)
+        _terminate_pod(procs)
         raise
 
 

@@ -49,6 +49,21 @@ class DistributedStrategy:
         self.sharding = {}  # extra var->spec annotations (TP etc.)
 
 
+_CHECKPOINT_PREFIX = "__paddle_checkpoint__"
+_TRAIN_STATUS_FILE = "train_status.json"
+
+
+def _checkpoint_numbers(fs, path):
+    nos = []
+    for d in fs.list_dirs(path):
+        if d.startswith(_CHECKPOINT_PREFIX):
+            try:
+                nos.append(int(d[len(_CHECKPOINT_PREFIX):]))
+            except ValueError:
+                continue
+    return sorted(nos)
+
+
 class Fleet:
     """Singleton facade (reference fleet_base.py)."""
 
@@ -129,6 +144,74 @@ class Fleet:
             io.save_inference_model(
                 dirname, feeded_var_names, target_vars, executor, main_program
             )
+
+
+    # -- fault-tolerant checkpointing (reference incubate/fleet/collective/
+    # __init__.py:155-240: _save_train_status :155,
+    # clean_redundant_check_points :205, save/load_check_point :236+) ------
+    def save_check_point(
+        self, executor, path, train_status, main_program=None, fs=None,
+        remain_all_checkpoint=False, max_checkpoint_num=3,
+    ):
+        """Save persistables + TrainStatus into a new numbered checkpoint
+        dir and rotate old ones. The payload is written locally and
+        published through the FS backend (upload + atomic mv), so remote
+        backends only implement the FS contract. First worker only;
+        returns the checkpoint number."""
+        import tempfile
+
+        from .fs_wrapper import LocalFS
+        from .. import io as _io
+
+        fs = fs or LocalFS()
+        if not self.is_first_worker():
+            return None
+        fs.mkdir(path)
+        nos = _checkpoint_numbers(fs, path)
+        no = (nos[-1] + 1) if nos else 0
+        ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
+        tmp = ckpt + ".tmp"
+        local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
+        _io.save_persistables(executor, local, main_program)
+        with open(os.path.join(local, _TRAIN_STATUS_FILE), "w") as f:
+            json.dump({"epoch_no": train_status._epoch_no}, f)
+        fs.delete(tmp)
+        fs.upload(local, tmp)
+        # atomic publish: a crash mid-save leaves only a .tmp dir behind,
+        # never a half-written numbered checkpoint
+        fs.mv(tmp, ckpt)
+        if not remain_all_checkpoint:
+            for old in _checkpoint_numbers(fs, path)[:-max_checkpoint_num]:
+                fs.delete(os.path.join(path, f"{_CHECKPOINT_PREFIX}{old}"))
+        return no
+
+    def load_check_point(
+        self, executor, path, trainer_id=None, main_program=None, fs=None,
+        checkpoint_no=None,
+    ):
+        """Load the newest (or requested) checkpoint via the FS backend;
+        returns its TrainStatus. Missing dir -> TrainStatus(-1) (cold
+        start, reference behavior)."""
+        import tempfile
+
+        from .fs_wrapper import LocalFS
+        from .. import io as _io
+
+        fs = fs or LocalFS()
+        nos = _checkpoint_numbers(fs, path) if fs.is_exist(path) else []
+        if not nos:
+            return TrainStatus(-1)
+        no = checkpoint_no if checkpoint_no is not None else nos[-1]
+        ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
+        local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
+        fs.download(ckpt, local)
+        _io.load_persistables(executor, local, main_program)
+        status_file = os.path.join(local, _TRAIN_STATUS_FILE)
+        if os.path.exists(status_file):
+            with open(status_file) as f:
+                return TrainStatus(json.load(f).get("epoch_no", -1))
+        return TrainStatus(-1)
+
 
 
 class TrainStatus:
@@ -229,3 +312,4 @@ class CollectiveOptimizer:
 
 
 fleet = Fleet()
+

@@ -1,0 +1,67 @@
+"""Pluggable checkpoint filesystems (reference
+incubate/fleet/collective/fs_wrapper.py: FS / LocalFS / BDFS).
+
+LocalFS covers single-host and NFS-mounted checkpoint dirs; a HadoopFS-style
+backend plugs in by implementing the same five methods (the reference
+shelled out to `hadoop fs`, framework/io/fs.cc)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class FS:
+    def list_dirs(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def mkdir(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def mv(self, src, dst):
+        raise NotImplementedError
+
+    def upload(self, local_path, remote_path):
+        """Publish a locally-written payload dir to the backend."""
+        raise NotImplementedError
+
+    def download(self, remote_path, local_path):
+        """Fetch a checkpoint dir into a local staging dir."""
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    def list_dirs(self, path):
+        if not os.path.isdir(path):
+            return []
+        return [
+            d for d in sorted(os.listdir(path))
+            if os.path.isdir(os.path.join(path, d))
+        ]
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdir(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst):
+        shutil.move(src, dst)
+
+    def upload(self, local_path, remote_path):
+        shutil.copytree(local_path, remote_path, dirs_exist_ok=True)
+
+    def download(self, remote_path, local_path):
+        shutil.copytree(remote_path, local_path, dirs_exist_ok=True)

@@ -144,8 +144,19 @@ class Executor:
         # the reference, which is deterministic per seed but advances its
         # generator every op execution.
         # seed 0 = nondeterministic (fluid semantics): fall back to the
-        # program's own nonce so unseeded Programs are mutually decorrelated
-        seed = program.random_seed or program._rng_nonce
+        # program's own nonce so unseeded Programs are mutually decorrelated.
+        # When the mesh spans processes the step key feeds a REPLICATED
+        # shard_map input, so every process must derive the same value: use
+        # a structural hash of the program instead of the per-process nonce
+        # (identically-built programs hash identically on every rank).
+        seed = program.random_seed
+        if not seed:
+            mesh = program._mesh
+            multiproc = mesh is not None and any(
+                d.process_index != jax.process_index()
+                for d in mesh.devices.flat
+            )
+            seed = program._structural_seed() if multiproc else program._rng_nonce
         step = program._rng_step
         program._rng_step += 1
         from ..core.random import prng_impl

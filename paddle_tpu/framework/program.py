@@ -289,6 +289,18 @@ class Program:
     def _bump(self):
         self._version += 1
 
+    def _structural_seed(self):
+        """Deterministic seed from program structure: identical on every
+        process of a multi-controller job that built the same program
+        (executor uses it for the replicated per-step RNG key when
+        random_seed is unset)."""
+        import zlib
+
+        sig = ",".join(
+            f"{op.type}:{op.uid}" for b in self.blocks for op in b.ops
+        )
+        return (zlib.crc32(sig.encode()) & 0x7FFFFFFF) | 1
+
     @property
     def global_block(self):
         return self.blocks[0]

@@ -154,3 +154,56 @@ def test_save_load_inference_model(tmp_path):
     types = [op.type for op in prog.global_block.ops]
     assert "__vjp__" not in types
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-5)
+
+
+def test_fleet_checkpoint_rotation_and_resume(tmp_path):
+    """save_check_point rotates numbered dirs + TrainStatus; load resumes
+    params and epoch (reference incubate/fleet/collective :155-240)."""
+    import os
+
+    from paddle_tpu.fleet import collective as fc
+
+    x = fluid.data("x", [-1, 4])
+    y = fluid.layers.fc(x, 2, param_attr=fluid.ParamAttr(name="ck_w"))
+    loss = fluid.layers.mean(y)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = fluid.framework.scope.global_scope()
+
+    from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+
+    fleet = fc.Fleet()
+    fleet.init(UserDefinedRoleMaker())
+
+    path = str(tmp_path / "ckpts")
+    feed = {"x": np.ones((2, 4), np.float32)}
+    saved_params = []
+    for epoch in range(5):
+        exe.run(feed=feed, fetch_list=[loss])
+        saved_params.append(np.asarray(scope.find_var("ck_w")).copy())
+        no = fleet.save_check_point(
+            exe, path, fc.TrainStatus(epoch), max_checkpoint_num=3
+        )
+        assert no == epoch
+    dirs = sorted(os.listdir(path))
+    assert dirs == [
+        "__paddle_checkpoint__2", "__paddle_checkpoint__3",
+        "__paddle_checkpoint__4",
+    ]
+
+    # clobber params, resume from latest
+    scope.set_var("ck_w", np.zeros_like(saved_params[-1]))
+    status = fleet.load_check_point(exe, path)
+    assert status.next() == 5
+    np.testing.assert_allclose(
+        np.asarray(scope.find_var("ck_w")), saved_params[-1]
+    )
+    # resume a specific earlier number
+    status = fleet.load_check_point(exe, path, checkpoint_no=2)
+    assert status.next() == 3
+    np.testing.assert_allclose(
+        np.asarray(scope.find_var("ck_w")), saved_params[2]
+    )
+    # cold start: empty dir -> TrainStatus(-1)
+    assert fleet.load_check_point(exe, str(tmp_path / "none")).next() == 0

@@ -21,6 +21,14 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
 
+def _free_port():
+    import socket
+
+    with socket.socket() as s_:
+        s_.bind(("127.0.0.1", 0))
+        return s_.getsockname()[1]
+
+
 @pytest.fixture(autouse=True)
 def fresh_programs():
     main, startup = fluid.Program(), fluid.Program()
@@ -69,7 +77,7 @@ def test_launch_two_process_fleet_dp(tmp_path):
     proc = subprocess.run(
         [
             sys.executable, "-m", "paddle_tpu.distributed.launch",
-            "--nproc_per_node=2", "--started_port=19411",
+            "--nproc_per_node=2", f"--started_port={_free_port()}",
             "--simulate_cpu",
             os.path.join(HERE, "dist_fleet_worker.py"), str(tmp_path),
         ],
@@ -93,7 +101,7 @@ def test_launcher_aborts_pod_on_child_failure(tmp_path):
     proc = subprocess.run(
         [
             sys.executable, "-m", "paddle_tpu.distributed.launch",
-            "--nproc_per_node=2", "--started_port=19431",
+            "--nproc_per_node=2", f"--started_port={_free_port()}",
             str(bad), "x",
         ],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
