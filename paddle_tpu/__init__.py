@@ -47,6 +47,17 @@ from .param_attr import ParamAttr  # noqa: F401
 from . import dataloader  # noqa: F401
 from . import profiler  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
+from . import metrics  # noqa: F401
+from . import nets  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from . import reader  # noqa: F401  (DataLoader + paddle.reader decorators)
+from .reader_decorators import batch  # noqa: F401
+from . import dataset  # noqa: F401
+from . import inference  # noqa: F401
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401
+from . import tensor  # noqa: F401
 from .reader import DataLoader  # noqa: F401
 
 # `fluid`-compatible alias so code written against the reference API reads

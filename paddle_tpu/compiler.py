@@ -1,0 +1,82 @@
+"""CompiledProgram shim (reference python/paddle/fluid/compiler.py:87,
+with_data_parallel :160).
+
+The reference's CompiledProgram constructed a C++ ParallelExecutor; here
+data parallelism is mesh + shard_map (parallel/spmd.py), so
+with_data_parallel attaches a dp mesh, inserts the grad allreduce if a
+loss_name is given, and Executor.run accepts the CompiledProgram wherever a
+Program goes (it unwraps .program)."""
+
+from __future__ import annotations
+
+from .framework.program import Program
+
+
+class BuildStrategy:
+    """Knob bag parity (details/build_strategy.h:38-135). XLA owns
+    scheduling/fusion/memory, so knobs are accepted and recorded only."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_reduce_ops = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 0  # XLA owns threading
+        self.num_iteration_per_drop_scope = 1
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        if isinstance(program_or_graph, CompiledProgram):
+            program_or_graph = program_or_graph.program
+        if not isinstance(program_or_graph, Program):
+            raise TypeError("CompiledProgram expects a Program")
+        self.program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._is_data_parallel = False
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        from .parallel.mesh import DATA_AXIS, make_mesh
+        from .parallel.spmd import shard_program
+        from .parallel.transpiler import GradAllReduce
+
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        mesh = make_mesh(devices=places)
+        dp = mesh.shape.get(DATA_AXIS, 1)
+        if loss_name is not None and dp > 1:
+            blk = self.program.global_block
+            grads = [
+                n for op in blk.ops for n in op.output_names()
+                if n.endswith("@GRAD") and blk.has_var(n)
+            ]
+            pgs = []
+            seen = set()
+            for op in blk.ops:
+                if op.type in ("sgd", "momentum", "adam", "adamw", "lamb"):
+                    p = op.inputs["Param"][0]
+                    g = op.inputs["Grad"][0]
+                    if g not in seen:
+                        pgs.append((blk.var(p), blk.var(g)))
+                        seen.add(g)
+            GradAllReduce(dp).transpile(self.program, pgs)
+        shardings = {
+            v.name: (DATA_AXIS,)
+            for v in self.program.list_vars()
+            if v.is_data
+        }
+        shard_program(self.program, mesh, shardings)
+        self._is_data_parallel = True
+        return self

@@ -1,0 +1,51 @@
+"""DataFeeder (reference python/paddle/fluid/data_feeder.py): converts
+row-oriented python samples into the column-oriented feed dict Executor.run
+expects, casting to each feed Variable's declared dtype/shape."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .core.dtypes import to_numpy_dtype
+from .framework.program import Variable, default_main_program
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.program = program or default_main_program()
+        self.feed_vars = []
+        for v in feed_list:
+            if isinstance(v, str):
+                v = self.program.global_block.var(v)
+            if not isinstance(v, Variable):
+                raise TypeError("feed_list entries must be Variables/names")
+            self.feed_vars.append(v)
+
+    def feed(self, iterable):
+        """iterable of rows, each row = one value per feed var (tuple/list),
+        -> {name: batched ndarray} (reference DataFeeder.feed)."""
+        columns = [[] for _ in self.feed_vars]
+        for row in iterable:
+            if not isinstance(row, (list, tuple)):
+                row = (row,)
+            if len(row) != len(self.feed_vars):
+                raise ValueError(
+                    f"sample has {len(row)} fields, feed_list expects "
+                    f"{len(self.feed_vars)}"
+                )
+            for c, v in zip(columns, row):
+                c.append(np.asarray(v))
+        out = {}
+        for var, col in zip(self.feed_vars, columns):
+            arr = np.stack(col).astype(to_numpy_dtype(var.dtype))
+            want = var.shape or ()
+            # -1 batch dims pass through; fixed trailing dims are validated
+            if len(want) == arr.ndim and all(
+                w in (-1, None) or w == a
+                for w, a in zip(want, arr.shape)
+            ):
+                pass
+            elif len(want) == arr.ndim + 1 and (want[-1] in (1, -1)):
+                arr = arr.reshape(arr.shape + (1,))
+            out[var.name] = arr
+        return out

@@ -1,0 +1,12 @@
+"""Canned dataset readers (reference python/paddle/dataset/: mnist, cifar,
+uci_housing, imdb, ... download from public mirrors and yield samples).
+
+This environment has no network egress, so each dataset loads from
+$PADDLE_TPU_DATA_HOME/<name>/ when the reference file layout is present and
+otherwise yields a DETERMINISTIC SYNTHETIC sample stream with the real
+shapes/dtypes/label ranges — the reader CONTRACT (generator of tuples,
+paddle.batch-composable) is what the framework tests and examples exercise.
+Each reader documents which mode produced its data via `.synthetic`.
+"""
+
+from . import cifar, mnist, uci_housing  # noqa: F401

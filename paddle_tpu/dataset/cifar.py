@@ -1,0 +1,68 @@
+"""CIFAR-10/100 readers (reference python/paddle/dataset/cifar.py: each
+sample = (3072-float image in [0,1], int label))."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from .common import data_path, have_file, synthetic_rng
+
+
+def _tar_reader(tar_name, sub_names, label_key):
+    def reader():
+        with tarfile.open(data_path("cifar", tar_name)) as tf:
+            for m in tf.getmembers():
+                if not any(s in m.name for s in sub_names):
+                    continue
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                for img, lab in zip(d[b"data"], d[label_key]):
+                    yield img.astype(np.float32) / 255.0, int(lab)
+
+    return reader
+
+
+def _synthetic(split, n_classes, n=512):
+    protos = synthetic_rng("cifar", f"protos{n_classes}").rand(
+        n_classes, 3072
+    ).astype(np.float32)
+
+    def gen():
+        r = synthetic_rng("cifar", split + str(n_classes))
+        for _ in range(n):
+            lab = int(r.randint(0, n_classes))
+            img = np.clip(
+                0.6 * protos[lab] + 0.4 * r.rand(3072), 0, 1
+            ).astype(np.float32)
+            yield img, lab
+
+    gen.synthetic = True
+    return gen
+
+
+def _make(tar_name, subs, label_key, split, n_classes):
+    if have_file("cifar", tar_name):
+        r = _tar_reader(tar_name, subs, label_key)
+        r.synthetic = False
+        return r
+    return _synthetic(split, n_classes)
+
+
+def train10():
+    return _make("cifar-10-python.tar.gz", [f"data_batch_{i}" for i in range(1, 6)],
+                 b"labels", "train", 10)
+
+
+def test10():
+    return _make("cifar-10-python.tar.gz", ["test_batch"], b"labels", "test", 10)
+
+
+def train100():
+    return _make("cifar-100-python.tar.gz", ["train"], b"fine_labels", "train", 100)
+
+
+def test100():
+    return _make("cifar-100-python.tar.gz", ["test"], b"fine_labels", "test", 100)

@@ -1,0 +1,29 @@
+"""Shared dataset plumbing (reference python/paddle/dataset/common.py)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu", "dataset"),
+)
+
+
+def data_path(name, filename):
+    return os.path.join(DATA_HOME, name, filename)
+
+
+def have_file(name, filename):
+    return os.path.exists(data_path(name, filename))
+
+
+def synthetic_rng(name, split):
+    """Deterministic per-(dataset, split) generator for synthetic mode."""
+    import zlib
+
+    return np.random.RandomState(
+        zlib.crc32(f"{name}:{split}".encode()) & 0x7FFFFFFF
+    )

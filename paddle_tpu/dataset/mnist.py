@@ -1,0 +1,71 @@
+"""MNIST readers (reference python/paddle/dataset/mnist.py: train/test yield
+(784-float image in [-1,1], int label))."""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from .common import data_path, have_file, synthetic_rng
+
+_N_TRAIN, _N_TEST = 60000, 10000
+
+
+def _idx_reader(images_gz, labels_gz):
+    with gzip.open(labels_gz, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), dtype=np.uint8)
+    with gzip.open(images_gz, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    for img, lab in zip(images, labels):
+        yield (img.astype(np.float32) / 127.5 - 1.0), int(lab)
+
+
+def _synthetic(split, n):
+    rng = synthetic_rng("mnist", split)
+    # class-conditional blobs: linearly separable enough that a softmax
+    # regression visibly learns (keeps convergence tests meaningful)
+    protos = rng.randn(10, 784).astype(np.float32)
+
+    def gen():
+        r = synthetic_rng("mnist", split + "-stream")
+        for _ in range(n):
+            lab = int(r.randint(0, 10))
+            img = np.clip(
+                protos[lab] * 0.5 + r.randn(784).astype(np.float32) * 0.5,
+                -1, 1,
+            ).astype(np.float32)
+            yield img, lab
+
+    return gen
+
+
+def _reader(split, images, labels, n):
+    if have_file("mnist", images) and have_file("mnist", labels):
+        def real():
+            return _idx_reader(
+                data_path("mnist", images), data_path("mnist", labels)
+            )
+
+        real.synthetic = False
+        return real
+    gen = _synthetic(split, min(n, 2048))
+    gen.synthetic = True
+    return gen
+
+
+def train():
+    return _reader(
+        "train", "train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
+        _N_TRAIN,
+    )
+
+
+def test():
+    return _reader(
+        "test", "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz",
+        _N_TEST,
+    )

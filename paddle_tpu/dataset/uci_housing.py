@@ -1,0 +1,50 @@
+"""UCI housing readers (reference python/paddle/dataset/uci_housing.py:
+13 normalized float features -> float target)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import data_path, have_file, synthetic_rng
+
+FEATURE_NUM = 13
+
+
+def _load_real():
+    raw = np.loadtxt(data_path("uci_housing", "housing.data"))
+    feats = raw[:, :-1]
+    feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+    return feats.astype(np.float32), raw[:, -1:].astype(np.float32)
+
+
+def _synthetic(split, n=512):
+    rng = synthetic_rng("uci_housing", split)
+    w = rng.randn(FEATURE_NUM, 1).astype(np.float32)
+    x = rng.randn(n, FEATURE_NUM).astype(np.float32)
+    y = x @ w + 0.1 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def _reader(split, lo, hi):
+    if have_file("uci_housing", "housing.data"):
+        x, y = _load_real()
+        x, y = x[int(lo * len(x)):int(hi * len(x))], y[int(lo * len(y)):int(hi * len(y))]
+        synthetic = False
+    else:
+        x, y = _synthetic(split)
+        synthetic = True
+
+    def reader():
+        for xi, yi in zip(x, y):
+            yield xi, yi
+
+    reader.synthetic = synthetic
+    return reader
+
+
+def train():
+    return _reader("train", 0.0, 0.8)
+
+
+def test():
+    return _reader("test", 0.8, 1.0)

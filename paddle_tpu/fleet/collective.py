@@ -166,20 +166,25 @@ class Fleet:
         fs = fs or LocalFS()
         if not self.is_first_worker():
             return None
+        import shutil
+
         fs.mkdir(path)
         nos = _checkpoint_numbers(fs, path)
         no = (nos[-1] + 1) if nos else 0
         ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
         tmp = ckpt + ".tmp"
         local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
-        _io.save_persistables(executor, local, main_program)
-        with open(os.path.join(local, _TRAIN_STATUS_FILE), "w") as f:
-            json.dump({"epoch_no": train_status._epoch_no}, f)
-        fs.delete(tmp)
-        fs.upload(local, tmp)
-        # atomic publish: a crash mid-save leaves only a .tmp dir behind,
-        # never a half-written numbered checkpoint
-        fs.mv(tmp, ckpt)
+        try:
+            _io.save_persistables(executor, local, main_program)
+            with open(os.path.join(local, _TRAIN_STATUS_FILE), "w") as f:
+                json.dump({"epoch_no": train_status._epoch_no}, f)
+            fs.delete(tmp)
+            fs.upload(local, tmp)
+            # atomic publish: a crash mid-save leaves only a .tmp dir
+            # behind, never a half-written numbered checkpoint
+            fs.mv(tmp, ckpt)
+        finally:
+            shutil.rmtree(local, ignore_errors=True)
         if not remain_all_checkpoint:
             for old in _checkpoint_numbers(fs, path)[:-max_checkpoint_num]:
                 fs.delete(os.path.join(path, f"{_CHECKPOINT_PREFIX}{old}"))
@@ -201,16 +206,21 @@ class Fleet:
         nos = _checkpoint_numbers(fs, path) if fs.is_exist(path) else []
         if not nos:
             return TrainStatus(-1)
+        import shutil
+
         no = checkpoint_no if checkpoint_no is not None else nos[-1]
         ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
         local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
-        fs.download(ckpt, local)
-        _io.load_persistables(executor, local, main_program)
-        status_file = os.path.join(local, _TRAIN_STATUS_FILE)
-        if os.path.exists(status_file):
-            with open(status_file) as f:
-                return TrainStatus(json.load(f).get("epoch_no", -1))
-        return TrainStatus(-1)
+        try:
+            fs.download(ckpt, local)
+            _io.load_persistables(executor, local, main_program)
+            status_file = os.path.join(local, _TRAIN_STATUS_FILE)
+            if os.path.exists(status_file):
+                with open(status_file) as f:
+                    return TrainStatus(json.load(f).get("epoch_no", -1))
+            return TrainStatus(-1)
+        finally:
+            shutil.rmtree(local, ignore_errors=True)
 
 
 

@@ -107,6 +107,8 @@ class Executor:
         use_program_cache=True,
     ):
         program = program if program is not None else default_main_program()
+        # CompiledProgram shim (compiler.py): run its underlying Program
+        program = getattr(program, "program", program)
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
         fetch_list = fetch_list or []
@@ -152,10 +154,19 @@ class Executor:
         seed = program.random_seed
         if not seed:
             mesh = program._mesh
-            multiproc = mesh is not None and any(
-                d.process_index != jax.process_index()
-                for d in mesh.devices.flat
-            )
+            multiproc = False
+            if mesh is not None:
+                cached = getattr(mesh, "_paddle_multiproc", None)
+                if cached is None:
+                    cached = any(
+                        d.process_index != jax.process_index()
+                        for d in mesh.devices.flat
+                    )
+                    try:
+                        mesh._paddle_multiproc = cached
+                    except AttributeError:
+                        pass
+                multiproc = cached
             seed = program._structural_seed() if multiproc else program._rng_nonce
         step = program._rng_step
         program._rng_step += 1

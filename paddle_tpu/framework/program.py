@@ -133,6 +133,8 @@ def _user_frame():
     return None
 
 
+_program_counter = 0
+
 GRAD_SUFFIX = "@GRAD"
 
 
@@ -280,6 +282,11 @@ class Program:
         import random as _random
 
         self._rng_nonce = _random.SystemRandom().getrandbits(31) | 1
+        # process-wide creation ordinal: rank-consistent when every process
+        # builds its programs in the same order (see _structural_seed)
+        global _program_counter
+        _program_counter += 1
+        self._creation_ordinal = _program_counter
 
     def _next_uid(self):
         uid = self._op_uid
@@ -290,16 +297,24 @@ class Program:
         self._version += 1
 
     def _structural_seed(self):
-        """Deterministic seed from program structure: identical on every
-        process of a multi-controller job that built the same program
-        (executor uses it for the replicated per-step RNG key when
-        random_seed is unset)."""
+        """Deterministic seed from program structure + a process-wide
+        creation ordinal: identical on every process of a multi-controller
+        job that built its programs in the same order (the rank-consistency
+        contract multi-controller SPMD already requires), while two
+        same-structured programs in ONE job still get distinct streams.
+        Cached per program version (executor hot path)."""
+        cached = self.__dict__.get("_structural_seed_cache")
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
         import zlib
 
         sig = ",".join(
             f"{op.type}:{op.uid}" for b in self.blocks for op in b.ops
         )
-        return (zlib.crc32(sig.encode()) & 0x7FFFFFFF) | 1
+        sig += f"#{self._creation_ordinal}"
+        seed = (zlib.crc32(sig.encode()) & 0x7FFFFFFF) | 1
+        self._structural_seed_cache = (self._version, seed)
+        return seed
 
     @property
     def global_block(self):
@@ -343,6 +358,10 @@ class Program:
             import random as _random
 
             self._rng_nonce = _random.SystemRandom().getrandbits(31) | 1
+        if "_creation_ordinal" not in self.__dict__:
+            global _program_counter
+            _program_counter += 1
+            self._creation_ordinal = _program_counter
         self.__dict__.setdefault("_spmd_mode", "shard_map")
         self.__dict__.setdefault("_pipeline", None)
 

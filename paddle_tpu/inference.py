@@ -1,0 +1,94 @@
+"""Inference predictor API (reference paddle/fluid/inference/:
+AnalysisConfig paddle_analysis_config.h, AnalysisPredictor
+analysis_predictor.cc, create_paddle_predictor, PaddleTensor).
+
+TPU-native: load_inference_model gives the pruned Program; the predictor
+compiles it once per input-shape set through the ordinary Executor (whole
+block -> one XLA executable — the role of the reference's IR pass manager +
+NaiveExecutor + TensorRT engines collapses into XLA). Zero-copy: outputs
+stay device arrays until .as_ndarray()."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class AnalysisConfig:
+    def __init__(self, model_dir=None, params_file=None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+        self._use_feed_fetch_ops = False
+        self._switch_ir_optim = True  # accepted; XLA owns optimization
+
+    def disable_glog_info(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        self._switch_ir_optim = flag
+
+    def switch_use_feed_fetch_ops(self, flag):
+        self._use_feed_fetch_ops = flag
+
+    def enable_use_gpu(self, *a, **k):  # API parity: device is the TPU
+        pass
+
+    def disable_gpu(self):
+        pass
+
+
+class PaddleTensor:
+    """Host-side input/output tensor (reference paddle_api.h PaddleTensor)."""
+
+    def __init__(self, data=None, name=""):
+        self.name = name
+        self.data = np.asarray(data) if data is not None else None
+
+    def as_ndarray(self):
+        return np.asarray(self.data)
+
+
+class Predictor:
+    """AnalysisPredictor parity: load once, run many."""
+
+    def __init__(self, config):
+        from . import io as _io
+        from .framework.executor import Executor
+        from .framework.scope import Scope, scope_guard
+
+        if config.model_dir is None:
+            raise ValueError("AnalysisConfig.model_dir is required")
+        self._scope = Scope()
+        self._exe = Executor()
+        with scope_guard(self._scope):
+            (
+                self._program,
+                self._feed_names,
+                self._fetch_vars,
+            ) = _io.load_inference_model(config.model_dir, self._exe)
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return [
+            v if isinstance(v, str) else v.name for v in self._fetch_vars
+        ]
+
+    def run(self, inputs):
+        """inputs: list of PaddleTensor/ndarray in feed order -> list of
+        PaddleTensor (reference PaddlePredictor::Run)."""
+        feed = {}
+        for name, t in zip(self._feed_names, inputs):
+            feed[name] = t.data if isinstance(t, PaddleTensor) else np.asarray(t)
+        outs = self._exe.run(
+            self._program, feed=feed, fetch_list=self._fetch_vars,
+            scope=self._scope,
+        )
+        return [
+            PaddleTensor(o, name=n)
+            for o, n in zip(outs, self.get_output_names())
+        ]
+
+
+def create_paddle_predictor(config):
+    return Predictor(config)

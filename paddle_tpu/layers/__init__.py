@@ -53,3 +53,5 @@ from .control_flow import (  # noqa: F401
     increment,
     switch_case,
 )
+from . import distributions  # noqa: F401
+from .tensor import assign_value, take_along_axis  # noqa: F401

@@ -1,0 +1,124 @@
+"""Probability distributions (reference python/paddle/fluid/layers/
+distributions.py: Distribution, Uniform, Normal, Categorical,
+MultivariateNormalDiag — sample / entropy / log_prob / kl_divergence as
+graph ops)."""
+
+from __future__ import annotations
+
+import math
+
+from ..framework.program import Variable
+from . import tensor
+
+
+def _as_var(value, like=None, dtype="float32"):
+    if isinstance(value, Variable):
+        return value
+    if isinstance(value, (list, tuple)):
+        return tensor.assign_value(value, dtype)
+    return tensor.fill_constant([1], dtype, float(value))
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference :100)."""
+
+    def __init__(self, low, high):
+        self.low = _as_var(low)
+        self.high = _as_var(high)
+
+    def sample(self, shape, seed=0):
+        u = tensor.uniform_random(shape, min=0.0, max=1.0, seed=seed)
+        return self.low + u * (self.high - self.low)
+
+    def entropy(self):
+        return tensor.log(self.high - self.low)
+
+    def log_prob(self, value):
+        inside = tensor.logical_and(
+            tensor.greater_equal(value, self.low),
+            tensor.less_than(value, self.high),
+        )
+        dens = tensor.cast(inside, "float32") / (self.high - self.low)
+        return tensor.log(dens + 1e-30)
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference :260)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_var(loc)
+        self.scale = _as_var(scale)
+
+    def sample(self, shape, seed=0):
+        z = tensor.gaussian_random(shape, mean=0.0, std=1.0, seed=seed)
+        return self.loc + z * self.scale
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + tensor.log(self.scale)
+
+    def log_prob(self, value):
+        var = self.scale * self.scale
+        return (
+            -1.0 * ((value - self.loc) * (value - self.loc)) / (2.0 * var)
+            - tensor.log(self.scale)
+            - 0.5 * math.log(2 * math.pi)
+        )
+
+    def kl_divergence(self, other):
+        """KL(self || other), both Normal (reference :372)."""
+        var_ratio = self.scale / other.scale
+        var_ratio = var_ratio * var_ratio
+        t1 = (self.loc - other.loc) / other.scale
+        t1 = t1 * t1
+        return 0.5 * (var_ratio + t1 - 1.0 - tensor.log(var_ratio))
+
+
+class Categorical(Distribution):
+    """Over unnormalized logits (reference :430)."""
+
+    def __init__(self, logits):
+        if not isinstance(logits, Variable):
+            raise TypeError("Categorical expects a logits Variable")
+        self.logits = logits
+
+    def _probs(self):
+        return tensor.softmax(self.logits, axis=-1)
+
+    def entropy(self):
+        p = self._probs()
+        logp = tensor.log(p + 1e-30)
+        return 0.0 - tensor.reduce_sum(
+            tensor.elementwise_mul(p, logp), -1, keep_dim=False
+        )
+
+    def log_prob(self, value):
+        logp = tensor.log(self._probs() + 1e-30)
+        idx = tensor.unsqueeze(tensor.cast(value, "int32"), [-1])
+        return tensor.squeeze(
+            tensor.take_along_axis(logp, idx, axis=-1), [-1]
+        )
+
+    def kl_divergence(self, other):
+        p = self._probs()
+        return tensor.reduce_sum(
+            tensor.elementwise_mul(
+                p,
+                tensor.log(p + 1e-30) - tensor.log(other._probs() + 1e-30),
+            ),
+            -1,
+            keep_dim=False,
+        )

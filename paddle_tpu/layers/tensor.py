@@ -225,6 +225,26 @@ def cumsum(x, axis=-1, exclusive=False, reverse=False):
     )
 
 
+def take_along_axis(x, index, axis=-1):
+    return _simple(
+        "take_along_axis", {"Input": [x], "Index": [index]}, {"Axis": axis},
+        out_slots=("Result",),
+    )
+
+
+def assign_value(values, dtype="float32"):
+    """Constant tensor from a python/numpy literal (assign_value op)."""
+    import numpy as np
+
+    arr = np.asarray(values)
+    return _simple(
+        "assign_value", {},
+        {"values": arr.reshape(-1).tolist(), "shape": list(arr.shape),
+         "dtype": dtype},
+        stop_gradient=True,
+    )
+
+
 def where(condition, x, y):
     return _simple("where", {"Condition": [condition], "X": [x], "Y": [y]}, {})
 

@@ -1,0 +1,99 @@
+"""Composite network helpers (reference python/paddle/fluid/nets.py:
+simple_img_conv_pool, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention)."""
+
+from __future__ import annotations
+
+import math
+
+from . import layers
+
+
+def simple_img_conv_pool(
+    input, num_filters, filter_size, pool_size, pool_stride,
+    pool_padding=0, pool_type="max", global_pooling=False,
+    conv_stride=1, conv_padding=0, conv_dilation=1, conv_groups=1,
+    param_attr=None, bias_attr=None, act=None, use_cudnn=True,
+):
+    conv_out = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=conv_stride, padding=conv_padding, dilation=conv_dilation,
+        groups=conv_groups, param_attr=param_attr, bias_attr=bias_attr,
+        act=act,
+    )
+    return layers.pool2d(
+        input=conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, pool_padding=pool_padding,
+        global_pooling=global_pooling,
+    )
+
+
+def img_conv_group(
+    input, conv_num_filter, pool_size, conv_padding=1, conv_filter_size=3,
+    conv_act=None, param_attr=None, conv_with_batchnorm=False,
+    conv_batchnorm_drop_rate=0.0, pool_stride=1, pool_type="max",
+    use_cudnn=True,
+):
+    if isinstance(conv_num_filter, int):
+        conv_num_filter = [conv_num_filter]
+
+    def bcast(v, n):
+        return v if isinstance(v, (list, tuple)) else [v] * n
+
+    n = len(conv_num_filter)
+    paddings = bcast(conv_padding, n)
+    fsizes = bcast(conv_filter_size, n)
+    with_bn = bcast(conv_with_batchnorm, n)
+    drops = bcast(conv_batchnorm_drop_rate, n)
+    attrs = param_attr if isinstance(param_attr, (list, tuple)) else [param_attr] * n
+
+    tmp = input
+    for i in range(n):
+        tmp = layers.conv2d(
+            input=tmp, num_filters=conv_num_filter[i],
+            filter_size=fsizes[i], padding=paddings[i],
+            param_attr=attrs[i],
+            act=None if with_bn[i] else conv_act,
+        )
+        if with_bn[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            if drops[i] > 0:
+                tmp = layers.dropout(tmp, dropout_prob=drops[i])
+    return layers.pool2d(
+        input=tmp, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride,
+    )
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in half on `dim`, a * sigmoid(b)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(
+    queries, keys, values, num_heads=1, dropout_rate=0.0,
+):
+    """Multi-head attention over [B, S, D] inputs (reference nets.py:503)."""
+    b, sq, d = queries.shape
+    _, sk, _ = keys.shape
+    if d % num_heads:
+        raise ValueError("hidden size must divide num_heads")
+    dh = d // num_heads
+
+    def split_heads(x, s):
+        x = layers.reshape(x, [b, s, num_heads, dh])
+        return layers.transpose(x, [0, 2, 1, 3])
+
+    q = split_heads(queries, sq)
+    k = split_heads(keys, sk)
+    v = split_heads(values, sk)
+    scores = layers.matmul(
+        q, k, transpose_y=True, alpha=1.0 / math.sqrt(dh)
+    )
+    weights = layers.softmax(scores, axis=-1)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    return layers.reshape(ctx, [b, sq, d])

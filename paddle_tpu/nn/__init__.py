@@ -1,0 +1,13 @@
+"""paddle.nn 2.0-preview namespace (reference python/paddle/nn/__init__.py:
+thin re-exports of fluid layers/dygraph modules)."""
+
+from ..dygraph.nn import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    Embedding,
+    LayerNorm,
+    Linear,
+    Pool2D,
+)
+from ..dygraph.layers import Layer, LayerList, ParameterList, Sequential  # noqa: F401
+from . import functional  # noqa: F401

@@ -1,0 +1,27 @@
+"""paddle.nn.functional namespace (reference python/paddle/nn/functional/):
+functional aliases of fluid.layers ops."""
+
+from ..layers import (  # noqa: F401
+    conv2d,
+    dropout,
+    elu,
+    gelu,
+    hard_sigmoid,
+    hard_swish,
+    leaky_relu,
+    log_softmax,
+    logsigmoid,
+    pool2d,
+    relu,
+    relu6,
+    selu,
+    sigmoid,
+    sigmoid_cross_entropy_with_logits,
+    silu,
+    softmax,
+    softmax_with_cross_entropy,
+    softplus,
+    softsign,
+    swish,
+    tanh,
+)

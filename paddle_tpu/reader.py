@@ -22,6 +22,17 @@ import threading
 
 import numpy as np
 
+from .reader_decorators import (  # noqa: F401  (paddle.reader decorators
+    batch,  # live under fluid.reader here: one package serves both the
+    buffered,  # fluid.reader module and the paddle.reader namespace)
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    shuffle,
+    xmap_readers,
+)
 from .dataloader import BatchSampler, Dataset, IterableDataset
 from .dataloader.dataloader_iter import (
     _MultiWorkerIter,

@@ -1,0 +1,156 @@
+"""Reader decorators + paddle.batch (reference python/paddle/reader/
+decorator.py: map_readers, shuffle, chain, compose, buffered, firstn,
+xmap_readers, cache; python/paddle/batch.py)."""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch parity: sample reader -> batch reader."""
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+def map_readers(func, *readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        random.shuffle(buf)
+        yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        return itertools.chain(*[r() for r in readers])
+
+    return chained
+
+
+def compose(*readers, check_alignment=True):
+    def composed():
+        iters = [r() for r in readers]
+        for items in zip(*iters):
+            out = ()
+            for item in items:
+                out += item if isinstance(item, tuple) else (item,)
+            yield out
+        if check_alignment:
+            for it in iters:
+                try:
+                    next(it)
+                except StopIteration:
+                    continue
+                raise ValueError("readers have different lengths")
+
+    return composed
+
+
+def buffered(reader, size):
+    """Background-thread prefetch of up to `size` samples."""
+
+    END = object()
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            finally:
+                q.put(END)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is END:
+                return
+            yield s
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads (reference keeps
+    subprocess workers; threads suffice for numpy-bound mappers)."""
+
+    def xreader():
+        samples = list(reader())
+        results = [None] * len(samples)
+        idx_q: queue.Queue = queue.Queue()
+        for i, s in enumerate(samples):
+            idx_q.put((i, s))
+
+        def work():
+            while True:
+                try:
+                    i, s = idx_q.get_nowait()
+                except queue.Empty:
+                    return
+                results[i] = mapper(s)
+
+        threads = [
+            threading.Thread(target=work, daemon=True)
+            for _ in range(process_num)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if order:
+            yield from results
+        else:
+            yield from results
+
+    return xreader
+
+
+def cache(reader):
+    data = []
+    filled = []
+
+    def cached():
+        if not filled:
+            data.extend(reader())
+            filled.append(True)
+        return iter(data)
+
+    return cached

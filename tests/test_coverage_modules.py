@@ -1,0 +1,249 @@
+"""Coverage-widening modules: metrics, nets, DataFeeder, 2.0 namespaces,
+dataset readers + decorators, distributions, CompiledProgram, inference
+predictor.
+
+Reference suites: test_metrics.py, test_nets.py, test_data_feeder.py,
+test_dataset_*.py, test_distributions.py, test_compiled_program.py,
+inference api tests.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+# -- metrics ---------------------------------------------------------------
+
+
+def test_metrics_accuracy_precision_recall_auc():
+    m = fluid.metrics.Accuracy()
+    m.update(0.5, weight=10)
+    m.update(1.0, weight=10)
+    assert m.eval() == pytest.approx(0.75)
+
+    p = fluid.metrics.Precision()
+    r = fluid.metrics.Recall()
+    preds = np.asarray([1, 1, 0, 1])
+    labels = np.asarray([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.eval() == pytest.approx(2 / 3)
+    assert r.eval() == pytest.approx(2 / 3)
+
+    auc = fluid.metrics.Auc()
+    scores = np.asarray([0.1, 0.4, 0.35, 0.8])
+    auc_labels = np.asarray([0, 0, 1, 1])
+    auc.update(scores, auc_labels)
+    # sklearn roc_auc_score for this case = 0.75
+    assert auc.eval() == pytest.approx(0.75, abs=1e-3)
+
+    comp = fluid.metrics.CompositeMetric()
+    comp.add_metric(fluid.metrics.Precision())
+    comp.add_metric(fluid.metrics.Recall())
+    comp.update(preds, labels)
+    assert comp.eval() == [pytest.approx(2 / 3), pytest.approx(2 / 3)]
+
+
+# -- nets ------------------------------------------------------------------
+
+
+def test_nets_build_and_run():
+    img = fluid.data("img", [2, 3, 8, 8])
+    conv_pool = fluid.nets.simple_img_conv_pool(
+        img, num_filters=4, filter_size=3, pool_size=2, pool_stride=2,
+        conv_padding=1, act="relu",
+    )
+    g = fluid.nets.glu(fluid.data("gx", [2, 6]), dim=-1)
+    q = fluid.data("q", [2, 5, 8])
+    att = fluid.nets.scaled_dot_product_attention(q, q, q, num_heads=2)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    outs = exe.run(
+        feed={
+            "img": rng.randn(2, 3, 8, 8).astype(np.float32),
+            "gx": rng.randn(2, 6).astype(np.float32),
+            "q": rng.randn(2, 5, 8).astype(np.float32),
+        },
+        fetch_list=[conv_pool, g, att],
+    )
+    assert np.asarray(outs[0]).shape == (2, 4, 4, 4)
+    assert np.asarray(outs[1]).shape == (2, 3)
+    assert np.asarray(outs[2]).shape == (2, 5, 8)
+
+
+# -- DataFeeder ------------------------------------------------------------
+
+
+def test_data_feeder_casts_and_batches():
+    x = fluid.data("x", [-1, 3], "float32")
+    y = fluid.data("y", [-1, 1], "int64")
+    feeder = fluid.DataFeeder(feed_list=[x, y])
+    feed = feeder.feed([
+        ([1, 2, 3], 0),
+        ([4, 5, 6], 1),
+    ])
+    assert feed["x"].dtype == np.float32 and feed["x"].shape == (2, 3)
+    assert feed["y"].dtype == np.int64 and feed["y"].shape == (2, 1)
+
+
+# -- reader decorators + dataset ------------------------------------------
+
+
+def test_reader_decorators():
+    r = lambda: iter(range(10))
+    assert list(fluid.reader.firstn(r, 3)()) == [0, 1, 2]
+    assert len(list(fluid.batch(r, 4)())) == 3
+    assert len(list(fluid.batch(r, 4, drop_last=True)())) == 2
+    assert list(fluid.reader.chain(r, r)()) == list(range(10)) * 2
+    assert sorted(fluid.reader.shuffle(r, 5)()) == list(range(10))
+    doubled = fluid.reader.map_readers(lambda a: a * 2, r)
+    assert list(doubled()) == [0, 2, 4, 6, 8, 10, 12, 14, 16, 18]
+    buf = fluid.reader.buffered(r, 2)
+    assert list(buf()) == list(range(10))
+    cached = fluid.reader.cache(r)
+    assert list(cached()) == list(cached())
+
+
+def test_dataset_readers_shapes():
+    tr = fluid.dataset.mnist.train()
+    img, lab = next(iter(tr()))
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert 0 <= lab < 10
+    hx, hy = next(iter(fluid.dataset.uci_housing.train()()))
+    assert hx.shape == (13,) and hy.shape == (1,)
+    ci, cl = next(iter(fluid.dataset.cifar.train10()()))
+    assert ci.shape == (3072,) and 0 <= cl < 10
+    # batch-composable (the reader contract)
+    b = next(iter(fluid.batch(tr, 16)()))
+    assert len(b) == 16
+
+
+def test_mnist_synthetic_is_learnable():
+    """Softmax regression on the synthetic MNIST stream converges — keeps
+    the book-test style convergence checks meaningful offline."""
+    img = fluid.data("img", [-1, 784])
+    label = fluid.data("label", [-1, 1], "int64")
+    probs = layers.fc(img, 10, act=None)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(probs, label)
+    )
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder([img, label])
+    losses = []
+    for epoch in range(3):
+        for b in fluid.batch(fluid.dataset.mnist.train(), 64, drop_last=True)():
+            feed = feeder.feed([(s[0], np.asarray([s[1]])) for s in b])
+            (lv,) = exe.run(feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5
+
+
+# -- distributions ---------------------------------------------------------
+
+
+def test_distributions_normal_uniform_categorical():
+    from paddle_tpu.layers.distributions import Categorical, Normal, Uniform
+
+    n1 = Normal(0.0, 1.0)
+    n2 = Normal(1.0, 2.0)
+    ent = n1.entropy()
+    kl = n1.kl_divergence(n2)
+    lp = n1.log_prob(layers.fill_constant([1], "float32", 0.0))
+    u = Uniform(0.0, 2.0)
+    ulp = u.log_prob(layers.fill_constant([1], "float32", 1.0))
+    logits = layers.assign_value([[1.0, 2.0, 0.5]])
+    c = Categorical(logits)
+    cent = c.entropy()
+    s = n1.sample([1000], seed=7)
+    smean = layers.reduce_mean(s)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    ev, kv, lv, uv, cv, sm = (
+        float(np.asarray(v).reshape(-1)[0])
+        for v in exe.run(fetch_list=[ent, kl, lp, ulp, cent, smean])
+    )
+    import math
+
+    assert ev == pytest.approx(0.5 * math.log(2 * math.pi) + 0.5, rel=1e-5)
+    # KL(N(0,1) || N(1,2)) = log(2) + (1+1)/(2*4) - 0.5
+    assert kv == pytest.approx(math.log(2) + 2 / 8 - 0.5, rel=1e-5)
+    assert lv == pytest.approx(-0.5 * math.log(2 * math.pi), rel=1e-5)
+    assert uv == pytest.approx(math.log(0.5), rel=1e-4)
+    p = np.exp([1.0, 2.0, 0.5])
+    p /= p.sum()
+    assert cv == pytest.approx(-(p * np.log(p)).sum(), rel=1e-4)
+    assert abs(sm) < 0.15  # sample mean near loc
+
+
+# -- CompiledProgram -------------------------------------------------------
+
+
+def test_compiled_program_data_parallel_runs():
+    x = fluid.data("x", [8, 4])
+    y = fluid.data("y", [8, 1])
+    loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()
+    ).with_data_parallel(loss_name=loss.name)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 4).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+    losses = [
+        float(np.asarray(exe.run(compiled, feed=feed, fetch_list=[loss])[0])
+              .reshape(-1)[0])
+        for _ in range(10)
+    ]
+    assert losses[-1] < losses[0]
+
+
+# -- inference predictor ---------------------------------------------------
+
+
+def test_predictor_roundtrip(tmp_path):
+    x = fluid.data("x", [-1, 4])
+    out = layers.fc(x, 2, act="relu")
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(str(tmp_path / "model"), ["x"], [out], exe)
+
+    config = fluid.inference.AnalysisConfig(str(tmp_path / "model"))
+    pred = fluid.inference.create_paddle_predictor(config)
+    assert pred.get_input_names() == ["x"]
+    xv = np.ones((3, 4), np.float32)
+    outs = pred.run([fluid.inference.PaddleTensor(xv, name="x")])
+    ref = exe.run(feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(
+        outs[0].as_ndarray(), np.asarray(ref[0]), rtol=1e-6
+    )
+
+
+# -- 2.0 namespaces --------------------------------------------------------
+
+
+def test_v2_namespaces():
+    assert fluid.nn.Linear is fluid.dygraph.nn.Linear
+    assert fluid.nn.functional.relu is layers.relu
+    x = fluid.data("nx", [2, 3])
+    s = fluid.tensor.sum(x)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (v,) = exe.run(feed={"nx": np.ones((2, 3), np.float32)}, fetch_list=[s])
+    assert float(np.asarray(v).reshape(-1)[0]) == 6.0

@@ -159,7 +159,5 @@ def test_op_grad(case):
     t = T()
     t.op_type = op_type
     kwargs = dict(delta=1e-3, rtol=1e-2, atol=1e-4)
-    kwargs.update({k: v for k, v in opts.items() if k != "output_slot"})
-    if "output_slot" in opts:
-        kwargs["output_slot"] = opts["output_slot"]
+    kwargs.update(opts)  # check_grad accepts output_slot as a plain kwarg
     t.check_grad(to_check, **kwargs)

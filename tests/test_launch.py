@@ -21,12 +21,22 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
 
-def _free_port():
+def _free_port_pair():
+    """A base port with base+1 also free (the launcher binds consecutive
+    ports for nproc_per_node=2). Random high ports, both bind-tested."""
+    import random
     import socket
 
-    with socket.socket() as s_:
-        s_.bind(("127.0.0.1", 0))
-        return s_.getsockname()[1]
+    for _ in range(128):
+        base = random.randint(20000, 60000)
+        try:
+            with socket.socket() as a, socket.socket() as b:
+                a.bind(("127.0.0.1", base))
+                b.bind(("127.0.0.1", base + 1))
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair found")
 
 
 @pytest.fixture(autouse=True)
@@ -77,7 +87,7 @@ def test_launch_two_process_fleet_dp(tmp_path):
     proc = subprocess.run(
         [
             sys.executable, "-m", "paddle_tpu.distributed.launch",
-            "--nproc_per_node=2", f"--started_port={_free_port()}",
+            "--nproc_per_node=2", f"--started_port={_free_port_pair()}",
             "--simulate_cpu",
             os.path.join(HERE, "dist_fleet_worker.py"), str(tmp_path),
         ],
@@ -101,7 +111,7 @@ def test_launcher_aborts_pod_on_child_failure(tmp_path):
     proc = subprocess.run(
         [
             sys.executable, "-m", "paddle_tpu.distributed.launch",
-            "--nproc_per_node=2", f"--started_port={_free_port()}",
+            "--nproc_per_node=2", f"--started_port={_free_port_pair()}",
             str(bad), "x",
         ],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
