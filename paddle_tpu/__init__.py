@@ -58,6 +58,7 @@ from . import inference  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import tensor  # noqa: F401
+from . import tools  # noqa: F401
 from .reader import DataLoader  # noqa: F401
 
 # `fluid`-compatible alias so code written against the reference API reads

@@ -58,10 +58,6 @@ class CompiledProgram:
         dp = mesh.shape.get(DATA_AXIS, 1)
         if loss_name is not None and dp > 1:
             blk = self.program.global_block
-            grads = [
-                n for op in blk.ops for n in op.output_names()
-                if n.endswith("@GRAD") and blk.has_var(n)
-            ]
             pgs = []
             seen = set()
             for op in blk.ops:

@@ -1,3 +1,4 @@
 """contrib: mixed precision (AMP), quantization-aware training (slim), etc."""
 
 from . import mixed_precision  # noqa: F401
+from . import slim  # noqa: F401

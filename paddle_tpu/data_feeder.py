@@ -39,13 +39,25 @@ class DataFeeder:
         for var, col in zip(self.feed_vars, columns):
             arr = np.stack(col).astype(to_numpy_dtype(var.dtype))
             want = var.shape or ()
-            # -1 batch dims pass through; fixed trailing dims are validated
-            if len(want) == arr.ndim and all(
-                w in (-1, None) or w == a
-                for w, a in zip(want, arr.shape)
-            ):
+
+            def ok(shape):
+                return len(want) == len(shape) and all(
+                    w in (-1, None) or w == a for w, a in zip(want, shape)
+                )
+
+            if ok(arr.shape):
                 pass
-            elif len(want) == arr.ndim + 1 and (want[-1] in (1, -1)):
+            elif len(want) == arr.ndim + 1 and want[-1] in (1, -1):
                 arr = arr.reshape(arr.shape + (1,))
+                if not ok(arr.shape):
+                    raise ValueError(
+                        f"feed {var.name!r}: samples batch to "
+                        f"{arr.shape}, variable declares {tuple(want)}"
+                    )
+            else:
+                raise ValueError(
+                    f"feed {var.name!r}: samples batch to {arr.shape}, "
+                    f"variable declares {tuple(want)}"
+                )
             out[var.name] = arr
         return out

@@ -37,3 +37,4 @@ from .learning_rate_scheduler import (  # noqa: F401
     PolynomialDecay,
     ReduceLROnPlateau,
 )
+from .dygraph_to_static import ProgramTranslator, declarative, to_static  # noqa: F401

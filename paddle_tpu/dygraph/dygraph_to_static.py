@@ -1,0 +1,121 @@
+"""dygraph->static translation: @declarative / to_static / ProgramTranslator.
+
+Reference: dygraph/dygraph_to_static/ (19 files) rewrote the Python AST of a
+dygraph function into static-graph ops (ProgramTranslator
+program_translator.py:252, transformers for if/loop/break/print/call) so
+the same source could run eagerly or compile into a ProgramDesc.
+
+TPU-native position: jax.jit *is* the dygraph->static compiler for the
+functional subset — tracing the eager emitters once yields the compiled
+graph with no source rewriting, and that covers everything the AST
+transforms handled EXCEPT data-dependent Python control flow. Data-dependent
+control must be expressed with layers.cond / layers.While / StaticRNN (the
+structured ops, ops/control_flow.py), which is also what the reference's
+transformed AST ultimately lowered to (convert_ifelse -> cond op,
+convert_while -> while op). @declarative here:
+
+  * eager mode: traces the function through jax.jit on first call per
+    input-shape set and runs the cached executable after (per-call python
+    dispatch drops to one jitted call);
+  * static mode (no tracer active): runs the function as ordinary
+    layer-building code, exactly like the reference's static branch;
+  * raises a targeted error when a python `if`/`while` touches a traced
+    value, pointing at the structured-control-flow APIs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..framework.program import _current_tracer
+from .varbase import VarBase
+
+
+class ProgramTranslator:
+    """reference program_translator.py:252 singleton enable/disable."""
+
+    _instance = None
+
+    def __init__(self):
+        self.enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag=True):
+        self.enabled = bool(flag)
+
+
+def declarative(fn=None):
+    """Decorator (reference @declarative / @paddle.jit.to_static)."""
+    if fn is None:
+        return declarative
+
+    cache = {}
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        tracer = _current_tracer()
+        if tracer is None or not ProgramTranslator.get_instance().enabled:
+            # static mode (or translation disabled): plain call
+            return fn(*args)
+
+        var_args = [a for a in args if isinstance(a, VarBase)]
+        # non-tensor args are baked into the trace: key the cache on them
+        # too, or f(x, 2.0) then f(x, 3.0) would replay the 2.0 trace
+        sig = (
+            tuple(
+                (tuple(a.value.shape), str(a.value.dtype)) for a in var_args
+            ),
+            tuple(
+                repr(a) for a in args if not isinstance(a, VarBase)
+            ),
+        )
+        if sig not in cache:
+            struct = {}  # filled during the (single) trace of the body
+
+            def pure(vals):
+                it = iter(vals)
+                inner = [
+                    VarBase(next(it)) if isinstance(a, VarBase) else a
+                    for a in args
+                ]
+                from .base import no_grad_ctx
+
+                with no_grad_ctx():
+                    out = fn(*inner)
+                struct["seq"] = isinstance(out, (list, tuple))
+                outs = out if struct["seq"] else [out]
+                return [o.value for o in outs]
+
+            cache[sig] = (jax.jit(pure), struct)
+
+        jitted, struct = cache[sig]
+        try:
+            out_vals = jitted([a.value for a in var_args])
+        except (
+            jax.errors.TracerBoolConversionError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError,
+        ) as e:
+            cache.pop(sig, None)
+            raise RuntimeError(
+                "declarative: the function depends on concrete traced "
+                "values in python (if/while/np conversion over tensors). "
+                "Express data-dependent control flow with layers.cond / "
+                "layers.While / StaticRNN — the reference's AST transforms "
+                "lowered to the same structured ops."
+            ) from e
+        outs = [VarBase(v) for v in out_vals]
+        return outs if struct["seq"] else outs[0]
+
+    wrapper._is_declarative = True
+    return wrapper
+
+
+to_static = declarative

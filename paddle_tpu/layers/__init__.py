@@ -55,3 +55,14 @@ from .control_flow import (  # noqa: F401
 )
 from . import distributions  # noqa: F401
 from .tensor import assign_value, take_along_axis  # noqa: F401
+from . import sequence_lod  # noqa: F401
+from .sequence_lod import (  # noqa: F401
+    sequence_concat,
+    sequence_expand,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_mask,
+    sequence_pool,
+    sequence_reverse,
+    sequence_softmax,
+)

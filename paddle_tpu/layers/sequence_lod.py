@@ -1,0 +1,150 @@
+"""Sequence ops over padded batches + lengths.
+
+Reference: operators/sequence_ops/ (~6.1k LoC) operate on LoD tensors —
+ragged batches encoded as offset vectors (lod_tensor.h:52) with per-kernel
+LoD walking. XLA needs static shapes, so the TPU-native design (SURVEY §7
+hard-part #1) is: sequences live as dense [B, T, ...] padded tensors plus an
+integer `lengths` vector, and every sequence op is a masked dense op — which
+also vectorizes on the VPU instead of looping per sequence like the
+reference kernels. The LoD boundary moves to the data pipeline edge.
+
+API mapping (reference -> here):
+  sequence_pool(LoD x)        -> sequence_pool(x, pool_type, lengths)
+  sequence_softmax(LoD x)     -> sequence_softmax(x, lengths)
+  sequence_reverse            -> sequence_reverse(x, lengths)
+  sequence_last_step/first    -> sequence_last_step(x, lengths) / first
+  sequence_expand             -> sequence_expand(x, ref_lengths)
+  sequence_mask (same)        -> sequence_mask(lengths, maxlen)
+"""
+
+from __future__ import annotations
+
+from . import tensor
+
+
+def sequence_mask(x_len, maxlen=None, dtype="float32"):
+    """[B] lengths -> [B, maxlen] mask (reference layers/nn.py
+    sequence_mask)."""
+    if maxlen is None:
+        raise ValueError(
+            "maxlen is required (static shapes: pass the padded T)"
+        )
+    r = tensor.reshape(tensor.range(0, maxlen, 1, "int64"), [1, maxlen])
+    lens = tensor.reshape(tensor.cast(x_len, "int64"), [-1, 1])
+    return tensor.cast(tensor.less_than(r, lens), dtype)
+
+
+def _mask3(x, lengths):
+    """[B, T, ...] mask broadcast to x's rank."""
+    b, t = x.shape[0], x.shape[1]
+    m = sequence_mask(lengths, t, dtype=x.dtype)  # [B, T]
+    extra = len(x.shape) - 2
+    if extra:
+        m = tensor.reshape(m, [b, t] + [1] * extra)
+    return m
+
+
+def sequence_pool(input, pool_type, lengths, pad_value=0.0):
+    """[B, T, D] + lengths -> [B, D] (reference sequence_pool_op.cc:
+    sum / average / max / sqrt / last / first)."""
+    pool_type = pool_type.lower()
+    b, t = input.shape[0], input.shape[1]
+    m = _mask3(input, lengths)
+    masked = tensor.elementwise_mul(input, m)
+    if pool_type == "sum":
+        return tensor.reduce_sum(masked, 1)
+    if pool_type == "average":
+        denom = tensor.reshape(
+            tensor.elementwise_max(
+                tensor.cast(lengths, input.dtype),
+                tensor.fill_constant([1], input.dtype, 1.0),
+            ),
+            [b, 1],
+        )
+        return tensor.elementwise_div(tensor.reduce_sum(masked, 1), denom)
+    if pool_type == "sqrt":
+        denom = tensor.reshape(
+            tensor.sqrt(
+                tensor.elementwise_max(
+                    tensor.cast(lengths, input.dtype),
+                    tensor.fill_constant([1], input.dtype, 1.0),
+                )
+            ),
+            [b, 1],
+        )
+        return tensor.elementwise_div(tensor.reduce_sum(masked, 1), denom)
+    if pool_type == "max":
+        neg = tensor.scale(
+            tensor.fill_constant([1], input.dtype, 1.0), scale=-1e9
+        )
+        shifted = tensor.elementwise_add(
+            masked, tensor.elementwise_mul(1.0 - m, neg)
+        )
+        return tensor.reduce_max(shifted, 1)
+    if pool_type == "last":
+        return sequence_last_step(input, lengths)
+    if pool_type == "first":
+        return sequence_first_step(input)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(input, lengths=None):
+    return tensor.squeeze(tensor.slice(input, [1], [0], [1]), [1])
+
+
+def sequence_last_step(input, lengths):
+    """Row b -> input[b, lengths[b]-1] via a one-hot contraction (gather
+    with batch-dependent index, XLA-friendly)."""
+    b, t = input.shape[0], input.shape[1]
+    idx = tensor.cast(lengths, "int64") - tensor.fill_constant(
+        [1], "int64", 1
+    )
+    onehot = tensor.cast(
+        tensor.equal(
+            tensor.reshape(tensor.range(0, t, 1, "int64"), [1, t]),
+            tensor.reshape(idx, [b, 1]),
+        ),
+        input.dtype,
+    )  # [B, T]
+    extra = len(input.shape) - 2
+    oh = tensor.reshape(onehot, [b, t] + [1] * extra)
+    return tensor.reduce_sum(tensor.elementwise_mul(input, oh), 1)
+
+
+def sequence_softmax(input, lengths):
+    """Masked softmax over the T axis of [B, T] (reference
+    sequence_softmax_op.cc normalizes within each sequence)."""
+    m = sequence_mask(lengths, input.shape[1], dtype=input.dtype)
+    neg = (1.0 - m) * -1e9
+    return tensor.softmax(tensor.elementwise_add(input, neg), axis=-1)
+
+
+def sequence_reverse(x, lengths):
+    """Reverse the first lengths[b] steps of each row, keep padding in
+    place (reference sequence_reverse_op.h)."""
+    b, t = x.shape[0], x.shape[1]
+    pos = tensor.reshape(tensor.range(0, t, 1, "int64"), [1, t])
+    lens = tensor.reshape(tensor.cast(lengths, "int64"), [b, 1])
+    # target index: len-1-pos inside the sequence, pos outside
+    inside = tensor.cast(tensor.less_than(pos, lens), "int64")
+    rev_idx = (lens - pos - tensor.fill_constant([1], "int64", 1)) * inside \
+        + pos * (tensor.fill_constant([1], "int64", 1) - inside)
+    extra_shape = list(x.shape[2:])
+    idx = tensor.reshape(rev_idx, [b, t] + [1] * len(extra_shape))
+    if extra_shape:
+        idx = tensor.expand(idx, [1, 1] + extra_shape)
+    return tensor.take_along_axis(x, idx, axis=1)
+
+
+def sequence_expand(x, ref_lengths, maxlen):
+    """[B, D] -> [B, maxlen, D] rows repeated up to ref_lengths then zero
+    padded (dense analog of sequence_expand_op)."""
+    b = x.shape[0]
+    ex = tensor.expand(tensor.unsqueeze(x, [1]), [1, maxlen, 1])
+    m = sequence_mask(ref_lengths, maxlen, dtype=x.dtype)
+    return tensor.elementwise_mul(ex, tensor.reshape(m, [b, maxlen, 1]))
+
+
+def sequence_concat(xs, axis=1):
+    """Concatenate along the time axis (padded tensors)."""
+    return tensor.concat(xs, axis=axis)

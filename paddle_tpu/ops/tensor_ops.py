@@ -380,3 +380,41 @@ def _increment(ctx, op, ins):
     write-back + donation update the counter buffer in place."""
     x = ins["X"][0]
     return {"Out": [x + jnp.asarray(op.attr("step", 1.0), dtype=x.dtype)]}
+
+
+@register_op(
+    "fake_quantize_dequantize_abs_max",
+    inputs=["X"],
+    outputs=["Out", "OutScale"],
+)
+def _fake_quant_dequant_abs_max(ctx, op, ins):
+    """QAT fake quantization (reference operators/fake_quantize_op.cc):
+    quantize to `bit_length` levels by abs-max scale, dequantize back —
+    forward sees quantization error, backward is straight-through (the
+    generic __vjp__ differentiates round() as 0 but the scale path keeps
+    x's grad: use x + stop_gradient(q - x))."""
+    x = ins["X"][0]
+    bits = op.attr("bit_length", 8)
+    bound = float(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(x)) + 1e-9
+    q = jnp.round(x / scale * bound) * scale / bound
+    # straight-through estimator: identity gradient
+    out = x + jax.lax.stop_gradient(q - x)
+    return {"Out": [out], "OutScale": [scale.reshape([1])]}
+
+
+@register_op(
+    "fake_channel_wise_quantize_dequantize_abs_max",
+    inputs=["X"],
+    outputs=["Out", "OutScale"],
+)
+def _fake_quant_channelwise(ctx, op, ins):
+    x = ins["X"][0]
+    bits = op.attr("bit_length", 8)
+    axis = op.attr("quant_axis", 0)
+    bound = float(2 ** (bits - 1) - 1)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True) + 1e-9
+    q = jnp.round(x / scale * bound) * scale / bound
+    out = x + jax.lax.stop_gradient(q - x)
+    return {"Out": [out], "OutScale": [scale.reshape(-1)]}

@@ -58,25 +58,29 @@ def chain(*readers):
 
 def compose(*readers, check_alignment=True):
     def composed():
+        END = object()
         iters = [r() for r in readers]
-        for items in zip(*iters):
+        while True:
+            items = [next(it, END) for it in iters]
+            stopped = [i is END for i in items]
+            if all(stopped):
+                return
+            if any(stopped):
+                if check_alignment:
+                    raise ValueError("readers have different lengths")
+                return
             out = ()
             for item in items:
                 out += item if isinstance(item, tuple) else (item,)
             yield out
-        if check_alignment:
-            for it in iters:
-                try:
-                    next(it)
-                except StopIteration:
-                    continue
-                raise ValueError("readers have different lengths")
 
     return composed
 
 
 def buffered(reader, size):
-    """Background-thread prefetch of up to `size` samples."""
+    """Background-thread prefetch of up to `size` samples. Producer
+    exceptions propagate to the consumer (a swallowed error would look
+    like a clean, truncated epoch)."""
 
     END = object()
 
@@ -87,8 +91,10 @@ def buffered(reader, size):
             try:
                 for sample in reader():
                     q.put(sample)
-            finally:
-                q.put(END)
+            except BaseException as e:
+                q.put(e)
+                return
+            q.put(END)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
@@ -96,6 +102,8 @@ def buffered(reader, size):
             s = q.get()
             if s is END:
                 return
+            if isinstance(s, BaseException):
+                raise s
             yield s
 
     return buffered_reader
@@ -110,35 +118,64 @@ def firstn(reader, n):
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     """Parallel map over samples with worker threads (reference keeps
-    subprocess workers; threads suffice for numpy-bound mappers)."""
+    subprocess workers; threads suffice for numpy-bound mappers).
+    Streaming: at most buffer_size samples are in flight; mapper
+    exceptions propagate; order=True yields in input order."""
+
+    END = object()
 
     def xreader():
-        samples = list(reader())
-        results = [None] * len(samples)
-        idx_q: queue.Queue = queue.Queue()
-        for i, s in enumerate(samples):
-            idx_q.put((i, s))
+        in_q: queue.Queue = queue.Queue(maxsize=max(1, buffer_size))
+        out_q: queue.Queue = queue.Queue(maxsize=max(1, buffer_size))
+
+        def feed():
+            try:
+                for i, s in enumerate(reader()):
+                    in_q.put((i, s))
+            except BaseException as e:
+                out_q.put(e)  # reader failure must reach the consumer
+            finally:
+                for _ in range(process_num):
+                    in_q.put(END)
 
         def work():
             while True:
-                try:
-                    i, s = idx_q.get_nowait()
-                except queue.Empty:
+                item = in_q.get()
+                if item is END:
+                    out_q.put(END)
                     return
-                results[i] = mapper(s)
+                i, s = item
+                try:
+                    out_q.put((i, mapper(s)))
+                except BaseException as e:
+                    out_q.put(e)
+                    return
 
-        threads = [
-            threading.Thread(target=work, daemon=True)
-            for _ in range(process_num)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if order:
-            yield from results
-        else:
-            yield from results
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        done = 0
+        pending = {}
+        next_idx = 0
+        while done < process_num:
+            item = out_q.get()
+            if item is END:
+                done += 1
+                continue
+            if isinstance(item, BaseException):
+                raise item
+            i, mapped = item
+            if not order:
+                yield mapped
+            else:
+                pending[i] = mapped
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        while order and next_idx in pending:
+            yield pending.pop(next_idx)
+            next_idx += 1
 
     return xreader
 

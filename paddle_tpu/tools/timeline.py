@@ -1,0 +1,84 @@
+"""Chrome-trace export of a profiler capture (reference tools/timeline.py:
+_ChromeTraceFormatter :36 / Timeline :131 converted the profiler proto to
+chrome://tracing JSON).
+
+Here the input is a jax.profiler xplane directory (paddle_tpu.profiler
+start/stop); every device/host event becomes a complete ("X") trace event
+with plane->pid, line->tid mapping — load the output in chrome://tracing
+or Perfetto."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+
+class _ChromeTraceFormatter:
+    def __init__(self):
+        self._events = []
+        self._metadata = []
+
+    def emit_pid(self, name, pid):
+        self._metadata.append(
+            {"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": name}}
+        )
+
+    def emit_tid(self, name, pid, tid):
+        self._metadata.append(
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": name}}
+        )
+
+    def emit_region(self, ts_us, dur_us, pid, tid, category, name, args=None):
+        self._events.append(
+            {"ph": "X", "cat": category, "name": name, "pid": pid,
+             "tid": tid, "ts": ts_us, "dur": dur_us, "args": args or {}}
+        )
+
+    def format_to_string(self, pretty=False):
+        return json.dumps(
+            {"traceEvents": self._metadata + self._events},
+            indent=4 if pretty else None,
+        )
+
+
+class Timeline:
+    def __init__(self, trace_dir):
+        self.trace_dir = trace_dir
+
+    def generate_chrome_trace(self):
+        from jax.profiler import ProfileData
+
+        files = sorted(
+            glob.glob(
+                os.path.join(self.trace_dir, "**", "*.xplane.pb"),
+                recursive=True,
+            )
+        )
+        if not files:
+            raise FileNotFoundError(
+                f"no xplane capture under {self.trace_dir}"
+            )
+        pd = ProfileData.from_serialized_xspace(open(files[-1], "rb").read())
+        fmt = _ChromeTraceFormatter()
+        for pid, plane in enumerate(pd.planes):
+            fmt.emit_pid(plane.name, pid)
+            for tid, line in enumerate(plane.lines):
+                fmt.emit_tid(line.name, pid, tid)
+                for ev in line.events:
+                    fmt.emit_region(
+                        ev.start_ns / 1e3,
+                        ev.duration_ns / 1e3,
+                        pid,
+                        tid,
+                        "op",
+                        ev.name[:120],
+                    )
+        return fmt.format_to_string()
+
+    def save(self, path):
+        with open(path, "w") as f:
+            f.write(self.generate_chrome_trace())
+        return path

@@ -247,3 +247,56 @@ def test_v2_namespaces():
     exe.run(fluid.default_startup_program())
     (v,) = exe.run(feed={"nx": np.ones((2, 3), np.float32)}, fetch_list=[s])
     assert float(np.asarray(v).reshape(-1)[0]) == 6.0
+
+
+def test_reader_error_propagation_and_alignment():
+    def bad():
+        yield 1
+        raise IOError("reader broke")
+
+    with pytest.raises(IOError, match="reader broke"):
+        list(fluid.reader.buffered(bad, 2)())
+    with pytest.raises(IOError, match="reader broke"):
+        list(fluid.reader.xmap_readers(lambda s: s, bad, 2, 4)())
+
+    def bad_map(s):
+        if s == 3:
+            raise ValueError("mapper broke")
+        return s * 2
+
+    r = lambda: iter(range(6))
+    with pytest.raises(ValueError, match="mapper broke"):
+        list(fluid.reader.xmap_readers(bad_map, r, 2, 4)())
+    ordered = list(
+        fluid.reader.xmap_readers(lambda s: s * 2, r, 3, 4, order=True)()
+    )
+    assert ordered == [0, 2, 4, 6, 8, 10]
+
+    r3 = lambda: iter(range(3))
+    r2 = lambda: iter(range(2))
+    with pytest.raises(ValueError, match="different lengths"):
+        list(fluid.reader.compose(r3, r2)())
+    with pytest.raises(ValueError, match="different lengths"):
+        list(fluid.reader.compose(r2, r3)())
+
+
+def test_data_feeder_rejects_bad_shapes():
+    x = fluid.data("fx", [-1, 3])
+    feeder = fluid.DataFeeder([x])
+    with pytest.raises(ValueError, match="declares"):
+        feeder.feed([([1, 2, 3, 4],)])
+
+
+def test_declarative_recaches_on_static_args():
+    dg = fluid.dygraph
+
+    @dg.declarative
+    def f(a, scale):
+        return layers.reduce_sum(a) * scale
+
+    with dg.guard():
+        a = dg.to_variable(np.ones((2,), np.float32))
+        r2 = f(a, 2.0)
+        r3 = f(a, 3.0)
+        assert float(np.asarray(r2.value).reshape(-1)[0]) == 4.0
+        assert float(np.asarray(r3.value).reshape(-1)[0]) == 6.0

@@ -1,0 +1,210 @@
+"""Sequence ops (padded+lengths), slim QAT, dygraph_to_static, timeline.
+
+Reference suites: test_sequence_pool.py / test_sequence_softmax_op.py /
+test_sequence_reverse.py (LoD-based — here padded+mask semantics are
+checked against per-row numpy loops), slim quantization tests,
+test_dygraph_to_static basics, timeline tool test.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+def _run(fetch, feed):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return [np.asarray(v) for v in exe.run(feed=feed, fetch_list=fetch)]
+
+
+def test_sequence_ops_match_numpy():
+    B, T, D = 3, 5, 2
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    lens = np.asarray([5, 3, 1], np.int64)
+
+    x = fluid.data("x", [B, T, D])
+    L = fluid.data("lens", [B], "int64")
+    fetches = [
+        layers.sequence_pool(x, "sum", L),
+        layers.sequence_pool(x, "average", L),
+        layers.sequence_pool(x, "max", L),
+        layers.sequence_last_step(x, L),
+        layers.sequence_first_step(x),
+        layers.sequence_reverse(x, L),
+        layers.sequence_mask(L, T),
+    ]
+    outs = _run(fetches, {"x": xv, "lens": lens})
+
+    want_sum = np.stack([xv[b, :lens[b]].sum(0) for b in range(B)])
+    want_avg = np.stack([xv[b, :lens[b]].mean(0) for b in range(B)])
+    want_max = np.stack([xv[b, :lens[b]].max(0) for b in range(B)])
+    want_last = np.stack([xv[b, lens[b] - 1] for b in range(B)])
+    want_rev = xv.copy()
+    for b in range(B):
+        want_rev[b, :lens[b]] = xv[b, :lens[b]][::-1]
+    np.testing.assert_allclose(outs[0], want_sum, rtol=1e-5)
+    np.testing.assert_allclose(outs[1], want_avg, rtol=1e-5)
+    np.testing.assert_allclose(outs[2], want_max, rtol=1e-5)
+    np.testing.assert_allclose(outs[3], want_last, rtol=1e-5)
+    np.testing.assert_allclose(outs[4], xv[:, 0], rtol=1e-5)
+    np.testing.assert_allclose(outs[5], want_rev, rtol=1e-5)
+    want_mask = (np.arange(T)[None, :] < lens[:, None]).astype(np.float32)
+    np.testing.assert_allclose(outs[6], want_mask)
+
+
+def test_sequence_softmax_masks_padding():
+    B, T = 2, 4
+    x = fluid.data("x", [B, T])
+    L = fluid.data("lens", [B], "int64")
+    sm = layers.sequence_softmax(x, L)
+    xv = np.zeros((B, T), np.float32)
+    (out,) = _run([sm], {"x": xv, "lens": np.asarray([2, 4], np.int64)})
+    np.testing.assert_allclose(out[0, :2], [0.5, 0.5], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 2:], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[1], 0.25, rtol=1e-5)
+
+
+# -- slim QAT ---------------------------------------------------------------
+
+
+def test_qat_inserts_fake_quant_and_trains():
+    from paddle_tpu.contrib.slim.quantization import quant_aware
+
+    x = fluid.data("x", [16, 8])
+    y = fluid.data("y", [16, 1])
+    h = layers.fc(x, 16, act="relu")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    main = fluid.default_main_program()
+    n_ops_before = len(main.global_block.ops)
+    quant_aware(main)
+    q_ops = [
+        op.type for op in main.global_block.ops if "fake" in op.type
+    ]
+    assert len(q_ops) >= 4  # 2 matmuls x (input + weight)
+    assert any("channel_wise" in t for t in q_ops)  # weights channel-wise
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(16, 8).astype(np.float32)}
+    feed["y"] = (feed["x"] @ rng.randn(8, 1)).astype(np.float32)
+    losses = [
+        float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+              .reshape(-1)[0])
+        for _ in range(60)
+    ]
+    assert losses[-1] < losses[0] * 0.3  # straight-through grads train
+
+
+def test_fake_quant_levels():
+    """Quantized values land on the int8 grid of the abs-max scale."""
+    x = fluid.data("x", [1, 6])
+    blk = fluid.default_main_program().global_block
+    q = blk.create_var(name="q", shape=[1, 6], dtype="float32")
+    s = blk.create_var(name="s", shape=[1], dtype="float32")
+    blk.append_op(
+        "fake_quantize_dequantize_abs_max",
+        {"X": ["x"]}, {"Out": ["q"], "OutScale": ["s"]}, {"bit_length": 8},
+    )
+    xv = np.asarray([[1.0, -0.5, 0.25, 0.1, -1.0, 0.77]], np.float32)
+    qv, sv = _run(["q", "s"], {"x": xv})
+    scale = float(sv[0])
+    levels = np.round(xv / scale * 127)
+    np.testing.assert_allclose(qv, levels * scale / 127, rtol=1e-5)
+
+
+def test_post_training_quantization_scales():
+    from paddle_tpu.contrib.slim.quantization import PostTrainingQuantization
+
+    x = fluid.data("x", [4, 3])
+    h = layers.scale(x, scale=2.0)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    ptq = PostTrainingQuantization(
+        exe, fluid.default_main_program(), ["x"], [h]
+    )
+    feeds = [
+        {"x": np.full((4, 3), v, np.float32)} for v in (0.5, -3.0, 1.0)
+    ]
+    scales = ptq.quantize(feeds, [h.name])
+    assert scales[h.name] == pytest.approx(6.0)
+
+
+# -- dygraph_to_static ------------------------------------------------------
+
+
+def test_declarative_caches_and_matches_eager():
+    dg = fluid.dygraph
+    calls = {"n": 0}
+
+    @dg.declarative
+    def f(a, b):
+        calls["n"] += 1
+        return layers.reduce_sum(layers.elementwise_mul(a, b))
+
+    with dg.guard():
+        a = dg.to_variable(np.ones((2, 3), np.float32) * 2)
+        b = dg.to_variable(np.ones((2, 3), np.float32) * 3)
+        r1 = f(a, b)
+        r2 = f(a, b)  # cached: python body must not re-run
+        assert float(np.asarray(r1.value)) == 36.0
+        assert float(np.asarray(r2.value)) == 36.0
+    assert calls["n"] == 1
+    # static mode: plain layer-building call
+    x = fluid.data("x", [2, 2])
+    out = f(x, x)
+    assert hasattr(out, "name")  # a graph Variable, not a VarBase
+
+
+def test_declarative_rejects_python_branch_on_tensor():
+    dg = fluid.dygraph
+
+    @dg.declarative
+    def g(a):
+        if float(np.asarray(a.value).sum()) > 0:  # concretizes a tracer
+            return a
+        return a
+
+    with dg.guard():
+        a = dg.to_variable(np.ones((2,), np.float32))
+        with pytest.raises(RuntimeError, match="layers.cond"):
+            g(a)
+
+
+# -- timeline ---------------------------------------------------------------
+
+
+def test_timeline_chrome_trace(tmp_path):
+    import paddle_tpu.profiler as prof
+    from paddle_tpu.tools.timeline import Timeline
+
+    x = fluid.data("x", [16, 16])
+    y = layers.matmul(x, x)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((16, 16), np.float32)}
+    exe.run(feed=feed, fetch_list=[y])
+    d = prof.start_profiler(log_dir=str(tmp_path / "prof"))
+    exe.run(feed=feed, fetch_list=[y])
+    prof.stop_profiler()
+    out = Timeline(d).save(str(tmp_path / "trace.json"))
+    trace = json.load(open(out))
+    assert "traceEvents" in trace and len(trace["traceEvents"]) > 0
+    kinds = {e["ph"] for e in trace["traceEvents"]}
+    assert "X" in kinds and "M" in kinds
