@@ -54,6 +54,8 @@ from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa:
 from . import reader  # noqa: F401  (DataLoader + paddle.reader decorators)
 from .reader_decorators import batch  # noqa: F401
 from . import dataset  # noqa: F401
+from .dataset import DatasetFactory  # noqa: F401
+from . import native  # noqa: F401
 from . import inference  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401

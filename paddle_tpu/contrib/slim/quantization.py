@@ -61,12 +61,18 @@ class QuantizationTranspiler:
                     sname = unique_name.generate(n + ".quant_scale")
                     blk.create_var(name=sname, shape=(1,), dtype="float32")
                     if is_weight:
+                        # output-channel axis: 0 for conv filters
+                        # [out,in,kh,kw], last for matmul weights [in,out]
+                        # (reference QuantizationTransformPass convention)
+                        axis = 0 if "conv" in op.type else len(
+                            v.shape or (1,)
+                        ) - 1
                         blk.append_op(
                             "fake_channel_wise_quantize_dequantize_abs_max",
                             {"X": [n]},
                             {"Out": [qname], "OutScale": [sname]},
-                            {"bit_length": self.weight_bits, "quant_axis":
-                             len(v.shape or (1,)) - 1},
+                            {"bit_length": self.weight_bits,
+                             "quant_axis": axis},
                             index=i,
                         )
                     else:
@@ -110,10 +116,11 @@ class PostTrainingQuantization:
     def quantize(self, calibration_feeds, var_names):
         import numpy as np
 
+        var_names = list(var_names)  # a generator must survive re-iteration
         scales = {n: 0.0 for n in var_names}
         for feed in calibration_feeds:
             outs = self._exe.run(
-                self._program, feed=feed, fetch_list=list(var_names),
+                self._program, feed=feed, fetch_list=var_names,
                 scope=self._scope,
             )
             for n, v in zip(var_names, outs):

@@ -10,3 +10,8 @@ Each reader documents which mode produced its data via `.synthetic`.
 """
 
 from . import cifar, mnist, uci_housing  # noqa: F401
+from .factory import (  # noqa: F401
+    DatasetFactory,
+    InMemoryDataset,
+    QueueDataset,
+)

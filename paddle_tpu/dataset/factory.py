@@ -1,0 +1,145 @@
+"""fluid.DatasetFactory / InMemoryDataset / QueueDataset — the
+train_from_dataset data path (reference python/paddle/fluid/dataset.py:328,
+852 over the C++ Dataset/DataFeed runtime, framework/data_set.h:43).
+
+TPU-native: files parse through the native MultiSlot parser
+(paddle_tpu/native, GIL-free C++) into CSR slots, pack to the padded shapes
+declared by set_use_var, and stream batches into the jitted train step —
+the role of the reference's per-thread DataFeed + HogwildWorker op loop
+(hogwild_worker.cc:189) with XLA replacing the per-op interpreter."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import native
+from ..core.dtypes import to_numpy_dtype
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._use_vars = []
+        self._filelist = []
+        self._thread_num = 1
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = int(thread_num)
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_pipe_command(self, cmd):
+        # the reference piped raw lines through a user command before
+        # parsing; with the native parser in-process this stage is unused
+        self._pipe_command = cmd
+
+    # -- iteration ---------------------------------------------------------
+    def _slot_spec(self, var):
+        shape = tuple(var.shape or (1,))
+        trailing = shape[1:] if len(shape) > 1 else (1,)
+        width = int(np.prod(trailing)) if trailing else 1
+        return width, to_numpy_dtype(var.dtype)
+
+    def _records(self):
+        num_slots = len(self._use_vars)
+        for fname in self._filelist:
+            with open(fname, "rb") as f:
+                vals, offs = native.parse_multislot(f.read(), num_slots)
+            n_records = (len(offs) - 1) // num_slots
+            for r in range(n_records):
+                row = []
+                for s in range(num_slots):
+                    c = r * num_slots + s
+                    row.append(vals[offs[c]:offs[c + 1]])
+                yield row
+
+    def _batches(self, records):
+        batch = []
+        for row in records:
+            batch.append(row)
+            if len(batch) == self._batch_size:
+                yield self._pack(batch)
+                batch = []
+        if batch:
+            yield self._pack(batch)
+
+    def _pack(self, batch):
+        feed = {}
+        for s, var in enumerate(self._use_vars):
+            width, dtype = self._slot_spec(var)
+            vals = np.concatenate([row[s] for row in batch]) if batch else \
+                np.empty(0, np.float32)
+            offsets = np.cumsum(
+                [0] + [len(row[s]) for row in batch]
+            ).astype(np.int64)
+            padded, _ = native.pack_padded(
+                vals, offsets, width, pad_value=0,
+                dtype=np.int64 if np.issubdtype(dtype, np.integer) else
+                np.float32,
+            )
+            feed[var.name] = padded.astype(dtype).reshape(
+                (len(batch),) + tuple((var.shape or (1,))[1:] or (1,))
+            )
+        return feed
+
+    def batches(self):
+        yield from self._batches(self._records())
+
+
+class QueueDataset(DatasetBase):
+    """Streaming (reference :852): files parsed on the fly per epoch."""
+
+
+class InMemoryDataset(DatasetBase):
+    """load_into_memory + local/global shuffle (reference :328)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory = None
+
+    def load_into_memory(self):
+        self._memory = list(self._records())
+
+    def local_shuffle(self, seed=None):
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() first")
+        np.random.RandomState(seed).shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=0):
+        """Every worker shuffles with the SAME seed then takes its
+        interleaved shard — the reference shuffled across nodes through
+        fleet RPC (data_set.h:111); a shared-seed permutation + rank
+        striding is equivalent for shared-filesystem filelists."""
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() first")
+        rank, nranks = 0, 1
+        if fleet is not None:
+            rank, nranks = fleet.worker_index(), fleet.worker_num()
+        np.random.RandomState(seed).shuffle(self._memory)
+        self._memory = self._memory[rank::nranks]
+
+    def release_memory(self):
+        self._memory = None
+
+    def batches(self):
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() first")
+        yield from self._batches(iter(self._memory))
+
+
+class DatasetFactory:
+    """reference dataset.py DatasetFactory.create_dataset."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")

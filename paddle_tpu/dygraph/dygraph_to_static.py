@@ -7,20 +7,21 @@ the same source could run eagerly or compile into a ProgramDesc.
 
 TPU-native position: jax.jit *is* the dygraph->static compiler for the
 functional subset — tracing the eager emitters once yields the compiled
-graph with no source rewriting, and that covers everything the AST
-transforms handled EXCEPT data-dependent Python control flow. Data-dependent
-control must be expressed with layers.cond / layers.While / StaticRNN (the
-structured ops, ops/control_flow.py), which is also what the reference's
-transformed AST ultimately lowered to (convert_ifelse -> cond op,
-convert_while -> while op). @declarative here:
+graph with no source rewriting. Data-dependent Python control flow must use
+layers.cond / layers.While / StaticRNN (ops/control_flow.py), which is also
+what the reference's transformed AST lowered to (convert_ifelse -> cond op).
 
-  * eager mode: traces the function through jax.jit on first call per
-    input-shape set and runs the cached executable after (per-call python
-    dispatch drops to one jitted call);
-  * static mode (no tracer active): runs the function as ordinary
-    layer-building code, exactly like the reference's static branch;
-  * raises a targeted error when a python `if`/`while` touches a traced
-    value, pointing at the structured-control-flow APIs.
+Semantics:
+  * static mode (no tracer): plain layer-building call, like the
+    reference's static branch;
+  * eager inference (no grad-requiring inputs): one cached jitted
+    executable per (tensor-shape, static-arg) signature — the python body
+    runs once per signature;
+  * eager TRAINING: Layer parameters (of Layer args / bound methods) are
+    lifted to traced inputs, and a boundary jax.vjp links the whole
+    compiled region into the autograd tape, so loss.backward() reaches the
+    parameters exactly as in undecorated eager code (the vjp re-traces per
+    step; the reference's to_static similarly rebuilt its backward program).
 """
 
 from __future__ import annotations
@@ -51,6 +52,34 @@ class ProgramTranslator:
         self.enabled = bool(flag)
 
 
+_TRACE_ERRORS = (
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.ConcretizationTypeError,
+)
+
+_TRACE_HINT = (
+    "declarative: the function depends on concrete traced values in python "
+    "(if/while/np conversion over tensors). Express data-dependent control "
+    "flow with layers.cond / layers.While / StaticRNN — the reference's AST "
+    "transforms lowered to the same structured ops."
+)
+
+
+def _collect_params(args):
+    """Parameters of every Layer argument (incl. `self` of bound methods):
+    they must be traced INPUTS, not baked constants, or calls after an
+    optimizer step would replay stale weights."""
+    from .layers import Layer
+
+    params = {}
+    for i, a in enumerate(args):
+        if isinstance(a, Layer):
+            for name, p in a.named_parameters():
+                params[f"{i}:{name}"] = p
+    return params
+
+
 def declarative(fn=None):
     """Decorator (reference @declarative / @paddle.jit.to_static)."""
     if fn is None:
@@ -62,24 +91,35 @@ def declarative(fn=None):
     def wrapper(*args):
         tracer = _current_tracer()
         if tracer is None or not ProgramTranslator.get_instance().enabled:
-            # static mode (or translation disabled): plain call
-            return fn(*args)
+            return fn(*args)  # static mode: plain layer-building call
 
+        from .layers import Layer
+
+        params = _collect_params(args)
         var_args = [a for a in args if isinstance(a, VarBase)]
-        # non-tensor args are baked into the trace: key the cache on them
-        # too, or f(x, 2.0) then f(x, 3.0) would replay the 2.0 trace
+        # cache key: tensor positions+shapes, static args (baked into the
+        # trace) with their positions, and the parameter set
         sig = (
             tuple(
-                (tuple(a.value.shape), str(a.value.dtype)) for a in var_args
+                (i, tuple(a.value.shape), str(a.value.dtype))
+                for i, a in enumerate(args)
+                if isinstance(a, VarBase)
             ),
             tuple(
-                repr(a) for a in args if not isinstance(a, VarBase)
+                (i, repr(a))
+                for i, a in enumerate(args)
+                if not isinstance(a, (VarBase, Layer))
             ),
+            tuple(sorted(params)),
         )
-        if sig not in cache:
-            struct = {}  # filled during the (single) trace of the body
 
-            def pure(vals):
+        struct = {}
+
+        def pure(param_vals, vals):
+            originals = {n: p._value for n, p in params.items()}
+            try:
+                for n, p in params.items():
+                    p._value = param_vals[n]
                 it = iter(vals)
                 inner = [
                     VarBase(next(it)) if isinstance(a, VarBase) else a
@@ -87,32 +127,57 @@ def declarative(fn=None):
                 ]
                 from .base import no_grad_ctx
 
+                # no tape entries inside: grads are handled at the boundary
                 with no_grad_ctx():
                     out = fn(*inner)
                 struct["seq"] = isinstance(out, (list, tuple))
                 outs = out if struct["seq"] else [out]
                 return [o.value for o in outs]
+            finally:
+                for n, p in params.items():
+                    p._value = originals[n]
 
-            cache[sig] = (jax.jit(pure), struct)
+        param_vals = {n: p.value for n, p in params.items()}
+        in_vals = [a.value for a in var_args]
 
-        jitted, struct = cache[sig]
+        want_grad = tracer.enable_grad
+        grad_pnames = [
+            n for n, p in sorted(params.items()) if not p.stop_gradient
+        ] if want_grad else []
+        grad_var_idx = [
+            i for i, a in enumerate(var_args) if not a.stop_gradient
+        ] if want_grad else []
+
         try:
-            out_vals = jitted([a.value for a in var_args])
-        except (
-            jax.errors.TracerBoolConversionError,
-            jax.errors.TracerArrayConversionError,
-            jax.errors.ConcretizationTypeError,
-        ) as e:
+            if not grad_pnames and not grad_var_idx:
+                if sig not in cache:
+                    cache[sig] = (jax.jit(pure), struct)
+                jitted, struct = cache[sig]  # struct persists across hits
+                out_vals = jitted(param_vals, in_vals)
+                outs = [VarBase(v) for v in out_vals]
+            else:
+                # training: boundary vjp stitches the compiled region into
+                # the eager tape (re-traces per call, like eager backward)
+                out_vals, vjp_fn = jax.vjp(pure, param_vals, in_vals)
+                outs = [VarBase(v, stop_gradient=False) for v in out_vals]
+                grad_inputs = [params[n] for n in grad_pnames] + [
+                    var_args[i] for i in grad_var_idx
+                ]
+
+                def tape_fn(cts):
+                    pg, vg = vjp_fn(list(cts))
+                    return [pg[n] for n in grad_pnames] + [
+                        vg[i] for i in grad_var_idx
+                    ]
+
+                from .tracer import TapeEntry
+
+                tracer._tape.append(TapeEntry(tape_fn, grad_inputs, outs))
+        except _TRACE_ERRORS as e:
             cache.pop(sig, None)
-            raise RuntimeError(
-                "declarative: the function depends on concrete traced "
-                "values in python (if/while/np conversion over tensors). "
-                "Express data-dependent control flow with layers.cond / "
-                "layers.While / StaticRNN — the reference's AST transforms "
-                "lowered to the same structured ops."
-            ) from e
-        outs = [VarBase(v) for v in out_vals]
-        return outs if struct["seq"] else outs[0]
+            raise RuntimeError(_TRACE_HINT) from e
+
+        return outs if struct.get("seq") else outs[0]
 
     wrapper._is_declarative = True
     return wrapper

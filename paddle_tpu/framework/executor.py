@@ -200,6 +200,59 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
+    def train_from_dataset(
+        self, program=None, dataset=None, scope=None, thread=0,
+        debug=False, fetch_list=None, fetch_info=None, print_period=100,
+    ):
+        """Train over a fluid Dataset (reference executor.py:1323 ->
+        TrainerFactory -> HogwildWorker op loops, hogwild_worker.cc:189).
+        Here each parsed batch feeds the ONE jitted step — the per-thread
+        op interpreter the reference needed is subsumed by XLA, so
+        `thread` only tunes the host-side parse (dataset.set_thread)."""
+        if dataset is None:
+            raise ValueError("train_from_dataset requires a dataset")
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [
+            getattr(v, "name", str(v)) for v in fetch_list
+        ]
+        step = 0
+        for feed in dataset.batches():
+            outs = self.run(
+                program, feed=feed, fetch_list=fetch_list, scope=scope,
+            )
+            step += 1
+            if debug and fetch_list and step % print_period == 0:
+                import numpy as _np
+
+                vals = ", ".join(
+                    f"{n}={_np.asarray(v).reshape(-1)[0]:.6g}"
+                    for n, v in zip(fetch_info, outs)
+                )
+                print(f"step {step}: {vals}")
+        return step
+
+    def infer_from_dataset(self, program=None, dataset=None, **kw):
+        """Like train_from_dataset but refuses programs containing update
+        ops — the reference guarantees no parameter mutation here; pass a
+        clone(for_test=True)/pruned inference program."""
+        prog = program if program is not None else default_main_program()
+        prog = getattr(prog, "program", prog)
+        update_ops = {
+            "sgd", "momentum", "lars_momentum", "adam", "adamw", "lamb",
+            "adagrad", "decayed_adagrad", "adadelta", "rmsprop", "ftrl",
+            "adamax", "dpsgd",
+        }
+        bad = [op.type for op in prog.global_block.ops
+               if op.type in update_ops]
+        if bad:
+            raise ValueError(
+                f"infer_from_dataset got a program with update ops {bad}; "
+                "pass an inference program (clone(for_test=True) before "
+                "minimize, or load_inference_model output)"
+            )
+        return self.train_from_dataset(program, dataset, **kw)
+
+    # ------------------------------------------------------------------
     def _from_scope(self, scope, name, block):
         v = scope.find_var(name)
         if v is None:

@@ -46,13 +46,30 @@ def _mask3(x, lengths):
 
 def sequence_pool(input, pool_type, lengths, pad_value=0.0):
     """[B, T, D] + lengths -> [B, D] (reference sequence_pool_op.cc:
-    sum / average / max / sqrt / last / first)."""
+    sum / average / max / sqrt / last / first). Rows with length 0 emit
+    pad_value (reference behavior for empty sequences)."""
     pool_type = pool_type.lower()
     b, t = input.shape[0], input.shape[1]
     m = _mask3(input, lengths)
     masked = tensor.elementwise_mul(input, m)
+
+    def empty_to_pad(out):
+        nonempty = tensor.reshape(
+            tensor.cast(
+                tensor.greater_than(
+                    tensor.cast(lengths, "int64"),
+                    tensor.fill_constant([1], "int64", 0),
+                ),
+                out.dtype,
+            ),
+            [b, 1],
+        )
+        return tensor.elementwise_add(
+            tensor.elementwise_mul(out, nonempty, axis=0),
+            (1.0 - nonempty) * float(pad_value),
+        )
     if pool_type == "sum":
-        return tensor.reduce_sum(masked, 1)
+        return empty_to_pad(tensor.reduce_sum(masked, 1))
     if pool_type == "average":
         denom = tensor.reshape(
             tensor.elementwise_max(
@@ -61,7 +78,9 @@ def sequence_pool(input, pool_type, lengths, pad_value=0.0):
             ),
             [b, 1],
         )
-        return tensor.elementwise_div(tensor.reduce_sum(masked, 1), denom)
+        return empty_to_pad(
+            tensor.elementwise_div(tensor.reduce_sum(masked, 1), denom)
+        )
     if pool_type == "sqrt":
         denom = tensor.reshape(
             tensor.sqrt(
@@ -72,7 +91,9 @@ def sequence_pool(input, pool_type, lengths, pad_value=0.0):
             ),
             [b, 1],
         )
-        return tensor.elementwise_div(tensor.reduce_sum(masked, 1), denom)
+        return empty_to_pad(
+            tensor.elementwise_div(tensor.reduce_sum(masked, 1), denom)
+        )
     if pool_type == "max":
         neg = tensor.scale(
             tensor.fill_constant([1], input.dtype, 1.0), scale=-1e9
@@ -80,7 +101,7 @@ def sequence_pool(input, pool_type, lengths, pad_value=0.0):
         shifted = tensor.elementwise_add(
             masked, tensor.elementwise_mul(1.0 - m, neg)
         )
-        return tensor.reduce_max(shifted, 1)
+        return empty_to_pad(tensor.reduce_max(shifted, 1))
     if pool_type == "last":
         return sequence_last_step(input, lengths)
     if pool_type == "first":

@@ -1,0 +1,97 @@
+// Native data-feed hot path (reference C++: framework/data_feed.cc
+// MultiSlotDataFeed::ParseOneInstance ~:632, operators/reader/
+// buffered_reader.cc): GIL-free parsing of MultiSlot text records and
+// ragged->padded packing (the LoD -> static-shape edge operation of the
+// TPU design, SURVEY §7 hard-part #1).
+//
+// Record format (the reference's MultiSlot schema): one instance per line,
+// per slot "<n> v1 v2 ... vn" fields separated by spaces; slots
+// concatenated left to right. Values parse as DOUBLE so integer id slots
+// round-trip exactly below 2^53 (CTR id spaces fit comfortably); the
+// padded packers then emit float32 or exact int64.
+//
+// Built by paddle_tpu/native/__init__.py with g++ -O3 -shared -fPIC and
+// loaded via ctypes (no pybind11 in this image); a numpy fallback keeps
+// the package importable without a toolchain.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// Parse newline-separated MultiSlot records.
+//   buf/len:        input text
+//   num_slots:      slots per instance
+//   out_vals:       flat value output, capacity max_vals
+//   out_offsets:    CSR offsets per (record, slot):
+//                   size max_records*num_slots+1; out_offsets[0] = 0
+//   returns number of complete records parsed, or -1 on malformed input,
+//   -2 on capacity overflow.
+long ps_parse_multislot(const char* buf, long len, int num_slots,
+                        double* out_vals, long max_vals,
+                        long* out_offsets, long max_records) {
+  long n_vals = 0;
+  long n_records = 0;
+  long cell = 0;
+  out_offsets[0] = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    // skip blank lines
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    if (n_records >= max_records) return -2;
+    for (int s = 0; s < num_slots; ++s) {
+      char* next = nullptr;
+      long n = strtol(p, &next, 10);
+      if (next == p || n < 0) return -1;
+      p = next;
+      for (long i = 0; i < n; ++i) {
+        if (n_vals >= max_vals) return -2;
+        double v = strtod(p, &next);
+        if (next == p) return -1;
+        out_vals[n_vals++] = v;
+        p = next;
+      }
+      out_offsets[++cell] = n_vals;
+    }
+    // consume to end of line
+    while (p < end && *p != '\n') ++p;
+    ++n_records;
+  }
+  return n_records;
+}
+
+// Ragged -> padded: pack CSR (vals, offsets) rows into [n_rows, max_len]
+// with pad_value, writing per-row lengths. float32 variant.
+void ps_pack_padded_f32(const float* vals, const long* offsets, long n_rows,
+                        long max_len, float pad_value, float* out,
+                        int32_t* lengths) {
+  for (long r = 0; r < n_rows; ++r) {
+    long lo = offsets[r], hi = offsets[r + 1];
+    long n = hi - lo;
+    if (n > max_len) n = max_len;
+    lengths[r] = (int32_t)n;
+    float* row = out + r * max_len;
+    for (long i = 0; i < n; ++i) row[i] = vals[lo + i];
+    for (long i = n; i < max_len; ++i) row[i] = pad_value;
+  }
+}
+
+// int64 variant (exact ids for embedding lookups).
+void ps_pack_padded_i64(const int64_t* vals, const long* offsets,
+                        long n_rows, long max_len, int64_t pad_value,
+                        int64_t* out, int32_t* lengths) {
+  for (long r = 0; r < n_rows; ++r) {
+    long lo = offsets[r], hi = offsets[r + 1];
+    long n = hi - lo;
+    if (n > max_len) n = max_len;
+    lengths[r] = (int32_t)n;
+    int64_t* row = out + r * max_len;
+    for (long i = 0; i < n; ++i) row[i] = vals[lo + i];
+    for (long i = n; i < max_len; ++i) row[i] = pad_value;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,171 @@
+"""Native MultiSlot parser + fluid Dataset + train_from_dataset.
+
+Reference: C++ data_feed parsing tests + test_dataset.py (QueueDataset/
+InMemoryDataset driving train_from_dataset over MultiSlot files).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, native
+from paddle_tpu.framework import unique_name
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+def test_native_lib_builds():
+    assert native.native_available(), "g++ build of the native lib failed"
+
+
+def test_parse_multislot_native_matches_python():
+    text = "2 11 12 1 0.5\n1 13 2 1.5 -2.25\n3 1 2 3 1 9\n"
+    v_n, o_n = native.parse_multislot(text, 2)
+    v_p, o_p = native._parse_multislot_py(text.encode(), 2)
+    np.testing.assert_allclose(v_n, v_p)
+    np.testing.assert_array_equal(o_n, o_p)
+    np.testing.assert_allclose(
+        v_n, [11, 12, 0.5, 13, 1.5, -2.25, 1, 2, 3, 9]
+    )
+    np.testing.assert_array_equal(o_n, [0, 2, 3, 4, 6, 9, 10])
+
+
+def test_parse_multislot_malformed():
+    with pytest.raises(ValueError, match="malformed"):
+        native.parse_multislot("2 11\n", 2)  # declares 2 values, has 1+EOL
+    with pytest.raises(ValueError, match="malformed"):
+        native._parse_multislot_py(b"2 11\n", 2)
+
+
+def test_pack_padded_variants():
+    vals = np.asarray([1.5, 2.5, 3.5], np.float32)
+    offs = np.asarray([0, 1, 1, 3], np.int64)
+    out, lens = native.pack_padded(vals, offs, 2, pad_value=-1.0)
+    np.testing.assert_allclose(out, [[1.5, -1], [-1, -1], [2.5, 3.5]])
+    np.testing.assert_array_equal(lens, [1, 0, 2])
+    big = np.asarray([2**40, 7], np.int64)
+    out_i, _ = native.pack_padded(
+        big, np.asarray([0, 2], np.int64), 3, dtype=np.int64
+    )
+    assert out_i[0, 0] == 2**40  # exact (why the i64 variant exists)
+
+
+def test_train_from_dataset(tmp_path):
+    """QueueDataset over MultiSlot files drives a CTR-style train loop
+    (closes the reference train_from_dataset path)."""
+    rng = np.random.RandomState(0)
+    files = []
+    for fi in range(2):
+        lines = []
+        for _ in range(64):
+            ids = rng.randint(0, 100, 3)
+            label = int(ids[0] % 2)
+            lines.append(
+                "3 " + " ".join(map(str, ids)) + f" 1 {label}"
+            )
+        f = tmp_path / f"part-{fi}.txt"
+        f.write_text("\n".join(lines) + "\n")
+        files.append(str(f))
+
+    ids = fluid.data("ids", [-1, 3], "int64")
+    label = fluid.data("label", [-1, 1], "float32")
+    emb = layers.embedding(ids, size=[100, 8])
+    logit = layers.fc(layers.reshape(emb, [-1, 24]), 1)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label)
+    )
+    fluid.optimizer.Adam(0.02).minimize(loss)
+
+    dataset = fluid.DatasetFactory().create_dataset("QueueDataset")
+    dataset.set_batch_size(32)
+    dataset.set_use_var([ids, label])
+    dataset.set_filelist(files)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    first = last = None
+    for epoch in range(25):
+        exe.train_from_dataset(
+            fluid.default_main_program(), dataset, fetch_list=[loss]
+        )
+        (lv,) = exe.run(
+            feed=next(iter(dataset.batches())), fetch_list=[loss]
+        )
+        lv = float(np.asarray(lv).reshape(-1)[0])
+        first = first if first is not None else lv
+        last = lv
+    assert last < first * 0.7, (first, last)
+
+
+def test_inmemory_dataset_shuffle_and_shard(tmp_path):
+    f = tmp_path / "d.txt"
+    f.write_text("".join(f"1 {i} 1 {i * 10}\n" for i in range(10)))
+    x = fluid.data("xa", [-1, 1], "int64")
+    y = fluid.data("ya", [-1, 1], "float32")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var([x, y])
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+    ds.local_shuffle(seed=0)
+    rows = [b for b in ds.batches()]
+    got = np.concatenate([b["xa"].reshape(-1) for b in rows])
+    assert sorted(got.tolist()) == list(range(10))
+
+    # global shuffle shards disjointly across 2 fake workers
+    class W:
+        def __init__(self, r):
+            self.r = r
+
+        def worker_index(self):
+            return self.r
+
+        def worker_num(self):
+            return 2
+
+    seen = []
+    for r in range(2):
+        ds2 = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds2.set_batch_size(4)
+        ds2.set_use_var([x, y])
+        ds2.set_filelist([str(f)])
+        ds2.load_into_memory()
+        ds2.global_shuffle(W(r), seed=3)
+        for b in ds2.batches():
+            seen.extend(b["xa"].reshape(-1).tolist())
+    assert sorted(seen) == list(range(10))
+
+
+def test_parser_preserves_large_ids():
+    """ids above 2^24 survive the parse->pack pipeline exactly (parsed as
+    double, packed as int64)."""
+    big = 16777217  # 2^24 + 1: not representable in float32
+    v, o = native.parse_multislot(f"1 {big} 1 1\n", 2)
+    assert v.dtype == np.float64
+    out, _ = native.pack_padded(v[:1], np.asarray([0, 1], np.int64), 1,
+                                dtype=np.int64)
+    assert out[0, 0] == big
+
+
+def test_infer_from_dataset_rejects_train_programs(tmp_path):
+    f = tmp_path / "d.txt"
+    f.write_text("1 1 1 1.0\n")
+    x = fluid.data("ix", [-1, 1], "int64")
+    y = fluid.data("iy", [-1, 1], "float32")
+    loss = layers.mean(layers.fc(layers.cast(x, "float32"), 1) + y)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(1)
+    ds.set_use_var([x, y])
+    ds.set_filelist([str(f)])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(ValueError, match="update ops"):
+        exe.infer_from_dataset(fluid.default_main_program(), ds)

@@ -208,3 +208,43 @@ def test_timeline_chrome_trace(tmp_path):
     assert "traceEvents" in trace and len(trace["traceEvents"]) > 0
     kinds = {e["ph"] for e in trace["traceEvents"]}
     assert "X" in kinds and "M" in kinds
+
+
+def test_sequence_pool_empty_rows_emit_pad_value():
+    x = fluid.data("x", [2, 3, 2])
+    L = fluid.data("lens", [2], "int64")
+    mx = layers.sequence_pool(x, "max", L, pad_value=-7.0)
+    sm = layers.sequence_pool(x, "sum", L, pad_value=-7.0)
+    xv = np.ones((2, 3, 2), np.float32)
+    outs = _run([mx, sm], {"x": xv, "lens": np.asarray([0, 2], np.int64)})
+    np.testing.assert_allclose(outs[0][0], -7.0)
+    np.testing.assert_allclose(outs[0][1], 1.0)
+    np.testing.assert_allclose(outs[1][0], -7.0)
+    np.testing.assert_allclose(outs[1][1], 2.0)
+
+
+def test_declarative_trains_layer():
+    """loss.backward() through a @declarative forward reaches parameters
+    (the reference to_static supports training)."""
+    dg = fluid.dygraph
+
+    @dg.declarative
+    def forward(net, a):
+        return layers.reduce_mean(
+            layers.elementwise_mul(net(a), net(a))
+        )
+
+    with dg.guard():
+        net = dg.Linear(4, 4, bias_attr=False)
+        opt = fluid.optimizer.SGD(
+            learning_rate=0.1, parameter_list=net.parameters()
+        )
+        xv = dg.to_variable(np.ones((2, 4), np.float32))
+        losses = []
+        for _ in range(20):
+            loss = forward(net, xv)
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+            losses.append(float(np.asarray(loss.value).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
