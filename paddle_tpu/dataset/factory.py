@@ -74,11 +74,18 @@ class DatasetBase:
         feed = {}
         for s, var in enumerate(self._use_vars):
             width, dtype = self._slot_spec(var)
+            counts = [len(row[s]) for row in batch]
+            bad = [c for c in counts if c != width]
+            if bad:
+                raise ValueError(
+                    f"slot {s} ({var.name!r}): records hold {sorted(set(bad))} "
+                    f"values but the variable declares {width} — refusing to "
+                    "truncate/zero-pad silently (reference MultiSlotDataFeed "
+                    "fails such batches too)"
+                )
             vals = np.concatenate([row[s] for row in batch]) if batch else \
                 np.empty(0, np.float32)
-            offsets = np.cumsum(
-                [0] + [len(row[s]) for row in batch]
-            ).astype(np.int64)
+            offsets = np.cumsum([0] + counts).astype(np.int64)
             padded, _ = native.pack_padded(
                 vals, offsets, width, pad_value=0,
                 dtype=np.int64 if np.issubdtype(dtype, np.integer) else

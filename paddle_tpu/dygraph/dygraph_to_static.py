@@ -69,7 +69,13 @@ _TRACE_HINT = (
 def _collect_params(args):
     """Parameters of every Layer argument (incl. `self` of bound methods):
     they must be traced INPUTS, not baked constants, or calls after an
-    optimizer step would replay stale weights."""
+    optimizer step would replay stale weights.
+
+    LIMITATION: a Layer reached only through a closure (not passed as an
+    argument) cannot be detected — its weights bake into the trace as
+    constants and never update/receive grads. Pass the Layer (or use a
+    method `@declarative def forward(self, x)`), like the reference's
+    to_static which bound to a Layer instance."""
     from .layers import Layer
 
     params = {}
@@ -97,8 +103,16 @@ def declarative(fn=None):
 
         params = _collect_params(args)
         var_args = [a for a in args if isinstance(a, VarBase)]
-        # cache key: tensor positions+shapes, static args (baked into the
-        # trace) with their positions, and the parameter set
+        var_pos = [i for i, a in enumerate(args) if isinstance(a, VarBase)]
+        # static (non-tensor) args are captured for the trace closure — but
+        # NOT the input VarBases, which would pin the first call's tensors
+        static_args = {
+            i: a for i, a in enumerate(args) if not isinstance(a, VarBase)
+        }
+        n_args = len(args)
+        # cache key: tensor positions+shapes, static args with their
+        # positions, Layer identities (two same-shaped Layers must not share
+        # a trace), and the parameter set
         sig = (
             tuple(
                 (i, tuple(a.value.shape), str(a.value.dtype))
@@ -106,9 +120,8 @@ def declarative(fn=None):
                 if isinstance(a, VarBase)
             ),
             tuple(
-                (i, repr(a))
-                for i, a in enumerate(args)
-                if not isinstance(a, (VarBase, Layer))
+                (i, id(a) if isinstance(a, Layer) else repr(a))
+                for i, a in sorted(static_args.items())
             ),
             tuple(sorted(params)),
         )
@@ -122,8 +135,8 @@ def declarative(fn=None):
                     p._value = param_vals[n]
                 it = iter(vals)
                 inner = [
-                    VarBase(next(it)) if isinstance(a, VarBase) else a
-                    for a in args
+                    static_args[i] if i in static_args else VarBase(next(it))
+                    for i in range(n_args)
                 ]
                 from .base import no_grad_ctx
 
@@ -151,24 +164,38 @@ def declarative(fn=None):
         try:
             if not grad_pnames and not grad_var_idx:
                 if sig not in cache:
-                    cache[sig] = (jax.jit(pure), struct)
-                jitted, struct = cache[sig]  # struct persists across hits
+                    cache[sig] = (jax.jit(pure), None, struct)
+                jitted, _, struct = cache[sig]  # struct persists across hits
                 out_vals = jitted(param_vals, in_vals)
                 outs = [VarBase(v) for v in out_vals]
             else:
-                # training: boundary vjp stitches the compiled region into
-                # the eager tape (re-traces per call, like eager backward)
-                out_vals, vjp_fn = jax.vjp(pure, param_vals, in_vals)
+                # training: both directions XLA-compiled and cached; the
+                # backward recomputes the forward inside its own executable
+                # (rematerialized boundary vjp — stable cache, no per-step
+                # python re-trace)
+                if sig not in cache or cache[sig][1] is None:
+                    fwd = jax.jit(pure)
+
+                    def bwd(pv, v, cts):
+                        _, vjp_fn = jax.vjp(pure, pv, v)
+                        return vjp_fn(cts)
+
+                    cache[sig] = (fwd, jax.jit(bwd), struct)
+                fwd, bwd, struct = cache[sig]
+                out_vals = fwd(param_vals, in_vals)
                 outs = [VarBase(v, stop_gradient=False) for v in out_vals]
                 grad_inputs = [params[n] for n in grad_pnames] + [
                     var_args[i] for i in grad_var_idx
                 ]
 
-                def tape_fn(cts):
-                    pg, vg = vjp_fn(list(cts))
-                    return [pg[n] for n in grad_pnames] + [
-                        vg[i] for i in grad_var_idx
-                    ]
+                def tape_fn(cts, _pv=param_vals, _iv=in_vals):
+                    pg, vg = bwd(_pv, _iv, list(cts))
+                    # 1-tuple: Tracer.run_backward unpacks
+                    # `(in_grads,) = entry.vjp_fn(cts)` (tracer.py:129)
+                    return (
+                        [pg[n] for n in grad_pnames]
+                        + [vg[i] for i in grad_var_idx],
+                    )
 
                 from .tracer import TapeEntry
 

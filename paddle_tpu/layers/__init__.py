@@ -66,3 +66,12 @@ from .sequence_lod import (  # noqa: F401
     sequence_reverse,
     sequence_softmax,
 )
+from . import rnn  # noqa: F401
+from .rnn import dynamic_gru, dynamic_lstm, gru, lstm  # noqa: F401
+from .detection import (  # noqa: F401
+    box_coder,
+    iou_similarity,
+    multiclass_nms,
+    prior_box,
+    yolo_box,
+)

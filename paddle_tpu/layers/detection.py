@@ -1,0 +1,66 @@
+"""Detection layer wrappers (reference fluid/layers/detection.py) over
+ops/detection.py."""
+
+from __future__ import annotations
+
+from .tensor import _simple
+
+
+def iou_similarity(x, y, name=None):
+    return _simple("iou_similarity", {"X": [x], "Y": [y]}, {})
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None):
+    return _simple(
+        "box_coder",
+        {"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+         "TargetBox": [target_box]},
+        {"code_type": code_type, "box_normalized": box_normalized},
+        out_slots=("OutputBox",),
+    )
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=False, clip=False, steps=None, offset=0.5,
+              name=None):
+    steps = steps or [0.0, 0.0]
+    return _simple(
+        "prior_box",
+        {"Input": [input], "Image": [image]},
+        {
+            "min_sizes": list(min_sizes),
+            "max_sizes": list(max_sizes or []),
+            "aspect_ratios": list(aspect_ratios or [1.0]),
+            "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+            "flip": flip, "clip": clip,
+            "step_w": steps[0], "step_h": steps[1], "offset": offset,
+        },
+        out_slots=("Boxes", "Variances"),
+        stop_gradient=True,
+    )
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, name=None):
+    return _simple(
+        "yolo_box",
+        {"X": [x], "ImgSize": [img_size]},
+        {"anchors": list(anchors), "class_num": class_num,
+         "conf_thresh": conf_thresh, "downsample_ratio": downsample_ratio},
+        out_slots=("Boxes", "Scores"),
+        stop_gradient=True,
+    )
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
+                   keep_top_k=16, nms_threshold=0.3, normalized=True,
+                   name=None):
+    return _simple(
+        "multiclass_nms",
+        {"BBoxes": [bboxes], "Scores": [scores]},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold},
+        out_slots=("Out", "NmsRoisNum"),
+        stop_gradient=True,
+    )

@@ -1,0 +1,92 @@
+"""Recurrent layers over the lstm/gru ops (reference fluid.layers.rnn /
+dynamic_lstm :X / dynamic_gru / cudnn lstm; 3,254 LoC of LoD machinery in
+the reference's rnn.py — here padded [B,T,D] + lengths, ops/rnn.py)."""
+
+from __future__ import annotations
+
+from ..framework import unique_name
+from ..initializer import Xavier
+from ..param_attr import ParamAttr
+from .helper import LayerHelper
+
+
+def lstm(
+    input, hidden_size, init_h=None, init_c=None, sequence_length=None,
+    num_layers=1, param_attr=None, bias_attr=None, is_bidirec=False,
+    name=None,
+):
+    """Multi-layer LSTM over [B, T, D]; returns (out [B,T,H], last_h,
+    last_c) — fluid.layers.lstm parity (cudnn_lstm_op role)."""
+    if is_bidirec:
+        raise NotImplementedError(
+            "bidirectional lstm: run a second stack over "
+            "layers.sequence_reverse(input, lengths) and concat"
+        )
+    helper = LayerHelper("lstm", name=name)
+    x = input
+    last_h = last_c = None
+    d = x.shape[-1]
+    for layer in range(num_layers):
+        wih = helper.create_parameter(
+            param_attr, [4 * hidden_size, d], "float32",
+            default_initializer=Xavier(),
+        )
+        whh = helper.create_parameter(
+            ParamAttr(name=unique_name.generate("lstm_whh")),
+            [4 * hidden_size, hidden_size], "float32",
+            default_initializer=Xavier(),
+        )
+        b = helper.create_parameter(
+            bias_attr if bias_attr is not None
+            else ParamAttr(name=unique_name.generate("lstm_b")),
+            [4 * hidden_size], "float32", is_bias=True,
+        )
+        ins = {"X": [x], "WIH": [wih], "WHH": [whh], "Bias": [b],
+               "H0": [init_h], "C0": [init_c],
+               "SeqLen": [sequence_length]}
+        ins = {k: v for k, v in ins.items() if v[0] is not None}
+        x, last_h, last_c = helper.create_and_append(
+            ins, {}, op_type="lstm", out_slots=("Out", "LastH", "LastC"),
+        )
+        d = hidden_size
+        init_h = init_c = None  # deeper layers start from zero state
+    return x, last_h, last_c
+
+
+def gru(
+    input, hidden_size, init_h=None, sequence_length=None, num_layers=1,
+    param_attr=None, bias_attr=None, name=None,
+):
+    """Multi-layer GRU over [B, T, D]; returns (out, last_h)."""
+    helper = LayerHelper("gru", name=name)
+    x = input
+    last_h = None
+    d = x.shape[-1]
+    for layer in range(num_layers):
+        wih = helper.create_parameter(
+            param_attr, [3 * hidden_size, d], "float32",
+            default_initializer=Xavier(),
+        )
+        whh = helper.create_parameter(
+            ParamAttr(name=unique_name.generate("gru_whh")),
+            [3 * hidden_size, hidden_size], "float32",
+            default_initializer=Xavier(),
+        )
+        b = helper.create_parameter(
+            bias_attr if bias_attr is not None
+            else ParamAttr(name=unique_name.generate("gru_b")),
+            [3 * hidden_size], "float32", is_bias=True,
+        )
+        ins = {"X": [x], "WIH": [wih], "WHH": [whh], "Bias": [b],
+               "H0": [init_h], "SeqLen": [sequence_length]}
+        ins = {k: v for k, v in ins.items() if v[0] is not None}
+        x, last_h = helper.create_and_append(
+            ins, {}, op_type="gru", out_slots=("Out", "LastH"),
+        )
+        d = hidden_size
+        init_h = None
+    return x, last_h
+
+
+dynamic_lstm = lstm
+dynamic_gru = gru

@@ -103,9 +103,9 @@ def sequence_pool(input, pool_type, lengths, pad_value=0.0):
         )
         return empty_to_pad(tensor.reduce_max(shifted, 1))
     if pool_type == "last":
-        return sequence_last_step(input, lengths)
+        return empty_to_pad(sequence_last_step(input, lengths))
     if pool_type == "first":
-        return sequence_first_step(input)
+        return empty_to_pad(sequence_first_step(input))
     raise ValueError(f"unknown pool_type {pool_type!r}")
 
 

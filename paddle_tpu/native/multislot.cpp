@@ -42,20 +42,29 @@ long ps_parse_multislot(const char* buf, long len, int num_slots,
     while (p < end && (*p == '\n' || *p == '\r')) ++p;
     if (p >= end) break;
     if (n_records >= max_records) return -2;
-    for (int s = 0; s < num_slots; ++s) {
+    // a record must be complete within ITS line: strtol/strtod would skip
+    // '\n' as whitespace and silently pull tokens from the next record, so
+    // skip field separators manually and treat newline as a hard stop
+    bool bad = false;
+    for (int s = 0; s < num_slots && !bad; ++s) {
       char* next = nullptr;
+      while (p < end && (*p == ' ' || *p == '\t')) ++p;
+      if (p >= end || *p == '\n' || *p == '\r') { bad = true; break; }
       long n = strtol(p, &next, 10);
-      if (next == p || n < 0) return -1;
+      if (next == p || n < 0) { bad = true; break; }
       p = next;
       for (long i = 0; i < n; ++i) {
         if (n_vals >= max_vals) return -2;
+        while (p < end && (*p == ' ' || *p == '\t')) ++p;
+        if (p >= end || *p == '\n' || *p == '\r') { bad = true; break; }
         double v = strtod(p, &next);
-        if (next == p) return -1;
+        if (next == p) { bad = true; break; }
         out_vals[n_vals++] = v;
         p = next;
       }
-      out_offsets[++cell] = n_vals;
+      if (!bad) out_offsets[++cell] = n_vals;
     }
+    if (bad) return -1;
     // consume to end of line
     while (p < end && *p != '\n') ++p;
     ++n_records;

@@ -11,11 +11,13 @@ from . import (  # noqa: F401
     amp_ops,
     collective,
     control_flow,
+    detection,
     math,
     metrics,
     nn,
     optimizer_ops,
     random,
+    rnn,
     sparse,
     tensor_ops,
 )

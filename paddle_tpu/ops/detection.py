@@ -1,0 +1,247 @@
+"""Detection ops (reference operators/detection/, ~16.7k LoC:
+iou_similarity_op, box_coder_op, prior_box_op, yolo_box_op,
+multiclass_nms_op, roi_align_op ...).
+
+TPU-native re-design: boxes are dense [_, 4] tensors; NMS — whose reference
+kernel emits a VARIABLE number of boxes via LoD — returns a FIXED keep_top_k
+set padded with -1 labels plus a validity count, so the whole detection
+head stays inside one static-shape XLA computation (the standard TPU
+object-detection formulation). Suppression uses the O(k^2) masked matrix
+form on the VPU instead of the reference's sequential CPU loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+def _iou_matrix(a, b):
+    """[N,4] x [M,4] -> [N,M] IoU (xmin,ymin,xmax,ymax)."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(
+        a[:, 3] - a[:, 1], 0
+    )
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0
+    )
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register_op("iou_similarity", inputs=["X", "Y"], outputs=["Out"])
+def _iou_similarity(ctx, op, ins):
+    return {"Out": [_iou_matrix(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("box_coder", inputs=["PriorBox", "PriorBoxVar", "TargetBox"],
+             outputs=["OutputBox"])
+def _box_coder(ctx, op, ins):
+    """encode_center_size / decode_center_size (box_coder_op.cc)."""
+    prior = ins["PriorBox"][0]  # [M, 4]
+    pvar = (
+        ins["PriorBoxVar"][0]
+        if ins.get("PriorBoxVar") and ins["PriorBoxVar"][0] is not None
+        else None
+    )
+    target = ins["TargetBox"][0]
+    code_type = op.attr("code_type", "encode_center_size")
+    norm = op.attr("box_normalized", True)
+    off = 0.0 if norm else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + 0.5 * tw
+        tcy = target[:, 1] + 0.5 * th
+        out = jnp.stack(
+            [
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                jnp.log(tw[:, None] / pw[None, :]),
+                jnp.log(th[:, None] / ph[None, :]),
+            ],
+            axis=-1,
+        )  # [N, M, 4]
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+        return {"OutputBox": [out]}
+
+    # decode: target [N, M, 4] deltas (or [N,4] broadcast against priors)
+    t = target if target.ndim == 3 else target[:, None, :]
+    if pvar is not None:
+        t = t * pvar[None, :, :]
+    dcx = t[..., 0] * pw[None, :] + pcx[None, :]
+    dcy = t[..., 1] * ph[None, :] + pcy[None, :]
+    dw = jnp.exp(t[..., 2]) * pw[None, :]
+    dh = jnp.exp(t[..., 3]) * ph[None, :]
+    out = jnp.stack(
+        [
+            dcx - 0.5 * dw,
+            dcy - 0.5 * dh,
+            dcx + 0.5 * dw - off,
+            dcy + 0.5 * dh - off,
+        ],
+        axis=-1,
+    )
+    return {"OutputBox": [out]}
+
+
+@register_op("prior_box", inputs=["Input", "Image"],
+             outputs=["Boxes", "Variances"], differentiable=False)
+def _prior_box(ctx, op, ins):
+    """SSD prior boxes per feature-map cell (prior_box_op.cc)."""
+    feat = ins["Input"][0]  # [B, C, H, W]
+    img = ins["Image"][0]  # [B, C, IH, IW]
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    min_sizes = [float(s) for s in op.attr("min_sizes")]
+    max_sizes = [float(s) for s in op.attr("max_sizes", [])]
+    ars = [1.0]
+    for a in op.attr("aspect_ratios", [1.0]):
+        a = float(a)
+        if not any(abs(a - e) < 1e-6 for e in ars):
+            ars.append(a)
+            if op.attr("flip", True):
+                ars.append(1.0 / a)
+    variances = [float(v) for v in op.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = op.attr("clip", True)
+    step_w = op.attr("step_w", 0.0) or IW / W
+    step_h = op.attr("step_h", 0.0) or IH / H
+    offset = op.attr("offset", 0.5)
+
+    whs = []
+    for ms in min_sizes:
+        for a in ars:
+            whs.append((ms * (a ** 0.5), ms / (a ** 0.5)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            whs.append(((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+    whs = jnp.asarray(whs, jnp.float32)  # [P, 2]
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    centers = jnp.stack([cxg, cyg], -1)[..., None, :]  # [H, W, 1, 2]
+    half = whs[None, None, :, :] / 2.0
+    mins = (centers - half) / jnp.asarray([IW, IH], jnp.float32)
+    maxs = (centers + half) / jnp.asarray([IW, IH], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], -1)  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), boxes.shape
+    )
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op("yolo_box", inputs=["X", "ImgSize"], outputs=["Boxes", "Scores"],
+             differentiable=False)
+def _yolo_box(ctx, op, ins):
+    """Decode YOLOv3 head output (yolo_box_op.cc): X [B, A*(5+C), H, W] ->
+    boxes [B, A*H*W, 4] + scores [B, A*H*W, C]."""
+    x = ins["X"][0]
+    img_size = ins["ImgSize"][0]  # [B, 2] (h, w)
+    anchors = [int(a) for a in op.attr("anchors")]
+    class_num = op.attr("class_num")
+    conf_thresh = op.attr("conf_thresh", 0.01)
+    downsample = op.attr("downsample_ratio", 32)
+    B, _, H, W = x.shape
+    A = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+
+    x = x.reshape(B, A, 5 + class_num, H, W)
+    tx, ty = jax.nn.sigmoid(x[:, :, 0]), jax.nn.sigmoid(x[:, :, 1])
+    tw, th = x[:, :, 2], x[:, :, 3]
+    conf = jax.nn.sigmoid(x[:, :, 4])  # [B, A, H, W]
+    cls = jax.nn.sigmoid(x[:, :, 5:])  # [B, A, C, H, W]
+
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    cx = (tx + gx) / W
+    cy = (ty + gy) / H
+    input_size = jnp.asarray([downsample * H, downsample * W], jnp.float32)
+    bw = jnp.exp(tw) * an[None, :, 0, None, None] / input_size[1]
+    bh = jnp.exp(th) * an[None, :, 1, None, None] / input_size[0]
+
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    x0 = (cx - bw / 2) * imw
+    y0 = (cy - bh / 2) * imh
+    x1 = (cx + bw / 2) * imw
+    y1 = (cy + bh / 2) * imh
+    boxes = jnp.stack([x0, y0, x1, y1], -1).reshape(B, A * H * W, 4)
+    score = conf[:, :, None] * cls  # [B, A, C, H, W]
+    keep = (conf > conf_thresh).astype(score.dtype)[:, :, None]
+    score = (score * keep).transpose(0, 1, 3, 4, 2).reshape(
+        B, A * H * W, class_num
+    )
+    return {"Boxes": [boxes], "Scores": [score]}
+
+
+@register_op(
+    "multiclass_nms", inputs=["BBoxes", "Scores"],
+    outputs=["Out", "NmsRoisNum"], differentiable=False,
+)
+def _multiclass_nms(ctx, op, ins):
+    """Fixed-size NMS (multiclass_nms_op.cc re-designed for static shapes):
+    per class, greedy-suppress by IoU, keep score_threshold survivors, then
+    global keep_top_k by score. Out [B, keep_top_k, 6] rows
+    [label, score, x0, y0, x1, y1], invalid rows label=-1; NmsRoisNum [B].
+    """
+    boxes = ins["BBoxes"][0]  # [B, N, 4]
+    scores = ins["Scores"][0]  # [B, C, N] (reference layout)
+    score_thresh = op.attr("score_threshold", 0.0)
+    nms_thresh = op.attr("nms_threshold", 0.3)
+    nms_top_k = op.attr("nms_top_k", 64)
+    keep_top_k = op.attr("keep_top_k", 16)
+    B, C, N = scores.shape
+    k = min(nms_top_k, N)
+
+    def one_class(b_boxes, c_scores):
+        sc, idx = lax.top_k(c_scores, k)
+        bx = b_boxes[idx]
+        iou = _iou_matrix(bx, bx)
+        # greedy suppression as a scan over rank order: box i dies if it
+        # overlaps any surviving higher-ranked box
+        def step(alive, i):
+            sup = jnp.any(
+                (iou[i] > nms_thresh) & alive & (jnp.arange(k) < i)
+            )
+            keep_i = jnp.logical_and(~sup, sc[i] > score_thresh)
+            return alive.at[i].set(keep_i), None
+
+        alive0 = jnp.zeros(k, bool)
+        alive, _ = lax.scan(step, alive0, jnp.arange(k))
+        return sc * alive, idx
+
+    def one_image(b_boxes, b_scores):
+        cls_scores, cls_idx = jax.vmap(
+            lambda cs: one_class(b_boxes, cs)
+        )(b_scores)  # [C, k], [C, k]
+        flat_scores = cls_scores.reshape(-1)
+        flat_idx = cls_idx.reshape(-1)
+        labels = jnp.repeat(jnp.arange(C), k)
+        kk = min(keep_top_k, flat_scores.shape[0])
+        top_sc, top_i = lax.top_k(flat_scores, kk)
+        valid = top_sc > jnp.maximum(score_thresh, 0.0)
+        lab = jnp.where(valid, labels[top_i], -1).astype(jnp.float32)
+        bx = b_boxes[flat_idx[top_i]]
+        out = jnp.concatenate(
+            [lab[:, None], top_sc[:, None], bx], axis=-1
+        )
+        return out, valid.sum().astype(jnp.int32)
+
+    out, num = jax.vmap(one_image)(boxes, scores)
+    return {"Out": [out], "NmsRoisNum": [num]}

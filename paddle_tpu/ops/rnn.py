@@ -1,0 +1,109 @@
+"""Recurrent ops: LSTM / GRU over padded batches.
+
+Reference: operators/cudnn_lstm_op.cu / lstm_op.cc / gru_op.cc and the
+LoD-aware dynamic_lstm/dynamic_gru (fluid.layers). TPU-native: one op per
+layer, a lax.scan over the time axis of dense [B, T, D] input — XLA fuses
+the gate matmuls per step and the generic __vjp__ provides BPTT (the
+reference hand-wrote lstm_grad kernels). Variable lengths are handled by
+masking: steps beyond a row's length carry the previous state through, so
+LastH/LastC equal the state at each row's true end (LoD semantics).
+
+Gate layout (i, f, g, o for LSTM; u, r, c for GRU) over stacked weights:
+W_ih [G*H, D], W_hh [G*H, H], bias [G*H].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+def _seq_mask(lengths, B, T):
+    if lengths is None:
+        return jnp.ones((T, B, 1), jnp.float32)
+    r = jnp.arange(T)[:, None]
+    return (r < lengths.astype(jnp.int32)[None, :]).astype(jnp.float32)[
+        ..., None
+    ]
+
+
+@register_op(
+    "lstm",
+    inputs=["X", "WIH", "WHH", "Bias", "H0", "C0", "SeqLen"],
+    outputs=["Out", "LastH", "LastC"],
+)
+def _lstm(ctx, op, ins):
+    x = ins["X"][0]  # [B, T, D]
+    wih, whh = ins["WIH"][0], ins["WHH"][0]  # [4H, D], [4H, H]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    B, T, D = x.shape
+    H = whh.shape[1]
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else jnp.zeros((B, H), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else jnp.zeros((B, H), x.dtype)
+    lens = ins["SeqLen"][0] if ins.get("SeqLen") and ins["SeqLen"][0] is not None else None
+
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, D]
+    # pre-compute input projections for every step in one big matmul (MXU)
+    xproj = jnp.einsum("tbd,gd->tbg", xs, wih)
+    if bias is not None:
+        xproj = xproj + bias
+    mask = _seq_mask(lens, B, T)
+
+    def step(carry, inp):
+        h, c = carry
+        xp, m = inp
+        gates = xp + h @ whh.T
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        # masked carry-through: padded steps keep the old state
+        h_out = m * h_new + (1 - m) * h
+        c_out = m * c_new + (1 - m) * c
+        return (h_out, c_out), h_out
+
+    (h_last, c_last), hs = lax.scan(step, (h0, c0), (xproj, mask))
+    return {
+        "Out": [jnp.swapaxes(hs, 0, 1)],
+        "LastH": [h_last],
+        "LastC": [c_last],
+    }
+
+
+@register_op(
+    "gru",
+    inputs=["X", "WIH", "WHH", "Bias", "H0", "SeqLen"],
+    outputs=["Out", "LastH"],
+)
+def _gru(ctx, op, ins):
+    x = ins["X"][0]
+    wih, whh = ins["WIH"][0], ins["WHH"][0]  # [3H, D], [3H, H]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    B, T, D = x.shape
+    H = whh.shape[1]
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else jnp.zeros((B, H), x.dtype)
+    lens = ins["SeqLen"][0] if ins.get("SeqLen") and ins["SeqLen"][0] is not None else None
+
+    xs = jnp.swapaxes(x, 0, 1)
+    xproj = jnp.einsum("tbd,gd->tbg", xs, wih)
+    if bias is not None:
+        xproj = xproj + bias
+    mask = _seq_mask(lens, B, T)
+    w_u, w_r, w_c = jnp.split(whh, 3, axis=0)  # each [H, H]
+
+    def step(h, inp):
+        xp, m = inp
+        xu, xr, xc = jnp.split(xp, 3, axis=-1)
+        u = jax.nn.sigmoid(xu + h @ w_u.T)
+        r = jax.nn.sigmoid(xr + h @ w_r.T)
+        cand = jnp.tanh(xc + (r * h) @ w_c.T)
+        h_new = u * h + (1 - u) * cand
+        h_out = m * h_new + (1 - m) * h
+        return h_out, h_out
+
+    h_last, hs = lax.scan(step, h0, (xproj, mask))
+    return {"Out": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}

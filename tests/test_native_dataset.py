@@ -169,3 +169,25 @@ def test_infer_from_dataset_rejects_train_programs(tmp_path):
     exe.run(fluid.default_startup_program())
     with pytest.raises(ValueError, match="update ops"):
         exe.infer_from_dataset(fluid.default_main_program(), ds)
+
+
+def test_parser_rejects_cross_line_records():
+    """A short line must NOT pull tokens from the next line (newline is a
+    hard record boundary, unlike bare strtod whitespace skipping)."""
+    with pytest.raises(ValueError, match="malformed"):
+        native.parse_multislot("2 11\n1 5\n", 1)
+    v, o = native.parse_multislot("2 11 12\n1 5\n", 1)
+    np.testing.assert_allclose(v, [11, 12, 5])
+
+
+def test_dataset_rejects_width_mismatch(tmp_path):
+    f = tmp_path / "d.txt"
+    f.write_text("2 1 2 1 1.0\n")  # slot 0 has 2 values
+    x = fluid.data("wx", [-1, 3], "int64")  # but declares width 3
+    y = fluid.data("wy", [-1, 1], "float32")
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(1)
+    ds.set_use_var([x, y])
+    ds.set_filelist([str(f)])
+    with pytest.raises(ValueError, match="declares 3"):
+        list(ds.batches())

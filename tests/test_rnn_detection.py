@@ -1,0 +1,196 @@
+"""LSTM/GRU layers and detection ops vs numpy references.
+
+Reference suites: test_lstm_op.py / test_gru_op.py (gate math vs numpy),
+test_iou_similarity_op.py, test_box_coder_op.py, test_yolo_box_op.py,
+test_multiclass_nms_op.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+def _run(fetch, feed):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return [np.asarray(v) for v in exe.run(feed=feed, fetch_list=fetch)]
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_lstm_matches_numpy_and_masks_padding():
+    B, T, D, H = 2, 4, 3, 5
+    x = fluid.data("x", [B, T, D])
+    lens = fluid.data("lens", [B], "int64")
+    out, last_h, last_c = layers.lstm(
+        x, H, sequence_length=lens,
+        param_attr=fluid.ParamAttr(name="wih"),
+    )
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    lv = np.asarray([4, 2], np.int64)
+    ov, hv, cv = _run([out, last_h, last_c], {"x": xv, "lens": lv})
+
+    scope = fluid.framework.scope.global_scope()
+    wih = np.asarray(scope.find_var("wih"))
+    names = list(fluid.default_main_program().global_block.vars)
+    whh = np.asarray(scope.find_var(
+        [n for n in names if n.startswith("lstm_whh")][0]
+    ))
+    b = np.asarray(scope.find_var(
+        [n for n in names if n.startswith("lstm_b")][0]
+    ))
+
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    want = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        gates = xv[:, t] @ wih.T + b + h @ whh.T
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+        g = np.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * np.tanh(c_new)
+        m = (t < lv).astype(np.float32)[:, None]
+        h = m * h_new + (1 - m) * h
+        c = m * c_new + (1 - m) * c
+        want[:, t] = h
+    np.testing.assert_allclose(ov, want, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(hv, h, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(cv, c, rtol=2e-4, atol=1e-5)
+    # padded steps carry the last real state through
+    np.testing.assert_allclose(ov[1, 2], ov[1, 1], rtol=1e-6)
+
+
+def test_lstm_trains():
+    B, T, D, H = 8, 6, 4, 8
+    x = fluid.data("x", [B, T, D])
+    y = fluid.data("y", [B, H])
+    out, last_h, _ = layers.lstm(x, H, num_layers=2)
+    loss = layers.mean(layers.square_error_cost(last_h, y))
+    fluid.optimizer.Adam(0.02).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(B, T, D).astype(np.float32),
+            "y": np.tanh(rng.randn(B, H)).astype(np.float32) * 0.5}
+    losses = [
+        float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+              .reshape(-1)[0])
+        for _ in range(80)
+    ]
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_gru_matches_numpy():
+    B, T, D, H = 2, 3, 3, 4
+    x = fluid.data("x", [B, T, D])
+    out, last_h = layers.gru(x, H, param_attr=fluid.ParamAttr(name="gwih"))
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    ov, hv = _run([out, last_h], {"x": xv})
+    scope = fluid.framework.scope.global_scope()
+    names = list(fluid.default_main_program().global_block.vars)
+    wih = np.asarray(scope.find_var("gwih"))
+    whh = np.asarray(scope.find_var(
+        [n for n in names if n.startswith("gru_whh")][0]
+    ))
+    b = np.asarray(scope.find_var(
+        [n for n in names if n.startswith("gru_b")][0]
+    ))
+    w_u, w_r, w_c = np.split(whh, 3, axis=0)
+    h = np.zeros((B, H), np.float32)
+    for t in range(T):
+        xp = xv[:, t] @ wih.T + b
+        xu, xr, xc = np.split(xp, 3, axis=-1)
+        u = _sigmoid(xu + h @ w_u.T)
+        r = _sigmoid(xr + h @ w_r.T)
+        cand = np.tanh(xc + (r * h) @ w_c.T)
+        h = u * h + (1 - u) * cand
+    np.testing.assert_allclose(hv, h, rtol=2e-4, atol=1e-5)
+
+
+# -- detection --------------------------------------------------------------
+
+
+def test_iou_similarity():
+    a = fluid.data("a", [2, 4])
+    b = fluid.data("b", [2, 4])
+    out = layers.iou_similarity(a, b)
+    av = np.asarray([[0, 0, 2, 2], [0, 0, 1, 1]], np.float32)
+    bv = np.asarray([[1, 1, 3, 3], [0, 0, 1, 1]], np.float32)
+    (got,) = _run([out], {"a": av, "b": bv})
+    assert got[0, 0] == pytest.approx(1 / 7)  # inter 1, union 7
+    assert got[1, 1] == pytest.approx(1.0)
+    assert got[1, 0] == pytest.approx(0.0)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    prior = fluid.data("prior", [3, 4])
+    target = fluid.data("target", [2, 4])
+    enc = layers.box_coder(prior, None, target, "encode_center_size")
+    dec = layers.box_coder(prior, None, enc, "decode_center_size")
+    rng = np.random.RandomState(0)
+    pv = np.sort(rng.rand(3, 2, 2), axis=1).reshape(3, 4).astype(np.float32)
+    tv = np.sort(rng.rand(2, 2, 2), axis=1).reshape(2, 4).astype(np.float32)
+    # ensure nonzero extents
+    pv[:, 2:] += 0.1
+    tv[:, 2:] += 0.1
+    e, d = _run([enc, dec], {"prior": pv, "target": tv})
+    # decode(encode(t)) == t for every prior column
+    for m in range(3):
+        np.testing.assert_allclose(d[:, m], tv, rtol=1e-4, atol=1e-5)
+
+
+def test_yolo_box_shapes_and_center():
+    B, A, C, Hh, Ww = 1, 2, 3, 2, 2
+    x = fluid.data("x", [B, A * (5 + C), Hh, Ww])
+    img = fluid.data("img", [B, 2], "int64")
+    boxes, scores = layers.yolo_box(
+        x, img, anchors=[10, 14, 23, 27], class_num=C, downsample_ratio=32
+    )
+    xv = np.zeros((B, A * (5 + C), Hh, Ww), np.float32)
+    (bv, sv) = _run(
+        [boxes, scores], {"x": xv, "img": np.asarray([[64, 64]], np.int64)}
+    )
+    assert bv.shape == (B, A * Hh * Ww, 4)
+    assert sv.shape == (B, A * Hh * Ww, C)
+    # zero logits: center of cell (0,0) is at 0.5/W * img -> box center 16
+    cx = (bv[0, 0, 0] + bv[0, 0, 2]) / 2
+    assert cx == pytest.approx(16.0, abs=1e-3)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    boxes = fluid.data("boxes", [1, 4, 4])
+    scores = fluid.data("scores", [1, 1, 4])
+    out, num = layers.multiclass_nms(
+        boxes, scores, score_threshold=0.05, nms_threshold=0.5,
+        nms_top_k=4, keep_top_k=4,
+    )
+    bv = np.asarray([[
+        [0, 0, 10, 10],
+        [1, 1, 10.5, 10.5],   # heavy overlap with box 0 -> suppressed
+        [20, 20, 30, 30],     # separate -> kept
+        [0, 0, 1, 1],         # low score -> below threshold
+    ]], np.float32)
+    sv = np.asarray([[[0.9, 0.8, 0.7, 0.01]]], np.float32)
+    ov, nv = _run([out, num], {"boxes": bv, "scores": sv})
+    assert int(nv[0]) == 2
+    kept = ov[0][ov[0, :, 0] >= 0]
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(kept[0, 1], 0.9)  # best box first
+    np.testing.assert_allclose(kept[1, 2:], [20, 20, 30, 30])
