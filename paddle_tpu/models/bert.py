@@ -130,18 +130,23 @@ def _attn_bias(input_mask):
     return layers.scale(mask, scale=1e4, bias=-1e4)
 
 
-def bert_encoder_layers(x, input_mask, cfg, start=0, end=None, is_test=False):
+def bert_encoder_layers(x, input_mask, cfg, start=0, end=None, is_test=False,
+                        checkpoints=None):
     """Run encoder layers [start, end) over [B,S,H] input — the unit of
-    pipeline-stage splitting (device_guard slices the layer stack)."""
+    pipeline-stage splitting (device_guard slices the layer stack).
+    `checkpoints`: optional list collecting per-layer outputs for
+    RecomputeOptimizer segment boundaries."""
     attn_bias = _attn_bias(input_mask)
     end = cfg.num_layers if end is None else end
     for i in range(start, end):
         x = _encoder_layer(x, attn_bias, cfg, f"bert_l{i}", is_test)
+        if checkpoints is not None:
+            checkpoints.append(x)
     return x
 
 
 def bert_encoder(input_ids, token_type_ids, input_mask, cfg, is_test=False,
-                 num_layers=None):
+                 num_layers=None, checkpoints=None):
     """input_ids/token_type_ids: [B,S] int64; input_mask: [B,S] float32.
     Returns sequence output [B,S,H]. num_layers limits the stack (pipeline
     stage 0 = embeddings + first half; see bert_encoder_layers)."""
@@ -173,7 +178,9 @@ def bert_encoder(input_ids, token_type_ids, input_mask, cfg, is_test=False,
     )
     emb = layers.dropout(emb, cfg.hidden_dropout, is_test=is_test)
     n = cfg.num_layers if num_layers is None else num_layers
-    return bert_encoder_layers(emb, input_mask, cfg, 0, n, is_test)
+    return bert_encoder_layers(
+        emb, input_mask, cfg, 0, n, is_test, checkpoints=checkpoints
+    )
 
 
 def bert_mlm_head(seq, mlm_labels, cfg):
@@ -202,9 +209,12 @@ def bert_mlm_head(seq, mlm_labels, cfg):
 
 
 def bert_pretrain(input_ids, token_type_ids, input_mask, mlm_labels, cfg,
-                  is_test=False):
+                  is_test=False, checkpoints=None):
     """End-to-end MLM pretraining loss (encoder + head)."""
-    seq = bert_encoder(input_ids, token_type_ids, input_mask, cfg, is_test)
+    seq = bert_encoder(
+        input_ids, token_type_ids, input_mask, cfg, is_test,
+        checkpoints=checkpoints,
+    )
     return bert_mlm_head(seq, mlm_labels, cfg)
 
 
