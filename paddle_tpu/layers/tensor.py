@@ -366,3 +366,40 @@ def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, seed=0):
         {"shape": list(shape), "dtype": dtype, "mean": mean, "std": std, "seed": seed},
         stop_gradient=True,
     )
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id=1,
+                is_accumulated=True, return_parent_idx=False,
+                first_step=False, name=None):
+    """One beam expansion step (fluid layers.beam_search signature over
+    beam_search_op; see ops/beam_search.py for the static-shape design).
+
+    scores: per-candidate LOG-PROBS [B, beam, V]; `ids` (candidate token
+    ids) is accepted for fluid parity and ignored — with a dense [.., V]
+    score tensor the candidate id IS the vocab index, as in fluid when
+    ids is None. Returns (selected_ids, selected_scores) like fluid, or
+    (+parent_idx) with return_parent_idx=True."""
+    out = _simple(
+        "beam_search",
+        {"PreIds": [pre_ids], "PreScores": [pre_scores], "Scores": [scores]},
+        {"beam_size": int(beam_size), "end_id": end_id,
+         "first_step": bool(first_step)},
+        out_slots=("SelectedIds", "SelectedScores", "ParentIdx"),
+        stop_gradient=True,
+    )
+    sel_ids, sel_scores, parent = out
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, parent_idx, end_id=1, name=None):
+    """Backtrack stacked [T, B, beam] selections -> [B, beam, T] sequences
+    (reference layers.beam_search_decode / beam_search_decode_op)."""
+    return _simple(
+        "beam_search_decode",
+        {"Ids": [ids], "ParentIdx": [parent_idx]},
+        {"end_id": end_id},
+        out_slots=("SentenceIds",),
+        stop_gradient=True,
+    )

@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     _helpers,
     activation,
     amp_ops,
+    beam_search,
     collective,
     control_flow,
     detection,

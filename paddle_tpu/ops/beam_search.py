@@ -1,0 +1,97 @@
+"""Beam search ops (reference operators/beam_search_op.cc +
+beam_search_decode_op.cc, used inside a While loop by
+fluid.layers.beam_search for seq2seq decoding).
+
+TPU-native re-design: the reference pruned beams into LoD tensors of
+varying width; here every step keeps a FIXED [B, beam] frontier (finished
+beams are forced to continue emitting end_id with frozen scores), so the
+whole decode loop is static-shape — one top_k over [B, beam*V] per step on
+the VPU instead of the reference's per-sequence CPU heap. Backtracking
+(beam_search_decode) is a reverse lax.scan over the stacked parent
+pointers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+NEG_INF = -1e9
+
+
+@register_op(
+    "beam_search",
+    inputs=["PreIds", "PreScores", "Scores"],
+    outputs=["SelectedIds", "SelectedScores", "ParentIdx"],
+    differentiable=False,
+)
+def _beam_search(ctx, op, ins):
+    """One expansion step.
+
+    PreIds/PreScores [B, beam]; Scores = per-candidate LOG-PROBS
+    [B, beam, V]. Finished beams (pre_id == end_id) may only continue as
+    end_id, keeping their score (the fluid is_accumulated contract)."""
+    pre_ids = ins["PreIds"][0].astype(jnp.int32)
+    pre_scores = ins["PreScores"][0]
+    logp = ins["Scores"][0]
+    beam = op.attr("beam_size")
+    end_id = op.attr("end_id", 1)
+    first_step = bool(op.attr("first_step", False))
+    B, K, V = logp.shape
+
+    # a start token that happens to equal end_id must not freeze the whole
+    # decode before it begins (first_step=True exempts the freeze; the
+    # layer sets it automatically when pre_ids is the bos input)
+    finished = (
+        jnp.zeros((B, K), bool) if first_step else pre_ids == end_id
+    )
+    total = pre_scores[..., None] + logp  # [B, K, V]
+    # finished beams: only end_id survives, score frozen
+    onehot_end = jnp.arange(V)[None, None, :] == end_id
+    frozen = jnp.where(onehot_end, pre_scores[..., None], NEG_INF)
+    total = jnp.where(finished[..., None], frozen, total)
+
+    flat = total.reshape(B, K * V)
+    top_scores, top_idx = lax.top_k(flat, beam)
+    # int32 throughout: jax x64 is disabled, and vocab/beam indices fit
+    parent = (top_idx // V).astype(jnp.int32)
+    ids = (top_idx % V).astype(jnp.int32)
+    return {
+        "SelectedIds": [ids],
+        "SelectedScores": [top_scores],
+        "ParentIdx": [parent],
+    }
+
+
+@register_op(
+    "beam_search_decode",
+    inputs=["Ids", "ParentIdx"],
+    outputs=["SentenceIds", "SentenceScores"],
+    differentiable=False,
+)
+def _beam_search_decode(ctx, op, ins):
+    """Backtrack stacked per-step selections into full sequences.
+
+    Ids/ParentIdx [T, B, beam] -> SentenceIds [B, beam, T] (each final beam
+    k traced back through its parent chain). SentenceScores passes through
+    the final step's scores when provided via attr (host keeps them)."""
+    ids = ins["Ids"][0].astype(jnp.int32)  # [T, B, beam]
+    parents = ins["ParentIdx"][0].astype(jnp.int32)
+    T, B, K = ids.shape
+    b_idx = jnp.arange(B)[:, None]
+
+    def back(beam_ptr, inp):
+        step_ids, step_parents = inp
+        tok = step_ids[b_idx, beam_ptr]  # [B, K]
+        prev = step_parents[b_idx, beam_ptr]
+        return prev, tok
+
+    init = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+    _, toks = lax.scan(back, init, (ids, parents), reverse=True)
+    # toks [T, B, K] in forward order after reverse scan
+    return {
+        "SentenceIds": [jnp.transpose(toks, (1, 2, 0))],
+        "SentenceScores": [],
+    }

@@ -194,3 +194,94 @@ def test_multiclass_nms_suppresses_overlaps():
     assert kept.shape[0] == 2
     np.testing.assert_allclose(kept[0, 1], 0.9)  # best box first
     np.testing.assert_allclose(kept[1, 2:], [20, 20, 30, 30])
+
+
+def _np_beam_search(logps, beam, end_id):  # freeze from step 1 on, like the op
+    """Full numpy beam search over per-step log-prob tables.
+    logps: list of T arrays, step t giving [n_states, V] where rows are the
+    current beam entries (here V-conditioned only on last token id for
+    simplicity: logps[t][id] -> [V])."""
+    B = 1
+    K = beam
+    pre_ids = np.zeros((B, K), np.int64)
+    pre_sc = np.full((B, K), 0.0, np.float32)
+    pre_sc[:, 1:] = -1e9  # only beam 0 is live initially
+    all_ids, all_par = [], []
+    for t, table in enumerate(logps):
+        total = np.zeros((B, K, table.shape[1]), np.float32)
+        for k in range(K):
+            if pre_ids[0, k] == end_id and t > 0:
+                row = np.full(table.shape[1], -1e9, np.float32)
+                row[end_id] = pre_sc[0, k]
+                total[0, k] = row
+            else:
+                total[0, k] = pre_sc[0, k] + table[pre_ids[0, k]]
+        flat = total.reshape(B, -1)
+        idx = np.argsort(-flat[0], kind="stable")[:K]
+        par = idx // table.shape[1]
+        ids = idx % table.shape[1]
+        sc = flat[0, idx]
+        all_ids.append(ids.copy())
+        all_par.append(par.copy())
+        pre_ids = ids[None].astype(np.int64)
+        pre_sc = sc[None].astype(np.float32)
+    # backtrack
+    seqs = []
+    for k in range(K):
+        ptr, seq = k, []
+        for t in range(len(logps) - 1, -1, -1):
+            seq.append(all_ids[t][ptr])
+            ptr = all_par[t][ptr]
+        seqs.append(seq[::-1])
+    return np.asarray(seqs), pre_sc[0]
+
+
+def test_beam_search_matches_numpy():
+    """3-step beam decode over a fixed Markov log-prob table, compared
+    against a reference numpy beam search (reference test_beam_search_op
+    + test_beam_search_decode_op combined)."""
+    V, K, T, END = 5, 3, 3, 1
+    rng = np.random.RandomState(0)
+    table_np = np.log(
+        rng.dirichlet(np.ones(V), size=V).astype(np.float32)
+    )  # [V, V]: row = conditional log-probs given last id
+
+    table = fluid.data("table", [V, V])
+    pre_ids = fluid.data("pre_ids", [1, K], "int64")
+    pre_sc = fluid.data("pre_sc", [1, K])
+    step_ids, step_par = [], []
+    ids_v, sc_v = pre_ids, pre_sc
+    for t in range(T):
+        logp = layers.reshape(
+            layers.gather(table, layers.reshape(ids_v, [K])), [1, K, V]
+        )
+        ids_v, sc_v, par_v = layers.beam_search(
+            ids_v, sc_v, None, logp, beam_size=K, end_id=END,
+            return_parent_idx=True, first_step=(t == 0),
+        )
+        step_ids.append(ids_v)
+        step_par.append(par_v)
+    stacked_ids = layers.stack(step_ids, axis=0)  # [T, 1, K]
+    stacked_par = layers.stack(step_par, axis=0)
+    sentences = layers.beam_search_decode(stacked_ids, stacked_par, end_id=END)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    init_sc = np.full((1, K), -1e9, np.float32)
+    init_sc[0, 0] = 0.0
+    got_seq, got_sc = (
+        np.asarray(v)
+        for v in exe.run(
+            feed={
+                "table": table_np,
+                "pre_ids": np.zeros((1, K), np.int64),
+                "pre_sc": init_sc,
+            },
+            fetch_list=[sentences, sc_v],
+        )
+    )
+    want_seqs, want_sc = _np_beam_search(
+        [table_np] * T, K, END
+    )
+    np.testing.assert_array_equal(got_seq[0], want_seqs)
+    np.testing.assert_allclose(got_sc[0], want_sc, rtol=1e-5)
