@@ -10,6 +10,37 @@ from ..param_attr import ParamAttr
 from .helper import LayerHelper
 
 
+def _layer_attrs(kind, layer, param_attr):
+    """(wih_attr, whh_attr, bias_attr) for one stacked layer. Names derive
+    from the wih param name when one is given, so a second program (e.g. a
+    decoding graph) reusing param_attr binds the SAME weights (fluid's
+    shared-name parameter semantics); non-name attributes (initializer,
+    learning_rate, regularizer, trainable) carry over to every layer's
+    weights."""
+    import copy
+
+    attr = ParamAttr.to_attr(param_attr) if param_attr is not None else None
+
+    def derive(name):
+        if attr is None:
+            return ParamAttr(name=name)
+        a = copy.copy(attr)
+        a.name = name
+        return a
+
+    base = getattr(attr, "name", None)
+    suffix = f"_l{layer}" if layer else ""
+    if base:
+        wih = derive(f"{base}{suffix}") if layer else attr
+        whh = derive(f"{base}{suffix}_hh")
+        bias = derive(f"{base}{suffix}_bias")
+    else:
+        wih = attr if attr is not None else None
+        whh = derive(unique_name.generate(f"{kind}_whh"))
+        bias = derive(unique_name.generate(f"{kind}_b"))
+    return wih, whh, bias
+
+
 def lstm(
     input, hidden_size, init_h=None, init_c=None, sequence_length=None,
     num_layers=1, param_attr=None, bias_attr=None, is_bidirec=False,
@@ -27,18 +58,18 @@ def lstm(
     last_h = last_c = None
     d = x.shape[-1]
     for layer in range(num_layers):
+        wih_attr, whh_attr, b_attr = _layer_attrs("lstm", layer, param_attr)
         wih = helper.create_parameter(
-            param_attr, [4 * hidden_size, d], "float32",
+            wih_attr, [4 * hidden_size, d], "float32",
             default_initializer=Xavier(),
         )
         whh = helper.create_parameter(
-            ParamAttr(name=unique_name.generate("lstm_whh")),
+            whh_attr,
             [4 * hidden_size, hidden_size], "float32",
             default_initializer=Xavier(),
         )
         b = helper.create_parameter(
-            bias_attr if bias_attr is not None
-            else ParamAttr(name=unique_name.generate("lstm_b")),
+            bias_attr if bias_attr is not None else b_attr,
             [4 * hidden_size], "float32", is_bias=True,
         )
         ins = {"X": [x], "WIH": [wih], "WHH": [whh], "Bias": [b],
@@ -63,18 +94,18 @@ def gru(
     last_h = None
     d = x.shape[-1]
     for layer in range(num_layers):
+        wih_attr, whh_attr, b_attr = _layer_attrs("gru", layer, param_attr)
         wih = helper.create_parameter(
-            param_attr, [3 * hidden_size, d], "float32",
+            wih_attr, [3 * hidden_size, d], "float32",
             default_initializer=Xavier(),
         )
         whh = helper.create_parameter(
-            ParamAttr(name=unique_name.generate("gru_whh")),
+            whh_attr,
             [3 * hidden_size, hidden_size], "float32",
             default_initializer=Xavier(),
         )
         b = helper.create_parameter(
-            bias_attr if bias_attr is not None
-            else ParamAttr(name=unique_name.generate("gru_b")),
+            bias_attr if bias_attr is not None else b_attr,
             [3 * hidden_size], "float32", is_bias=True,
         )
         ins = {"X": [x], "WIH": [wih], "WHH": [whh], "Bias": [b],

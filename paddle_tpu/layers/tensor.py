@@ -383,7 +383,8 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id=1,
         "beam_search",
         {"PreIds": [pre_ids], "PreScores": [pre_scores], "Scores": [scores]},
         {"beam_size": int(beam_size), "end_id": end_id,
-         "first_step": bool(first_step)},
+         "first_step": bool(first_step),
+         "is_accumulated": bool(is_accumulated)},
         out_slots=("SelectedIds", "SelectedScores", "ParentIdx"),
         stop_gradient=True,
     )
@@ -395,7 +396,11 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id=1,
 
 def beam_search_decode(ids, parent_idx, end_id=1, name=None):
     """Backtrack stacked [T, B, beam] selections -> [B, beam, T] sequences
-    (reference layers.beam_search_decode / beam_search_decode_op)."""
+    (reference layers.beam_search_decode / beam_search_decode_op).
+
+    Static-shape contract: sequences are NOT trimmed at end_id — finished
+    beams repeat end_id to full length (trim on the host if needed); the
+    end_id argument is accepted for fluid parity."""
     return _simple(
         "beam_search_decode",
         {"Ids": [ids], "ParentIdx": [parent_idx]},

@@ -30,24 +30,29 @@ NEG_INF = -1e9
 def _beam_search(ctx, op, ins):
     """One expansion step.
 
-    PreIds/PreScores [B, beam]; Scores = per-candidate LOG-PROBS
-    [B, beam, V]. Finished beams (pre_id == end_id) may only continue as
-    end_id, keeping their score (the fluid is_accumulated contract)."""
+    PreIds/PreScores [B, beam]; Scores [B, beam, V]: accumulated prefix
+    scores when is_accumulated=True (fluid default), per-step log-probs
+    otherwise. Finished beams (pre_id == end_id) may only continue as
+    end_id, keeping their score."""
     pre_ids = ins["PreIds"][0].astype(jnp.int32)
     pre_scores = ins["PreScores"][0]
     logp = ins["Scores"][0]
     beam = op.attr("beam_size")
     end_id = op.attr("end_id", 1)
     first_step = bool(op.attr("first_step", False))
+    is_accumulated = bool(op.attr("is_accumulated", True))
     B, K, V = logp.shape
 
     # a start token that happens to equal end_id must not freeze the whole
-    # decode before it begins (first_step=True exempts the freeze; the
-    # layer sets it automatically when pre_ids is the bos input)
+    # decode before it begins: pass first_step=True on the bos step (an
+    # extension over the fluid signature — fluid had no such hazard because
+    # its LoD pruning dropped finished beams out of the frontier)
     finished = (
         jnp.zeros((B, K), bool) if first_step else pre_ids == end_id
     )
-    total = pre_scores[..., None] + logp  # [B, K, V]
+    # is_accumulated=True (fluid default): `Scores` already contains the
+    # accumulated prefix score; False: per-step log-probs to be added
+    total = logp if is_accumulated else pre_scores[..., None] + logp
     # finished beams: only end_id survives, score frozen
     onehot_end = jnp.arange(V)[None, None, :] == end_id
     frozen = jnp.where(onehot_end, pre_scores[..., None], NEG_INF)

@@ -1,0 +1,65 @@
+"""Worker for the LocalSGD multi-process test: each rank trains
+INDEPENDENTLY (no per-step grad allreduce), then runs the LocalSGD
+averaging program; writes pre/post parameter values per rank
+(reference transpiler/collective.py:270 LocalSGD semantics)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.fleet.role_maker import PaddleCloudRoleMaker
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.transpiler import LocalSGD
+
+
+def main():
+    out_dir = sys.argv[1]
+    role = PaddleCloudRoleMaker()
+    role.generate_role()  # brings up jax.distributed
+    rank, nranks = role.worker_index(), role.worker_num()
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.data("x", [8, 4])
+        y = fluid.data("y", [8, 1])
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.framework.scope.global_scope()
+
+    # rank-dependent data -> params diverge across workers
+    rng = np.random.RandomState(100 + rank)
+    feed = {"x": rng.randn(8, 4).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+    for _ in range(3):
+        exe.run(main_prog, feed=feed, fetch_list=[loss])
+    pre = np.asarray(scope.find_var("w")).copy()
+
+    # periodic averaging step over the global device mesh: the divisor is
+    # the AXIS SIZE (every device holds a model copy — a process's local
+    # devices hold replicas, so psum counts each rank local_count times)
+    import jax
+
+    n_dev = len(jax.devices())
+    avg = LocalSGD(n_dev).build_average_program(main_prog)
+    from paddle_tpu.parallel.spmd import shard_program
+
+    shard_program(avg, make_mesh({"dp": n_dev}, jax.devices()))
+    exe.run(avg, scope=scope)
+    post = np.asarray(scope.find_var("w"))
+
+    with open(os.path.join(out_dir, f"localsgd_{rank}.json"), "w") as f:
+        json.dump({"pre": pre.tolist(), "post": post.tolist()}, f)
+
+
+if __name__ == "__main__":
+    main()

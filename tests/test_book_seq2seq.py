@@ -1,0 +1,150 @@
+"""End-to-end seq2seq "book test" (reference
+tests/book/test_machine_translation.py): GRU encoder-decoder trained with
+teacher forcing on a toy copy task, then beam-search decoding reproduces
+the sequences. Exercises embedding + GRU + attention-free decoding +
+beam_search/beam_search_decode together.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+
+V, T, H, B = 12, 5, 64, 32
+BOS, EOS = 0, 1
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+def _embed(ids, name):
+    return layers.embedding(
+        ids, size=[V, H],
+        param_attr=fluid.ParamAttr(name=name),
+    )
+
+
+def _decoder_logits(dec_in_emb, enc_last):
+    dec_out, _ = layers.gru(
+        dec_in_emb, H, init_h=enc_last,
+        param_attr=fluid.ParamAttr(name="dec_wih"),
+    )
+    b, t = dec_out.shape[0], dec_out.shape[1]
+    flat = layers.reshape(dec_out, [b * t, H])
+    logits = layers.fc(
+        flat, V,
+        param_attr=fluid.ParamAttr(name="proj_w"),
+        bias_attr=fluid.ParamAttr(name="proj_b"),
+    )
+    return layers.reshape(logits, [b, t, V])
+
+
+def _batch(rng, n):
+    """Toy task: target = source (copy), source tokens in [2, V)."""
+    src = rng.randint(2, V, (n, T)).astype(np.int64)
+    dec_in = np.concatenate(
+        [np.full((n, 1), BOS, np.int64), src[:, :-1]], axis=1
+    )
+    return src, dec_in, src  # (src, decoder input, labels)
+
+
+def test_seq2seq_trains_and_beam_decodes():
+    src = fluid.data("src", [B, T], "int64")
+    dec_in = fluid.data("dec_in", [B, T], "int64")
+    label = fluid.data("label", [B, T], "int64")
+
+    _, enc_last = layers.gru(
+        _embed(src, "src_emb"), H,
+        param_attr=fluid.ParamAttr(name="enc_wih"),
+    )
+    logits = _decoder_logits(_embed(dec_in, "tgt_emb"), enc_last)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(
+            layers.reshape(logits, [B * T, V]),
+            layers.reshape(label, [B * T, 1]),
+        )
+    )
+    fluid.optimizer.Adam(0.02).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    losses = []
+    for step in range(700):
+        s, d, l = _batch(rng, B)
+        (lv,) = exe.run(
+            feed={"src": s, "dec_in": d, "label": l}, fetch_list=[loss]
+        )
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < 0.2, (losses[0], losses[-1])
+
+    # ---- greedy/beam decoding program reusing the trained weights ----
+    K = 3
+    infer = fluid.Program()
+    with fluid.program_guard(infer, fluid.Program()):
+        src_i = fluid.data("src", [1, T], "int64")
+        _, h = layers.gru(
+            _embed(src_i, "src_emb"), H,
+            param_attr=fluid.ParamAttr(name="enc_wih"),
+        )
+        # beam state: [1, K] frontier; decoder state per beam [K, H]
+        pre_ids = fluid.data("pre0", [1, K], "int64")
+        pre_sc = fluid.data("sc0", [1, K])
+        state = layers.expand(h, [K, 1])  # same encoder state per beam
+        ids_v, sc_v = pre_ids, pre_sc
+        step_ids, step_par = [], []
+        for t in range(T):
+            emb_t = layers.reshape(
+                _embed(layers.reshape(ids_v, [K, 1]), "tgt_emb"), [K, 1, H]
+            )
+            out_t, state_next = layers.gru(
+                emb_t, H, init_h=state,
+                param_attr=fluid.ParamAttr(name="dec_wih"),
+            )
+            logits_t = layers.fc(
+                layers.reshape(out_t, [K, H]), V,
+                param_attr=fluid.ParamAttr(name="proj_w"),
+                bias_attr=fluid.ParamAttr(name="proj_b"),
+            )
+            logp = layers.reshape(
+                layers.log_softmax(logits_t), [1, K, V]
+            )
+            ids_v, sc_v, par_v = layers.beam_search(
+                ids_v, sc_v, None, logp, beam_size=K, end_id=EOS,
+                is_accumulated=False,  # logp is per-step log-probs
+                return_parent_idx=True, first_step=(t == 0),
+            )
+            # reorder decoder states to follow the selected parents
+            state_next = layers.reshape(state_next, [K, H])
+            state = layers.gather(state_next, layers.reshape(par_v, [K]))
+            step_ids.append(ids_v)
+            step_par.append(par_v)
+        sentences = layers.beam_search_decode(
+            layers.stack(step_ids, axis=0),
+            layers.stack(step_par, axis=0), end_id=EOS,
+        )
+
+    correct = 0
+    trials = 10
+    init_sc = np.full((1, K), -1e9, np.float32)
+    init_sc[0, 0] = 0.0
+    for _ in range(trials):
+        s, _, _ = _batch(rng, 1)
+        (seqs,) = exe.run(
+            infer,
+            feed={"src": s,
+                  "pre0": np.full((1, K), BOS, np.int64),
+                  "sc0": init_sc},
+            fetch_list=[sentences],
+        )
+        if np.array_equal(np.asarray(seqs)[0, 0], s[0]):
+            correct += 1
+    assert correct >= 8, f"beam decode reproduced {correct}/{trials}"

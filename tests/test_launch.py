@@ -118,3 +118,27 @@ def test_launcher_aborts_pod_on_child_failure(tmp_path):
     )
     assert proc.returncode != 0
     assert "pod aborted" in proc.stderr
+
+
+def test_localsgd_two_process_averaging(tmp_path):
+    """LocalSGD: ranks train independently, the averaging program brings
+    parameters to the cross-rank mean (VERDICT: previously untested)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node=2", f"--started_port={_free_port_pair()}",
+            "--simulate_cpu",
+            os.path.join(HERE, "dist_localsgd_worker.py"), str(tmp_path),
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=540,
+    )
+    assert proc.returncode == 0, f"stdout:{proc.stdout}\nstderr:{proc.stderr}"
+    r0 = json.load(open(tmp_path / "localsgd_0.json"))
+    r1 = json.load(open(tmp_path / "localsgd_1.json"))
+    pre0, pre1 = np.asarray(r0["pre"]), np.asarray(r1["pre"])
+    assert np.abs(pre0 - pre1).max() > 1e-4  # genuinely diverged
+    want = (pre0 + pre1) / 2
+    np.testing.assert_allclose(np.asarray(r0["post"]), want, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(r1["post"]), want, rtol=1e-5)

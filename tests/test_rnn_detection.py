@@ -47,13 +47,8 @@ def test_lstm_matches_numpy_and_masks_padding():
 
     scope = fluid.framework.scope.global_scope()
     wih = np.asarray(scope.find_var("wih"))
-    names = list(fluid.default_main_program().global_block.vars)
-    whh = np.asarray(scope.find_var(
-        [n for n in names if n.startswith("lstm_whh")][0]
-    ))
-    b = np.asarray(scope.find_var(
-        [n for n in names if n.startswith("lstm_b")][0]
-    ))
+    whh = np.asarray(scope.find_var("wih_hh"))
+    b = np.asarray(scope.find_var("wih_bias"))
 
     h = np.zeros((B, H), np.float32)
     c = np.zeros((B, H), np.float32)
@@ -104,14 +99,9 @@ def test_gru_matches_numpy():
     xv = rng.randn(B, T, D).astype(np.float32)
     ov, hv = _run([out, last_h], {"x": xv})
     scope = fluid.framework.scope.global_scope()
-    names = list(fluid.default_main_program().global_block.vars)
     wih = np.asarray(scope.find_var("gwih"))
-    whh = np.asarray(scope.find_var(
-        [n for n in names if n.startswith("gru_whh")][0]
-    ))
-    b = np.asarray(scope.find_var(
-        [n for n in names if n.startswith("gru_b")][0]
-    ))
+    whh = np.asarray(scope.find_var("gwih_hh"))
+    b = np.asarray(scope.find_var("gwih_bias"))
     w_u, w_r, w_c = np.split(whh, 3, axis=0)
     h = np.zeros((B, H), np.float32)
     for t in range(T):
@@ -257,6 +247,7 @@ def test_beam_search_matches_numpy():
         )
         ids_v, sc_v, par_v = layers.beam_search(
             ids_v, sc_v, None, logp, beam_size=K, end_id=END,
+            is_accumulated=False,  # logp is per-step log-probs
             return_parent_idx=True, first_step=(t == 0),
         )
         step_ids.append(ids_v)
