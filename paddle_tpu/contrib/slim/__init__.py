@@ -1,1 +1,1 @@
-from . import quantization  # noqa: F401
+from . import distillation, quantization  # noqa: F401

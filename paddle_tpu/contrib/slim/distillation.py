@@ -1,0 +1,142 @@
+"""Knowledge distillation (reference contrib/slim/distillation/
+distiller.py + graph merging in slim's GraphWrapper.merge: teacher ops are
+copied into the student graph under a name prefix, then soft-label / FSP /
+L2 distill losses connect matched layers).
+
+TPU-native: `merge` is a Program transform — teacher ops+vars re-emitted
+into the student program with a "teacher_" prefix and stop_gradient
+teacher parameters (the whole merged graph still compiles to ONE XLA
+computation, so teacher and student run fused in the same step — the
+reference ran two executors). Loss builders mirror the reference's
+DistillationStrategy losses.
+"""
+
+from __future__ import annotations
+
+from ... import layers
+from ...framework.program import default_startup_program
+
+TEACHER_PREFIX = "teacher_"
+
+
+def merge(
+    teacher_program,
+    student_program,
+    data_name_map,
+    scope=None,
+    name_prefix=TEACHER_PREFIX,
+    teacher_scope=None,
+):
+    """Copy the teacher's (inference) program into the student program.
+
+    data_name_map: {teacher_feed_name: student_var_name} — teacher feeds
+    rebind to student vars; every other teacher var is renamed with
+    `name_prefix`. Teacher parameters must already be loaded in
+    `teacher_scope` (or the global scope); they are re-registered under
+    the prefixed name as non-trainable. Returns the student program."""
+    from ...framework.scope import global_scope
+
+    scope = scope or global_scope()
+    teacher_scope = teacher_scope or scope
+    sblk = student_program.global_block
+
+    def rename(n):
+        return data_name_map.get(n, name_prefix + n)
+
+    for tvar in teacher_program.list_vars():
+        if tvar.name in data_name_map:
+            continue
+        new_name = rename(tvar.name)
+        if sblk.has_var(new_name):
+            raise ValueError(
+                f"merge: student program already has a var {new_name!r} "
+                "(merging two teachers? pass a distinct name_prefix)"
+            )
+        if tvar.persistable:
+            p = sblk.create_parameter(
+                new_name, tvar.shape, tvar.dtype, trainable=False
+            )
+            p.stop_gradient = True
+            val = teacher_scope.find_var(tvar.name)
+            if val is None:
+                raise ValueError(
+                    f"teacher parameter {tvar.name!r} not found in scope; "
+                    "load the teacher model first"
+                )
+            scope.set_var(new_name, val)
+        else:
+            sblk.create_var(
+                name=new_name, shape=tvar.shape, dtype=tvar.dtype,
+                stop_gradient=True,
+            )
+    for top in teacher_program.global_block.ops:
+        sblk.append_op(
+            top.type,
+            {s: [rename(n) if n else n for n in ns]
+             for s, ns in top.inputs.items()},
+            {s: [rename(n) if n else n for n in ns]
+             for s, ns in top.outputs.items()},
+            {k: v for k, v in top.attrs.items() if k != "__uid__"},
+        )
+    student_program._bump()
+    return student_program
+
+
+def soft_label_loss(
+    teacher_var_name, student_var_name, program=None,
+    teacher_temperature=1.0, student_temperature=1.0,
+):
+    """Cross-entropy between softened teacher and student logits
+    (reference distiller.py soft_label_loss)."""
+    from ...framework.program import default_main_program
+
+    program = program or default_main_program()
+    blk = program.global_block
+    t = blk.var(teacher_var_name)
+    s = blk.var(student_var_name)
+    t_soft = layers.softmax(layers.scale(t, scale=1.0 / teacher_temperature))
+    s_log = layers.log_softmax(
+        layers.scale(s, scale=1.0 / student_temperature)
+    )
+    ce = layers.reduce_sum(
+        layers.elementwise_mul(t_soft, s_log), -1, keep_dim=False
+    )
+    return layers.scale(layers.reduce_mean(ce), scale=-1.0)
+
+
+def l2_loss(teacher_var_name, student_var_name, program=None):
+    from ...framework.program import default_main_program
+
+    program = program or default_main_program()
+    blk = program.global_block
+    diff = layers.elementwise_sub(
+        blk.var(student_var_name), blk.var(teacher_var_name)
+    )
+    return layers.reduce_mean(layers.square(diff))
+
+
+def fsp_loss(
+    teacher_var1_name, teacher_var2_name, student_var1_name,
+    student_var2_name, program=None,
+):
+    """Flow-of-solution-procedure loss (reference distiller.py fsp_loss):
+    L2 between teacher and student Gram matrices of two feature maps."""
+    from ...framework.program import default_main_program
+
+    program = program or default_main_program()
+    blk = program.global_block
+
+    def gram(a_name, b_name):
+        a, b = blk.var(a_name), blk.var(b_name)
+        n, ca = a.shape[0], a.shape[1]
+        cb = b.shape[1]
+        hw = int(a.shape[2]) * int(a.shape[3])
+        fa = layers.reshape(a, [n, ca, hw])
+        fb = layers.transpose(layers.reshape(b, [n, cb, hw]), [0, 2, 1])
+        return layers.scale(layers.matmul(fa, fb), scale=1.0 / hw)
+
+    diff = layers.elementwise_sub(
+        gram(student_var1_name, student_var2_name),
+        gram(teacher_var1_name, teacher_var2_name),
+    )
+    return layers.reduce_mean(layers.square(diff))

@@ -65,3 +65,65 @@ class LocalFS(FS):
 
     def download(self, remote_path, local_path):
         shutil.copytree(remote_path, local_path, dirs_exist_ok=True)
+
+
+class HadoopFS(FS):
+    """HDFS backend shelling out to `hadoop fs` — exactly the reference's
+    approach (framework/io/fs.cc hdfs_* commands, incubate/fleet/utils/
+    hdfs.py HDFSClient). Checkpoint payloads are written locally and moved
+    through upload/download, so only these six commands are needed."""
+
+    def __init__(self, hadoop_bin="hadoop", configs=None):
+        self._bin = hadoop_bin
+        self._cfg = []
+        for k, v in (configs or {}).items():
+            self._cfg += ["-D", f"{k}={v}"]
+
+    def _run(self, *args, check=True):
+        import subprocess
+
+        cmd = [self._bin, "fs"] + self._cfg + list(args)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if check and proc.returncode != 0:
+            raise RuntimeError(
+                f"hadoop fs {' '.join(args)} failed (rc={proc.returncode}): "
+                f"{proc.stderr.strip()}"
+            )
+        return proc
+
+    def list_dirs(self, path):
+        proc = self._run("-ls", path, check=False)
+        if proc.returncode != 0:
+            return []
+        out = []
+        for line in proc.stdout.splitlines():
+            parts = line.split()
+            # "drwxr-xr-x - user group 0 date time /path/dir"
+            if len(parts) >= 8 and parts[0].startswith("d"):
+                out.append(parts[-1].rstrip("/").rsplit("/", 1)[-1])
+        return sorted(out)
+
+    def is_exist(self, path):
+        return self._run("-test", "-e", path, check=False).returncode == 0
+
+    def mkdir(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path, check=False)
+
+    def mv(self, src, dst):
+        self._run("-mv", src, dst)
+
+    def upload(self, local_path, remote_path):
+        self._run("-put", "-f", local_path, remote_path)
+
+    def download(self, remote_path, local_path):
+        import os
+
+        # -get refuses an existing destination dir; fetch into it instead
+        os.makedirs(local_path, exist_ok=True)
+        proc = self._run("-get", f"{remote_path.rstrip('/')}/*", local_path,
+                         check=False)
+        if proc.returncode != 0:
+            self._run("-get", remote_path, local_path)

@@ -10,6 +10,19 @@ from ..param_attr import ParamAttr
 from .helper import LayerHelper
 
 
+def _per_layer(attr, layer):
+    """Suffix a user attr's name per stacked layer (a shared name would
+    silently alias one tensor across layers)."""
+    import copy
+
+    a = ParamAttr.to_attr(attr)
+    if a is None or getattr(a, "name", None) is None or not layer:
+        return attr
+    b = copy.copy(a)
+    b.name = f"{a.name}_l{layer}"
+    return b
+
+
 def _layer_attrs(kind, layer, param_attr):
     """(wih_attr, whh_attr, bias_attr) for one stacked layer. Names derive
     from the wih param name when one is given, so a second program (e.g. a
@@ -69,7 +82,7 @@ def lstm(
             default_initializer=Xavier(),
         )
         b = helper.create_parameter(
-            bias_attr if bias_attr is not None else b_attr,
+            _per_layer(bias_attr, layer) if bias_attr is not None else b_attr,
             [4 * hidden_size], "float32", is_bias=True,
         )
         ins = {"X": [x], "WIH": [wih], "WHH": [whh], "Bias": [b],
@@ -105,7 +118,7 @@ def gru(
             default_initializer=Xavier(),
         )
         b = helper.create_parameter(
-            bias_attr if bias_attr is not None else b_attr,
+            _per_layer(bias_attr, layer) if bias_attr is not None else b_attr,
             [3 * hidden_size], "float32", is_bias=True,
         )
         ins = {"X": [x], "WIH": [wih], "WHH": [whh], "Bias": [b],

@@ -374,11 +374,13 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id=1,
     """One beam expansion step (fluid layers.beam_search signature over
     beam_search_op; see ops/beam_search.py for the static-shape design).
 
-    scores: per-candidate LOG-PROBS [B, beam, V]; `ids` (candidate token
-    ids) is accepted for fluid parity and ignored — with a dense [.., V]
-    score tensor the candidate id IS the vocab index, as in fluid when
-    ids is None. Returns (selected_ids, selected_scores) like fluid, or
-    (+parent_idx) with return_parent_idx=True."""
+    scores [B, beam, V]: with is_accumulated=True (fluid default) these
+    already CONTAIN the prefix scores; pass is_accumulated=False for raw
+    per-step log-probs (pre_scores are then added internally). `ids`
+    (candidate token ids) is accepted for fluid parity and ignored — with
+    a dense [.., V] score tensor the candidate id IS the vocab index, as
+    in fluid when ids is None. Returns (selected_ids, selected_scores)
+    like fluid, or (+parent_idx) with return_parent_idx=True."""
     out = _simple(
         "beam_search",
         {"PreIds": [pre_ids], "PreScores": [pre_scores], "Scores": [scores]},

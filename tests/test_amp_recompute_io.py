@@ -207,3 +207,79 @@ def test_fleet_checkpoint_rotation_and_resume(tmp_path):
     )
     # cold start: empty dir -> TrainStatus(-1)
     assert fleet.load_check_point(exe, str(tmp_path / "none")).next() == 0
+
+
+def test_hadoop_fs_checkpoint_roundtrip(tmp_path):
+    """HadoopFS drives save/load_check_point through a fake `hadoop`
+    binary backed by a local dir (reference pattern: fs.cc shells out;
+    incubate/fleet/utils/hdfs.py tests used mocks the same way)."""
+    import os
+    import stat
+
+    store = tmp_path / "hdfs_store"
+    store.mkdir()
+    fake = tmp_path / "bin" / "hadoop"
+    fake.parent.mkdir()
+    # translate `hadoop fs -cmd args...` to local filesystem operations
+    fake.write_text(f"""#!/usr/bin/env python3
+import os, shutil, sys
+root = {str(store)!r}
+
+def loc(p):
+    return os.path.join(root, p.lstrip("/"))
+
+args = sys.argv[2:]  # drop 'fs'
+cmd, rest = args[0], args[1:]
+if cmd == "-ls":
+    d = loc(rest[0])
+    if not os.path.isdir(d):
+        sys.exit(1)
+    for n in sorted(os.listdir(d)):
+        kind = "d" if os.path.isdir(os.path.join(d, n)) else "-"
+        print(f"{{kind}}rwxr-xr-x - u g 0 d t {{rest[0].rstrip('/')}}/{{n}}")
+elif cmd == "-test":
+    sys.exit(0 if os.path.exists(loc(rest[1])) else 1)
+elif cmd == "-mkdir":
+    os.makedirs(loc(rest[-1]), exist_ok=True)
+elif cmd == "-rm":
+    p = loc(rest[-1])
+    shutil.rmtree(p, ignore_errors=True) if os.path.isdir(p) else (
+        os.path.exists(p) and os.remove(p))
+elif cmd == "-mv":
+    shutil.move(loc(rest[0]), loc(rest[1]))
+elif cmd == "-put":
+    src, dst = rest[-2], loc(rest[-1])
+    shutil.copytree(src, dst, dirs_exist_ok=True)
+elif cmd == "-get":
+    src = loc(rest[0].replace("/*", ""))
+    shutil.copytree(src, rest[1], dirs_exist_ok=True)
+else:
+    sys.exit(2)
+""")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+
+    from paddle_tpu.fleet import collective as fc
+    from paddle_tpu.fleet.fs_wrapper import HadoopFS
+    from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+
+    x = fluid.data("x", [-1, 4])
+    y = fluid.layers.fc(x, 2, param_attr=fluid.ParamAttr(name="hw"))
+    loss = fluid.layers.mean(y)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = fluid.framework.scope.global_scope()
+
+    fleet = fc.Fleet()
+    fleet.init(UserDefinedRoleMaker())
+    fs = HadoopFS(hadoop_bin=str(fake))
+    exe.run(feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[loss])
+    saved = np.asarray(scope.find_var("hw")).copy()
+    no = fleet.save_check_point(exe, "/ckpts", fc.TrainStatus(4), fs=fs)
+    assert no == 0
+    assert (store / "ckpts" / "__paddle_checkpoint__0").is_dir()
+
+    scope.set_var("hw", np.zeros_like(saved))
+    status = fleet.load_check_point(exe, "/ckpts", fs=fs)
+    assert status.next() == 5
+    np.testing.assert_allclose(np.asarray(scope.find_var("hw")), saved)

@@ -276,3 +276,15 @@ def test_beam_search_matches_numpy():
     )
     np.testing.assert_array_equal(got_seq[0], want_seqs)
     np.testing.assert_allclose(got_sc[0], want_sc, rtol=1e-5)
+
+
+def test_stacked_rnn_bias_not_aliased():
+    """num_layers=2 with a NAMED bias_attr must create distinct per-layer
+    biases (regression: layers silently shared one bias tensor)."""
+    x = fluid.data("x", [2, 3, 4])
+    layers.lstm(x, 5, num_layers=2,
+                param_attr=fluid.ParamAttr(name="sw"),
+                bias_attr=fluid.ParamAttr(name="sb"))
+    names = set(fluid.default_main_program().global_block.vars)
+    assert "sb" in names and "sb_l1" in names
+    assert "sw" in names and "sw_l1" in names

@@ -293,3 +293,65 @@ def test_declarative_distinguishes_layer_instances():
         w2 = np.asarray(n2.weight.value).sum() * 2
         assert r1 == pytest.approx(w1, rel=1e-5)
         assert r2 == pytest.approx(w2, rel=1e-5)
+
+
+def test_distillation_merge_and_soft_label():
+    """Student learns to match a fixed teacher through the merged program
+    (reference slim distillation flow: merge -> soft_label_loss -> train)."""
+    from paddle_tpu.contrib.slim.distillation import merge, soft_label_loss
+
+    scope = fluid.framework.scope.global_scope()
+
+    # teacher: a fixed random linear projection (trained stand-in)
+    teacher = fluid.Program()
+    t_start = fluid.Program()
+    with fluid.program_guard(teacher, t_start):
+        tx = fluid.data("x", [16, 8])
+        t_logits = layers.fc(
+            tx, 4, param_attr=fluid.ParamAttr(name="t_w"),
+            bias_attr=fluid.ParamAttr(name="t_b"),
+        )
+    exe = fluid.Executor()
+    exe.run(t_start)
+
+    # student program with its own tower
+    s_logits = layers.fc(
+        fluid.data("x", [16, 8]), 4,
+        param_attr=fluid.ParamAttr(name="s_w"),
+        bias_attr=fluid.ParamAttr(name="s_b"),
+    )
+    main = fluid.default_main_program()
+    merge(teacher, main, {"x": "x"}, scope=scope)
+    assert main.global_block.has_var("teacher_" + t_logits.name)
+    loss = soft_label_loss("teacher_" + t_logits.name, s_logits.name)
+    fluid.optimizer.Adam(0.05).minimize(loss)
+    # teacher params must stay frozen
+    tw_before = np.asarray(scope.find_var("teacher_t_w")).copy()
+
+    exe.run(fluid.default_startup_program())
+    scope.set_var("teacher_t_w", tw_before)  # startup may re-init; restore
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(120):
+        (lv,) = exe.run(
+            feed={"x": rng.randn(16, 8).astype(np.float32)},
+            fetch_list=[loss],
+        )
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.8
+    np.testing.assert_allclose(
+        np.asarray(scope.find_var("teacher_t_w")), tw_before
+    )
+    # student mimics teacher: logits close on fresh data
+    xv = rng.randn(16, 8).astype(np.float32)
+    sw = np.asarray(scope.find_var("s_w"))
+    sb = np.asarray(scope.find_var("s_b"))
+    tw = np.asarray(scope.find_var("teacher_t_w"))
+    tb = np.asarray(scope.find_var("teacher_t_b"))
+    s_out = xv @ sw + sb
+    t_out = xv @ tw + tb
+    # compare softmax distributions (soft-label target)
+    def softmax(z):
+        e = np.exp(z - z.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+    assert np.abs(softmax(s_out) - softmax(t_out)).max() < 0.2
