@@ -55,17 +55,20 @@ def _conv2d(ctx, op, ins):
         dilations,
     )
     groups = op.attr("groups", 1) or 1
+    # compute in NHWC: XLA:TPU lowers NCHW convs ~20x slower on v5e (no
+    # automatic relayout); the wrapping transposes fuse into neighbors.
+    # The public op contract stays NCHW (fluid layout).
     out = lax.conv_general_dilated(
-        x,
-        w,
+        jnp.transpose(x, (0, 2, 3, 1)),
+        jnp.transpose(w, (2, 3, 1, 0)),
         window_strides=strides,
         padding=pads,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
         preferred_element_type=None,
     )
-    return {"Output": [out]}
+    return {"Output": [jnp.transpose(out, (0, 3, 1, 2))]}
 
 
 @register_op("depthwise_conv2d", inputs=["Input", "Filter"], outputs=["Output"])
@@ -91,16 +94,17 @@ def _conv2d_transpose(ctx, op, ins):
     # per-group swap to OIHW: [g, in_c/g, oc/g, kh, kw] -> [oc, in_c/g, kh, kw]
     w_t = jnp.flip(w, axis=(2, 3)).reshape(g, in_c // g, oc_g, kh, kw)
     w_t = w_t.transpose(0, 2, 1, 3, 4).reshape(g * oc_g, in_c // g, kh, kw)
+    # NHWC internally (see _conv2d)
     out = lax.conv_general_dilated(
-        x,
-        w_t,
+        jnp.transpose(x, (0, 2, 3, 1)),
+        jnp.transpose(w_t, (2, 3, 1, 0)),
         window_strides=[1, 1],
         padding=pads,
         lhs_dilation=strides,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=g,
     )
-    return {"Output": [out]}
+    return {"Output": [jnp.transpose(out, (0, 3, 1, 2))]}
 
 
 @register_op("pool2d", inputs=["X"], outputs=["Out"])
