@@ -4,9 +4,9 @@ dropout, interpolation, losses.
 Reference parity: operators/conv_op.cc (+conv_cudnn_op.cu), pool_op.cc,
 batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc, lookup_table_v2_op.cc,
 cross_entropy_op.cc, softmax_with_cross_entropy_op.cc, smooth_l1_loss,
-huber_loss, squared_l2 — as XLA emitters. Convs use lax.conv_general_dilated
-(NCHW to match the fluid API; XLA:TPU relayouts to its native tiling
-internally, so no NHWC pass is needed). BatchNorm running stats are expressed
+huber_loss, squared_l2 — as XLA emitters. Convs keep the fluid NCHW contract
+at the op boundary but compute in NHWC internally (_nhwc_conv): XLA:TPU lowers
+NCHW convs ~20x slower on v5e. BatchNorm running stats are expressed
 functionally: MeanOut/VarianceOut are op outputs the Executor writes back to
 the Scope (the reference mutates them in place, batch_norm_op.cc).
 """
@@ -42,6 +42,20 @@ def _conv_pads(paddings, algorithm, ksize, strides, dilations):
     return [(p[0], p[1]), (p[2], p[3])]
 
 
+def _nhwc_conv(x, w_oihw, **conv_kwargs):
+    """conv_general_dilated computed in NHWC: XLA:TPU lowers NCHW convs ~20x
+    slower on v5e (no automatic relayout); the wrapping transposes fuse into
+    neighbors. Takes/returns NCHW (the public fluid op contract), weights
+    OIHW."""
+    out = lax.conv_general_dilated(
+        jnp.transpose(x, (0, 2, 3, 1)),
+        jnp.transpose(w_oihw, (2, 3, 1, 0)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        **conv_kwargs,
+    )
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
 @register_op("conv2d", inputs=["Input", "Filter"], outputs=["Output"])
 def _conv2d(ctx, op, ins):
     x, w = ins["Input"][0], ins["Filter"][0]
@@ -55,20 +69,15 @@ def _conv2d(ctx, op, ins):
         dilations,
     )
     groups = op.attr("groups", 1) or 1
-    # compute in NHWC: XLA:TPU lowers NCHW convs ~20x slower on v5e (no
-    # automatic relayout); the wrapping transposes fuse into neighbors.
-    # The public op contract stays NCHW (fluid layout).
-    out = lax.conv_general_dilated(
-        jnp.transpose(x, (0, 2, 3, 1)),
-        jnp.transpose(w, (2, 3, 1, 0)),
+    out = _nhwc_conv(
+        x,
+        w,
         window_strides=strides,
         padding=pads,
         rhs_dilation=dilations,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
-        preferred_element_type=None,
     )
-    return {"Output": [jnp.transpose(out, (0, 3, 1, 2))]}
+    return {"Output": [out]}
 
 
 @register_op("depthwise_conv2d", inputs=["Input", "Filter"], outputs=["Output"])
@@ -94,17 +103,15 @@ def _conv2d_transpose(ctx, op, ins):
     # per-group swap to OIHW: [g, in_c/g, oc/g, kh, kw] -> [oc, in_c/g, kh, kw]
     w_t = jnp.flip(w, axis=(2, 3)).reshape(g, in_c // g, oc_g, kh, kw)
     w_t = w_t.transpose(0, 2, 1, 3, 4).reshape(g * oc_g, in_c // g, kh, kw)
-    # NHWC internally (see _conv2d)
-    out = lax.conv_general_dilated(
-        jnp.transpose(x, (0, 2, 3, 1)),
-        jnp.transpose(w_t, (2, 3, 1, 0)),
+    out = _nhwc_conv(
+        x,
+        w_t,
         window_strides=[1, 1],
         padding=pads,
         lhs_dilation=strides,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=g,
     )
-    return {"Output": [jnp.transpose(out, (0, 3, 1, 2))]}
+    return {"Output": [out]}
 
 
 @register_op("pool2d", inputs=["X"], outputs=["Out"])

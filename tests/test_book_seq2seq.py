@@ -19,6 +19,9 @@ BOS, EOS = 0, 1
 @pytest.fixture(autouse=True)
 def fresh_programs():
     main, startup = fluid.Program(), fluid.Program()
+    # seed 0 = fluid's "nondeterministic" mode (per-instance nonce), which
+    # makes the convergence/beam-decode assertions stochastic — pin them
+    main.random_seed = startup.random_seed = 2024
     scope = fluid.framework.scope.Scope()
     with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
             unique_name.guard():
