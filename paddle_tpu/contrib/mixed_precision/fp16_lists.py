@@ -16,6 +16,8 @@ WHITE_LIST = {
     "conv2d",
     "depthwise_conv2d",
     "conv2d_transpose",
+    # Pallas flash kernel: bf16 in/out, fp32 softmax internally
+    "fused_multihead_attention",
 }
 
 BLACK_LIST = {

@@ -1,0 +1,11 @@
+"""Hand-written Pallas TPU kernels for ops where XLA fusion is not enough.
+
+The reference hand-fused its hot paths in CUDA (operators/fused/
+multihead_matmul_op.cu, fused_embedding_seq_pool, bert_encoder_functor.cu);
+here the same role is played by Pallas/Mosaic kernels. Most ops do NOT need
+this — the whole-block jit executor already lets XLA fuse elementwise chains
+into matmuls — so kernels live here only when they change the memory-traffic
+complexity class (e.g. flash attention: O(S^2) HBM -> O(S)).
+"""
+
+from .flash_attention import fused_attention  # noqa: F401

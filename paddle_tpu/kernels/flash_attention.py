@@ -1,0 +1,334 @@
+"""Fused multihead attention as a Pallas TPU kernel (flash-attention style).
+
+Replaces the reference's CUDA hand fusion (operators/fused/
+multihead_matmul_op.cu, math/bert_encoder_functor.cu) with the TPU
+equivalent: one Mosaic kernel per (batch, head) that computes
+softmax(QK^T * scale + bias) V without ever writing the [S, S] probability
+matrix to HBM. At BERT-base shapes (S=512) the probs tensor is the single
+largest HBM stream in the dense formulation; keeping it in VMEM is the
+memory-complexity win XLA cannot get on its own (it will not re-associate
+softmax across two matmuls).
+
+Semantics match the composed fluid ops exactly (matmul -> softmax ->
+dropout -> matmul), including fluid's "downgrade_in_infer" dropout
+(train: drop without rescale; infer: scale by 1-p — dropout_op.cc).
+
+Backward is a second Pallas kernel over the same grid that recomputes the
+probabilities from (q, k, v) — flash attention's standard recompute trade —
+and regenerates the identical dropout mask from the same hardware PRNG seed,
+so no mask tensor is ever materialized (the reference saves an explicit
+uint8 mask; determinism makes that free here).
+
+Scope: whole-row kernel — each grid step owns a full [S, S] score tile in
+VMEM, so S is capped (fp32 scores: S=1024 -> 4 MB). Long-context beyond the
+cap is the job of sequence parallelism (parallel/ring_attention.py), which
+shards S before attention runs. Dispatch:
+  * TPU backend  -> Pallas kernels (fwd + custom-vjp bwd)
+  * other        -> jnp reference (same math; CPU tests + sharded fallback)
+  * interpret=True forces the kernel through the Mosaic interpreter on CPU
+    (kernel-logic tests; the interpreter's prng_random_bits is a zero stub,
+    so dropout>0 training is TPU-only through the kernel path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+# whole-row kernel holds an [S, S] fp32 score tile in VMEM
+MAX_SEQ = 1024
+
+
+def supports(seq_len: int, head_dim: int, dtype) -> bool:
+    """Can the Pallas kernel take these shapes? (else: jnp reference)."""
+    return (
+        seq_len % 128 == 0
+        and seq_len <= MAX_SEQ
+        and head_dim % 8 == 0
+        and jnp.dtype(dtype) in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
+    )
+
+
+def _probs(q, k, bias_row, scale, causal):
+    """fp32 softmax probabilities for one head: q [S,D], k [S,D], bias [1,S]."""
+    s = jnp.dot(
+        q.astype(jnp.float32),
+        k.astype(jnp.float32).T,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = s + bias_row  # [1,S] broadcasts over query rows
+    if causal:
+        n = s.shape[0]
+        row = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+        s = jnp.where(col <= row, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    return p / jnp.sum(p, axis=-1, keepdims=True)
+
+
+def _seed_prng(seed_ref):
+    # Mosaic accepts at most 2 seed words; mix the (batch, head) grid index
+    # in arithmetically (Knuth/Murmur multiplicative constants, uint32 wrap)
+    head = (
+        pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+    ).astype(jnp.uint32)
+    s0 = seed_ref[0] + head * jnp.uint32(0x9E3779B1)
+    s1 = seed_ref[1] ^ (head * jnp.uint32(0x85EBCA6B))
+    pltpu.prng_seed(s0, s1)
+
+
+def _keep_mask(shape, rate):
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    # clamp to uint32 range: rate=1.0 would otherwise overflow (keeping a
+    # ~2^-32 sliver of probability mass is the cost of the clamp)
+    thresh = np.uint32(min(int(rate * 2**32), 0xFFFFFFFF))
+    return bits >= thresh
+
+
+def _apply_dropout(p, rate, is_test, upscale):
+    """fluid dropout semantics on probabilities p (static rate/flags)."""
+    if rate == 0.0:
+        return p
+    if is_test:
+        return p if upscale else p * (1.0 - rate)
+    keep = _keep_mask(p.shape, rate)
+    dropped = jnp.where(keep, p / (1.0 - rate) if upscale else p, 0.0)
+    return dropped
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+                *, scale, rate, is_test, upscale, causal):
+    q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+    if rate > 0.0 and not is_test:
+        _seed_prng(seed_ref)
+    p = _probs(q, k, bias_ref[0], scale, causal)
+    p = _apply_dropout(p, rate, is_test, upscale)
+    o_ref[0, 0] = jnp.dot(
+        p, v.astype(jnp.float32), preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+
+
+def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, dbias_ref,
+                *, scale, rate, is_test, upscale, causal):
+    q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    if rate > 0.0 and not is_test:
+        # identical seeding sequence as _fwd_kernel -> identical mask
+        _seed_prng(seed_ref)
+    p = _probs(q, k, bias_ref[0], scale, causal)
+    kf = k.astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if rate > 0.0 and not is_test:
+        keep = _keep_mask(p.shape, rate)
+        inv = 1.0 / (1.0 - rate) if upscale else 1.0
+        pm = jnp.where(keep, p * inv, 0.0)
+        dpm = jnp.dot(do, vf.T, preferred_element_type=jnp.float32)
+        dp = jnp.where(keep, dpm * inv, 0.0)
+    else:
+        test_scale = 1.0 if (rate == 0.0 or upscale) else 1.0 - rate
+        pm = p * test_scale
+        dpm = jnp.dot(do, vf.T, preferred_element_type=jnp.float32)
+        dp = dpm * test_scale
+    dv_ref[0, 0] = jnp.dot(pm.T, do, preferred_element_type=jnp.float32).astype(
+        dv_ref.dtype
+    )
+    # softmax backward: dS = P * (dP - rowsum(dP * P))
+    d = jnp.sum(dp * p, axis=-1, keepdims=True)
+    ds = p * (dp - d)
+    dq_ref[0, 0] = (
+        jnp.dot(ds, kf, preferred_element_type=jnp.float32) * scale
+    ).astype(dq_ref.dtype)
+    dk_ref[0, 0] = (
+        jnp.dot(ds.T, qf, preferred_element_type=jnp.float32) * scale
+    ).astype(dk_ref.dtype)
+    # bias broadcasts over heads and query rows -> grad reduces over both.
+    # The h grid axis is innermost, so this output block (indexed by b only)
+    # stays resident while heads accumulate into it.
+    db = jnp.sum(ds, axis=0, keepdims=True)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        dbias_ref[0] = db
+
+    @pl.when(pl.program_id(1) != 0)
+    def _acc():
+        dbias_ref[0] = dbias_ref[0] + db
+
+
+def _head_spec(S, D):
+    return pl.BlockSpec(
+        (1, 1, S, D), lambda b, h: (b, h, 0, 0), memory_space=pltpu.VMEM
+    )
+
+
+def _bias_spec(S):
+    # bias is passed as [B, 1, S]: a (1, 1, S) block's trailing two dims
+    # equal the array's, satisfying Mosaic's (8, 128)-divisibility rule
+    return pl.BlockSpec(
+        (1, 1, S), lambda b, h: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+
+
+def _pallas_fwd(q, k, v, bias, seed, statics, interpret):
+    B, H, S, D = q.shape
+    bias = bias.reshape(B, 1, S)
+    kern = functools.partial(_fwd_kernel, **statics)
+    return pl.pallas_call(
+        kern,
+        grid=(B, H),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            _head_spec(S, D),
+            _head_spec(S, D),
+            _head_spec(S, D),
+            _bias_spec(S),
+        ],
+        out_specs=_head_spec(S, D),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed, q, k, v, bias)
+
+
+def _pallas_bwd(q, k, v, bias, seed, do, statics, interpret):
+    B, H, S, D = q.shape
+    bias = bias.reshape(B, 1, S)
+    kern = functools.partial(_bwd_kernel, **statics)
+    dq, dk, dv, dbias = pl.pallas_call(
+        kern,
+        grid=(B, H),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            _head_spec(S, D),
+            _head_spec(S, D),
+            _head_spec(S, D),
+            _bias_spec(S),
+            _head_spec(S, D),
+        ],
+        out_specs=[
+            _head_spec(S, D),
+            _head_spec(S, D),
+            _head_spec(S, D),
+            _bias_spec(S),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(bias.shape, jnp.float32),
+        ],
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed, q, k, v, bias, do)
+    return dq, dk, dv, dbias.reshape(B, S)
+
+
+def _reference(q, k, v, bias, rng_key, *, scale, rate, is_test, upscale,
+               causal):
+    """Same math as the kernels in plain jnp (CPU path / oracle). Dropout
+    masks come from jax.random instead of the TPU hardware PRNG — same
+    distribution, different stream."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+    ) * scale
+    s = s + bias[:, None, None, :]
+    if causal:
+        S = s.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        s = jnp.where((col <= row)[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if rate > 0.0:
+        if is_test:
+            p = p if upscale else p * (1.0 - rate)
+        else:
+            keep = jax.random.bernoulli(rng_key, 1.0 - rate, p.shape)
+            p = jnp.where(keep, p / (1.0 - rate) if upscale else p, 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash(q, k, v, bias, seed, statics, interpret):
+    return _pallas_fwd(q, k, v, bias, seed, dict(statics), interpret)
+
+
+def _flash_fwd(q, k, v, bias, seed, statics, interpret):
+    out = _pallas_fwd(q, k, v, bias, seed, dict(statics), interpret)
+    return out, (q, k, v, bias, seed)
+
+
+def _flash_bwd(statics, interpret, res, g):
+    q, k, v, bias, seed = res
+    dq, dk, dv, dbias = _pallas_bwd(
+        q, k, v, bias, seed, g, dict(statics), interpret
+    )
+    dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dbias, dseed
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def fused_attention(
+    q,
+    k,
+    v,
+    key_bias=None,
+    *,
+    scale=None,
+    dropout_rate=0.0,
+    is_test=True,
+    dropout_implementation="downgrade_in_infer",
+    causal=False,
+    rng_key=None,
+    interpret=False,
+    force_reference=False,
+):
+    """softmax(q k^T * scale + key_bias) v with fused dropout.
+
+    q, k, v: [B, H, S, D]; key_bias: additive [B, S] fp32 (e.g. padding mask
+    as 0 / -1e4), broadcast over heads and query positions. Differentiable
+    in q, k, v, key_bias. `rng_key` (a jax PRNG key) feeds dropout; required
+    when dropout_rate > 0 and not is_test.
+    """
+    B, H, S, D = q.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    upscale = dropout_implementation == "upscale_in_train"
+    statics = dict(
+        scale=float(scale),
+        rate=float(dropout_rate),
+        is_test=bool(is_test),
+        upscale=upscale,
+        causal=bool(causal),
+    )
+    if key_bias is None:
+        bias = jnp.zeros((B, S), jnp.float32)
+    else:
+        bias = key_bias.astype(jnp.float32)
+    training_dropout = dropout_rate > 0.0 and not is_test
+    if rng_key is None:
+        if training_dropout:
+            raise ValueError("fused_attention: dropout needs rng_key")
+        rng_key = jax.random.key(0)
+    use_pallas = not force_reference and (
+        interpret
+        or (jax.default_backend() == "tpu" and supports(S, D, q.dtype))
+    )
+    if not use_pallas:
+        return _reference(q, k, v, bias, rng_key, **statics)
+    seed = jnp.ravel(jax.random.key_data(rng_key)).astype(jnp.uint32)[:2]
+    if seed.shape[0] < 2:  # rbg/other impls may expose a single word
+        seed = jnp.concatenate([seed, jnp.zeros(1, jnp.uint32)])
+    return _flash(q, k, v, bias, seed, tuple(statics.items()), interpret)

@@ -20,6 +20,41 @@ def _attn(op_type, q, k, v, axis_name, causal, scale, name):
     )
 
 
+def fused_multihead_attention(
+    q,
+    k,
+    v,
+    key_bias=None,
+    scale=None,
+    dropout_prob=0.0,
+    is_test=False,
+    dropout_implementation="downgrade_in_infer",
+    causal=False,
+    name=None,
+):
+    """softmax(q k^T * scale + key_bias) v in one op — the Pallas flash
+    kernel on TPU (kernels/flash_attention.py), jnp reference elsewhere.
+
+    q/k/v: [B, H, S, D]; key_bias: optional additive [B, S] (0 keep /
+    -1e4 mask). Dropout applies to attention probabilities with fluid
+    dropout semantics. Reference: the fused CUDA attention of
+    operators/fused/multihead_matmul_op.cu, generalized with mask+dropout.
+    """
+    helper = LayerHelper("fused_multihead_attention", name=name)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if key_bias is not None:
+        inputs["KeyBias"] = [key_bias]
+    attrs = {
+        "dropout_prob": dropout_prob,
+        "is_test": is_test,
+        "dropout_implementation": dropout_implementation,
+        "causal": causal,
+    }
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    return helper.create_and_append(inputs, attrs)
+
+
 def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
                    name=None):
     """q,k,v: [B, H, S, D] with S sharded over `axis_name` under SPMD."""

@@ -33,6 +33,7 @@ class BertConfig:
         hidden_dropout=0.1,
         attention_dropout=0.1,
         initializer_range=0.02,
+        use_fused_attention=True,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -44,6 +45,11 @@ class BertConfig:
         self.hidden_dropout = hidden_dropout
         self.attention_dropout = attention_dropout
         self.initializer_range = initializer_range
+        # one fused attention op (Pallas flash kernel on TPU) vs composed
+        # matmul/softmax/dropout ops. The composed path is what TP/gspmd
+        # sharding tests exercise; the fused op itself degrades to the same
+        # math when the kernel cannot run (see ops/fused.py).
+        self.use_fused_attention = use_fused_attention
 
     @classmethod
     def base(cls):
@@ -89,14 +95,29 @@ def _attention(x, attn_bias, cfg, prefix, is_test):
     q = head(layers.slice(qkv, [2], [0], [h]))
     k = head(layers.slice(qkv, [2], [h], [2 * h]))
     v = head(layers.slice(qkv, [2], [2 * h], [3 * h]))
-    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(dh))
-    if attn_bias is not None:
-        scores = scores + attn_bias  # [B,1,1,S] additive mask broadcast
-    probs = layers.softmax(scores, axis=-1)
-    probs = layers.dropout(
-        probs, dropout_prob=cfg.attention_dropout, is_test=is_test
-    )
-    ctxv = layers.matmul(probs, v)  # [B,nh,S,dh]
+    if cfg.use_fused_attention:
+        # one op: Pallas flash kernel on TPU (never materializes the
+        # [B,nh,S,S] probs to HBM), jnp reference elsewhere — attn_bias here
+        # is the [B,S] key mask (0 keep / -1e4 pad)
+        ctxv = layers.fused_multihead_attention(
+            q, k, v, key_bias=attn_bias,
+            scale=1.0 / math.sqrt(dh),
+            dropout_prob=cfg.attention_dropout, is_test=is_test,
+        )
+    else:
+        bias4 = None
+        if attn_bias is not None:
+            bias4 = layers.reshape(attn_bias, [b, 1, 1, s])
+        scores = layers.matmul(
+            q, k, transpose_y=True, alpha=1.0 / math.sqrt(dh)
+        )
+        if bias4 is not None:
+            scores = scores + bias4  # [B,1,1,S] additive mask broadcast
+        probs = layers.softmax(scores, axis=-1)
+        probs = layers.dropout(
+            probs, dropout_prob=cfg.attention_dropout, is_test=is_test
+        )
+        ctxv = layers.matmul(probs, v)  # [B,nh,S,dh]
     ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
     ctxv = layers.reshape(ctxv, [b, s, h])
     return _dense(ctxv, h, f"{prefix}_out", cfg)
@@ -123,11 +144,10 @@ def _encoder_layer(x, attn_bias, cfg, prefix, is_test):
 
 
 def _attn_bias(input_mask):
-    """[B,S] float mask -> additive attention bias [B,1,1,S]
-    (0 keep, -1e4 mask; bf16-safe)."""
-    b, s = input_mask.shape
-    mask = layers.reshape(input_mask, [b, 1, 1, s])
-    return layers.scale(mask, scale=1e4, bias=-1e4)
+    """[B,S] float mask -> additive key-side attention bias [B,S]
+    (0 keep, -1e4 mask; bf16-safe). Kept 2-D: the fused attention op takes
+    the key bias directly, the dense path reshapes to [B,1,1,S]."""
+    return layers.scale(input_mask, scale=1e4, bias=-1e4)
 
 
 def bert_encoder_layers(x, input_mask, cfg, start=0, end=None, is_test=False,

@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     collective,
     control_flow,
     detection,
+    fused,
     math,
     metrics,
     nn,

@@ -1,0 +1,60 @@
+"""Fused ops backed by hand-written Pallas kernels (paddle_tpu.kernels).
+
+Reference parity: paddle/fluid/operators/fused/ — the reference fuses its
+transformer hot path by hand in CUDA (multihead_matmul_op.cu,
+fused_embedding_eltwise_layernorm). The TPU analogue keeps most fusion in
+XLA (whole-block jit), and drops to Pallas only where the fusion changes
+HBM-traffic complexity: attention. See kernels/flash_attention.py.
+"""
+
+from __future__ import annotations
+
+from ..framework.registry import register_op
+
+
+@register_op(
+    "fused_multihead_attention",
+    inputs=["Q", "K", "V", "KeyBias"],
+    outputs=["Out"],
+)
+def _fused_multihead_attention(ctx, op, ins):
+    """softmax(QK^T * scale + KeyBias) V with fused attention-prob dropout.
+
+    Q/K/V: [B, H, S, D]; KeyBias (optional): additive [B, S] fp32. On TPU
+    this lowers to the Pallas flash kernel; elsewhere (CPU tests, or shapes
+    the kernel does not support) it falls back to the jnp reference with
+    identical semantics. Under gspmd-mode SPMD (mesh annotations without
+    shard_map) the reference path is forced: GSPMD cannot partition a
+    pallas_call, while inside shard_map the kernel sees local shards and is
+    safe.
+    """
+    from ..kernels.flash_attention import fused_attention
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = ins.get("KeyBias", [None])[0] if ins.get("KeyBias") else None
+    is_test = bool(op.attr("is_test", False)) or ctx.is_test
+    rate = float(op.attr("dropout_prob", 0.0))
+    rng_key = None
+    if rate > 0.0 and not is_test:
+        rng_key = ctx.key_for(op.uid, op.type)
+    gspmd_mode = (
+        not ctx.mesh_axes
+        and ctx.program is not None
+        and getattr(ctx.program, "_mesh", None) is not None
+    )
+    out = fused_attention(
+        q,
+        k,
+        v,
+        key_bias=bias,
+        scale=op.attr("scale", None),
+        dropout_rate=rate,
+        is_test=is_test,
+        dropout_implementation=op.attr(
+            "dropout_implementation", "downgrade_in_infer"
+        ),
+        causal=bool(op.attr("causal", False)),
+        rng_key=rng_key,
+        force_reference=gspmd_mode,
+    )
+    return {"Out": [out]}
