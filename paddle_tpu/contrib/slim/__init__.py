@@ -1,1 +1,1 @@
-from . import distillation, quantization  # noqa: F401
+from . import analysis, distillation, nas, prune, quantization  # noqa: F401

@@ -1,0 +1,60 @@
+"""Model analysis: FLOPs estimation over a Program.
+
+Reference parity: PaddleSlim's flops() util (the slim strategies in
+contrib/slim/prune/prune_strategy.py steer on a FLOPs budget). Counts
+multiply-accumulates of the MXU-bound ops from recorded var shapes,
+including static batch dims; -1 batch dims count as 1, so fully
+batch-agnostic programs report per-sample FLOPs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _d(x):
+    return 1 if x in (-1, None) else int(x)
+
+
+def flops(program, detail=False):
+    """Per-sample forward multiply-add FLOPs (2*MACs) of conv2d / mul /
+    matmul ops in the program. detail=True also returns {op_idx: flops}."""
+    total = 0
+    per_op = {}
+    block = program.global_block
+    for i, op in enumerate(block.ops):
+        f = 0
+        if op.type in ("conv2d", "depthwise_conv2d"):
+            w = block._find_var_recursive(op.inputs["Filter"][0])
+            out = block._find_var_recursive(op.outputs["Output"][0])
+            if w is None or out is None or not out.shape:
+                continue
+            oc, ic_g, kh, kw = (int(s) for s in w.shape)
+            batch, oh, ow = _d(out.shape[0]), _d(out.shape[-2]), _d(out.shape[-1])
+            f = 2 * batch * oc * ic_g * kh * kw * oh * ow
+        elif op.type == "mul":
+            w = block._find_var_recursive(op.inputs["Y"][0])
+            x = block._find_var_recursive(op.inputs["X"][0])
+            if w is None or x is None or not w.shape:
+                continue
+            k = int(np.prod([_d(s) for s in w.shape[:-1]]))
+            n = _d(w.shape[-1])
+            ncol = op.attr("x_num_col_dims", 1)
+            m = int(np.prod([_d(s) for s in (x.shape or ())[:ncol]]))
+            f = 2 * m * k * n
+        elif op.type == "matmul":
+            a = block._find_var_recursive(op.inputs["X"][0])
+            b = block._find_var_recursive(op.inputs["Y"][0])
+            if a is None or b is None or not a.shape or not b.shape:
+                continue
+            ash = [_d(s) for s in a.shape]
+            bsh = [_d(s) for s in b.shape]
+            m = ash[-1] if op.attr("transpose_x", False) else ash[-2]
+            k = ash[-2] if op.attr("transpose_x", False) else ash[-1]
+            n = bsh[-2] if op.attr("transpose_y", False) else bsh[-1]
+            batch = int(np.prod(ash[:-2])) if len(ash) > 2 else 1
+            f = 2 * batch * m * k * n
+        if f:
+            total += f
+            per_op[i] = f
+    return (total, per_op) if detail else total

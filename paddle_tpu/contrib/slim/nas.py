@@ -1,0 +1,241 @@
+"""Neural architecture search (reference python/paddle/fluid/contrib/slim/:
+searcher/controller.py SAController, nas/search_space.py SearchSpace,
+nas/controller_server.py + nas/search_agent.py socket protocol,
+nas/light_nas_strategy.py orchestration).
+
+TPU-native framing: a candidate architecture is just a token vector that a
+SearchSpace turns into a fresh Program; "evaluate" is a handful of jitted
+train/eval steps on the chip (whole-block compile makes small candidate nets
+cheap to stand up), and the latency constraint is scored with the static
+FLOPs estimator (analysis.flops) instead of wall-clock on a shared chip.
+The controller is plain simulated annealing over tokens; a tiny TCP
+server/agent pair lets multiple hosts search against one annealing chain
+(the reference's ControllerServer pattern — its "d;a;r" wire format is
+replaced with a JSON line protocol)."""
+
+from __future__ import annotations
+
+import json
+import math
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+
+class SearchSpace:
+    """Subclass contract (reference nas/search_space.py): tokens <-> nets."""
+
+    def init_tokens(self):
+        raise NotImplementedError
+
+    def range_table(self):
+        """list<int>: tokens[i] ranges over [0, range_table[i])."""
+        raise NotImplementedError
+
+    def create_net(self, tokens):
+        """tokens -> (startup_program, train_program, eval_program,
+        train_fetch, eval_fetch)."""
+        raise NotImplementedError
+
+    def get_model_latency(self, program):
+        """Proxy latency score; default = static FLOPs (analysis.flops)."""
+        from .analysis import flops
+
+        return float(flops(program))
+
+
+class EvolutionaryController:
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+    def reset(self, range_table, constrain_func=None):
+        raise NotImplementedError
+
+    def next_tokens(self):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing over token vectors (reference
+    searcher/controller.py:59): mutate one position, accept worse solutions
+    with prob exp(-(best - reward) / T), geometric cooling."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=None):
+        self._range_table = list(range_table or [])
+        self._reduce_rate = float(reduce_rate)
+        self._init_temperature = float(init_temperature)
+        self._max_iter_number = int(max_iter_number)
+        self._reward = -np.inf
+        self._tokens = None
+        self._constrain_func = None
+        self._iter = 0
+        self._rng = np.random.RandomState(seed)
+        self.best_tokens = None
+        self.best_reward = -np.inf
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._tokens = list(init_tokens)
+        self._constrain_func = constrain_func
+        self._reward = -np.inf
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        """Accept/reject `tokens` given its measured reward."""
+        self._iter += 1
+        temperature = self._init_temperature * (
+            self._reduce_rate ** self._iter
+        )
+        if (reward > self._reward) or (
+            self._rng.uniform()
+            < math.exp(min((reward - self._reward) / max(temperature, 1e-9),
+                           0.0))
+        ):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self.best_reward:
+            self.best_reward = reward
+            self.best_tokens = list(tokens)
+
+    def next_tokens(self):
+        for _ in range(self._max_iter_number):
+            tokens = list(self._tokens)
+            i = int(self._rng.randint(len(tokens)))
+            tokens[i] = int(self._rng.randint(self._range_table[i]))
+            if self._constrain_func is None or self._constrain_func(tokens):
+                return tokens
+        return list(self._tokens)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        line = self.rfile.readline()
+        if not line:
+            return
+        msg = json.loads(line)
+        server = self.server.nas_server
+        with server._lock:
+            if msg["cmd"] == "next_tokens":
+                out = {"tokens": server.controller.next_tokens()}
+            elif msg["cmd"] == "update":
+                server.controller.update(msg["tokens"], float(msg["reward"]))
+                out = {"ok": True,
+                       "best_reward": server.controller.best_reward}
+            elif msg["cmd"] == "best":
+                out = {"tokens": server.controller.best_tokens,
+                       "reward": server.controller.best_reward}
+            else:
+                out = {"error": f"unknown cmd {msg['cmd']!r}"}
+        self.wfile.write((json.dumps(out) + "\n").encode())
+
+
+class ControllerServer:
+    """Serve one annealing chain to remote SearchAgents over TCP (reference
+    nas/controller_server.py, JSON-lines instead of its ad-hoc format)."""
+
+    def __init__(self, controller, address=("127.0.0.1", 0)):
+        self.controller = controller
+        self._lock = threading.Lock()
+        self._srv = socketserver.ThreadingTCPServer(
+            address, _Handler, bind_and_activate=True
+        )
+        self._srv.daemon_threads = True
+        self._srv.nas_server = self
+        self._thread = None
+
+    @property
+    def address(self):
+        return self._srv.server_address
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class SearchAgent:
+    """Client side (reference nas/search_agent.py)."""
+
+    def __init__(self, server_address):
+        self.server_address = tuple(server_address)
+
+    def _call(self, payload):
+        with socket.create_connection(self.server_address, timeout=30) as s:
+            s.sendall((json.dumps(payload) + "\n").encode())
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+        return json.loads(buf)
+
+    def next_tokens(self):
+        return self._call({"cmd": "next_tokens"})["tokens"]
+
+    def update(self, tokens, reward):
+        return self._call(
+            {"cmd": "update", "tokens": list(tokens), "reward": float(reward)}
+        )
+
+    def best(self):
+        return self._call({"cmd": "best"})
+
+
+class LightNAS:
+    """Search loop (reference nas/light_nas_strategy.py): draw tokens,
+    build + briefly train the candidate, reward = metric - latency penalty,
+    anneal. `eval_candidate(tokens) -> (metric, latency)` is supplied by the
+    caller (it owns programs/executors/data); when `agent` is given the
+    controller lives in a remote ControllerServer."""
+
+    def __init__(self, search_space, controller=None, agent=None,
+                 max_latency=None, latency_weight=0.0):
+        self.space = search_space
+        self.agent = agent
+        self.controller = controller
+        if controller is None and agent is None:
+            self.controller = SAController(search_space.range_table())
+        if self.controller is not None:
+            self.controller.reset(
+                search_space.range_table(), search_space.init_tokens()
+            )
+        self.max_latency = max_latency
+        self.latency_weight = float(latency_weight)
+
+    def _next(self):
+        return (
+            self.agent.next_tokens()
+            if self.agent is not None
+            else self.controller.next_tokens()
+        )
+
+    def _update(self, tokens, reward):
+        if self.agent is not None:
+            self.agent.update(tokens, reward)
+        else:
+            self.controller.update(tokens, reward)
+
+    def search(self, eval_candidate, steps=10):
+        for _ in range(steps):
+            tokens = self._next()
+            metric, latency = eval_candidate(tokens)
+            reward = float(metric)
+            if self.max_latency is not None and latency > self.max_latency:
+                reward -= self.latency_weight * (
+                    latency / self.max_latency - 1.0
+                )
+            self._update(tokens, reward)
+        if self.agent is not None:
+            best = self.agent.best()
+            return best["tokens"], best["reward"]
+        return self.controller.best_tokens, self.controller.best_reward

@@ -1,0 +1,342 @@
+"""Structured channel pruning (reference python/paddle/fluid/contrib/slim/
+prune/: StructurePruner l1-norm group selection in pruner.py,
+SensitivePruneStrategy / UniformPruneStrategy graph surgery in
+prune_strategy.py, sensitivity-driven ratio selection in
+auto_prune_strategy.py).
+
+TPU-native: pruning is a *Program rewrite + Scope array slice*. There is no
+kernel work at all — once the weight arrays shrink and the dependent ops'
+params are sliced to match, the next Executor.run re-traces and XLA compiles
+the smaller model (the reference instead had to rebuild its IR graph and
+re-bind kernels). The dependency walk matches the reference's: pruning conv
+output channels propagates through channel-preserving ops (activations,
+batch_norm params, pooling, dropout, per-channel bias adds) until the next
+channel-consuming conv/fc, whose input-channel axis is sliced.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_ACT_OPS = {
+    "relu", "relu6", "sigmoid", "tanh", "gelu", "leaky_relu", "swish",
+    "hard_swish", "elu", "softplus", "dropout", "pool2d",
+}
+
+
+class Pruner:
+    """Base class (reference pruner.py:22)."""
+
+    def prune(self, param):
+        pass
+
+
+class StructurePruner(Pruner):
+    """Group (channel) pruner: picks the lowest-norm slices of a weight
+    along an axis (reference pruner.py:34)."""
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def _criterion(self, name):
+        return self.criterions.get(name, self.criterions.get("*", "l1_norm"))
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        if axis is None:
+            axis = self.pruning_axis.get(name, self.pruning_axis.get("*", 0))
+        param = np.asarray(param)
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
+        crit = self._criterion(name)
+        if crit == "l1_norm":
+            scores = np.sum(np.abs(param), axis=reduce_dims)
+        elif crit == "l2_norm":
+            scores = np.sqrt(np.sum(param * param, axis=reduce_dims))
+        else:
+            raise ValueError(f"unknown criterion {crit!r}")
+        return np.sort(np.argsort(scores)[:prune_num])
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=False):
+        tensor = np.asarray(tensor)
+        mask = np.zeros(tensor.shape[pruned_axis], dtype=bool)
+        mask[np.asarray(pruned_idx, dtype=np.int64)] = True
+        if lazy:
+            out = tensor.copy()
+            sl = [slice(None)] * tensor.ndim
+            sl[pruned_axis] = mask
+            out[tuple(sl)] = 0
+            return out
+        sl = [slice(None)] * tensor.ndim
+        sl[pruned_axis] = ~mask
+        return tensor[tuple(sl)]
+
+
+def _consumers(block, var_name):
+    out = []
+    for op in block.ops:
+        for slot, names in op.inputs.items():
+            if var_name in names:
+                out.append((op, slot))
+    return out
+
+
+# optimizer ops whose state tensors mirror the param's shape and must be
+# sliced with it (reference: the slim strategies retrain from a fresh
+# optimizer; here the train program keeps working in place)
+_OPT_STATE_SLOTS = {
+    "momentum": ("Velocity",),
+    "lars_momentum": ("Velocity",),
+    "adam": ("Moment1", "Moment2"),
+    "lamb": ("Moment1", "Moment2"),
+    "adamax": ("Moment", "InfNorm"),
+    "adagrad": ("Moment",),
+    "decayed_adagrad": ("Moment",),
+    "adadelta": ("AvgSquaredGrad", "AvgSquaredUpdate"),
+    "rmsprop": ("Moment", "MeanSquare", "MeanGrad"),
+    "ftrl": ("SquaredAccumulator", "LinearAccumulator"),
+}
+
+
+def _slice_param(block, scope, name, keep, axis):
+    arr = scope.find_var(name)
+    if arr is None:
+        raise ValueError(f"parameter {name!r} not initialized in scope")
+    arr = np.take(np.asarray(arr), keep, axis=axis)
+    scope.set_var(name, arr)
+    v = block._find_var_recursive(name)
+    if v is not None and v.shape is not None:
+        shape = list(v.shape)
+        shape[axis] = len(keep)
+        v.shape = tuple(shape)
+    # keep optimizer state aligned with the pruned param
+    for op in block.ops:
+        slots = _OPT_STATE_SLOTS.get(op.type)
+        if not slots or (op.inputs.get("Param") or [None])[0] != name:
+            continue
+        for slot in slots:
+            for st in op.inputs.get(slot) or []:
+                st_arr = scope.find_var(st)
+                if st_arr is not None:
+                    scope.set_var(
+                        st, np.take(np.asarray(st_arr), keep, axis=axis)
+                    )
+                _set_channel_dim(block, st, len(keep), axis=axis)
+
+
+def _set_channel_dim(block, var_name, n, axis=1):
+    v = block._find_var_recursive(var_name)
+    if v is not None and v.shape is not None and len(v.shape) > axis:
+        shape = list(v.shape)
+        shape[axis] = n
+        v.shape = tuple(shape)
+
+
+def prune_conv_output(program, scope, filter_name, keep_idx):
+    """Keep only `keep_idx` output channels of the conv2d owning
+    `filter_name`, propagating the shape change through consumers.
+
+    Supported downstream ops: per-channel bias add, batch_norm (all four
+    channel params sliced), activations/dropout/pool2d, depthwise_conv2d,
+    the next conv2d (input-channel slice), and mul/fc (row-group slice).
+    Residual adds joining two pruned branches are rejected — prune both
+    branches identically via uniform ratios instead.
+    """
+    block = program.global_block
+    keep = np.asarray(sorted(int(i) for i in keep_idx), dtype=np.int64)
+    conv = None
+    for op in block.ops:
+        if op.type in ("conv2d", "conv2d_transpose") and filter_name in (
+            op.inputs.get("Filter") or []
+        ):
+            conv = op
+            break
+    if conv is None:
+        raise ValueError(f"no conv2d consumes Filter {filter_name!r}")
+    if conv.attr("groups", 1) not in (1, None):
+        raise ValueError("grouped conv pruning is not supported")
+    oc_axis = 0 if conv.type == "conv2d" else 1
+    old_oc = block.var(filter_name).shape[oc_axis]
+    _slice_param(block, scope, filter_name, keep, oc_axis)
+    out_var = conv.outputs["Output"][0]
+    _walk_channel_consumers(block, scope, out_var, keep, old_oc)
+    program._bump()
+
+
+def _walk_channel_consumers(block, scope, var_name, keep, old_c):
+    _set_channel_dim(block, var_name, len(keep))
+    for op, slot in _consumers(block, var_name):
+        if op.type == "__vjp__" or op.type.endswith("_grad"):
+            # backward ops replay the (now pruned) forward emitters and
+            # re-derive their shapes from the live arrays — nothing to do
+            continue
+        if op.type == "elementwise_add" and slot == "X":
+            other = op.inputs["Y"][0]
+            ov = block._find_var_recursive(other)
+            if ov is not None and ov.shape is not None and len(ov.shape) == 1:
+                _slice_param(block, scope, other, keep, 0)  # per-channel bias
+                _walk_channel_consumers(
+                    block, scope, op.outputs["Out"][0], keep, old_c
+                )
+            else:
+                raise ValueError(
+                    "pruning across a residual elementwise_add is not "
+                    "supported; prune both branches with equal ratios"
+                )
+        elif op.type in _ACT_OPS:
+            _walk_channel_consumers(
+                block, scope, op.outputs["Out"][0], keep, old_c
+            )
+        elif op.type == "batch_norm":
+            for s in ("Scale", "Bias", "Mean", "Variance"):
+                _slice_param(block, scope, op.inputs[s][0], keep, 0)
+            for s in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+                names = op.outputs.get(s) or []
+                if names:
+                    _set_channel_dim(block, names[0], len(keep), axis=0)
+            _walk_channel_consumers(
+                block, scope, op.outputs["Y"][0], keep, old_c
+            )
+        elif op.type == "depthwise_conv2d":
+            _slice_param(block, scope, op.inputs["Filter"][0], keep, 0)
+            if op.attr("groups") is not None:
+                op.attrs["groups"] = len(keep)
+            _walk_channel_consumers(
+                block, scope, op.outputs["Output"][0], keep, old_c
+            )
+        elif op.type == "conv2d":
+            if op.attr("groups", 1) not in (1, None):
+                raise ValueError(
+                    "pruning into a grouped conv2d consumer is not "
+                    "supported (its filter's input-channel axis covers "
+                    "only one group)"
+                )
+            _slice_param(block, scope, op.inputs["Filter"][0], keep, 1)
+        elif op.type == "mul":
+            w_name = op.inputs["Y"][0]
+            w = block._find_var_recursive(w_name)
+            rows = w.shape[0]
+            if rows % old_c:
+                raise ValueError(
+                    f"fc weight rows {rows} not divisible by channel count "
+                    f"{old_c}; cannot group-slice {w_name!r}"
+                )
+            per = rows // old_c  # spatial positions per channel (C*H*W rows)
+            row_idx = (keep[:, None] * per + np.arange(per)[None, :]).ravel()
+            _slice_param(block, scope, w_name, row_idx, 0)
+        elif op.type == "reshape2":
+            raise ValueError(
+                "pruning through reshape2 with baked shapes is not "
+                "supported; use fc(num_flatten_dims=...) directly"
+            )
+        else:
+            raise ValueError(
+                f"op {op.type!r} consuming pruned channels is not supported"
+            )
+
+
+def prune_program(program, scope, ratios, criterion="l1_norm", lazy=False):
+    """Prune conv output channels by per-filter ratios
+    ({filter_param_name: ratio}). `lazy` zeroes channels instead of removing
+    them (reference pruner.py prune_tensor lazy mode) — shapes stay intact,
+    useful for trial evaluation without re-tracing."""
+    pruner = StructurePruner(criterions={"*": criterion})
+    block = program.global_block
+    for name, ratio in ratios.items():
+        w = scope.find_var(name)
+        if w is None:
+            raise ValueError(f"parameter {name!r} not in scope")
+        idx = pruner.cal_pruned_idx(name, w, float(ratio), axis=0)
+        if lazy:
+            scope.set_var(name, pruner.prune_tensor(w, idx, 0, lazy=True))
+            continue
+        oc = np.asarray(w).shape[0]
+        keep = [i for i in range(oc) if i not in set(idx.tolist())]
+        prune_conv_output(program, scope, name, keep)
+    if lazy:
+        program._bump()
+
+
+def sensitivity(program, scope, eval_func, param_names, ratios=(0.1, 0.3, 0.5)):
+    """Per-parameter accuracy sensitivity (reference
+    auto_prune_strategy.py / prune_strategy.py SensitivePruneStrategy):
+    lazily zero each param's lowest-norm channels at each ratio and measure
+    the metric drop. Returns {param: {ratio: loss_fraction}}."""
+    baseline = float(eval_func(program, scope))
+    pruner = StructurePruner()
+    out = {}
+    for name in param_names:
+        orig = np.asarray(scope.find_var(name)).copy()
+        out[name] = {}
+        for r in ratios:
+            idx = pruner.cal_pruned_idx(name, orig, float(r), axis=0)
+            scope.set_var(name, pruner.prune_tensor(orig, idx, 0, lazy=True))
+            program._bump()  # zeroed weights: same shapes, new executable
+            metric = float(eval_func(program, scope))
+            out[name][float(r)] = (
+                (baseline - metric) / abs(baseline) if baseline else 0.0
+            )
+        scope.set_var(name, orig)
+        program._bump()
+    return out
+
+
+def get_ratios_by_sensitivity(sensitivities, target_loss=0.05):
+    """Pick, per parameter, the largest trial ratio whose measured metric
+    loss stays under `target_loss` (greedy per-param rule, the shape of the
+    reference's auto strategy). Returns {param: ratio}."""
+    picked = {}
+    for name, table in sensitivities.items():
+        best = 0.0
+        for r, loss in sorted(table.items()):
+            if loss <= target_loss and r > best:
+                best = r
+        if best > 0.0:
+            picked[name] = best
+    return picked
+
+
+class UniformPruneStrategy:
+    """Same ratio on every listed conv filter (reference
+    prune_strategy.py UniformPruneStrategy)."""
+
+    def __init__(self, pruner=None, target_ratio=0.5, pruned_params=None):
+        self.pruner = pruner or StructurePruner()
+        self.target_ratio = float(target_ratio)
+        self.pruned_params = list(pruned_params or [])
+
+    def apply(self, program, scope):
+        prune_program(
+            program,
+            scope,
+            {n: self.target_ratio for n in self.pruned_params},
+            criterion=self.pruner._criterion("*"),
+        )
+
+
+class SensitivePruneStrategy:
+    """Sensitivity-scan then prune (reference prune_strategy.py:
+    SensitivePruneStrategy), compressed to the TPU design: one scan with
+    lazy zeroing, greedy ratio pick, one structural prune."""
+
+    def __init__(self, pruner=None, eval_func=None, pruned_params=None,
+                 sensitivity_ratios=(0.1, 0.3, 0.5), target_loss=0.05):
+        self.pruner = pruner or StructurePruner()
+        self.eval_func = eval_func
+        self.pruned_params = list(pruned_params or [])
+        self.sensitivity_ratios = tuple(sensitivity_ratios)
+        self.target_loss = float(target_loss)
+        self.sensitivities = None
+
+    def apply(self, program, scope):
+        self.sensitivities = sensitivity(
+            program, scope, self.eval_func, self.pruned_params,
+            self.sensitivity_ratios,
+        )
+        ratios = get_ratios_by_sensitivity(
+            self.sensitivities, self.target_loss
+        )
+        if ratios:
+            prune_program(program, scope, ratios,
+                          criterion=self.pruner._criterion("*"))
+        return ratios

@@ -322,6 +322,15 @@ def fused_attention(
         if training_dropout:
             raise ValueError("fused_attention: dropout needs rng_key")
         rng_key = jax.random.key(0)
+    if interpret and training_dropout:
+        # the Mosaic interpreter's prng_random_bits is a zero stub: every
+        # probability would be dropped and the kernel would silently return
+        # zeros — refuse instead
+        raise ValueError(
+            "fused_attention: training dropout is unsupported in interpret "
+            "mode (interpreter PRNG is a stub); test dropout on TPU or via "
+            "the jnp reference path"
+        )
     use_pallas = not force_reference and (
         interpret
         or (jax.default_backend() == "tpu" and supports(S, D, q.dtype))
