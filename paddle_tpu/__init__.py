@@ -56,6 +56,7 @@ from .reader_decorators import batch  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from . import native  # noqa: F401
+from . import crypto  # noqa: F401  (model-file encryption, framework/io/crypto)
 from . import inference  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401

@@ -1,0 +1,232 @@
+"""Model-file encryption (reference paddle/fluid/framework/io/crypto/:
+Cipher/AESCipher in cipher.h + aes_cipher.cc, key helpers in
+cipher_utils.cc, pybind surface in pybind/crypto.cc).
+
+Scheme: AES-CTR (native C++ core, native/crypto.cpp; pure-Python AES
+fallback when no toolchain) with encrypt-then-MAC HMAC-SHA256 truncated to
+16 bytes. The reference uses cryptopp AES-GCM; this image vendors no crypto
+library, so CTR+HMAC provides the same confidentiality+integrity contract
+from first principles — wire format: iv(16) || ciphertext || tag(16).
+Both AES cores are validated against the FIPS-197 and NIST SP 800-38A
+known-answer vectors (tests/test_crypto.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import os
+
+from . import native
+
+_SBOX = None
+
+
+def _sbox():
+    """Compute the AES S-box (multiplicative inverse in GF(2^8) + affine
+    transform) — table-free construction for the fallback core."""
+    global _SBOX
+    if _SBOX is not None:
+        return _SBOX
+    # build inverse table via exp/log over generator 3
+    exp, log = [0] * 510, [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x ^= (x << 1) ^ (0x11B if x & 0x80 else 0)
+        x &= 0xFF
+    for i in range(255, 510):
+        exp[i] = exp[i - 255]
+    sbox = [0] * 256
+    for v in range(256):
+        inv = 0 if v == 0 else exp[255 - log[v]]
+        b = inv
+        r = inv
+        for _ in range(4):
+            b = ((b << 1) | (b >> 7)) & 0xFF
+            r ^= b
+        sbox[v] = r ^ 0x63
+    _SBOX = sbox
+    return sbox
+
+
+def _xtime(b):
+    return ((b << 1) ^ 0x1B) & 0xFF if b & 0x80 else b << 1
+
+
+def _expand_key(key):
+    sbox = _sbox()
+    nk = len(key) // 4
+    rounds = nk + 6
+    w = [list(key[4 * i:4 * i + 4]) for i in range(nk)]
+    rcon = 1
+    for i in range(nk, 4 * (rounds + 1)):
+        t = list(w[i - 1])
+        if i % nk == 0:
+            t = [sbox[t[1]] ^ rcon, sbox[t[2]], sbox[t[3]], sbox[t[0]]]
+            rcon = _xtime(rcon)
+        elif nk > 6 and i % nk == 4:
+            t = [sbox[b] for b in t]
+        w.append([a ^ b for a, b in zip(w[i - nk], t)])
+    return w, rounds
+
+
+def _py_block_encrypt(key, block, _sched=None):
+    sbox = _sbox()
+    w, rounds = _sched if _sched is not None else _expand_key(key)
+    s = [block[i] ^ w[i // 4][i % 4] for i in range(16)]
+    for rnd in range(1, rounds + 1):
+        t = [0] * 16
+        for c in range(4):
+            for r in range(4):
+                t[4 * c + r] = sbox[s[4 * ((c + r) & 3) + r]]
+        if rnd < rounds:
+            s = [0] * 16
+            for c in range(4):
+                a = t[4 * c:4 * c + 4]
+                x = a[0] ^ a[1] ^ a[2] ^ a[3]
+                for r in range(4):
+                    s[4 * c + r] = a[r] ^ x ^ _xtime(a[r] ^ a[(r + 1) & 3])
+        else:
+            s = t
+        rk = w[4 * rnd:4 * rnd + 4]
+        s = [s[i] ^ rk[i // 4][i % 4] for i in range(16)]
+    return bytes(s)
+
+
+def _py_ctr_crypt(key, iv, data):
+    out = bytearray(data)
+    ctr = int.from_bytes(iv, "big")
+    sched = _expand_key(key)  # hoisted: dominates per-block cost otherwise
+    for off in range(0, len(data), 16):
+        ks = _py_block_encrypt(key, ctr.to_bytes(16, "big"), _sched=sched)
+        ctr = (ctr + 1) % (1 << 128)
+        for i in range(min(16, len(data) - off)):
+            out[off + i] ^= ks[i]
+    return bytes(out)
+
+
+def _ctr_crypt(key, iv, data):
+    got = native.aes_ctr_crypt(key, iv, data)
+    return got if got is not None else _py_ctr_crypt(key, iv, data)
+
+
+class CipherUtils:
+    """Key management (reference cipher_utils.h:25)."""
+
+    AES_DEFAULT_IV_SIZE = 16
+    AES_DEFAULT_TAG_SIZE = 16
+
+    @staticmethod
+    def gen_key(length):
+        """length in bits (reference GenKey semantics)."""
+        if length % 8:
+            raise ValueError("key length must be a multiple of 8 bits")
+        return os.urandom(length // 8)
+
+    @staticmethod
+    def gen_key_to_file(length, filename):
+        key = CipherUtils.gen_key(length)
+        with open(filename, "wb") as f:
+            f.write(key)
+        return key
+
+    @staticmethod
+    def read_key_from_file(filename):
+        with open(filename, "rb") as f:
+            return f.read()
+
+
+class Cipher:
+    def encrypt(self, plaintext, key):
+        raise NotImplementedError
+
+    def decrypt(self, ciphertext, key):
+        raise NotImplementedError
+
+    def encrypt_to_file(self, plaintext, key, filename):
+        with open(filename, "wb") as f:
+            f.write(self.encrypt(plaintext, key))
+
+    def decrypt_from_file(self, key, filename):
+        with open(filename, "rb") as f:
+            return self.decrypt(f.read(), key)
+
+
+class AESCipher(Cipher):
+    """AES-CTR + HMAC-SHA256(16B tag), iv || ct || tag on the wire."""
+
+    def __init__(self, iv_size=16, tag_size=16):
+        if iv_size != 16:
+            raise ValueError("AES-CTR iv must be 16 bytes")
+        tag_size = int(tag_size)
+        if not 1 <= tag_size <= 32:
+            # 0 would silently disable authentication; >32 exceeds the
+            # HMAC-SHA256 digest and could never verify
+            raise ValueError("tag_size must be in [1, 32] bytes")
+        self.iv_size = iv_size
+        self.tag_size = tag_size
+
+    def _mac_key(self, key):
+        return hashlib.sha256(b"paddle_tpu-mac|" + key).digest()
+
+    def _check_key(self, key):
+        if not isinstance(key, (bytes, bytearray)):
+            raise TypeError("key must be bytes")
+        if len(key) not in (16, 24, 32):
+            raise ValueError("AES key must be 16/24/32 bytes")
+        return bytes(key)
+
+    def encrypt(self, plaintext, key):
+        key = self._check_key(key)
+        if isinstance(plaintext, str):
+            plaintext = plaintext.encode()
+        iv = os.urandom(self.iv_size)
+        ct = _ctr_crypt(key, iv, plaintext)
+        tag = _hmac.new(
+            self._mac_key(key), iv + ct, hashlib.sha256
+        ).digest()[: self.tag_size]
+        return iv + ct + tag
+
+    def decrypt(self, ciphertext, key):
+        key = self._check_key(key)
+        n = len(ciphertext)
+        if n < self.iv_size + self.tag_size:
+            raise ValueError("ciphertext too short")
+        iv = ciphertext[: self.iv_size]
+        ct = ciphertext[self.iv_size: n - self.tag_size]
+        tag = ciphertext[n - self.tag_size:]
+        want = _hmac.new(
+            self._mac_key(key), iv + ct, hashlib.sha256
+        ).digest()[: self.tag_size]
+        if not _hmac.compare_digest(tag, want):
+            raise ValueError(
+                "model file authentication failed: wrong key or corrupted "
+                "ciphertext"
+            )
+        return _ctr_crypt(key, iv, ct)
+
+
+class CipherFactory:
+    """create_cipher(config_file) (reference cipher.h:44). The config is a
+    properties file: `cipher_name=AES_CTR_NoPadding`, optional
+    `iv_size`/`tag_size` in bytes; no file -> defaults."""
+
+    @staticmethod
+    def create_cipher(config_file=None):
+        cfg = {}
+        if config_file:
+            with open(config_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#") and "=" in line:
+                        k, v = line.split("=", 1)
+                        cfg[k.strip()] = v.strip()
+        name = cfg.get("cipher_name", "AES_CTR_NoPadding")
+        if "AES" not in name:
+            raise ValueError(f"unsupported cipher {name!r}")
+        return AESCipher(
+            iv_size=int(cfg.get("iv_size", 16)),
+            tag_size=int(cfg.get("tag_size", 16)),
+        )

@@ -322,6 +322,12 @@ def fused_attention(
         if training_dropout:
             raise ValueError("fused_attention: dropout needs rng_key")
         rng_key = jax.random.key(0)
+    use_pallas = not force_reference and (
+        interpret
+        or (jax.default_backend() == "tpu" and supports(S, D, q.dtype))
+    )
+    if not use_pallas:
+        return _reference(q, k, v, bias, rng_key, **statics)
     if interpret and training_dropout:
         # the Mosaic interpreter's prng_random_bits is a zero stub: every
         # probability would be dropped and the kernel would silently return
@@ -329,14 +335,8 @@ def fused_attention(
         raise ValueError(
             "fused_attention: training dropout is unsupported in interpret "
             "mode (interpreter PRNG is a stub); test dropout on TPU or via "
-            "the jnp reference path"
+            "the jnp reference path (force_reference=True)"
         )
-    use_pallas = not force_reference and (
-        interpret
-        or (jax.default_backend() == "tpu" and supports(S, D, q.dtype))
-    )
-    if not use_pallas:
-        return _reference(q, k, v, bias, rng_key, **statics)
     seed = jnp.ravel(jax.random.key_data(rng_key)).astype(jnp.uint32)[:2]
     if seed.shape[0] < 2:  # rbg/other impls may expose a single word
         seed = jnp.concatenate([seed, jnp.zeros(1, jnp.uint32)])

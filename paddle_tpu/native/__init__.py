@@ -22,7 +22,10 @@ import subprocess
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "multislot.cpp")
+_SRCS = [
+    os.path.join(_HERE, "multislot.cpp"),
+    os.path.join(_HERE, "crypto.cpp"),
+]
 _LIB = os.path.join(_HERE, "_libpaddle_native.so")
 
 _lib = None
@@ -30,7 +33,7 @@ _tried = False
 
 
 def _build():
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SRCS, "-o", _LIB]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
 
 
@@ -40,36 +43,83 @@ def _load():
         return _lib
     _tried = True
     try:
-        if (not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < max(
+            os.path.getmtime(s) for s in _SRCS
+        ):
             _build()
-        lib = ctypes.CDLL(_LIB)
-        lib.ps_parse_multislot.restype = ctypes.c_long
-        lib.ps_parse_multislot.argtypes = [
-            ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_double), ctypes.c_long,
-            ctypes.POINTER(ctypes.c_long), ctypes.c_long,
-        ]
-        lib.ps_pack_padded_f32.restype = None
-        lib.ps_pack_padded_f32.argtypes = [
-            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_long),
-            ctypes.c_long, ctypes.c_long, ctypes.c_float,
-            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.ps_pack_padded_i64.restype = None
-        lib.ps_pack_padded_i64.argtypes = [
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_long),
-            ctypes.c_long, ctypes.c_long, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
-        ]
-        _lib = lib
+        _lib = _bind(ctypes.CDLL(_LIB))
+    except AttributeError:
+        # stale prebuilt .so missing newly added symbols (mtime races on
+        # rsync'd checkouts): force one rebuild, else fall back to Python
+        try:
+            _build()
+            _lib = _bind(ctypes.CDLL(_LIB))
+        except (OSError, subprocess.CalledProcessError, AttributeError):
+            _lib = None
     except (OSError, subprocess.CalledProcessError):
         _lib = None
     return _lib
 
 
+def _bind(lib):
+    """Declare ctypes signatures; AttributeError here means a stale .so."""
+    lib.ps_parse_multislot.restype = ctypes.c_long
+    lib.ps_parse_multislot.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long), ctypes.c_long,
+    ]
+    lib.ps_pack_padded_f32.restype = None
+    lib.ps_pack_padded_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long, ctypes.c_long, ctypes.c_float,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.ps_pack_padded_i64.restype = None
+    lib.ps_pack_padded_i64.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long, ctypes.c_long, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.pd_aes_block_encrypt.restype = ctypes.c_int
+    lib.pd_aes_block_encrypt.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.pd_aes_ctr_crypt.restype = ctypes.c_int
+    lib.pd_aes_ctr_crypt.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
+    ]
+    return lib
+
+
 def native_available():
     return _load() is not None
+
+
+def aes_block_encrypt(key: bytes, block: bytes):
+    """One AES block through the native core; None if native is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint8 * 16)()
+    rc = lib.pd_aes_block_encrypt(key, len(key), bytes(block), out)
+    if rc != 0:
+        raise ValueError(f"bad AES key length {len(key)}")
+    return bytes(out)
+
+
+def aes_ctr_crypt(key: bytes, iv: bytes, data: bytes):
+    """AES-CTR over data (encrypt == decrypt); None if native is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    rc = lib.pd_aes_ctr_crypt(key, len(key), bytes(iv), buf, len(data))
+    if rc != 0:
+        raise ValueError(f"bad AES key length {len(key)}")
+    return bytes(buf)
 
 
 def parse_multislot(text, num_slots):

@@ -1,0 +1,134 @@
+// AES-128/192/256 block cipher + CTR mode, C ABI for ctypes.
+//
+// Reference parity: paddle/fluid/framework/io/crypto/aes_cipher.cc — the
+// reference links cryptopp for AES-GCM model-file encryption; this image
+// vendors no crypto library, so the primitive is implemented here (FIPS-197
+// key expansion + rounds, validated against the FIPS/NIST known-answer
+// vectors in tests/test_crypto.py). Authentication is done Python-side with
+// HMAC-SHA256 (encrypt-then-MAC), see paddle_tpu/crypto.py.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+const uint8_t SBOX[256] = {
+    0x63, 0x7c, 0x77, 0x7b, 0xf2, 0x6b, 0x6f, 0xc5, 0x30, 0x01, 0x67, 0x2b,
+    0xfe, 0xd7, 0xab, 0x76, 0xca, 0x82, 0xc9, 0x7d, 0xfa, 0x59, 0x47, 0xf0,
+    0xad, 0xd4, 0xa2, 0xaf, 0x9c, 0xa4, 0x72, 0xc0, 0xb7, 0xfd, 0x93, 0x26,
+    0x36, 0x3f, 0xf7, 0xcc, 0x34, 0xa5, 0xe5, 0xf1, 0x71, 0xd8, 0x31, 0x15,
+    0x04, 0xc7, 0x23, 0xc3, 0x18, 0x96, 0x05, 0x9a, 0x07, 0x12, 0x80, 0xe2,
+    0xeb, 0x27, 0xb2, 0x75, 0x09, 0x83, 0x2c, 0x1a, 0x1b, 0x6e, 0x5a, 0xa0,
+    0x52, 0x3b, 0xd6, 0xb3, 0x29, 0xe3, 0x2f, 0x84, 0x53, 0xd1, 0x00, 0xed,
+    0x20, 0xfc, 0xb1, 0x5b, 0x6a, 0xcb, 0xbe, 0x39, 0x4a, 0x4c, 0x58, 0xcf,
+    0xd0, 0xef, 0xaa, 0xfb, 0x43, 0x4d, 0x33, 0x85, 0x45, 0xf9, 0x02, 0x7f,
+    0x50, 0x3c, 0x9f, 0xa8, 0x51, 0xa3, 0x40, 0x8f, 0x92, 0x9d, 0x38, 0xf5,
+    0xbc, 0xb6, 0xda, 0x21, 0x10, 0xff, 0xf3, 0xd2, 0xcd, 0x0c, 0x13, 0xec,
+    0x5f, 0x97, 0x44, 0x17, 0xc4, 0xa7, 0x7e, 0x3d, 0x64, 0x5d, 0x19, 0x73,
+    0x60, 0x81, 0x4f, 0xdc, 0x22, 0x2a, 0x90, 0x88, 0x46, 0xee, 0xb8, 0x14,
+    0xde, 0x5e, 0x0b, 0xdb, 0xe0, 0x32, 0x3a, 0x0a, 0x49, 0x06, 0x24, 0x5c,
+    0xc2, 0xd3, 0xac, 0x62, 0x91, 0x95, 0xe4, 0x79, 0xe7, 0xc8, 0x37, 0x6d,
+    0x8d, 0xd5, 0x4e, 0xa9, 0x6c, 0x56, 0xf4, 0xea, 0x65, 0x7a, 0xae, 0x08,
+    0xba, 0x78, 0x25, 0x2e, 0x1c, 0xa6, 0xb4, 0xc6, 0xe8, 0xdd, 0x74, 0x1f,
+    0x4b, 0xbd, 0x8b, 0x8a, 0x70, 0x3e, 0xb5, 0x66, 0x48, 0x03, 0xf6, 0x0e,
+    0x61, 0x35, 0x57, 0xb9, 0x86, 0xc1, 0x1d, 0x9e, 0xe1, 0xf8, 0x98, 0x11,
+    0x69, 0xd9, 0x8e, 0x94, 0x9b, 0x1e, 0x87, 0xe9, 0xce, 0x55, 0x28, 0xdf,
+    0x8c, 0xa1, 0x89, 0x0d, 0xbf, 0xe6, 0x42, 0x68, 0x41, 0x99, 0x2d, 0x0f,
+    0xb0, 0x54, 0xbb, 0x16};
+
+inline uint8_t xtime(uint8_t x) {
+  return static_cast<uint8_t>((x << 1) ^ ((x >> 7) * 0x1b));
+}
+
+struct AesKey {
+  uint8_t rk[15][16];  // round keys
+  int rounds;
+};
+
+// FIPS-197 key expansion (Nk words in, 4*(rounds+1) words out)
+int expand_key(const uint8_t* key, int key_len, AesKey* out) {
+  int nk = key_len / 4;
+  if (key_len != 16 && key_len != 24 && key_len != 32) return -1;
+  out->rounds = nk + 6;
+  int total_words = 4 * (out->rounds + 1);
+  uint8_t w[60][4];
+  std::memcpy(w, key, key_len);
+  uint8_t rcon = 1;
+  for (int i = nk; i < total_words; ++i) {
+    uint8_t t[4];
+    std::memcpy(t, w[i - 1], 4);
+    if (i % nk == 0) {
+      uint8_t tmp = t[0];  // RotWord
+      t[0] = SBOX[t[1]] ^ rcon;
+      t[1] = SBOX[t[2]];
+      t[2] = SBOX[t[3]];
+      t[3] = SBOX[tmp];
+      rcon = xtime(rcon);
+    } else if (nk > 6 && i % nk == 4) {
+      for (int j = 0; j < 4; ++j) t[j] = SBOX[t[j]];
+    }
+    for (int j = 0; j < 4; ++j) w[i][j] = w[i - nk][j] ^ t[j];
+  }
+  std::memcpy(out->rk, w, total_words * 4);
+  return 0;
+}
+
+void encrypt_block(const AesKey& k, const uint8_t in[16], uint8_t out[16]) {
+  uint8_t s[16];
+  for (int i = 0; i < 16; ++i) s[i] = in[i] ^ k.rk[0][i];
+  for (int round = 1; round <= k.rounds; ++round) {
+    // SubBytes + ShiftRows (column-major state: s[4*col + row])
+    uint8_t t[16];
+    for (int c = 0; c < 4; ++c)
+      for (int r = 0; r < 4; ++r)
+        t[4 * c + r] = SBOX[s[4 * ((c + r) & 3) + r]];
+    if (round < k.rounds) {
+      for (int c = 0; c < 4; ++c) {  // MixColumns
+        uint8_t a0 = t[4 * c], a1 = t[4 * c + 1], a2 = t[4 * c + 2],
+                a3 = t[4 * c + 3];
+        uint8_t x = a0 ^ a1 ^ a2 ^ a3;
+        s[4 * c] = a0 ^ x ^ xtime(static_cast<uint8_t>(a0 ^ a1));
+        s[4 * c + 1] = a1 ^ x ^ xtime(static_cast<uint8_t>(a1 ^ a2));
+        s[4 * c + 2] = a2 ^ x ^ xtime(static_cast<uint8_t>(a2 ^ a3));
+        s[4 * c + 3] = a3 ^ x ^ xtime(static_cast<uint8_t>(a3 ^ a0));
+      }
+    } else {
+      std::memcpy(s, t, 16);
+    }
+    for (int i = 0; i < 16; ++i) s[i] ^= k.rk[round][i];
+  }
+  std::memcpy(out, s, 16);
+}
+
+}  // namespace
+
+extern "C" {
+
+int pd_aes_block_encrypt(const uint8_t* key, int key_len,
+                         const uint8_t in[16], uint8_t out[16]) {
+  AesKey k;
+  if (expand_key(key, key_len, &k) != 0) return -1;
+  encrypt_block(k, in, out);
+  return 0;
+}
+
+// CTR mode, in place (encrypt == decrypt): keystream = AES(counter),
+// counter = iv treated as a 128-bit big-endian integer, incremented per
+// block (NIST SP 800-38A).
+int pd_aes_ctr_crypt(const uint8_t* key, int key_len, const uint8_t iv[16],
+                     uint8_t* buf, long n) {
+  AesKey k;
+  if (expand_key(key, key_len, &k) != 0) return -1;
+  uint8_t ctr[16], ks[16];
+  std::memcpy(ctr, iv, 16);
+  for (long off = 0; off < n; off += 16) {
+    encrypt_block(k, ctr, ks);
+    long m = (n - off < 16) ? n - off : 16;
+    for (long i = 0; i < m; ++i) buf[off + i] ^= ks[i];
+    for (int i = 15; i >= 0; --i)
+      if (++ctr[i] != 0) break;
+  }
+  return 0;
+}
+
+}  // extern "C"
