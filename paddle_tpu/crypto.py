@@ -224,7 +224,9 @@ class CipherFactory:
                         k, v = line.split("=", 1)
                         cfg[k.strip()] = v.strip()
         name = cfg.get("cipher_name", "AES_CTR_NoPadding")
-        if "AES" not in name:
+        if not name.startswith("AES_CTR"):
+            # refuse e.g. the reference's AES_GCM_NoPadding(128) rather than
+            # silently producing an incompatible CTR+HMAC file
             raise ValueError(f"unsupported cipher {name!r}")
         return AESCipher(
             iv_size=int(cfg.get("iv_size", 16)),

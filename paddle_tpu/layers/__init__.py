@@ -75,4 +75,5 @@ from .detection import (  # noqa: F401
     multiclass_nms,
     prior_box,
     yolo_box,
+    yolov3_loss,
 )

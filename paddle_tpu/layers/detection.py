@@ -64,3 +64,27 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
         out_slots=("Out", "NmsRoisNum"),
         stop_gradient=True,
     )
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh=0.7, downsample_ratio=32, gt_score=None,
+                use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 head loss (reference fluid/layers/detection.py yolo family ->
+    detection/yolov3_loss_op.h). Returns per-image loss [N]."""
+    inputs = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        inputs["GTScore"] = [gt_score]
+    return _simple(
+        "yolov3_loss",
+        inputs,
+        {
+            "anchors": list(anchors),
+            "anchor_mask": list(anchor_mask),
+            "class_num": class_num,
+            "ignore_thresh": ignore_thresh,
+            "downsample_ratio": downsample_ratio,
+            "use_label_smooth": use_label_smooth,
+            "scale_x_y": scale_x_y,
+        },
+        out_slots=("Loss", "ObjectnessMask", "GTMatchMask"),
+    )[0]

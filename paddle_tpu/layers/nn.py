@@ -210,7 +210,12 @@ def batch_norm(
     data_layout="NCHW",
     name=None,
     use_global_stats=False,
+    moving_mean_name=None,
+    moving_variance_name=None,
 ):
+    """moving_mean_name/moving_variance_name (fluid layers/nn.py batch_norm
+    params): deterministic running-stat names so a separately built
+    inference program shares the trained statistics."""
     helper = LayerHelper("batch_norm", name=name)
     c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
     dtype = input.dtype if input.dtype != "float16" else "float32"
@@ -222,7 +227,8 @@ def batch_norm(
 
     mean = helper.create_parameter(
         ParamAttr(
-            name=unique_name.generate("bn_mean"), trainable=False,
+            name=moving_mean_name or unique_name.generate("bn_mean"),
+            trainable=False,
             initializer=Constant(0.0),
         ),
         [c],
@@ -230,7 +236,8 @@ def batch_norm(
     )
     var = helper.create_parameter(
         ParamAttr(
-            name=unique_name.generate("bn_variance"), trainable=False,
+            name=moving_variance_name or unique_name.generate("bn_variance"),
+            trainable=False,
             initializer=Constant(1.0),
         ),
         [c],
@@ -419,3 +426,31 @@ def sparse_embedding(
         {"axis_name": axis},
         op_type="distributed_lookup_table",
     )
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None):
+    """Nearest-neighbor upsampling (reference layers/nn.py resize_nearest ->
+    nearest_interp_op.cc); out_shape [H, W] or a scale factor."""
+    helper = LayerHelper("nearest_interp", name=name)
+    attrs = _resize_attrs(out_shape, scale)
+    return helper.create_and_append({"X": [input]}, attrs,
+                                    op_type="nearest_interp")
+
+
+def _resize_attrs(out_shape, scale):
+    if out_shape is None and scale is None:
+        raise ValueError("one of out_shape and scale must be set")
+    attrs = {}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    return attrs
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None):
+    """Bilinear resize (reference layers/nn.py resize_bilinear)."""
+    helper = LayerHelper("bilinear_interp", name=name)
+    attrs = _resize_attrs(out_shape, scale)
+    return helper.create_and_append({"X": [input]}, attrs,
+                                    op_type="bilinear_interp")

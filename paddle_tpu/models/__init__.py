@@ -10,3 +10,10 @@ public layers API only — they double as end-to-end tests of the framework
 from .resnet import resnet  # noqa: F401
 from .bert import BertConfig, bert_encoder, bert_pretrain  # noqa: F401
 from .deepfm import DeepFMConfig, deepfm  # noqa: F401
+from .yolov3 import (  # noqa: F401
+    YoloConfig,
+    darknet53,
+    yolov3_heads,
+    yolov3_infer,
+    yolov3_train,
+)

@@ -1,0 +1,167 @@
+"""YOLOv3 — DarkNet-53 backbone + 3-scale FPN heads (the reference's
+detection flagship; ops behavior from operators/detection/yolov3_loss_op.h
+and yolo_box_op.cc; model topology per the YOLOv3 paper, built on the
+public layers API only — like models/resnet.py, convs run NCHW at the op
+boundary and NHWC inside, kernels on the MXU via XLA).
+
+`yolov3_train` returns the summed three-scale loss; `yolov3_infer` decodes
+all heads with yolo_box and fuses them through multiclass_nms. A `scale`
+knob shrinks every channel count for tests/dry-runs (scale=1 is the paper
+model: 53-conv backbone, 75-channel heads for COCO).
+"""
+
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+# paper anchors (COCO, 416 input); mask [6,7,8] = coarsest stride-32 head
+DEFAULT_ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
+                   59, 119, 116, 90, 156, 198, 373, 326]
+DEFAULT_ANCHOR_MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]
+
+
+class YoloConfig:
+    def __init__(self, class_num=80, anchors=None, anchor_masks=None,
+                 scale=1.0, ignore_thresh=0.7, use_label_smooth=True):
+        self.class_num = class_num
+        self.anchors = list(anchors or DEFAULT_ANCHORS)
+        self.anchor_masks = [list(m) for m in
+                             (anchor_masks or DEFAULT_ANCHOR_MASKS)]
+        if not 1 <= len(self.anchor_masks) <= 3:
+            raise ValueError("anchor_masks: 1-3 scales supported "
+                             "(heads start at stride 32 and halve)")
+        self.scale = float(scale)
+        self.ignore_thresh = ignore_thresh
+        self.use_label_smooth = use_label_smooth
+
+    def ch(self, n):
+        return max(4, int(n * self.scale))
+
+    @classmethod
+    def tiny(cls, class_num=4):
+        """1/8-width model for CPU tests and dry runs."""
+        return cls(class_num=class_num, scale=0.125)
+
+
+def _cbl(x, ch, k, stride, prefix, cfg):
+    """conv-bn-leaky_relu, the darknet unit."""
+    x = layers.conv2d(
+        x, ch, k, stride=stride, padding=(k - 1) // 2, bias_attr=False,
+        param_attr=ParamAttr(name=f"{prefix}_w"),
+    )
+    return layers.batch_norm(
+        x, act="leaky_relu",
+        param_attr=ParamAttr(name=f"{prefix}_bn_s"),
+        bias_attr=ParamAttr(name=f"{prefix}_bn_b"),
+        moving_mean_name=f"{prefix}_bn_m",
+        moving_variance_name=f"{prefix}_bn_v",
+    )
+
+
+def _res_block(x, ch, prefix, cfg):
+    """1x1 bottleneck + 3x3, residual add (darknet53 block)."""
+    s = _cbl(x, ch // 2, 1, 1, f"{prefix}_a", cfg)
+    s = _cbl(s, ch, 3, 1, f"{prefix}_b", cfg)
+    return x + s
+
+
+def darknet53(img, cfg, prefix="dark"):
+    """Backbone; returns the C3/C4/C5 feature maps (strides 8/16/32)."""
+    depths = (1, 2, 8, 8, 4)
+    x = _cbl(img, cfg.ch(32), 3, 1, f"{prefix}_stem", cfg)
+    feats = []
+    ch = 32
+    for stage, blocks in enumerate(depths):
+        ch *= 2
+        x = _cbl(x, cfg.ch(ch), 3, 2, f"{prefix}_down{stage}", cfg)
+        for b in range(blocks):
+            x = _res_block(x, cfg.ch(ch), f"{prefix}_s{stage}b{b}", cfg)
+        if stage >= 2:
+            feats.append(x)
+    return feats  # [C3 (stride 8), C4 (16), C5 (32)]
+
+
+def _detection_block(x, ch, prefix, cfg):
+    """5-conv block; returns (route for the next scale, head input)."""
+    for i in range(2):
+        x = _cbl(x, ch, 1, 1, f"{prefix}_r{i}a", cfg)
+        x = _cbl(x, ch * 2, 3, 1, f"{prefix}_r{i}b", cfg)
+    route = _cbl(x, ch, 1, 1, f"{prefix}_route", cfg)
+    tip = _cbl(route, ch * 2, 3, 1, f"{prefix}_tip", cfg)
+    return route, tip
+
+
+def yolov3_heads(img, cfg, prefix="yolo"):
+    """Backbone + FPN neck; returns raw head outputs
+    [stride 32, stride 16, stride 8], each [N, M*(5+C), H, W]."""
+    c3, c4, c5 = darknet53(img, cfg, prefix=f"{prefix}_dark")
+    outputs = []
+    route = None
+    scales = [c5, c4, c3][: len(cfg.anchor_masks)]
+    for i, feat in enumerate(scales):
+        if route is not None:
+            route = _cbl(route, cfg.ch(256 // (2 ** (i - 1))), 1, 1,
+                         f"{prefix}_lat{i}", cfg)
+            route = layers.resize_nearest(route, scale=2.0)
+            feat = layers.concat([route, feat], axis=1)
+        route, tip = _detection_block(
+            feat, cfg.ch(512 // (2 ** i)), f"{prefix}_det{i}", cfg
+        )
+        n_out = len(cfg.anchor_masks[i]) * (5 + cfg.class_num)
+        outputs.append(
+            layers.conv2d(
+                tip, n_out, 1,
+                param_attr=ParamAttr(name=f"{prefix}_head{i}_w"),
+                bias_attr=ParamAttr(name=f"{prefix}_head{i}_b"),
+            )
+        )
+    return outputs
+
+
+def yolov3_train(img, gt_box, gt_label, cfg, gt_score=None, prefix="yolo"):
+    """Mean over the batch of the three-scale yolov3_loss sum."""
+    heads = yolov3_heads(img, cfg, prefix=prefix)
+    losses = []
+    for i, head in enumerate(heads):
+        per_image = layers.yolov3_loss(
+            head, gt_box, gt_label,
+            anchors=cfg.anchors,
+            anchor_mask=cfg.anchor_masks[i],
+            class_num=cfg.class_num,
+            ignore_thresh=cfg.ignore_thresh,
+            downsample_ratio=32 // (2 ** i),
+            gt_score=gt_score,
+            use_label_smooth=cfg.use_label_smooth,
+        )
+        losses.append(layers.reduce_mean(per_image))
+    total = losses[0]
+    for extra in losses[1:]:
+        total = total + extra
+    return total
+
+
+def yolov3_infer(img, img_size, cfg, prefix="yolo",
+                 conf_thresh=0.01, nms_thresh=0.45, keep_top_k=100):
+    """Decode + NMS: returns ([N, keep_top_k, 6] label/score/x0y0x1y1,
+    valid counts [N])."""
+    heads = yolov3_heads(img, cfg, prefix=prefix)
+    boxes, scores = [], []
+    for i, head in enumerate(heads):
+        masked_anchors = []
+        for a in cfg.anchor_masks[i]:
+            masked_anchors += cfg.anchors[2 * a:2 * a + 2]
+        b, s = layers.yolo_box(
+            head, img_size, anchors=masked_anchors,
+            class_num=cfg.class_num, conf_thresh=conf_thresh,
+            downsample_ratio=32 // (2 ** i),
+        )
+        boxes.append(b)
+        scores.append(layers.transpose(s, [0, 2, 1]))
+    return layers.multiclass_nms(
+        layers.concat(boxes, axis=1),
+        layers.concat(scores, axis=2),
+        score_threshold=conf_thresh,
+        nms_threshold=nms_thresh,
+        keep_top_k=keep_top_k,
+    )

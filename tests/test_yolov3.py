@@ -1,0 +1,188 @@
+"""YOLOv3: the yolov3_loss op against an independent numpy port of the
+reference semantics (detection/yolov3_loss_op.h), and the full model
+(darknet53 + FPN heads) training and decoding end to end."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+from paddle_tpu.models import YoloConfig, yolov3_infer, yolov3_train
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+def _sce(x, z):
+    return max(x, 0.0) - x * z + np.log1p(np.exp(-abs(x)))
+
+
+def _np_yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                    ignore_thresh, downsample, use_label_smooth=True):
+    """Literal numpy port of the reference loops (yolov3_loss_op.h:256+),
+    gt_score == 1."""
+    N, _, H, W = x.shape
+    M, A = len(anchor_mask), len(anchors) // 2
+    B = gt_box.shape[1]
+    input_size = downsample * H
+    xr = x.reshape(N, M, 5 + class_num, H, W).astype(np.float64)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    if use_label_smooth:
+        sm = min(1.0 / class_num, 1.0 / 40)
+        pos_l, neg_l = 1.0 - sm, sm
+    else:
+        pos_l, neg_l = 1.0, 0.0
+
+    def iou(b1, b2):
+        ow = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2) - max(
+            b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+        oh = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2) - max(
+            b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+        inter = 0.0 if ow < 0 or oh < 0 else ow * oh
+        return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter)
+
+    loss = np.zeros(N)
+    obj = np.zeros((N, M, H, W))
+    for n in range(N):
+        valid = [gt_box[n, t, 2] > 1e-6 and gt_box[n, t, 3] > 1e-6
+                 for t in range(B)]
+        for j in range(M):
+            for k in range(H):
+                for l in range(W):  # noqa: E741
+                    pred = (
+                        (l + sig(xr[n, j, 0, k, l])) / H,
+                        (k + sig(xr[n, j, 1, k, l])) / H,
+                        np.exp(xr[n, j, 2, k, l])
+                        * anchors[2 * anchor_mask[j]] / input_size,
+                        np.exp(xr[n, j, 3, k, l])
+                        * anchors[2 * anchor_mask[j] + 1] / input_size,
+                    )
+                    best = 0.0
+                    for t in range(B):
+                        if valid[t]:
+                            best = max(best, iou(pred, gt_box[n, t]))
+                    if best > ignore_thresh:
+                        obj[n, j, k, l] = -1
+        for t in range(B):
+            if not valid[t]:
+                continue
+            gx, gy, gw, gh = gt_box[n, t]
+            gi, gj = int(gx * W), int(gy * H)
+            best_iou, best_n = 0.0, 0
+            for a in range(A):
+                cand = (0, 0, anchors[2 * a] / input_size,
+                        anchors[2 * a + 1] / input_size)
+                v = iou(cand, (0, 0, gw, gh))
+                if v > best_iou:
+                    best_iou, best_n = v, a
+            if best_n not in anchor_mask:
+                continue
+            m = anchor_mask.index(best_n)
+            tx, ty = gx * H - gi, gy * H - gj
+            tw = np.log(gw * input_size / anchors[2 * best_n])
+            th = np.log(gh * input_size / anchors[2 * best_n + 1])
+            sc = 2.0 - gw * gh
+            loss[n] += _sce(xr[n, m, 0, gj, gi], tx) * sc
+            loss[n] += _sce(xr[n, m, 1, gj, gi], ty) * sc
+            loss[n] += abs(xr[n, m, 2, gj, gi] - tw) * sc
+            loss[n] += abs(xr[n, m, 3, gj, gi] - th) * sc
+            obj[n, m, gj, gi] = 1.0
+            for c in range(class_num):
+                lab = pos_l if c == gt_label[n, t] else neg_l
+                loss[n] += _sce(xr[n, m, 5 + c, gj, gi], lab)
+        for j in range(M):
+            for k in range(H):
+                for l in range(W):  # noqa: E741
+                    o = obj[n, j, k, l]
+                    if o > 1e-5:
+                        loss[n] += _sce(xr[n, j, 4, k, l], 1.0) * o
+                    elif o > -0.5:
+                        loss[n] += _sce(xr[n, j, 4, k, l], 0.0)
+    return loss, obj
+
+
+def test_yolov3_loss_matches_reference_port():
+    rng = np.random.RandomState(0)
+    N, H, W, C, B = 2, 8, 8, 4, 5
+    anchors = [10, 14, 23, 27, 37, 58, 81, 82]
+    anchor_mask = [1, 2]
+    M = len(anchor_mask)
+    x = rng.randn(N, M * (5 + C), H, W).astype("float32") * 0.5
+    gt = rng.uniform(0.1, 0.9, (N, B, 4)).astype("float32")
+    gt[:, :, 2:] = rng.uniform(0.05, 0.5, (N, B, 2))
+    gt[0, 3:, 2:] = 0.0  # invalid boxes
+    labels = rng.randint(0, C, (N, B)).astype("int64")
+
+    ref_loss, ref_obj = _np_yolov3_loss(
+        x, gt, labels, anchors, anchor_mask, C, 0.5, 16
+    )
+
+    xv = fluid.data("x", [N, M * (5 + C), H, W])
+    gv = fluid.data("gt", [N, B, 4])
+    lv = fluid.data("lab", [N, B], "int64")
+    loss = layers.yolov3_loss(
+        xv, gv, lv, anchors=anchors, anchor_mask=anchor_mask, class_num=C,
+        ignore_thresh=0.5, downsample_ratio=16,
+    )
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (got,) = exe.run(
+        feed={"x": x, "gt": gt, "lab": labels}, fetch_list=[loss]
+    )
+    np.testing.assert_allclose(np.asarray(got), ref_loss, rtol=2e-5,
+                               atol=2e-4)
+
+
+def test_yolov3_trains_on_toy_boxes():
+    cfg = YoloConfig.tiny(class_num=3)
+    N, S, B = 2, 64, 4
+    img = fluid.data("img", [N, 3, S, S])
+    gt = fluid.data("gt", [N, B, 4])
+    lab = fluid.data("lab", [N, B], "int64")
+    loss = yolov3_train(img, gt, lab, cfg)
+    fluid.optimizer.Adam(1e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    feed = {
+        "img": rng.randn(N, 3, S, S).astype("float32"),
+        "gt": np.tile(
+            np.array([[0.5, 0.5, 0.3, 0.4]], np.float32), (N, B, 1)
+        ),
+        "lab": np.ones((N, B), np.int64),
+    }
+    losses = []
+    for _ in range(12):
+        (v,) = exe.run(feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(v).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+
+
+def test_yolov3_infer_decodes_boxes():
+    cfg = YoloConfig.tiny(class_num=3)
+    N, S = 1, 64
+    img = fluid.data("img", [N, 3, S, S])
+    size = fluid.data("size", [N, 2], "int32")
+    out, num = yolov3_infer(img, size, cfg, keep_top_k=20)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    o, n = exe.run(
+        feed={
+            "img": rng.randn(N, 3, S, S).astype("float32"),
+            "size": np.array([[S, S]], np.int32),
+        },
+        fetch_list=[out, num],
+    )
+    o = np.asarray(o)
+    assert o.shape == (N, 20, 6)
+    assert int(np.asarray(n)[0]) >= 0
