@@ -14,9 +14,10 @@ import numpy as np
 
 
 class AnalysisConfig:
-    def __init__(self, model_dir=None, params_file=None):
+    def __init__(self, model_dir=None, params_file=None, model_file=None):
         self.model_dir = model_dir
         self.params_file = params_file
+        self.model_file = model_file
         self._use_feed_fetch_ops = False
         self._switch_ir_optim = True  # accepted; XLA owns optimization
 
@@ -64,7 +65,12 @@ class Predictor:
                 self._program,
                 self._feed_names,
                 self._fetch_vars,
-            ) = _io.load_inference_model(config.model_dir, self._exe)
+            ) = _io.load_inference_model(
+                config.model_dir,
+                self._exe,
+                model_filename=getattr(config, "model_file", None),
+                params_filename=getattr(config, "params_file", None),
+            )
 
     def get_input_names(self):
         return list(self._feed_names)

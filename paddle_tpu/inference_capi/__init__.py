@@ -1,0 +1,54 @@
+"""C inference API build helper (reference inference/capi/ is compiled into
+libpaddle's CMake build; here the library is built on demand with
+`python3-config --embed` link flags, since the C API hosts the Python/XLA
+runtime in-process). See paddle_tpu_capi.h for the surface.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "capi.cpp")
+_HDR = os.path.join(_HERE, "paddle_tpu_capi.h")
+_LIB = os.path.join(_HERE, "libpaddle_tpu_capi.so")
+
+
+def _embed_flags():
+    """Compiler/linker flags to embed this interpreter."""
+    inc = sysconfig.get_path("include")
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION"
+    )
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    cflags = [f"-I{inc}"]
+    ldflags = [f"-L{libdir}", f"-lpython{ver}"] if libdir else [
+        f"-lpython{ver}"
+    ]
+    return cflags, ldflags
+
+
+def header_path():
+    return _HDR
+
+
+def build_capi(force=False):
+    """Compile libpaddle_tpu_capi.so (cached by source mtime); returns its
+    path. Raises on compile failure (g++ is in the base image)."""
+    if (
+        not force
+        and os.path.exists(_LIB)
+        and os.path.getmtime(_LIB) >= max(
+            os.path.getmtime(_SRC), os.path.getmtime(_HDR)
+        )
+    ):
+        return _LIB
+    cflags, ldflags = _embed_flags()
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f"-I{_HERE}", *cflags, _SRC, "-o", _LIB, *ldflags,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return _LIB

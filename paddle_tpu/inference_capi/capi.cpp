@@ -1,0 +1,283 @@
+// C inference API over the embedded Python Predictor.
+//
+// Reference parity: inference/capi/c_api.cc + pd_predictor.cc front the
+// C++ AnalysisPredictor; this fronts paddle_tpu.inference.Predictor. The
+// C++ side only marshals buffers — tensor conversion and the actual run
+// happen in one embedded helper (_HELPER below) so the numpy C API is
+// never needed.
+
+#include "paddle_tpu_capi.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+const char* kHelper = R"PY(
+import numpy as np
+import paddle_tpu
+from paddle_tpu.inference import AnalysisConfig, PaddleTensor, Predictor
+
+_DT = {0: "float32", 1: "int32", 2: "int64", 3: "uint8"}
+_DT_INV = {v: k for k, v in _DT.items()}
+
+
+def new_predictor(model_dir, model_file, params_file):
+    cfg = AnalysisConfig(model_dir)
+    if model_file:
+        cfg.model_file = model_file
+    if params_file:
+        cfg.params_file = params_file
+    return Predictor(cfg)
+
+
+def run(predictor, names, dtypes, shapes, views):
+    by_name = {}
+    for name, dt, shape, view in zip(names, dtypes, shapes, views):
+        arr = np.frombuffer(view, dtype=_DT[dt]).reshape(shape)
+        by_name[name] = PaddleTensor(arr, name=name)
+    # Predictor.run takes tensors in feed order; C callers pass any order
+    inputs = [by_name[n] for n in predictor.get_input_names()]
+    outs = predictor.run(inputs)
+    result = []
+    for t in outs:
+        a = np.ascontiguousarray(t.as_ndarray())
+        if a.dtype.name not in _DT_INV:
+            a = a.astype("float32")
+        result.append(
+            (t.name, _DT_INV[a.dtype.name], list(a.shape), a.tobytes())
+        )
+    return result
+)PY";
+
+PyObject* g_helper = nullptr;  // module holding kHelper's globals
+std::mutex g_init_mutex;
+
+bool ensure_python() {
+  std::lock_guard<std::mutex> lock(g_init_mutex);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Py_InitializeEx leaves this thread holding the GIL; release it so
+    // the PyGILState_Ensure/Release pairs below work from ANY thread
+    // (otherwise a second thread deadlocks in Ensure forever)
+    PyEval_SaveThread();
+  }
+  if (g_helper == nullptr) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject* mod = PyModule_New("paddle_tpu_capi_helper");
+    PyObject* globals = PyModule_GetDict(mod);
+    PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+    PyObject* res =
+        PyRun_String(kHelper, Py_file_input, globals, globals);
+    if (res == nullptr) {
+      set_error_from_python();
+      Py_DECREF(mod);
+      PyGILState_Release(gil);
+      return false;
+    }
+    Py_DECREF(res);
+    g_helper = mod;
+    PyGILState_Release(gil);
+  }
+  return true;
+}
+
+PyObject* helper_fn(const char* name) {
+  return PyObject_GetAttrString(g_helper, name);
+}
+
+}  // namespace
+
+struct PD_AnalysisConfig {
+  std::string model_dir;
+  std::string model_file;
+  std::string params_file;
+};
+
+struct PD_Predictor {
+  PyObject* py;  // paddle_tpu.inference.Predictor
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+};
+
+extern "C" {
+
+PD_AnalysisConfig* PD_NewAnalysisConfig(void) {
+  return new PD_AnalysisConfig();
+}
+
+void PD_DeleteAnalysisConfig(PD_AnalysisConfig* config) { delete config; }
+
+void PD_SetModel(PD_AnalysisConfig* config, const char* model_dir,
+                 const char* model_file, const char* params_file) {
+  config->model_dir = model_dir != nullptr ? model_dir : "";
+  config->model_file = model_file != nullptr ? model_file : "";
+  config->params_file = params_file != nullptr ? params_file : "";
+}
+
+PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* config) {
+  if (!ensure_python()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PD_Predictor* out = nullptr;
+  PyObject* fn = helper_fn("new_predictor");
+  PyObject* py = fn != nullptr
+                     ? PyObject_CallFunction(
+                           fn, "sss", config->model_dir.c_str(),
+                           config->model_file.c_str(),
+                           config->params_file.c_str())
+                     : nullptr;
+  if (py == nullptr) {
+    set_error_from_python();
+  } else {
+    out = new PD_Predictor();
+    out->py = py;
+    for (const char* which : {"get_input_names", "get_output_names"}) {
+      PyObject* names = PyObject_CallMethod(py, which, nullptr);
+      auto& dst = std::strcmp(which, "get_input_names") == 0
+                      ? out->input_names
+                      : out->output_names;
+      if (names != nullptr) {
+        for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+          dst.push_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+        }
+        Py_DECREF(names);
+      } else {
+        PyErr_Clear();
+      }
+    }
+  }
+  Py_XDECREF(fn);
+  PyGILState_Release(gil);
+  return out;
+}
+
+void PD_DeletePredictor(PD_Predictor* predictor) {
+  if (predictor == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(predictor->py);
+  PyGILState_Release(gil);
+  delete predictor;
+}
+
+int PD_GetInputNum(const PD_Predictor* p) {
+  return static_cast<int>(p->input_names.size());
+}
+
+int PD_GetOutputNum(const PD_Predictor* p) {
+  return static_cast<int>(p->output_names.size());
+}
+
+const char* PD_GetInputName(const PD_Predictor* p, int i) {
+  return p->input_names[i].c_str();
+}
+
+const char* PD_GetOutputName(const PD_Predictor* p, int i) {
+  return p->output_names[i].c_str();
+}
+
+bool PD_PredictorRun(PD_Predictor* predictor, const PD_TensorC* inputs,
+                     int in_size, PD_TensorC** outputs, int* out_size) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  bool ok = false;
+  PyObject *names = PyList_New(in_size), *dtypes = PyList_New(in_size),
+           *shapes = PyList_New(in_size), *views = PyList_New(in_size);
+  for (int i = 0; i < in_size; ++i) {
+    const PD_TensorC& t = inputs[i];
+    PyList_SetItem(names, i, PyUnicode_FromString(t.name));
+    PyList_SetItem(dtypes, i, PyLong_FromLong(t.dtype));
+    PyObject* shp = PyTuple_New(t.rank);
+    for (int d = 0; d < t.rank; ++d) {
+      PyTuple_SetItem(shp, d, PyLong_FromLongLong(t.shape[d]));
+    }
+    PyList_SetItem(shapes, i, shp);
+    PyList_SetItem(
+        views, i,
+        PyMemoryView_FromMemory(static_cast<char*>(t.data),
+                                static_cast<Py_ssize_t>(t.byte_size),
+                                PyBUF_READ));
+  }
+  PyObject* fn = helper_fn("run");
+  PyObject* res =
+      fn != nullptr ? PyObject_CallFunctionObjArgs(
+                          fn, predictor->py, names, dtypes, shapes, views,
+                          nullptr)
+                    : nullptr;
+  if (res == nullptr) {
+    set_error_from_python();
+  } else {
+    int n = static_cast<int>(PyList_Size(res));
+    PD_TensorC* outs = new PD_TensorC[n]();
+    for (int i = 0; i < n; ++i) {
+      PyObject* item = PyList_GetItem(res, i);  // (name, dtype, shape, bytes)
+      const char* nm = PyUnicode_AsUTF8(PyTuple_GetItem(item, 0));
+      char* nm_copy = new char[std::strlen(nm) + 1];
+      std::strcpy(nm_copy, nm);
+      outs[i].name = nm_copy;
+      outs[i].dtype =
+          static_cast<PD_DataType>(PyLong_AsLong(PyTuple_GetItem(item, 1)));
+      PyObject* shp = PyTuple_GetItem(item, 2);
+      outs[i].rank = static_cast<int>(PyList_Size(shp));
+      int64_t* sh = new int64_t[outs[i].rank];
+      for (int d = 0; d < outs[i].rank; ++d) {
+        sh[d] = PyLong_AsLongLong(PyList_GetItem(shp, d));
+      }
+      outs[i].shape = sh;
+      PyObject* payload = PyTuple_GetItem(item, 3);
+      char* buf = nullptr;
+      Py_ssize_t len = 0;
+      PyBytes_AsStringAndSize(payload, &buf, &len);
+      outs[i].byte_size = static_cast<size_t>(len);
+      outs[i].data = new char[len];
+      std::memcpy(outs[i].data, buf, static_cast<size_t>(len));
+    }
+    *outputs = outs;
+    *out_size = n;
+    ok = true;
+    Py_DECREF(res);
+  }
+  Py_XDECREF(fn);
+  Py_XDECREF(names);
+  Py_XDECREF(dtypes);
+  Py_XDECREF(shapes);
+  Py_XDECREF(views);
+  PyGILState_Release(gil);
+  return ok;
+}
+
+void PD_FreeOutputs(PD_TensorC* outputs, int out_size) {
+  if (outputs == nullptr) return;
+  for (int i = 0; i < out_size; ++i) {
+    delete[] outputs[i].name;
+    delete[] outputs[i].shape;
+    delete[] static_cast<char*>(outputs[i].data);
+  }
+  delete[] outputs;
+}
+
+const char* PD_GetLastError(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,80 @@
+/* C inference API (reference paddle/fluid/inference/capi/paddle_c_api.h).
+ *
+ * The reference's C API fronts its C++ AnalysisPredictor; here it fronts
+ * the Python Predictor (paddle_tpu/inference.py) by embedding CPython —
+ * the XLA/TPU runtime lives behind the interpreter, so a C deployment
+ * links this library (built with `python3-config --embed` flags) and gets
+ * the same compiled-program cache the Python API uses.
+ *
+ * Threading: calls may come from any thread; the library takes the GIL
+ * per call. One interpreter per process (PD_* objects are process-global).
+ */
+
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdbool.h>
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum PD_DataType {
+  PD_FLOAT32 = 0,
+  PD_INT32 = 1,
+  PD_INT64 = 2,
+  PD_UINT8 = 3,
+  PD_UNKDTYPE = 4
+} PD_DataType;
+
+typedef struct PD_AnalysisConfig PD_AnalysisConfig;
+typedef struct PD_Predictor PD_Predictor;
+
+/* A tensor crossing the C boundary. For inputs, all fields are caller
+ * owned. For outputs, name/shape/data are library-allocated; release the
+ * whole batch with PD_FreeOutputs. */
+typedef struct PD_TensorC {
+  const char* name;
+  PD_DataType dtype;
+  const int64_t* shape;
+  int rank;
+  void* data;        /* contiguous, C order */
+  size_t byte_size;
+} PD_TensorC;
+
+/* -- config ------------------------------------------------------------ */
+PD_AnalysisConfig* PD_NewAnalysisConfig(void);
+void PD_DeleteAnalysisConfig(PD_AnalysisConfig* config);
+/* model_dir: directory from save_inference_model; model_file /
+ * params_file: optional file names inside it (NULL for defaults). */
+void PD_SetModel(PD_AnalysisConfig* config, const char* model_dir,
+                 const char* model_file, const char* params_file);
+
+/* -- predictor --------------------------------------------------------- */
+/* NULL on failure; PD_GetLastError() describes why. */
+PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* config);
+void PD_DeletePredictor(PD_Predictor* predictor);
+
+int PD_GetInputNum(const PD_Predictor* predictor);
+int PD_GetOutputNum(const PD_Predictor* predictor);
+/* Returned strings are owned by the predictor. */
+const char* PD_GetInputName(const PD_Predictor* predictor, int index);
+const char* PD_GetOutputName(const PD_Predictor* predictor, int index);
+
+/* Run a batch. On success returns true and fills *outputs (library
+ * allocated array of *out_size tensors). */
+bool PD_PredictorRun(PD_Predictor* predictor, const PD_TensorC* inputs,
+                     int in_size, PD_TensorC** outputs, int* out_size);
+void PD_FreeOutputs(PD_TensorC* outputs, int out_size);
+
+/* Last error message for this thread's most recent failed call ("" if
+ * none). Owned by the library. */
+const char* PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H_ */

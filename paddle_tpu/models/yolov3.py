@@ -44,7 +44,7 @@ class YoloConfig:
         return cls(class_num=class_num, scale=0.125)
 
 
-def _cbl(x, ch, k, stride, prefix, cfg):
+def _cbl(x, ch, k, stride, prefix):
     """conv-bn-leaky_relu, the darknet unit."""
     x = layers.conv2d(
         x, ch, k, stride=stride, padding=(k - 1) // 2, bias_attr=False,
@@ -59,36 +59,36 @@ def _cbl(x, ch, k, stride, prefix, cfg):
     )
 
 
-def _res_block(x, ch, prefix, cfg):
+def _res_block(x, ch, prefix):
     """1x1 bottleneck + 3x3, residual add (darknet53 block)."""
-    s = _cbl(x, ch // 2, 1, 1, f"{prefix}_a", cfg)
-    s = _cbl(s, ch, 3, 1, f"{prefix}_b", cfg)
+    s = _cbl(x, ch // 2, 1, 1, f"{prefix}_a")
+    s = _cbl(s, ch, 3, 1, f"{prefix}_b")
     return x + s
 
 
 def darknet53(img, cfg, prefix="dark"):
     """Backbone; returns the C3/C4/C5 feature maps (strides 8/16/32)."""
     depths = (1, 2, 8, 8, 4)
-    x = _cbl(img, cfg.ch(32), 3, 1, f"{prefix}_stem", cfg)
+    x = _cbl(img, cfg.ch(32), 3, 1, f"{prefix}_stem")
     feats = []
     ch = 32
     for stage, blocks in enumerate(depths):
         ch *= 2
-        x = _cbl(x, cfg.ch(ch), 3, 2, f"{prefix}_down{stage}", cfg)
+        x = _cbl(x, cfg.ch(ch), 3, 2, f"{prefix}_down{stage}")
         for b in range(blocks):
-            x = _res_block(x, cfg.ch(ch), f"{prefix}_s{stage}b{b}", cfg)
+            x = _res_block(x, cfg.ch(ch), f"{prefix}_s{stage}b{b}")
         if stage >= 2:
             feats.append(x)
     return feats  # [C3 (stride 8), C4 (16), C5 (32)]
 
 
-def _detection_block(x, ch, prefix, cfg):
+def _detection_block(x, ch, prefix):
     """5-conv block; returns (route for the next scale, head input)."""
     for i in range(2):
-        x = _cbl(x, ch, 1, 1, f"{prefix}_r{i}a", cfg)
-        x = _cbl(x, ch * 2, 3, 1, f"{prefix}_r{i}b", cfg)
-    route = _cbl(x, ch, 1, 1, f"{prefix}_route", cfg)
-    tip = _cbl(route, ch * 2, 3, 1, f"{prefix}_tip", cfg)
+        x = _cbl(x, ch, 1, 1, f"{prefix}_r{i}a")
+        x = _cbl(x, ch * 2, 3, 1, f"{prefix}_r{i}b")
+    route = _cbl(x, ch, 1, 1, f"{prefix}_route")
+    tip = _cbl(route, ch * 2, 3, 1, f"{prefix}_tip")
     return route, tip
 
 
@@ -102,11 +102,11 @@ def yolov3_heads(img, cfg, prefix="yolo"):
     for i, feat in enumerate(scales):
         if route is not None:
             route = _cbl(route, cfg.ch(256 // (2 ** (i - 1))), 1, 1,
-                         f"{prefix}_lat{i}", cfg)
+                         f"{prefix}_lat{i}")
             route = layers.resize_nearest(route, scale=2.0)
             feat = layers.concat([route, feat], axis=1)
         route, tip = _detection_block(
-            feat, cfg.ch(512 // (2 ** i)), f"{prefix}_det{i}", cfg
+            feat, cfg.ch(512 // (2 ** i)), f"{prefix}_det{i}"
         )
         n_out = len(cfg.anchor_masks[i]) * (5 + cfg.class_num)
         outputs.append(

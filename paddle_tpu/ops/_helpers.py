@@ -68,3 +68,12 @@ def register_reduce(op_type, fn):
         return {"Out": [out]}
 
     return emit
+
+
+def stable_sigmoid_ce(x, z):
+    """Numerically stable sigmoid cross-entropy from logits:
+    max(x,0) - x*z + log(1+exp(-|x|)) — shared by the
+    sigmoid_cross_entropy_with_logits emitter and yolov3_loss."""
+    import jax
+
+    return jnp.maximum(x, 0) - x * z + jax.nn.softplus(-jnp.abs(x))

@@ -249,9 +249,7 @@ def _multiclass_nms(ctx, op, ins):
     return {"Out": [out], "NmsRoisNum": [num]}
 
 
-def _sce(x, z):
-    """Stable sigmoid cross-entropy from logits (yolov3_loss_op.h:35)."""
-    return jnp.maximum(x, 0.0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+from ._helpers import stable_sigmoid_ce as _sce  # yolov3_loss_op.h:35
 
 
 @register_op(

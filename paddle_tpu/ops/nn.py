@@ -359,8 +359,10 @@ def _smooth_l1(ctx, op, ins):
     "sigmoid_cross_entropy_with_logits", inputs=["X", "Label"], outputs=["Out"]
 )
 def _sigmoid_ce(ctx, op, ins):
+    from ._helpers import stable_sigmoid_ce
+
     x, label = ins["X"][0], ins["Label"][0]
-    loss = jnp.maximum(x, 0) - x * label + jax.nn.softplus(-jnp.abs(x))
+    loss = stable_sigmoid_ce(x, label)
     ignore = op.attr("ignore_index", -100)
     loss = jnp.where(label == ignore, 0.0, loss)
     if op.attr("normalize", False):
