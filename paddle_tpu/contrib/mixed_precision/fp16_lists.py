@@ -16,8 +16,9 @@ WHITE_LIST = {
     "conv2d",
     "depthwise_conv2d",
     "conv2d_transpose",
-    # Pallas flash kernel: bf16 in/out, fp32 softmax internally
+    # Pallas flash kernels: bf16 in/out, fp32 softmax internally
     "fused_multihead_attention",
+    "fused_qkv_attention",
 }
 
 BLACK_LIST = {

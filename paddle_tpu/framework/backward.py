@@ -192,7 +192,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
             continue
 
         if op_def.grad_maker is not None:
-            op_def.grad_maker(op, block, contribs, finalize)
+            op_def.grad_maker(op, block, contribs, finalize,
+                              needs_grad=needs_grad)
             continue
 
         # finalize the grads of this op's outputs

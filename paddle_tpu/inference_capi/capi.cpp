@@ -155,19 +155,35 @@ PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* config) {
   } else {
     out = new PD_Predictor();
     out->py = py;
+    bool names_ok = true;
     for (const char* which : {"get_input_names", "get_output_names"}) {
       PyObject* names = PyObject_CallMethod(py, which, nullptr);
       auto& dst = std::strcmp(which, "get_input_names") == 0
                       ? out->input_names
                       : out->output_names;
-      if (names != nullptr) {
-        for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
-          dst.push_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
-        }
-        Py_DECREF(names);
-      } else {
-        PyErr_Clear();
+      if (names == nullptr) {
+        set_error_from_python();
+        names_ok = false;
+        break;
       }
+      for (Py_ssize_t i = 0; names_ok && i < PyList_Size(names); ++i) {
+        const char* s = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+        if (s == nullptr) {
+          set_error_from_python();
+          names_ok = false;
+          break;
+        }
+        dst.push_back(s);
+      }
+      Py_DECREF(names);
+      if (!names_ok) break;
+    }
+    if (!names_ok) {
+      // a predictor with unknown inputs/outputs violates the header
+      // contract (PD_GetInputName would index an empty vector) — fail loud
+      Py_DECREF(py);
+      delete out;
+      out = nullptr;
     }
   }
   Py_XDECREF(fn);

@@ -104,27 +104,19 @@ def _apply_dropout(p, rate, is_test, upscale):
     return dropped
 
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
-                *, scale, rate, is_test, upscale, causal):
-    q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
-    if rate > 0.0 and not is_test:
-        _seed_prng(seed_ref)
-    p = _probs(q, k, bias_ref[0], scale, causal)
+def _head_fwd(q, k, v, bias_row, scale, rate, is_test, upscale, causal):
+    """One head's attention output [S, D] (fp32). Draws ONE dropout mask
+    from the already-seeded PRNG when training with dropout — callers must
+    keep the per-head call order identical between forward and backward."""
+    p = _probs(q, k, bias_row, scale, causal)
     p = _apply_dropout(p, rate, is_test, upscale)
-    o_ref[0, 0] = jnp.dot(
-        p, v.astype(jnp.float32), preferred_element_type=jnp.float32
-    ).astype(o_ref.dtype)
+    return jnp.dot(p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
 
 
-def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
-                dq_ref, dk_ref, dv_ref, dbias_ref,
-                *, scale, rate, is_test, upscale, causal):
-    q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
-    do = do_ref[0, 0].astype(jnp.float32)
-    if rate > 0.0 and not is_test:
-        # identical seeding sequence as _fwd_kernel -> identical mask
-        _seed_prng(seed_ref)
-    p = _probs(q, k, bias_ref[0], scale, causal)
+def _head_bwd(q, k, v, bias_row, do, scale, rate, is_test, upscale, causal):
+    """One head's (dq, dk, dv [S,D] fp32, dbias [1,S]); same single PRNG
+    draw as _head_fwd."""
+    p = _probs(q, k, bias_row, scale, causal)
     kf = k.astype(jnp.float32)
     qf = q.astype(jnp.float32)
     vf = v.astype(jnp.float32)
@@ -139,23 +131,42 @@ def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
         pm = p * test_scale
         dpm = jnp.dot(do, vf.T, preferred_element_type=jnp.float32)
         dp = dpm * test_scale
-    dv_ref[0, 0] = jnp.dot(pm.T, do, preferred_element_type=jnp.float32).astype(
-        dv_ref.dtype
-    )
+    dv = jnp.dot(pm.T, do, preferred_element_type=jnp.float32)
     # softmax backward: dS = P * (dP - rowsum(dP * P))
     d = jnp.sum(dp * p, axis=-1, keepdims=True)
     ds = p * (dp - d)
-    dq_ref[0, 0] = (
-        jnp.dot(ds, kf, preferred_element_type=jnp.float32) * scale
-    ).astype(dq_ref.dtype)
-    dk_ref[0, 0] = (
-        jnp.dot(ds.T, qf, preferred_element_type=jnp.float32) * scale
-    ).astype(dk_ref.dtype)
+    dq = jnp.dot(ds, kf, preferred_element_type=jnp.float32) * scale
+    dk = jnp.dot(ds.T, qf, preferred_element_type=jnp.float32) * scale
+    return dq, dk, dv, jnp.sum(ds, axis=0, keepdims=True)
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+                *, scale, rate, is_test, upscale, causal):
+    q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+    if rate > 0.0 and not is_test:
+        _seed_prng(seed_ref)
+    o_ref[0, 0] = _head_fwd(
+        q, k, v, bias_ref[0], scale, rate, is_test, upscale, causal
+    ).astype(o_ref.dtype)
+
+
+def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, dbias_ref,
+                *, scale, rate, is_test, upscale, causal):
+    q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    if rate > 0.0 and not is_test:
+        # identical seeding sequence as _fwd_kernel -> identical mask
+        _seed_prng(seed_ref)
+    dq, dk, dv, db = _head_bwd(
+        q, k, v, bias_ref[0], do, scale, rate, is_test, upscale, causal
+    )
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
     # bias broadcasts over heads and query rows -> grad reduces over both.
     # The h grid axis is innermost, so this output block (indexed by b only)
     # stays resident while heads accumulate into it.
-    db = jnp.sum(ds, axis=0, keepdims=True)
-
     @pl.when(pl.program_id(1) == 0)
     def _init():
         dbias_ref[0] = db
@@ -231,6 +242,325 @@ def _pallas_bwd(q, k, v, bias, seed, do, statics, interpret):
     return dq, dk, dv, dbias.reshape(B, S)
 
 
+# ---------------------------------------------------------------------------
+# packed-QKV variant: reads the fused [B, S, 3H] projection directly
+# ---------------------------------------------------------------------------
+#
+# The [B,H,S,D] kernels above force the model to materialize head-split
+# transposes around the custom call (XLA cannot fuse a transpose INTO a
+# Mosaic call): at BERT-base that is 8 copies of [B,S,H] per layer per
+# step, ~2.4 GB of pure layout traffic. The packed kernels instead index
+# the qkv projection output [B, S, 3*H*D] in place — q/k/v are the SAME
+# operand passed three times with different column-block index maps — and
+# emit [B, S, H*D]. Each grid step owns a 128-lane column group
+# (G = 128//D heads) so the lane dimension is full.
+
+
+def supports_packed(seq_len: int, num_heads: int, head_dim: int, dtype):
+    g = 128 // head_dim if head_dim and 128 % head_dim == 0 else 0
+    return (
+        g > 0
+        and num_heads % g == 0
+        and seq_len % 128 == 0
+        and seq_len <= MAX_SEQ
+        and jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                 jnp.dtype(jnp.bfloat16))
+    )
+
+
+def _group_spec(S, section, num_groups):
+    """(1, S, 128) blocks over [B, S, 3*H*D]; section 0/1/2 = q/k/v."""
+    return pl.BlockSpec(
+        (1, S, 128),
+        lambda b, g: (b, 0, section * num_groups + g),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _out_group_spec(S):
+    return pl.BlockSpec(
+        (1, S, 128), lambda b, g: (b, 0, g), memory_space=pltpu.VMEM
+    )
+
+
+def _bias_spec2(S):
+    return pl.BlockSpec(
+        (1, 1, S), lambda b, g: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+
+
+def _fwd_kernel_qkv(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+                    *, D, scale, rate, is_test, upscale, causal):
+    if rate > 0.0 and not is_test:
+        _seed_prng(seed_ref)
+    qg, kg, vg, bias = q_ref[0], k_ref[0], v_ref[0], bias_ref[0]
+    for i in range(128 // D):
+        sl = slice(i * D, (i + 1) * D)
+        o_ref[0, :, sl] = _head_fwd(
+            qg[:, sl], kg[:, sl], vg[:, sl], bias,
+            scale, rate, is_test, upscale, causal,
+        ).astype(o_ref.dtype)
+
+
+def _bwd_kernel_qkv(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                    dq_ref, dk_ref, dv_ref, dbias_ref,
+                    *, D, scale, rate, is_test, upscale, causal):
+    if rate > 0.0 and not is_test:
+        # same seed + same per-head draw order as _fwd_kernel_qkv
+        _seed_prng(seed_ref)
+    qg, kg, vg, bias = q_ref[0], k_ref[0], v_ref[0], bias_ref[0]
+    db_total = jnp.zeros((1, bias.shape[-1]), jnp.float32)
+    for i in range(128 // D):
+        sl = slice(i * D, (i + 1) * D)
+        do = do_ref[0, :, sl].astype(jnp.float32)
+        dq, dk, dv, db = _head_bwd(
+            qg[:, sl], kg[:, sl], vg[:, sl], bias, do,
+            scale, rate, is_test, upscale, causal,
+        )
+        dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
+        dk_ref[0, :, sl] = dk.astype(dk_ref.dtype)
+        dv_ref[0, :, sl] = dv.astype(dv_ref.dtype)
+        db_total = db_total + db
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        dbias_ref[0] = db_total
+
+    @pl.when(pl.program_id(1) != 0)
+    def _acc():
+        dbias_ref[0] = dbias_ref[0] + db_total
+
+
+def _pallas_fwd_qkv(qkv, bias, seed, H, D, statics, interpret):
+    B, S, _ = qkv.shape
+    num_groups = H * D // 128
+    bias = bias.reshape(B, 1, S)
+    kern = functools.partial(_fwd_kernel_qkv, D=D, **statics)
+    return pl.pallas_call(
+        kern,
+        grid=(B, num_groups),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            _group_spec(S, 0, num_groups),
+            _group_spec(S, 1, num_groups),
+            _group_spec(S, 2, num_groups),
+            _bias_spec2(S),
+        ],
+        out_specs=_out_group_spec(S),
+        out_shape=jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed, qkv, qkv, qkv, bias)
+
+
+def _pallas_bwd_qkv(qkv, bias, seed, do, H, D, statics, interpret):
+    B, S, _ = qkv.shape
+    num_groups = H * D // 128
+    bias = bias.reshape(B, 1, S)
+    kern = functools.partial(_bwd_kernel_qkv, D=D, **statics)
+    dq, dk, dv, dbias = pl.pallas_call(
+        kern,
+        grid=(B, num_groups),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            _group_spec(S, 0, num_groups),
+            _group_spec(S, 1, num_groups),
+            _group_spec(S, 2, num_groups),
+            _bias_spec2(S),
+            _out_group_spec(S),
+        ],
+        out_specs=[
+            _out_group_spec(S),
+            _out_group_spec(S),
+            _out_group_spec(S),
+            _bias_spec2(S),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
+            jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
+            jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
+            jax.ShapeDtypeStruct((B, 1, S), jnp.float32),
+        ],
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed, qkv, qkv, qkv, bias, do)
+    dqkv = jnp.concatenate([dq, dk, dv], axis=-1)
+    return dqkv, dbias.reshape(B, S)
+
+
+def _reference_qkv(qkv, bias, rng_key, H, **statics):
+    B, S, three_hd = qkv.shape
+    D = three_hd // 3 // H
+    def split(i):
+        part = qkv[..., i * H * D:(i + 1) * H * D]
+        return part.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    out = _reference(split(0), split(1), split(2), bias, rng_key, **statics)
+    return out.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_qkv(qkv, bias, seed, H, D, statics, interpret):
+    return _pallas_fwd_qkv(qkv, bias, seed, H, D, dict(statics), interpret)
+
+
+def _flash_qkv_fwd(qkv, bias, seed, H, D, statics, interpret):
+    out = _pallas_fwd_qkv(qkv, bias, seed, H, D, dict(statics), interpret)
+    return out, (qkv, bias, seed)
+
+
+def _flash_qkv_bwd(H, D, statics, interpret, res, g):
+    qkv, bias, seed = res
+    dqkv, dbias = _pallas_bwd_qkv(
+        qkv, bias, seed, g, H, D, dict(statics), interpret
+    )
+    dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dqkv, dbias, dseed
+
+
+_flash_qkv.defvjp(_flash_qkv_fwd, _flash_qkv_bwd)
+
+
+def fused_attention_qkv(
+    qkv,
+    num_heads,
+    key_bias=None,
+    *,
+    scale=None,
+    dropout_rate=0.0,
+    is_test=True,
+    dropout_implementation="downgrade_in_infer",
+    causal=False,
+    rng_key=None,
+    interpret=False,
+    force_reference=False,
+):
+    """Attention over a packed qkv projection [B, S, 3*H*D] -> [B, S, H*D].
+    Same semantics as fused_attention; the packed layout avoids every
+    head-split transpose/copy around the kernel."""
+    B, S, three_hd = qkv.shape
+    D = three_hd // 3 // num_heads
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    statics = dict(
+        scale=float(scale),
+        rate=float(dropout_rate),
+        is_test=bool(is_test),
+        upscale=dropout_implementation == "upscale_in_train",
+        causal=bool(causal),
+    )
+    bias = (
+        jnp.zeros((B, S), jnp.float32)
+        if key_bias is None
+        else key_bias.astype(jnp.float32)
+    )
+    training_dropout = dropout_rate > 0.0 and not is_test
+    if rng_key is None:
+        if training_dropout:
+            raise ValueError("fused_attention_qkv: dropout needs rng_key")
+        rng_key = jax.random.key(0)
+    use_pallas = (
+        not force_reference
+        and (interpret or jax.default_backend() == "tpu")
+        and supports_packed(S, num_heads, D, qkv.dtype)
+    )
+    if not use_pallas:
+        if (
+            not force_reference
+            and not interpret
+            and jax.default_backend() == "tpu"
+            and supports(S, D, qkv.dtype)
+        ):
+            # packed layout unsupported (e.g. odd head grouping) but the
+            # 4-D kernel can run: pay the unpack transposes, never the
+            # dense [B,H,S,S]-in-HBM cliff
+            q, k, v = _unpack_qkv(qkv, num_heads)
+            seed = _seed_words(rng_key)
+            out4 = _flash(q, k, v, bias, seed, tuple(statics.items()), False)
+            B_, H_, S_, D_ = out4.shape
+            return out4.transpose(0, 2, 1, 3).reshape(B_, S_, H_ * D_)
+        return _reference_qkv(qkv, bias, rng_key, num_heads, **statics)
+    if interpret and training_dropout:
+        raise ValueError(
+            "fused_attention_qkv: training dropout is unsupported in "
+            "interpret mode (interpreter PRNG is a stub)"
+        )
+    seed = _seed_words(rng_key)
+    return _flash_qkv(
+        qkv, bias, seed, num_heads, D, tuple(statics.items()), interpret
+    )
+
+
+def _unpack_qkv(qkv, H):
+    B, S, three_hd = qkv.shape
+    D = three_hd // 3 // H
+    def part(i):
+        sec = qkv[..., i * H * D:(i + 1) * H * D]
+        return sec.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    return part(0), part(1), part(2)
+
+
+def attention_grads_qkv(qkv, num_heads, key_bias, d_out, rng_key, *,
+                        scale=None, dropout_rate=0.0, is_test=True,
+                        dropout_implementation="downgrade_in_infer",
+                        causal=False, force_reference=False,
+                        interpret=False):
+    """(dqkv, dbias) without re-running the forward kernel (see
+    attention_grads)."""
+    B, S, three_hd = qkv.shape
+    D = three_hd // 3 // num_heads
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    statics = dict(
+        scale=float(scale),
+        rate=float(dropout_rate),
+        is_test=bool(is_test),
+        upscale=dropout_implementation == "upscale_in_train",
+        causal=bool(causal),
+    )
+    bias = (
+        jnp.zeros((B, S), jnp.float32)
+        if key_bias is None
+        else key_bias.astype(jnp.float32)
+    )
+    if rng_key is None:
+        if dropout_rate > 0.0 and not is_test:
+            # silently substituting a fixed key would draw a mask UNRELATED
+            # to the forward's -> silently wrong gradients
+            raise ValueError("attention_grads_qkv: dropout needs rng_key")
+        rng_key = jax.random.key(0)
+    use_pallas = (
+        not force_reference
+        and (interpret or jax.default_backend() == "tpu")
+        and supports_packed(S, num_heads, D, qkv.dtype)
+    )
+    if use_pallas:
+        seed = _seed_words(rng_key)
+        return _pallas_bwd_qkv(
+            qkv, bias, seed, d_out, num_heads, D, statics, interpret
+        )
+    if (
+        not force_reference
+        and not interpret
+        and jax.default_backend() == "tpu"
+        and supports(S, D, qkv.dtype)
+    ):
+        # mirror fused_attention_qkv's 4-D kernel fallback exactly (same
+        # seed -> same dropout masks as the forward it pairs with)
+        q, k, v = _unpack_qkv(qkv, num_heads)
+        do4 = d_out.reshape(B, S, num_heads, D).transpose(0, 2, 1, 3)
+        seed = _seed_words(rng_key)
+        dq, dk, dv, dbias = _pallas_bwd(
+            q, k, v, bias, seed, do4, statics, False
+        )
+        def pack(t):
+            return t.transpose(0, 2, 1, 3).reshape(B, S, num_heads * D)
+        return jnp.concatenate([pack(dq), pack(dk), pack(dv)], -1), dbias
+    _, vjp = jax.vjp(
+        lambda qkv_, b_: _reference_qkv(qkv_, b_, rng_key, num_heads,
+                                        **statics),
+        qkv, bias,
+    )
+    return vjp(d_out)
+
+
 def _reference(q, k, v, bias, rng_key, *, scale, rate, is_test, upscale,
                causal):
     """Same math as the kernels in plain jnp (CPU path / oracle). Dropout
@@ -256,6 +586,56 @@ def _reference(q, k, v, bias, rng_key, *, scale, rate, is_test, upscale,
             p = jnp.where(keep, p / (1.0 - rate) if upscale else p, 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def attention_grads(q, k, v, key_bias, d_out, rng_key, *, scale=None,
+                    dropout_rate=0.0, is_test=True,
+                    dropout_implementation="downgrade_in_infer",
+                    causal=False, force_reference=False, interpret=False):
+    """(dq, dk, dv, dbias) for fused_attention, computed WITHOUT re-running
+    the forward kernel — the flash backward needs no forward residuals.
+    Used by the fused_multihead_attention_grad op so training programs run
+    one forward + one backward Mosaic call (XLA does not CSE custom-calls,
+    so the generic vjp-replay pattern would pay the forward twice)."""
+    B, H, S, D = q.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    statics = dict(
+        scale=float(scale),
+        rate=float(dropout_rate),
+        is_test=bool(is_test),
+        upscale=dropout_implementation == "upscale_in_train",
+        causal=bool(causal),
+    )
+    bias = (
+        jnp.zeros((B, S), jnp.float32)
+        if key_bias is None
+        else key_bias.astype(jnp.float32)
+    )
+    if rng_key is None:
+        if dropout_rate > 0.0 and not is_test:
+            # a substitute key would draw a mask unrelated to the forward's
+            raise ValueError("attention_grads: dropout needs rng_key")
+        rng_key = jax.random.key(0)
+    use_pallas = not force_reference and (
+        interpret
+        or (jax.default_backend() == "tpu" and supports(S, D, q.dtype))
+    )
+    if use_pallas:
+        seed = _seed_words(rng_key)
+        return _pallas_bwd(q, k, v, bias, seed, d_out, statics, interpret)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_, b_: _reference(q_, k_, v_, b_, rng_key, **statics),
+        q, k, v, bias,
+    )
+    return vjp(d_out)
+
+
+def _seed_words(rng_key):
+    seed = jnp.ravel(jax.random.key_data(rng_key)).astype(jnp.uint32)[:2]
+    if seed.shape[0] < 2:  # rbg/other impls may expose a single word
+        seed = jnp.concatenate([seed, jnp.zeros(1, jnp.uint32)])
+    return seed
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
@@ -337,7 +717,5 @@ def fused_attention(
             "mode (interpreter PRNG is a stub); test dropout on TPU or via "
             "the jnp reference path (force_reference=True)"
         )
-    seed = jnp.ravel(jax.random.key_data(rng_key)).astype(jnp.uint32)[:2]
-    if seed.shape[0] < 2:  # rbg/other impls may expose a single word
-        seed = jnp.concatenate([seed, jnp.zeros(1, jnp.uint32)])
+    seed = _seed_words(rng_key)
     return _flash(q, k, v, bias, seed, tuple(statics.items()), interpret)

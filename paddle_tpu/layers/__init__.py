@@ -5,6 +5,7 @@ from .nn import *  # noqa: F401,F403
 from .nn import _apply_act  # noqa: F401
 from .attention import (  # noqa: F401
     fused_multihead_attention,
+    fused_qkv_attention,
     moe_ffn,
     moe_shardings,
     ring_attention,

@@ -55,6 +55,37 @@ def fused_multihead_attention(
     return helper.create_and_append(inputs, attrs)
 
 
+def fused_qkv_attention(
+    qkv,
+    num_heads,
+    key_bias=None,
+    scale=None,
+    dropout_prob=0.0,
+    is_test=False,
+    dropout_implementation="downgrade_in_infer",
+    causal=False,
+    name=None,
+):
+    """Attention directly over a packed qkv projection [B, S, 3*H*D] ->
+    [B, S, H*D]. Preferred over fused_multihead_attention when the model
+    computes qkv as one matmul: the Pallas kernel indexes the projection in
+    place, so no head-split transposes/copies ever materialize."""
+    helper = LayerHelper("fused_qkv_attention", name=name)
+    inputs = {"QKV": [qkv]}
+    if key_bias is not None:
+        inputs["KeyBias"] = [key_bias]
+    attrs = {
+        "num_heads": int(num_heads),
+        "dropout_prob": dropout_prob,
+        "is_test": is_test,
+        "dropout_implementation": dropout_implementation,
+        "causal": causal,
+    }
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    return helper.create_and_append(inputs, attrs)
+
+
 def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
                    name=None):
     """q,k,v: [B, H, S, D] with S sharded over `axis_name` under SPMD."""

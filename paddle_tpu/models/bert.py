@@ -85,39 +85,38 @@ def _attention(x, attn_bias, cfg, prefix, is_test):
     b, s, h = x.shape
     nh, dh = cfg.num_heads, cfg.hidden_size // cfg.num_heads
     qkv = _dense(x, 3 * h, f"{prefix}_qkv", cfg)  # [B,S,3H] one fused matmul
-    # slice along the feature dim + per-tensor [B,nh,S,dh] transposes: XLA
-    # folds the slices into the producing matmul and the three small
-    # transposes fuse with their consuming dots, unlike a single 5-D
-    # [3,B,nh,S,dh] megatranspose which materializes a full copy
+    if cfg.use_fused_attention:
+        # one op straight off the qkv matmul: the Pallas flash kernel
+        # indexes the packed [B,S,3H] projection in place (no head-split
+        # transposes, no [B,nh,S,S] probs in HBM); attn_bias is the [B,S]
+        # key mask (0 keep / -1e4 pad)
+        ctxv = layers.fused_qkv_attention(
+            qkv, nh, key_bias=attn_bias,
+            scale=1.0 / math.sqrt(dh),
+            dropout_prob=cfg.attention_dropout, is_test=is_test,
+        )
+        return _dense(ctxv, h, f"{prefix}_out", cfg)
+
+    # dense path: slice along the feature dim + per-tensor [B,nh,S,dh]
+    # transposes (XLA folds the slices into the producing matmul and fuses
+    # the transposes with their consuming dots)
     def head(t):
         return layers.transpose(layers.reshape(t, [b, s, nh, dh]), [0, 2, 1, 3])
 
     q = head(layers.slice(qkv, [2], [0], [h]))
     k = head(layers.slice(qkv, [2], [h], [2 * h]))
     v = head(layers.slice(qkv, [2], [2 * h], [3 * h]))
-    if cfg.use_fused_attention:
-        # one op: Pallas flash kernel on TPU (never materializes the
-        # [B,nh,S,S] probs to HBM), jnp reference elsewhere — attn_bias here
-        # is the [B,S] key mask (0 keep / -1e4 pad)
-        ctxv = layers.fused_multihead_attention(
-            q, k, v, key_bias=attn_bias,
-            scale=1.0 / math.sqrt(dh),
-            dropout_prob=cfg.attention_dropout, is_test=is_test,
-        )
-    else:
-        bias4 = None
-        if attn_bias is not None:
-            bias4 = layers.reshape(attn_bias, [b, 1, 1, s])
-        scores = layers.matmul(
-            q, k, transpose_y=True, alpha=1.0 / math.sqrt(dh)
-        )
-        if bias4 is not None:
-            scores = scores + bias4  # [B,1,1,S] additive mask broadcast
-        probs = layers.softmax(scores, axis=-1)
-        probs = layers.dropout(
-            probs, dropout_prob=cfg.attention_dropout, is_test=is_test
-        )
-        ctxv = layers.matmul(probs, v)  # [B,nh,S,dh]
+    bias4 = None
+    if attn_bias is not None:
+        bias4 = layers.reshape(attn_bias, [b, 1, 1, s])
+    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(dh))
+    if bias4 is not None:
+        scores = scores + bias4  # [B,1,1,S] additive mask broadcast
+    probs = layers.softmax(scores, axis=-1)
+    probs = layers.dropout(
+        probs, dropout_prob=cfg.attention_dropout, is_test=is_test
+    )
+    ctxv = layers.matmul(probs, v)  # [B,nh,S,dh]
     ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
     ctxv = layers.reshape(ctxv, [b, s, h])
     return _dense(ctxv, h, f"{prefix}_out", cfg)

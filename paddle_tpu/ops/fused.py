@@ -12,10 +12,76 @@ from __future__ import annotations
 from ..framework.registry import register_op
 
 
+def _make_attention_grad_maker(grad_op_type, primal_slots):
+    """Grad makers that emit a dedicated grad op instead of the generic
+    __vjp__ replay.
+
+    The flash backward kernel needs only the primal inputs and d_out — no
+    forward residuals — but the generic __vjp__ re-traces the forward
+    emitter under jax.vjp, and XLA does NOT CSE the two identical Mosaic
+    custom-calls, so every training step would run the forward attention
+    kernel twice. A custom grad op calls the backward kernel directly.
+    Gradient outputs are emitted only for inputs in `needs_grad` (the
+    generic path's per-input filter, backward.py)."""
+
+    def maker(op, block, contribs, finalize, needs_grad=None):
+        from ..framework import unique_name
+        from ..framework.backward import _ensure_var
+        from ..framework.program import grad_var_name
+
+        g_out = finalize(op.outputs["Out"][0])
+        if g_out is None:
+            return
+        has_bias = bool(op.inputs.get("KeyBias"))
+        inputs = {slot: op.inputs[slot] for slot in primal_slots}
+        inputs["OutGrad"] = [g_out]
+        if has_bias:
+            inputs["KeyBias"] = op.inputs["KeyBias"]
+        outs = {}
+        for slot in primal_slots + (("KeyBias",) if has_bias else ()):
+            n = op.inputs[slot][0]
+            if needs_grad is not None and n not in needs_grad:
+                continue
+            gname = unique_name.generate(grad_var_name(n) + "@RENAME")
+            _ensure_var(block, gname, n)
+            outs[slot + "Grad"] = [gname]
+            contribs.setdefault(n, []).append(gname)
+        if not outs:
+            return
+        attrs = {
+            k: v
+            for k, v in op.attrs.items()
+            if k not in ("__uid__", "__loc__")
+        }
+        # dropout masks must regenerate from the FORWARD op's RNG stream
+        attrs["__fwd_uid__"] = op.uid
+        block.append_op(grad_op_type, inputs, outs, attrs)
+
+    return maker
+
+
+def _attn_ctx(ctx, op):
+    """(is_test, dropout rate, gspmd-mode?) shared by the fused attention
+    emitters. Under gspmd-mode SPMD (mesh annotations without shard_map)
+    the jnp reference path is forced: GSPMD cannot partition a pallas_call,
+    while inside shard_map the kernel sees local shards and is safe."""
+    is_test = bool(op.attr("is_test", False)) or ctx.is_test
+    rate = float(op.attr("dropout_prob", 0.0))
+    gspmd_mode = (
+        not ctx.mesh_axes
+        and ctx.program is not None
+        and getattr(ctx.program, "_mesh", None) is not None
+    )
+    return is_test, rate, gspmd_mode
+
+
 @register_op(
     "fused_multihead_attention",
     inputs=["Q", "K", "V", "KeyBias"],
     outputs=["Out"],
+    grad_maker=_make_attention_grad_maker(
+        "fused_multihead_attention_grad", ("Q", "K", "V")
+    ),
 )
 def _fused_multihead_attention(ctx, op, ins):
     """softmax(QK^T * scale + KeyBias) V with fused attention-prob dropout.
@@ -23,25 +89,16 @@ def _fused_multihead_attention(ctx, op, ins):
     Q/K/V: [B, H, S, D]; KeyBias (optional): additive [B, S] fp32. On TPU
     this lowers to the Pallas flash kernel; elsewhere (CPU tests, or shapes
     the kernel does not support) it falls back to the jnp reference with
-    identical semantics. Under gspmd-mode SPMD (mesh annotations without
-    shard_map) the reference path is forced: GSPMD cannot partition a
-    pallas_call, while inside shard_map the kernel sees local shards and is
-    safe.
+    identical semantics (see _attn_ctx for the SPMD rule).
     """
     from ..kernels.flash_attention import fused_attention
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     bias = ins.get("KeyBias", [None])[0] if ins.get("KeyBias") else None
-    is_test = bool(op.attr("is_test", False)) or ctx.is_test
-    rate = float(op.attr("dropout_prob", 0.0))
+    is_test, rate, gspmd_mode = _attn_ctx(ctx, op)
     rng_key = None
     if rate > 0.0 and not is_test:
         rng_key = ctx.key_for(op.uid, op.type)
-    gspmd_mode = (
-        not ctx.mesh_axes
-        and ctx.program is not None
-        and getattr(ctx.program, "_mesh", None) is not None
-    )
     out = fused_attention(
         q,
         k,
@@ -58,3 +115,119 @@ def _fused_multihead_attention(ctx, op, ins):
         force_reference=gspmd_mode,
     )
     return {"Out": [out]}
+
+
+@register_op(
+    "fused_multihead_attention_grad",
+    inputs=["Q", "K", "V", "KeyBias", "OutGrad"],
+    outputs=["QGrad", "KGrad", "VGrad", "KeyBiasGrad"],
+    differentiable=False,
+)
+def _fused_multihead_attention_grad(ctx, op, ins):
+    """Backward of the fused attention op via the flash backward kernel —
+    no forward replay (see _fused_mha_grad_maker). Dropout masks regenerate
+    from the forward op's RNG stream (__fwd_uid__)."""
+    from ..kernels.flash_attention import attention_grads
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = ins.get("KeyBias", [None])[0] if ins.get("KeyBias") else None
+    d_out = ins["OutGrad"][0]
+    is_test, rate, gspmd_mode = _attn_ctx(ctx, op)
+    rng_key = None
+    if rate > 0.0 and not is_test:
+        rng_key = ctx.key_for(
+            int(op.attr("__fwd_uid__", 0)), "fused_multihead_attention"
+        )
+    dq, dk, dv, dbias = attention_grads(
+        q, k, v, bias, d_out, rng_key,
+        scale=op.attr("scale", None),
+        dropout_rate=rate,
+        is_test=is_test,
+        dropout_implementation=op.attr(
+            "dropout_implementation", "downgrade_in_infer"
+        ),
+        causal=bool(op.attr("causal", False)),
+        force_reference=gspmd_mode,
+    )
+    outs = {}
+    for slot, g in (("QGrad", dq), ("KGrad", dk), ("VGrad", dv)):
+        if op.outputs.get(slot):
+            outs[slot] = [g]
+    if op.outputs.get("KeyBiasGrad"):
+        outs["KeyBiasGrad"] = [dbias.astype(bias.dtype)]
+    return outs
+
+
+@register_op(
+    "fused_qkv_attention",
+    inputs=["QKV", "KeyBias"],
+    outputs=["Out"],
+    grad_maker=_make_attention_grad_maker(
+        "fused_qkv_attention_grad", ("QKV",)
+    ),
+)
+def _fused_qkv_attention(ctx, op, ins):
+    """Attention over the packed qkv projection [B, S, 3*H*D] -> [B, S,
+    H*D] (attr num_heads). On TPU the Pallas kernel indexes the projection
+    in place — no head-split transposes ever materialize (the 4-D op above
+    costs 8 layout copies of [B,S,H] per layer per step)."""
+    from ..kernels.flash_attention import fused_attention_qkv
+
+    qkv = ins["QKV"][0]
+    bias = ins.get("KeyBias", [None])[0] if ins.get("KeyBias") else None
+    is_test, rate, gspmd_mode = _attn_ctx(ctx, op)
+    rng_key = None
+    if rate > 0.0 and not is_test:
+        rng_key = ctx.key_for(op.uid, op.type)
+    out = fused_attention_qkv(
+        qkv,
+        int(op.attr("num_heads")),
+        key_bias=bias,
+        scale=op.attr("scale", None),
+        dropout_rate=rate,
+        is_test=is_test,
+        dropout_implementation=op.attr(
+            "dropout_implementation", "downgrade_in_infer"
+        ),
+        causal=bool(op.attr("causal", False)),
+        rng_key=rng_key,
+        force_reference=gspmd_mode,
+    )
+    return {"Out": [out]}
+
+
+@register_op(
+    "fused_qkv_attention_grad",
+    inputs=["QKV", "KeyBias", "OutGrad"],
+    outputs=["QKVGrad", "KeyBiasGrad"],
+    differentiable=False,
+)
+def _fused_qkv_attention_grad(ctx, op, ins):
+    from ..kernels.flash_attention import attention_grads_qkv
+
+    qkv = ins["QKV"][0]
+    bias = ins.get("KeyBias", [None])[0] if ins.get("KeyBias") else None
+    d_out = ins["OutGrad"][0]
+    is_test, rate, gspmd_mode = _attn_ctx(ctx, op)
+    rng_key = None
+    if rate > 0.0 and not is_test:
+        rng_key = ctx.key_for(
+            int(op.attr("__fwd_uid__", 0)), "fused_qkv_attention"
+        )
+    dqkv, dbias = attention_grads_qkv(
+        qkv, int(op.attr("num_heads")), bias, d_out, rng_key,
+        scale=op.attr("scale", None),
+        dropout_rate=rate,
+        is_test=is_test,
+        dropout_implementation=op.attr(
+            "dropout_implementation", "downgrade_in_infer"
+        ),
+        causal=bool(op.attr("causal", False)),
+        force_reference=gspmd_mode,
+    )
+    outs = {}
+    if op.outputs.get("QKVGrad"):
+        outs["QKVGrad"] = [dqkv]
+    if op.outputs.get("KeyBiasGrad"):
+        outs["KeyBiasGrad"] = [dbias.astype(bias.dtype)]
+    return outs
