@@ -166,6 +166,12 @@ PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* config) {
         names_ok = false;
         break;
       }
+      if (!PyList_Check(names)) {
+        g_last_error = "predictor name query did not return a list";
+        Py_DECREF(names);
+        names_ok = false;
+        break;
+      }
       for (Py_ssize_t i = 0; names_ok && i < PyList_Size(names); ++i) {
         const char* s = PyUnicode_AsUTF8(PyList_GetItem(names, i));
         if (s == nullptr) {

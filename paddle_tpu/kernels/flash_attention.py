@@ -389,10 +389,8 @@ def _pallas_bwd_qkv(qkv, bias, seed, do, H, D, statics, interpret):
 def _reference_qkv(qkv, bias, rng_key, H, **statics):
     B, S, three_hd = qkv.shape
     D = three_hd // 3 // H
-    def split(i):
-        part = qkv[..., i * H * D:(i + 1) * H * D]
-        return part.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-    out = _reference(split(0), split(1), split(2), bias, rng_key, **statics)
+    q, k, v = _unpack_qkv(qkv, H)
+    out = _reference(q, k, v, bias, rng_key, **statics)
     return out.transpose(0, 2, 1, 3).reshape(B, S, H * D)
 
 
@@ -526,6 +524,12 @@ def attention_grads_qkv(qkv, num_heads, key_bias, d_out, rng_key, *,
             # to the forward's -> silently wrong gradients
             raise ValueError("attention_grads_qkv: dropout needs rng_key")
         rng_key = jax.random.key(0)
+    if interpret and dropout_rate > 0.0 and not is_test:
+        # interpreter PRNG is a zero stub -> mask unrelated to any forward
+        raise ValueError(
+            "attention_grads_qkv: training dropout is unsupported in "
+            "interpret mode (interpreter PRNG is a stub)"
+        )
     use_pallas = (
         not force_reference
         and (interpret or jax.default_backend() == "tpu")
@@ -617,6 +621,11 @@ def attention_grads(q, k, v, key_bias, d_out, rng_key, *, scale=None,
             # a substitute key would draw a mask unrelated to the forward's
             raise ValueError("attention_grads: dropout needs rng_key")
         rng_key = jax.random.key(0)
+    if interpret and dropout_rate > 0.0 and not is_test:
+        raise ValueError(
+            "attention_grads: training dropout is unsupported in interpret "
+            "mode (interpreter PRNG is a stub)"
+        )
     use_pallas = not force_reference and (
         interpret
         or (jax.default_backend() == "tpu" and supports(S, D, q.dtype))

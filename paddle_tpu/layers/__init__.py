@@ -71,10 +71,14 @@ from .sequence_lod import (  # noqa: F401
 from . import rnn  # noqa: F401
 from .rnn import dynamic_gru, dynamic_lstm, gru, lstm  # noqa: F401
 from .detection import (  # noqa: F401
+    anchor_generator,
+    box_clip,
     box_coder,
     iou_similarity,
     multiclass_nms,
     prior_box,
+    roi_align,
+    roi_pool,
     yolo_box,
     yolov3_loss,
 )

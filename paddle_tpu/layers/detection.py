@@ -88,3 +88,58 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         },
         out_slots=("Loss", "ObjectnessMask", "GTMatchMask"),
     )[0]
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    """RoIAlign (reference fluid/layers: roi_align -> roi_align_op.h).
+    rois [R, 4] image-coordinate corners; rois_num [N] per-image counts
+    (LoD-free)."""
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    return _simple(
+        "roi_align",
+        inputs,
+        {"pooled_height": pooled_height, "pooled_width": pooled_width,
+         "spatial_scale": spatial_scale, "sampling_ratio": sampling_ratio},
+    )
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    return _simple(
+        "roi_pool",
+        inputs,
+        {"pooled_height": pooled_height, "pooled_width": pooled_width,
+         "spatial_scale": spatial_scale},
+        out_slots=("Out", "Argmax"),
+    )[0]
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=None, stride=None, offset=0.5, name=None):
+    return _simple(
+        "anchor_generator",
+        {"Input": [input]},
+        {"anchor_sizes": list(anchor_sizes or [64.0]),
+         "aspect_ratios": list(aspect_ratios or [1.0]),
+         "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+         "stride": list(stride or [16.0, 16.0]),
+         "offset": offset},
+        out_slots=("Anchors", "Variances"),
+        stop_gradient=True,
+    )
+
+
+def box_clip(input, im_info, name=None):
+    return _simple(
+        "box_clip",
+        {"Input": [input], "ImInfo": [im_info]},
+        {},
+        out_slots=("Output",),
+    )

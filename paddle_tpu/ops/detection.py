@@ -398,3 +398,230 @@ def _yolov3_loss(ctx, op, ins):
         "ObjectnessMask": [obj_mask],
         "GTMatchMask": [gt_match.astype(jnp.int32)],
     }
+
+
+def _roi_batch_idx(rois_num, R, N):
+    """per-roi image index from RoisNum [N] (the LoD-free replacement for
+    the reference's ROIs LoD): roi r belongs to image sum(r >= cumsum)."""
+    if rois_num is None:
+        return jnp.zeros((R,), jnp.int32)
+    bounds = jnp.cumsum(rois_num.astype(jnp.int32))  # [N]
+    r = jnp.arange(R, dtype=jnp.int32)
+    return jnp.sum(r[:, None] >= bounds[None, :], axis=1).astype(jnp.int32)
+
+
+@register_op(
+    "roi_align", inputs=["X", "ROIs", "RoisNum"], outputs=["Out"]
+)
+def _roi_align(ctx, op, ins):
+    """RoIAlign (roi_align_op.h, Mask R-CNN head input): average of
+    bilinear samples per output bin. The reference's adaptive sampling
+    count ceil(bin_size) is data-dependent — static-shape re-design uses a
+    fixed grid (sampling_ratio attr; <=0 falls back to 2, the standard
+    detectron setting) so the whole op is gathers + one mean on the MXU
+    host. Differentiable via the generic vjp (gather grad = scatter-add,
+    exactly the reference's hand-written bilinear backward)."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0].astype(jnp.float32)
+    rois_num = (
+        ins["RoisNum"][0]
+        if ins.get("RoisNum") and ins["RoisNum"][0] is not None
+        else None
+    )
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    scale = float(op.attr("spatial_scale", 1.0))
+    sr = int(op.attr("sampling_ratio", -1))
+    s = sr if sr > 0 else 2
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _roi_batch_idx(rois_num, R, N)
+
+    xmin = rois[:, 0] * scale
+    ymin = rois[:, 1] * scale
+    xmax = rois[:, 2] * scale
+    ymax = rois[:, 3] * scale
+    roi_w = jnp.maximum(xmax - xmin, 1.0)
+    roi_h = jnp.maximum(ymax - ymin, 1.0)
+    bw = roi_w / pw
+    bh = roi_h / ph
+
+    iy = jnp.arange(s, dtype=jnp.float32) + 0.5
+    py = jnp.arange(ph, dtype=jnp.float32)
+    px = jnp.arange(pw, dtype=jnp.float32)
+    # sample coords [R, ph(pw), s]
+    ys = (
+        ymin[:, None, None]
+        + py[None, :, None] * bh[:, None, None]
+        + iy[None, None, :] * bh[:, None, None] / s
+    )
+    xs = (
+        xmin[:, None, None]
+        + px[None, :, None] * bw[:, None, None]
+        + iy[None, None, :] * bw[:, None, None] / s
+    )
+
+    def axis_weights(coord, size):
+        """(low idx, high idx, weight_low, weight_high, in-bounds)."""
+        inb = (coord >= -1.0) & (coord <= size)
+        c = jnp.maximum(coord, 0.0)
+        low = jnp.minimum(c.astype(jnp.int32), size - 1)
+        at_edge = low >= size - 1
+        high = jnp.minimum(low + 1, size - 1)
+        frac = jnp.where(at_edge, 0.0, c - low)
+        return low, high, 1.0 - frac, frac, inb
+
+    yl, yh, wyl, wyh, yin = axis_weights(ys, H)
+    xl, xh, wxl, wxh, xin = axis_weights(xs, W)
+
+    # gather the 4 corners for every (roi, bin_y, bin_x, sy, sx)
+    b = bidx[:, None, None, None, None]
+    YL = yl[:, :, None, :, None]
+    YH = yh[:, :, None, :, None]
+    XL = xl[:, None, :, None, :]
+    XH = xh[:, None, :, None, :]
+
+    def g(yi, xi):
+        return x[b, :, yi, xi]  # [R, ph, pw, s, s, C]
+
+    WY_L = wyl[:, :, None, :, None]
+    WY_H = wyh[:, :, None, :, None]
+    WX_L = wxl[:, None, :, None, :]
+    WX_H = wxh[:, None, :, None, :]
+    val = (
+        g(YL, XL) * (WY_L * WX_L)[..., None]
+        + g(YL, XH) * (WY_L * WX_H)[..., None]
+        + g(YH, XL) * (WY_H * WX_L)[..., None]
+        + g(YH, XH) * (WY_H * WX_H)[..., None]
+    )
+    inb = (yin[:, :, None, :, None] & xin[:, None, :, None, :])[..., None]
+    val = jnp.where(inb, val, 0.0)
+    out = jnp.mean(val, axis=(3, 4))  # average the s*s samples
+    return {"Out": [jnp.transpose(out, (0, 3, 1, 2)).astype(x.dtype)]}
+
+
+@register_op(
+    "roi_pool", inputs=["X", "ROIs", "RoisNum"], outputs=["Out", "Argmax"]
+)
+def _roi_pool(ctx, op, ins):
+    """RoIPool (roi_pool_op.cc): max over integer-quantized bins. Static
+    re-design: every bin maxes a masked view of the full feature map
+    (O(H*W) per bin — fine for head-sized maps; roi_align is the
+    recommended TPU path)."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0].astype(jnp.float32)
+    rois_num = (
+        ins["RoisNum"][0]
+        if ins.get("RoisNum") and ins["RoisNum"][0] is not None
+        else None
+    )
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    scale = float(op.attr("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _roi_batch_idx(rois_num, R, N)
+
+    def cround(v):
+        # std::round = half away from zero (coords are >= 0 here); jnp.round
+        # is half-to-even and would shift bins at exact .5 boundaries
+        return jnp.floor(v + 0.5).astype(jnp.int32)
+
+    xmin = cround(rois[:, 0] * scale)
+    ymin = cround(rois[:, 1] * scale)
+    xmax = cround(rois[:, 2] * scale)
+    ymax = cround(rois[:, 3] * scale)
+    roi_h = jnp.maximum(ymax - ymin + 1, 1)
+    roi_w = jnp.maximum(xmax - xmin + 1, 1)
+
+    py = jnp.arange(ph, dtype=jnp.int32)
+    px = jnp.arange(pw, dtype=jnp.int32)
+    # bin edges, clipped to the map (roi_pool_op.cc bin arithmetic)
+    hstart = jnp.clip(
+        ymin[:, None] + (py[None, :] * roi_h[:, None]) // ph, 0, H
+    )
+    hend = jnp.clip(
+        ymin[:, None] + ((py[None, :] + 1) * roi_h[:, None] + ph - 1) // ph,
+        0, H,
+    )
+    wstart = jnp.clip(
+        xmin[:, None] + (px[None, :] * roi_w[:, None]) // pw, 0, W
+    )
+    wend = jnp.clip(
+        xmin[:, None] + ((px[None, :] + 1) * roi_w[:, None] + pw - 1) // pw,
+        0, W,
+    )
+    hh = jnp.arange(H, dtype=jnp.int32)
+    ww = jnp.arange(W, dtype=jnp.int32)
+    # [R, ph, H] / [R, pw, W] membership
+    in_h = (hh[None, None, :] >= hstart[..., None]) & (
+        hh[None, None, :] < hend[..., None]
+    )
+    in_w = (ww[None, None, :] >= wstart[..., None]) & (
+        ww[None, None, :] < wend[..., None]
+    )
+    mask = in_h[:, :, None, :, None] & in_w[:, None, :, None, :]
+    feats = x[bidx]  # [R, C, H, W]
+    masked = jnp.where(
+        mask[:, None], feats[:, :, None, None], -jnp.inf
+    )  # [R, C, ph, pw, H, W]
+    flat = masked.reshape(R, C, ph, pw, H * W)
+    arg = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    out = jnp.max(flat, axis=-1)
+    empty = ~jnp.any(mask, axis=(3, 4))  # [R, ph, pw]
+    out = jnp.where(empty[:, None], 0.0, out)
+    arg = jnp.where(empty[:, None], -1, arg)
+    return {"Out": [out.astype(x.dtype)], "Argmax": [arg]}
+
+
+@register_op(
+    "anchor_generator", inputs=["Input"], outputs=["Anchors", "Variances"],
+    differentiable=False,
+)
+def _anchor_generator(ctx, op, ins):
+    """RPN anchors per feature-map cell (anchor_generator_op.h:38-85):
+    anchors [H, W, A, 4] with A = len(aspect_ratios)*len(anchor_sizes)."""
+    feat = ins["Input"][0]
+    H, W = feat.shape[2], feat.shape[3]
+    sizes = [float(v) for v in op.attr("anchor_sizes")]
+    ars = [float(v) for v in op.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in op.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(v) for v in op.attr("stride")]
+    offset = float(op.attr("offset", 0.5))
+    sw, sh = stride[0], stride[1]
+
+    whs = []
+    for ar in ars:
+        base_w = np.round(np.sqrt(sw * sh / ar))
+        base_h = np.round(base_w * ar)
+        for size in sizes:
+            whs.append((size / sw * base_w, size / sh * base_h))
+    whs = jnp.asarray(whs, jnp.float32)  # [A, 2]
+
+    xc = jnp.arange(W, dtype=jnp.float32) * sw + offset * (sw - 1)
+    yc = jnp.arange(H, dtype=jnp.float32) * sh + offset * (sh - 1)
+    xg, yg = jnp.meshgrid(xc, yc)  # [H, W]
+    ctr = jnp.stack([xg, yg], -1)[:, :, None, :]  # [H, W, 1, 2]
+    half = (whs[None, None] - 1.0) / 2.0
+    mins = ctr - half
+    maxs = ctr + half
+    anchors = jnp.concatenate([mins, maxs], -1)  # [H, W, A, 4]
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), anchors.shape
+    )
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+@register_op("box_clip", inputs=["Input", "ImInfo"], outputs=["Output"])
+def _box_clip(ctx, op, ins):
+    """Clip boxes to image bounds (box_clip_op.cc): ImInfo [N, 3] =
+    (h, w, im_scale); boxes [N, M, 4] clipped to [0, dim/scale - 1]."""
+    boxes = ins["Input"][0]
+    im_info = ins["ImInfo"][0].astype(jnp.float32)
+    # reference rounds the descaled image dims before the -1 (box_clip_op.h)
+    hmax = jnp.round(im_info[:, 0] / im_info[:, 2]) - 1.0  # [N]
+    wmax = jnp.round(im_info[:, 1] / im_info[:, 2]) - 1.0
+    zero = jnp.zeros_like(wmax)
+    lo = jnp.stack([zero, zero, zero, zero], -1)[:, None, :]
+    hi = jnp.stack([wmax, hmax, wmax, hmax], -1)[:, None, :]
+    return {"Output": [jnp.clip(boxes, lo, hi)]}

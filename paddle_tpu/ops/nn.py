@@ -170,16 +170,30 @@ def _batch_norm(ctx, op, ins):
         saved_mean = mean
         saved_var = var
     else:
-        cf32 = x.astype(jnp.float32)
-        use_mean = jnp.mean(cf32, axis=axes)
-        use_var = jnp.var(cf32, axis=axes)
+        # single-pass stats: E[x^2] - E[x]^2 with fp32 ACCUMULATION but no
+        # fp32 materialization of x — jnp reductions take an accumulation
+        # dtype, and XLA fuses convert+square INTO the reduction, so a
+        # bf16 activation is read twice (mean, m2) instead of being written
+        # out as fp32 (at ResNet stage-1 shapes that fp32 temporary
+        # dominated the BN cost). BN inputs are near zero-mean, so the
+        # cancellation in m2 - mean^2 is benign in fp32 (the cuDNN-style
+        # fused-BN formulation).
+        use_mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+        use_var = jnp.maximum(m2 - jnp.square(use_mean), 0.0)
         mean_out = mean * momentum + use_mean.astype(mean.dtype) * (1 - momentum)
         var_out = var * momentum + use_var.astype(var.dtype) * (1 - momentum)
         saved_mean = use_mean
         saved_var = use_var
-    inv = lax.rsqrt(use_var.astype(jnp.float32) + eps).reshape(bshape)
-    y = (x - use_mean.astype(x.dtype).reshape(bshape)) * inv.astype(x.dtype)
-    y = y * scale.reshape(bshape).astype(x.dtype) + bias.reshape(bshape).astype(x.dtype)
+    # normalize as one per-channel affine in the INPUT dtype: y = x*a + b
+    # (a, b computed per-channel in fp32) — keeps the big elementwise pass
+    # bf16 under AMP and fusable with neighboring activations
+    inv = lax.rsqrt(use_var.astype(jnp.float32) + eps)
+    a = scale.astype(jnp.float32) * inv
+    bvec = bias.astype(jnp.float32) - use_mean.astype(jnp.float32) * a
+    y = x * a.astype(x.dtype).reshape(bshape) + bvec.astype(x.dtype).reshape(
+        bshape
+    )
     return {
         "Y": [y],
         "MeanOut": [mean_out],

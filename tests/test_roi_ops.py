@@ -1,0 +1,214 @@
+"""ROI ops (reference roi_align_op.h, roi_pool_op.cc,
+detection/anchor_generator_op.h, detection/box_clip_op.cc) against literal
+numpy ports of the reference kernels, plus gradient flow through
+roi_align."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+def _np_roi_align(x, rois, bidx, ph, pw, scale, s):
+    """Literal port of roi_align_op.h with fixed sampling grid s."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    out = np.zeros((R, C, ph, pw), np.float64)
+    for r in range(R):
+        xm, ym, xM, yM = rois[r] * scale
+        rw = max(xM - xm, 1.0)
+        rh = max(yM - ym, 1.0)
+        bw, bh = rw / pw, rh / ph
+        for c in range(C):
+            for py in range(ph):
+                for px in range(pw):
+                    acc = 0.0
+                    for iy in range(s):
+                        y = ym + py * bh + (iy + 0.5) * bh / s
+                        for ix in range(s):
+                            xx = xm + px * bw + (ix + 0.5) * bw / s
+                            if y < -1.0 or y > H or xx < -1.0 or xx > W:
+                                continue
+                            y_ = max(y, 0.0)
+                            x_ = max(xx, 0.0)
+                            yl, xl = int(y_), int(x_)
+                            if yl >= H - 1:
+                                yl = yh = H - 1
+                                y_ = float(yl)
+                            else:
+                                yh = yl + 1
+                            if xl >= W - 1:
+                                xl = xh = W - 1
+                                x_ = float(xl)
+                            else:
+                                xh = xl + 1
+                            ly, lx = y_ - yl, x_ - xl
+                            hy, hx = 1 - ly, 1 - lx
+                            m = x[bidx[r], c]
+                            acc += (
+                                hy * hx * m[yl, xl] + hy * lx * m[yl, xh]
+                                + ly * hx * m[yh, xl] + ly * lx * m[yh, xh]
+                            )
+                    out[r, c, py, px] = acc / (s * s)
+    return out
+
+
+def _np_roi_pool(x, rois, bidx, ph, pw, scale):
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    out = np.zeros((R, C, ph, pw), np.float64)
+    for r in range(R):
+        # std::round semantics (half away from zero), not Python's banker's
+        xm = int(np.floor(rois[r, 0] * scale + 0.5))
+        ym = int(np.floor(rois[r, 1] * scale + 0.5))
+        xM = int(np.floor(rois[r, 2] * scale + 0.5))
+        yM = int(np.floor(rois[r, 3] * scale + 0.5))
+        rh = max(yM - ym + 1, 1)
+        rw = max(xM - xm + 1, 1)
+        for py in range(ph):
+            hs = min(max(ym + py * rh // ph, 0), H)
+            he = min(max(ym + ((py + 1) * rh + ph - 1) // ph, 0), H)
+            for px in range(pw):
+                ws = min(max(xm + px * rw // pw, 0), W)
+                we = min(max(xm + ((px + 1) * rw + pw - 1) // pw, 0), W)
+                for c in range(C):
+                    region = x[bidx[r], c, hs:he, ws:we]
+                    out[r, c, py, px] = region.max() if region.size else 0.0
+    return out
+
+
+def test_roi_align_matches_reference_port():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 16, 16).astype("float32")
+    rois = np.array(
+        [[2.0, 2.0, 20.0, 24.0], [0.0, 0.0, 30.0, 30.0],
+         [8.0, 4.0, 14.0, 30.0]], np.float32,
+    )
+    rois_num = np.array([2, 1], np.int32)
+    bidx = [0, 0, 1]
+    ref = _np_roi_align(x, rois, bidx, 4, 4, 0.5, 2)
+
+    xv = fluid.data("x", [2, 3, 16, 16])
+    rv = fluid.data("rois", [3, 4])
+    nv = fluid.data("rn", [2], "int32")
+    out = layers.roi_align(
+        xv, rv, pooled_height=4, pooled_width=4, spatial_scale=0.5,
+        sampling_ratio=2, rois_num=nv,
+    )
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (got,) = exe.run(
+        feed={"x": x, "rois": rois, "rn": rois_num}, fetch_list=[out]
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_roi_align_gradients_flow():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 8, 8).astype("float32")
+    rois = np.array([[0.0, 0.0, 7.0, 7.0]], np.float32)
+    xv = fluid.data("x", [1, 2, 8, 8])
+    xv.stop_gradient = False
+    rv = fluid.data("rois", [1, 4])
+    out = layers.roi_align(xv, rv, 2, 2, 1.0, 2)
+    loss = layers.reduce_sum(out)
+    grads = fluid.framework.backward.gradients([loss], [xv])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (g,) = exe.run(feed={"x": x, "rois": rois}, fetch_list=[grads[0]])
+    g = np.asarray(g)
+    # sum of bilinear scatter weights per output bin is 1 -> grad sums to
+    # n_bins * channels
+    np.testing.assert_allclose(g.sum(), 2 * 2 * 2, rtol=1e-5)
+    assert (np.abs(g) > 0).sum() > 8
+
+
+def test_roi_pool_matches_reference_port():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 2, 12, 12).astype("float32")
+    rois = np.array(
+        [[0.0, 0.0, 11.0, 11.0], [4.0, 4.0, 10.0, 8.0]], np.float32
+    )
+    rois_num = np.array([1, 1], np.int32)
+    ref = _np_roi_pool(x, rois, [0, 1], 3, 3, 1.0)
+    xv = fluid.data("x", [2, 2, 12, 12])
+    rv = fluid.data("rois", [2, 4])
+    nv = fluid.data("rn", [2], "int32")
+    out = layers.roi_pool(xv, rv, 3, 3, 1.0, rois_num=nv)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (got,) = exe.run(
+        feed={"x": x, "rois": rois, "rn": rois_num}, fetch_list=[out]
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_anchor_generator_matches_reference_port():
+    H, W = 3, 4
+    sizes, ars = [32.0, 64.0], [0.5, 1.0]
+    sw = sh = 16.0
+    offset = 0.5
+    # literal port of anchor_generator_op.h:52-85
+    A = len(sizes) * len(ars)
+    ref = np.zeros((H, W, A, 4), np.float32)
+    for hi in range(H):
+        for wi in range(W):
+            xc = wi * sw + offset * (sw - 1)
+            yc = hi * sh + offset * (sh - 1)
+            i = 0
+            for ar in ars:
+                bw = round(np.sqrt(sw * sh / ar))
+                bh = round(bw * ar)
+                for size in sizes:
+                    aw = size / sw * bw
+                    ah = size / sh * bh
+                    ref[hi, wi, i] = [
+                        xc - 0.5 * (aw - 1), yc - 0.5 * (ah - 1),
+                        xc + 0.5 * (aw - 1), yc + 0.5 * (ah - 1),
+                    ]
+                    i += 1
+
+    feat = fluid.data("feat", [1, 8, H, W])
+    anchors, variances = layers.anchor_generator(
+        feat, anchor_sizes=sizes, aspect_ratios=ars, stride=[sw, sh],
+        offset=offset,
+    )
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    a, v = exe.run(
+        feed={"feat": np.zeros((1, 8, H, W), np.float32)},
+        fetch_list=[anchors, variances],
+    )
+    np.testing.assert_allclose(np.asarray(a), ref, rtol=1e-5, atol=1e-4)
+    assert np.asarray(v).shape == (H, W, A, 4)
+
+
+def test_box_clip():
+    boxes = np.array(
+        [[[-5.0, -3.0, 120.0, 80.0], [10.0, 10.0, 50.0, 50.0]]], np.float32
+    )
+    im_info = np.array([[100.0, 200.0, 1.0]], np.float32)  # h, w, scale
+    bv = fluid.data("b", [1, 2, 4])
+    iv = fluid.data("i", [1, 3])
+    out = layers.box_clip(bv, iv)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (got,) = exe.run(feed={"b": boxes, "i": im_info}, fetch_list=[out])
+    got = np.asarray(got)
+    np.testing.assert_allclose(
+        got[0, 0], [0.0, 0.0, 120.0, 80.0], atol=1e-6
+    )  # clipped to [0, w-1=199] x [0, h-1=99]
+    assert got[0, 0, 2] <= 199.0 and got[0, 0, 3] <= 99.0
+    np.testing.assert_allclose(got[0, 1], boxes[0, 1], atol=1e-6)
