@@ -54,6 +54,13 @@ _declare(
     "rbg on TPU, threefry2x32 elsewhere (core/random.py).",
 )
 _declare(
+    "paddle_tpu_pallas_layer_norm", False,
+    "Route layer_norm through the standalone Pallas kernel "
+    "(kernels/layer_norm.py). Off by default: on BERT-style models XLA's "
+    "fused jnp formulation wins because the custom call blocks fusion with "
+    "the residual add feeding each LN.",
+)
+_declare(
     "eager_delete_tensor_gb", 0.0,
     "Accepted for parity; XLA buffer assignment subsumes eager deletion "
     "(reference flags.cc eager_delete_tensor_gb).",

@@ -192,9 +192,12 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
             continue
 
         if op_def.grad_maker is not None:
-            op_def.grad_maker(op, block, contribs, finalize,
-                              needs_grad=needs_grad)
-            continue
+            # a maker may decline (return False) to fall back to the
+            # generic __vjp__ path, e.g. when a rarely-differentiated
+            # auxiliary output turns out to carry gradients
+            if op_def.grad_maker(op, block, contribs, finalize,
+                                 needs_grad=needs_grad) is not False:
+                continue
 
         # finalize the grads of this op's outputs
         grad_ins = {}

@@ -410,3 +410,13 @@ def beam_search_decode(ids, parent_idx, end_id=1, name=None):
         out_slots=("SentenceIds",),
         stop_gradient=True,
     )
+
+
+def tril(x, diagonal=0, name=None):
+    return _simple("tril_triu", {"X": [x]},
+                   {"diagonal": diagonal, "lower": True})
+
+
+def triu(x, diagonal=0, name=None):
+    return _simple("tril_triu", {"X": [x]},
+                   {"diagonal": diagonal, "lower": False})

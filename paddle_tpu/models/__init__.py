@@ -10,6 +10,12 @@ public layers API only — they double as end-to-end tests of the framework
 from .resnet import resnet  # noqa: F401
 from .bert import BertConfig, bert_encoder, bert_pretrain  # noqa: F401
 from .deepfm import DeepFMConfig, deepfm  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    gpt_decoder,
+    gpt_lm_loss,
+    gpt_tp_shardings,
+)
 from .yolov3 import (  # noqa: F401
     YoloConfig,
     darknet53,

@@ -1,0 +1,177 @@
+"""GPT — decoder-only causal LM (the reference era's ERNIE-GEN/GPT-2
+workloads; BASELINE.md lists ERNIE dygraph pretrain as the stretch
+target). Pre-LN transformer decoder built on the public layers API; the
+causal mask runs INSIDE the packed-QKV Pallas flash kernel (causal=True),
+so no [B,nh,S,S] mask or probability tensor ever reaches HBM.
+
+Tensor-parallel ready like models/bert.py: deterministic parameter names +
+`gpt_tp_shardings` Megatron annotations over the "mp" axis.
+"""
+
+from __future__ import annotations
+
+import math
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+class GPTConfig:
+    def __init__(
+        self,
+        vocab_size=50257,
+        hidden_size=768,
+        num_layers=12,
+        num_heads=12,
+        intermediate_size=3072,
+        max_position=1024,
+        hidden_dropout=0.1,
+        attention_dropout=0.1,
+        initializer_range=0.02,
+        use_fused_attention=True,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.hidden_dropout = hidden_dropout
+        self.attention_dropout = attention_dropout
+        self.initializer_range = initializer_range
+        self.use_fused_attention = use_fused_attention
+
+    @classmethod
+    def small(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        return cls(
+            vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+            intermediate_size=256, max_position=128,
+        )
+
+
+def _init(cfg):
+    from ..initializer import Normal
+
+    return Normal(0.0, cfg.initializer_range)
+
+
+def _dense(x, size, name, cfg, act=None):
+    return layers.fc(
+        x, size=size, num_flatten_dims=2, act=act,
+        param_attr=ParamAttr(name=f"{name}_w", initializer=_init(cfg)),
+        bias_attr=ParamAttr(name=f"{name}_b"),
+    )
+
+
+def _ln(x, name):
+    return layers.layer_norm(
+        x, begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{name}_scale"),
+        bias_attr=ParamAttr(name=f"{name}_bias"),
+    )
+
+
+def _decoder_layer(x, cfg, prefix, is_test):
+    b, s, h = x.shape
+    nh, dh = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    # pre-LN attention block
+    a = _ln(x, f"{prefix}_ln1")
+    qkv = _dense(a, 3 * h, f"{prefix}_attn_qkv", cfg)
+    if cfg.use_fused_attention:
+        ctxv = layers.fused_qkv_attention(
+            qkv, nh, causal=True, scale=1.0 / math.sqrt(dh),
+            dropout_prob=cfg.attention_dropout, is_test=is_test,
+        )
+    else:
+        def head(t):
+            return layers.transpose(
+                layers.reshape(t, [b, s, nh, dh]), [0, 2, 1, 3]
+            )
+
+        q = head(layers.slice(qkv, [2], [0], [h]))
+        k = head(layers.slice(qkv, [2], [h], [2 * h]))
+        v = head(layers.slice(qkv, [2], [2 * h], [3 * h]))
+        scores = layers.matmul(
+            q, k, transpose_y=True, alpha=1.0 / math.sqrt(dh)
+        )
+        # causal additive mask: 0 on/below the diagonal, -1e4 above
+        mask = layers.reshape(
+            layers.scale(
+                layers.tril(layers.fill_constant([s, s], "float32", 1.0)),
+                scale=1e4, bias=-1e4,
+            ),
+            [1, 1, s, s],
+        )
+        scores = scores + mask
+        probs = layers.softmax(scores, axis=-1)
+        probs = layers.dropout(
+            probs, cfg.attention_dropout, is_test=is_test
+        )
+        ctxv = layers.reshape(
+            layers.transpose(layers.matmul(probs, v), [0, 2, 1, 3]),
+            [b, s, h],
+        )
+    attn = _dense(ctxv, h, f"{prefix}_attn_out", cfg)
+    x = x + layers.dropout(attn, cfg.hidden_dropout, is_test=is_test)
+    # pre-LN MLP block
+    m = _ln(x, f"{prefix}_ln2")
+    m = _dense(m, cfg.intermediate_size, f"{prefix}_mlp_in", cfg, act="gelu")
+    m = _dense(m, cfg.hidden_size, f"{prefix}_mlp_out", cfg)
+    return x + layers.dropout(m, cfg.hidden_dropout, is_test=is_test)
+
+
+def gpt_decoder(input_ids, cfg, is_test=False):
+    """input_ids [B, S] int64 -> final hidden states [B, S, H]."""
+    b, s = input_ids.shape
+    tok = layers.embedding(
+        input_ids, size=[cfg.vocab_size, cfg.hidden_size],
+        param_attr=ParamAttr(name="wte", initializer=_init(cfg)),
+    )
+    pos_ids = layers.reshape(layers.range(0, s, 1, "int64"), [1, s])
+    pos = layers.embedding(
+        pos_ids, size=[cfg.max_position, cfg.hidden_size],
+        param_attr=ParamAttr(name="wpe", initializer=_init(cfg)),
+    )
+    x = layers.dropout(tok + pos, cfg.hidden_dropout, is_test=is_test)
+    for i in range(cfg.num_layers):
+        x = _decoder_layer(x, cfg, f"gpt_l{i}", is_test)
+    return _ln(x, "gpt_lnf")
+
+
+def gpt_lm_loss(input_ids, cfg, is_test=False, labels=None):
+    """Next-token LM loss; labels default to input_ids shifted left (the
+    final position predicts nothing and is dropped)."""
+    b, s = input_ids.shape
+    hidden = gpt_decoder(input_ids, cfg, is_test=is_test)
+    logits = layers.fc(
+        hidden, cfg.vocab_size, num_flatten_dims=2, bias_attr=False,
+        param_attr=ParamAttr(name="lm_head_w", initializer=_init(cfg)),
+    )
+    pred = layers.slice(logits, [1], [0], [s - 1])
+    if labels is None:
+        tgt = layers.slice(input_ids, [1], [1], [s])
+    else:
+        tgt = layers.slice(labels, [1], [1], [s])
+    loss = layers.softmax_with_cross_entropy(
+        layers.reshape(pred, [b * (s - 1), cfg.vocab_size]),
+        layers.reshape(tgt, [b * (s - 1), 1]),
+    )
+    return layers.mean(loss)
+
+
+def gpt_tp_shardings(cfg, axis="mp"):
+    """Megatron column/row-parallel annotations (see bert_tp_shardings)."""
+    sh = {"wte": (axis, None), "lm_head_w": (None, axis)}
+    for i in range(cfg.num_layers):
+        p = f"gpt_l{i}"
+        sh[f"{p}_attn_qkv_w"] = (None, axis)
+        sh[f"{p}_attn_qkv_b"] = (axis,)
+        sh[f"{p}_attn_out_w"] = (axis, None)
+        sh[f"{p}_mlp_in_w"] = (None, axis)
+        sh[f"{p}_mlp_in_b"] = (axis,)
+        sh[f"{p}_mlp_out_w"] = (axis, None)
+    return sh

@@ -404,6 +404,14 @@ def _roi_batch_idx(rois_num, R, N):
     """per-roi image index from RoisNum [N] (the LoD-free replacement for
     the reference's ROIs LoD): roi r belongs to image sum(r >= cumsum)."""
     if rois_num is None:
+        if N != 1:
+            # assigning every ROI to image 0 would be silently wrong — the
+            # reference derives the mapping from the ROIs' LoD, so a
+            # multi-image batch without RoisNum is ambiguous here
+            raise ValueError(
+                f"roi op over a batch of {N} images needs RoisNum "
+                "(per-image roi counts)"
+            )
         return jnp.zeros((R,), jnp.int32)
     bounds = jnp.cumsum(rois_num.astype(jnp.int32))  # [N]
     r = jnp.arange(R, dtype=jnp.int32)

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..framework.registry import register_op
+from ..framework.registry import get_op_def, register_op
 
 
 def _pair(v):
@@ -203,6 +203,32 @@ def _batch_norm(ctx, op, ins):
     }
 
 
+def _ln_use_pallas(ctx, x, begin):
+    from ..flags import flag
+    from ..kernels import layer_norm as lnk
+
+    rows = int(np.prod(x.shape[:begin])) if begin else 1
+    n = int(np.prod(x.shape[begin:]))
+    gspmd_mode = (
+        not ctx.mesh_axes
+        and ctx.program is not None
+        and getattr(ctx.program, "_mesh", None) is not None
+    )
+    # OFF by default: measured on BERT-base, the standalone kernel LOSES to
+    # XLA's fused jnp formulation (~6% step regression) — the custom call
+    # is a fusion barrier, so the residual add feeding each LN materializes
+    # instead of fusing into the normalization pass. The kernel stays for
+    # workloads where LN is isolated (enable with
+    # FLAGS_paddle_tpu_pallas_layer_norm=1); the dedicated grad op below is
+    # unconditional and is what actually pays (no forward replay).
+    return (
+        bool(flag("paddle_tpu_pallas_layer_norm"))
+        and not gspmd_mode
+        and jax.default_backend() == "tpu"
+        and lnk.supports(rows, n, x.dtype)
+    ), rows, n
+
+
 @register_op(
     "layer_norm",
     inputs=["X", "Scale", "Bias"],
@@ -214,6 +240,30 @@ def _layer_norm(ctx, op, ins):
     bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
     eps = op.attr("epsilon", 1e-5)
     begin = op.attr("begin_norm_axis", 1)
+    use_pallas, rows, n = _ln_use_pallas(ctx, x, begin)
+    if use_pallas:
+        # Pallas kernel: one read + one write per pass, fp32 stats in
+        # registers — the jnp form materializes fp32 temporaries between
+        # the mean/var/normalize passes. The _diff wrapper carries a
+        # custom_vjp so fallback autodiff paths (generic __vjp__, dygraph
+        # tape) can differentiate through the Mosaic call
+        # (kernels/layer_norm.py).
+        from ..kernels.layer_norm import layer_norm_fwd_diff
+
+        y2, mean, var = layer_norm_fwd_diff(
+            x.reshape(rows, n),
+            scale.reshape(n) if scale is not None
+            else jnp.ones((n,), jnp.float32),
+            bias.reshape(n) if bias is not None
+            else jnp.zeros((n,), jnp.float32),
+            eps,
+        )
+        lead = x.shape[:begin]
+        return {
+            "Y": [y2.reshape(x.shape)],
+            "Mean": [mean.reshape(lead)],
+            "Variance": [var.reshape(lead)],
+        }
     axes = tuple(range(begin, x.ndim))
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
@@ -223,7 +273,118 @@ def _layer_norm(ctx, op, ins):
         y = y * scale.reshape(x.shape[begin:]).astype(x.dtype)
     if bias is not None:
         y = y + bias.reshape(x.shape[begin:]).astype(x.dtype)
-    return {"Y": [y], "Mean": [mean.squeeze()], "Variance": [var.squeeze()]}
+    lead = x.shape[:begin]
+    return {
+        "Y": [y],
+        "Mean": [mean.reshape(lead)],
+        "Variance": [var.reshape(lead)],
+    }
+
+
+def _layer_norm_grad_maker(op, block, contribs, finalize, needs_grad=None):
+    """Dedicated grad op, emitted only when the Pallas LN kernel is enabled
+    (a Mosaic forward must not be replayed — XLA cannot CSE custom calls).
+    With the default jnp formulation the generic __vjp__ replay IS CSE'd
+    and its derived backward fuses better than hand-written formulas
+    (measured on BERT), so this declines. Also declines when the auxiliary
+    Mean/Variance outputs carry gradients."""
+    from ..flags import flag
+    from ..framework import unique_name
+    from ..framework.backward import _ensure_var
+    from ..framework.program import grad_var_name
+
+    if not flag("paddle_tpu_pallas_layer_norm"):
+        return False
+    for aux in ("Mean", "Variance"):
+        names = op.outputs.get(aux) or []
+        if names and names[0] in contribs:
+            return False  # fall back to the generic __vjp__
+    g_out = finalize(op.outputs["Y"][0])
+    if g_out is None:
+        return
+    inputs = {"X": op.inputs["X"], "YGrad": [g_out]}
+    for slot in ("Scale", "Bias"):
+        if op.inputs.get(slot):
+            inputs[slot] = op.inputs[slot]
+    outs = {}
+    for slot in ("X", "Scale", "Bias"):
+        names = op.inputs.get(slot) or []
+        if not names or not names[0]:
+            continue
+        n = names[0]
+        if needs_grad is not None and n not in needs_grad:
+            continue
+        gname = unique_name.generate(grad_var_name(n) + "@RENAME")
+        _ensure_var(block, gname, n)
+        outs[slot + "Grad"] = [gname]
+        contribs.setdefault(n, []).append(gname)
+    if not outs:
+        return
+    attrs = {
+        k: v for k, v in op.attrs.items() if k not in ("__uid__", "__loc__")
+    }
+    block.append_op("layer_norm_grad", inputs, outs, attrs)
+
+
+get_op_def("layer_norm").grad_maker = _layer_norm_grad_maker
+
+
+@register_op(
+    "layer_norm_grad",
+    inputs=["X", "Scale", "Bias", "YGrad"],
+    outputs=["XGrad", "ScaleGrad", "BiasGrad"],
+    differentiable=False,
+)
+def _layer_norm_grad(ctx, op, ins):
+    x = ins["X"][0]
+    scale = (
+        ins["Scale"][0]
+        if ins.get("Scale") and ins["Scale"][0] is not None
+        else None
+    )
+    dy = ins["YGrad"][0]
+    eps = op.attr("epsilon", 1e-5)
+    begin = op.attr("begin_norm_axis", 1)
+    use_pallas, rows, n = _ln_use_pallas(ctx, x, begin)
+    if use_pallas:
+        from ..kernels.layer_norm import layer_norm_bwd
+
+        dx2, ds, db = layer_norm_bwd(
+            x.reshape(rows, n),
+            scale.reshape(n) if scale is not None else None,
+            dy.reshape(rows, n),
+            eps,
+        )
+        dx = dx2.reshape(x.shape)
+    else:
+        axes = tuple(range(begin, x.ndim))
+        xf = x.astype(jnp.float32)
+        dyf = dy.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        rstd = lax.rsqrt(var + eps)
+        xhat = (xf - mean) * rstd
+        sf = (
+            scale.reshape(x.shape[begin:]).astype(jnp.float32)
+            if scale is not None
+            else 1.0
+        )
+        dyw = dyf * sf
+        m1 = jnp.mean(dyw, axis=axes, keepdims=True)
+        m2 = jnp.mean(dyw * xhat, axis=axes, keepdims=True)
+        dx = (rstd * (dyw - m1 - xhat * m2)).astype(x.dtype)
+        lead_axes = tuple(range(begin))
+        ds = jnp.sum(dyf * xhat, axis=lead_axes).reshape(-1)
+        db = jnp.sum(dyf, axis=lead_axes).reshape(-1)
+    outs = {}
+    if op.outputs.get("XGrad"):
+        outs["XGrad"] = [dx]
+    if op.outputs.get("ScaleGrad"):
+        outs["ScaleGrad"] = [ds.reshape(scale.shape).astype(scale.dtype)]
+    if op.outputs.get("BiasGrad"):
+        b = ins["Bias"][0]
+        outs["BiasGrad"] = [db.reshape(b.shape).astype(b.dtype)]
+    return outs
 
 
 @register_op("instance_norm", inputs=["X", "Scale", "Bias"], outputs=["Y"])

@@ -418,3 +418,13 @@ def _fake_quant_channelwise(ctx, op, ins):
     q = jnp.round(x / scale * bound) * scale / bound
     out = x + jax.lax.stop_gradient(q - x)
     return {"Out": [out], "OutScale": [scale.reshape(-1)]}
+
+
+@register_op("tril_triu", inputs=["X"], outputs=["Out"])
+def _tril_triu(ctx, op, ins):
+    """Lower/upper triangle (reference operators/tril_triu_op.cc)."""
+    x = ins["X"][0]
+    diagonal = int(op.attr("diagonal", 0))
+    lower = bool(op.attr("lower", True))
+    fn = jnp.tril if lower else jnp.triu
+    return {"Out": [fn(x, k=diagonal)]}
