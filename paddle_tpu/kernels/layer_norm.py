@@ -22,8 +22,6 @@ from __future__ import annotations
 
 import functools
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -68,7 +66,6 @@ def _bwd_kernel(x_ref, scale_ref, dy_ref, dx_ref, dscale_ref, dbias_ref,
                 *, eps):
     x = x_ref[:].astype(jnp.float32)
     dy = dy_ref[:].astype(jnp.float32)
-    n = x.shape[1]
     mean = jnp.mean(x, axis=1, keepdims=True)
     var = jnp.mean(jnp.square(x), axis=1, keepdims=True) - jnp.square(mean)
     rstd = jax.lax.rsqrt(jnp.maximum(var, 0.0) + eps)

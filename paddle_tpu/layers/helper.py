@@ -53,6 +53,17 @@ class LayerHelper:
         # create_parameter does the same) — so the executor state analysis
         # sees them and sub-blocks capture them as external reads
         mb, sb = default_main_program().global_block, startup_block()
+        existing = mb.vars.get(name)
+        if existing is not None:
+            # weight sharing by name (e.g. crf_decoding reusing
+            # linear_chain_crf's transition): re-creating would silently
+            # drop the first declaration's regularizer/lr/trainable attrs
+            if tuple(existing.shape or ()) != tuple(shape):
+                raise ValueError(
+                    f"parameter {name!r} reused with shape {shape}, but it "
+                    f"was created with shape {existing.shape}"
+                )
+            return existing
         p = mb.create_parameter(
             name, shape, dtype, trainable=attr.trainable
         )

@@ -14,7 +14,7 @@ import numpy as np
 from ..core.dtypes import convert_dtype
 from ..framework import unique_name
 from ..framework.program import default_main_program
-from ..initializer import Constant, Normal, Xavier
+from ..initializer import Constant, Normal, Uniform, Xavier
 from .helper import LayerHelper, main_block
 
 
@@ -454,3 +454,39 @@ def resize_bilinear(input, out_shape=None, scale=None, name=None):
     attrs = _resize_attrs(out_shape, scale)
     return helper.create_and_append({"X": [input]}, attrs,
                                     op_type="bilinear_interp")
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None, name=None):
+    """CRF cost (reference fluid/layers/nn.py linear_chain_crf ->
+    linear_chain_crf_op). input: emissions [B, T, D]; label [B, T] int;
+    length [B] optional valid lengths. Creates the [D+2, D] transition
+    parameter (row 0 start, row 1 end, rest the transition matrix) and
+    returns per-sequence negative log likelihood [B, 1]."""
+    helper = LayerHelper("linear_chain_crf", name=name)
+    d = input.shape[-1]
+    transition = helper.create_parameter(
+        param_attr, [d + 2, d], input.dtype,
+        default_initializer=Uniform(-0.1, 0.1),
+    )
+    ins = {"Emission": [input], "Transition": [transition], "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    return helper.create_and_append(ins, {}, out_slots=("LogLikelihood",))
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None, name=None):
+    """Viterbi decode [B, T] using the SAME transition parameter as
+    linear_chain_crf (pass the same param_attr name). With label, returns
+    the per-position correctness mask (reference crf_decoding_op)."""
+    helper = LayerHelper("crf_decoding", name=name)
+    d = input.shape[-1]
+    transition = helper.create_parameter(
+        param_attr, [d + 2, d], input.dtype,
+        default_initializer=Uniform(-0.1, 0.1),
+    )
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    if length is not None:
+        ins["Length"] = [length]
+    return helper.create_and_append(ins, {}, out_slots=("ViterbiPath",))

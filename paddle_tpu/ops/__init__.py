@@ -12,6 +12,7 @@ from . import (  # noqa: F401
     beam_search,
     collective,
     control_flow,
+    crf,
     detection,
     fused,
     math,

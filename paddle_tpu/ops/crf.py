@@ -1,0 +1,170 @@
+"""Linear-chain CRF ops (reference operators/linear_chain_crf_op.h and
+crf_decoding_op.h — the sequence-labeling head of the
+label_semantic_roles book workload).
+
+TPU-native re-design: the reference works on LoD-packed sequences in
+probability space with hand-written gradients (ExpSum/Alpha/Beta buffers);
+here sequences are padded [B, T, D] + Length [B], the forward algorithm is
+a `lax.scan` in LOG space (numerically stable, MXU-friendly
+[B, D, D] broadcasts), and the gradient falls out of autodiff through the
+scan — no Alpha/Beta plumbing at all.
+
+Transition parameter layout matches fluid: [D+2, D], row 0 = start
+weights, row 1 = end weights, rows 2.. = transition matrix w[i, j]
+(score of moving FROM tag i TO tag j).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+def _unpack(transition):
+    return transition[0], transition[1], transition[2:]
+
+
+def _crf_logz_and_score(emission, transition, label, length):
+    """(logZ [B], gold score [B]) for padded [B, T, D] emissions."""
+    B, T, D = emission.shape
+    start, end, w = _unpack(transition)
+    em = emission.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    t_idx = jnp.arange(T)
+    valid = t_idx[None, :] < length[:, None]  # [B, T]
+
+    # ---- partition function: log-space forward algorithm ----
+    alpha0 = start.astype(jnp.float32)[None, :] + em[:, 0]  # [B, D]
+
+    def step(alpha, inputs):
+        e_t, valid_t = inputs  # [B, D], [B]
+        nxt = (
+            jax.nn.logsumexp(alpha[:, :, None] + wf[None], axis=1) + e_t
+        )
+        alpha = jnp.where(valid_t[:, None], nxt, alpha)
+        return alpha, None
+
+    alpha, _ = lax.scan(
+        step,
+        alpha0,
+        (em[:, 1:].swapaxes(0, 1), valid[:, 1:].swapaxes(0, 1)),
+    )
+    logz = jax.nn.logsumexp(alpha + end.astype(jnp.float32)[None], axis=1)
+
+    # ---- gold path score ----
+    lab = label.astype(jnp.int32)
+    b_idx = jnp.arange(B)[:, None]
+    em_score = jnp.sum(
+        jnp.where(valid, em[b_idx, t_idx[None, :], lab], 0.0), axis=1
+    )
+    trans_score = jnp.sum(
+        jnp.where(
+            valid[:, 1:], wf[lab[:, :-1], lab[:, 1:]], 0.0
+        ),
+        axis=1,
+    )
+    last = jnp.clip(length - 1, 0, T - 1)
+    score = (
+        em_score
+        + trans_score
+        + start.astype(jnp.float32)[lab[:, 0]]
+        + end.astype(jnp.float32)[lab[jnp.arange(B), last]]
+    )
+    return logz, score
+
+
+@register_op(
+    "linear_chain_crf",
+    inputs=["Emission", "Transition", "Label", "Length"],
+    outputs=["LogLikelihood"],
+)
+def _linear_chain_crf(ctx, op, ins):
+    """Per-sequence NEGATIVE log likelihood [B, 1] (the reference's
+    LogLikelihood output is the cost the book models feed to mean())."""
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    label = ins["Label"][0]
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label[..., 0]
+    B, T, D = emission.shape
+    length = (
+        ins["Length"][0].astype(jnp.int32)
+        if ins.get("Length") and ins["Length"][0] is not None
+        else jnp.full((B,), T, jnp.int32)
+    )
+    logz, score = _crf_logz_and_score(emission, transition, label, length)
+    return {"LogLikelihood": [(logz - score)[:, None]]}
+
+
+@register_op(
+    "crf_decoding",
+    inputs=["Emission", "Transition", "Label", "Length"],
+    outputs=["ViterbiPath"],
+    differentiable=False,
+)
+def _crf_decoding(ctx, op, ins):
+    """Viterbi decode [B, T] (padded positions 0). With Label given,
+    returns the reference's correctness mask instead: 1 where the decoded
+    tag equals the label (crf_decoding_op.h behavior)."""
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    B, T, D = emission.shape
+    length = (
+        ins["Length"][0].astype(jnp.int32)
+        if ins.get("Length") and ins["Length"][0] is not None
+        else jnp.full((B,), T, jnp.int32)
+    )
+    start, end, w = _unpack(transition)
+    em = emission.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    t_idx = jnp.arange(T)
+    valid = t_idx[None, :] < length[:, None]
+
+    delta0 = start.astype(jnp.float32)[None, :] + em[:, 0]
+
+    def step(delta, inputs):
+        e_t, valid_t = inputs
+        cand = delta[:, :, None] + wf[None]  # [B, D(from), D(to)]
+        best = jnp.argmax(cand, axis=1).astype(jnp.int32)  # [B, D]
+        nxt = jnp.max(cand, axis=1) + e_t
+        delta_new = jnp.where(valid_t[:, None], nxt, delta)
+        # padded steps record identity backpointers
+        best = jnp.where(
+            valid_t[:, None], best, jnp.arange(D, dtype=jnp.int32)[None]
+        )
+        return delta_new, best
+
+    delta, back = lax.scan(
+        step,
+        delta0,
+        (em[:, 1:].swapaxes(0, 1), valid[:, 1:].swapaxes(0, 1)),
+    )  # back: [T-1, B, D]
+    last_tag = jnp.argmax(
+        delta + end.astype(jnp.float32)[None], axis=1
+    ).astype(jnp.int32)
+
+    def backtrack(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # tags_rev[t] is the tag at position t+1; the scan's final carry is
+    # the tag at position 0
+    first_tag, tags_rev = lax.scan(backtrack, last_tag, back, reverse=True)
+    path = jnp.concatenate(
+        [first_tag[None], tags_rev.astype(jnp.int32)], axis=0
+    ).swapaxes(0, 1)  # [B, T]
+    path = jnp.where(valid, path, 0).astype(jnp.int64)
+    if ins.get("Label") and ins["Label"][0] is not None:
+        label = ins["Label"][0]
+        if label.ndim == 3 and label.shape[-1] == 1:
+            label = label[..., 0]
+        return {
+            "ViterbiPath": [
+                (jnp.where(valid, path == label.astype(jnp.int64), False)
+                 ).astype(jnp.int64)
+            ]
+        }
+    return {"ViterbiPath": [path]}

@@ -400,16 +400,18 @@ def _yolov3_loss(ctx, op, ins):
     }
 
 
-def _roi_batch_idx(rois_num, R, N):
+def _roi_batch_idx(rois_num, R, N, abstract=False):
     """per-roi image index from RoisNum [N] (the LoD-free replacement for
     the reference's ROIs LoD): roi r belongs to image sum(r >= cumsum)."""
     if rois_num is None:
-        if N != 1:
+        if N != 1 and not abstract:
             # assigning every ROI to image 0 would be silently wrong — the
             # reference derives the mapping from the ROIs' LoD, so a
-            # multi-image batch without RoisNum is ambiguous here
+            # multi-image batch without RoisNum is ambiguous here. Shape
+            # inference (abstract=True) sees the -1 batch sentinel and must
+            # not reject a program whose runtime batch is 1.
             raise ValueError(
-                f"roi op over a batch of {N} images needs RoisNum "
+                "roi op over a multi-image batch needs RoisNum "
                 "(per-image roi counts)"
             )
         return jnp.zeros((R,), jnp.int32)
@@ -443,7 +445,7 @@ def _roi_align(ctx, op, ins):
     s = sr if sr > 0 else 2
     N, C, H, W = x.shape
     R = rois.shape[0]
-    bidx = _roi_batch_idx(rois_num, R, N)
+    bidx = _roi_batch_idx(rois_num, R, N, ctx.abstract)
 
     xmin = rois[:, 0] * scale
     ymin = rois[:, 1] * scale
@@ -528,7 +530,7 @@ def _roi_pool(ctx, op, ins):
     scale = float(op.attr("spatial_scale", 1.0))
     N, C, H, W = x.shape
     R = rois.shape[0]
-    bidx = _roi_batch_idx(rois_num, R, N)
+    bidx = _roi_batch_idx(rois_num, R, N, ctx.abstract)
 
     def cround(v):
         # std::round = half away from zero (coords are >= 0 here); jnp.round

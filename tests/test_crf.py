@@ -1,0 +1,137 @@
+"""Linear-chain CRF: negative log likelihood and Viterbi against
+brute-force enumeration over all label sequences (the gold oracle), plus
+a label_semantic_roles-style book test (reference
+tests/book/test_label_semantic_roles.py): embedding + LSTM + CRF trained
+until Viterbi decoding recovers a deterministic tagging rule."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+
+B, T, D = 3, 5, 4
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+def _score(em, trans, tags):
+    start, end, w = trans[0], trans[1], trans[2:]
+    s = start[tags[0]] + end[tags[-1]]
+    for t, tag in enumerate(tags):
+        s += em[t, tag]
+    for t in range(1, len(tags)):
+        s += w[tags[t - 1], tags[t]]
+    return s
+
+
+def _brute(em, trans, label, L):
+    """(neg log likelihood, viterbi path) by enumerating D^L sequences."""
+    scores = {
+        tags: _score(em[:L], trans, tags)
+        for tags in itertools.product(range(D), repeat=L)
+    }
+    logz = np.logaddexp.reduce(np.array(list(scores.values())))
+    nll = logz - scores[tuple(label[:L])]
+    best = max(scores, key=scores.get)
+    return nll, list(best)
+
+
+def test_crf_nll_and_viterbi_match_enumeration():
+    rng = np.random.RandomState(0)
+    em = rng.randn(B, T, D).astype("float32")
+    trans = rng.randn(D + 2, D).astype("float32") * 0.5
+    label = rng.randint(0, D, (B, T)).astype("int64")
+    lengths = np.array([5, 3, 4], np.int32)
+
+    e = fluid.data("e", [B, T, D])
+    lab = fluid.data("lab", [B, T], "int64")
+    ln = fluid.data("ln", [B], "int32")
+    nll = layers.linear_chain_crf(
+        e, lab, param_attr=fluid.ParamAttr(name="crf_w"), length=ln
+    )
+    path = layers.crf_decoding(
+        e, param_attr=fluid.ParamAttr(name="crf_w"), length=ln
+    )
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = fluid.framework.scope.global_scope()
+    scope.set_var("crf_w", trans)
+    fluid.default_main_program()._bump()
+    got_nll, got_path = exe.run(
+        feed={"e": em, "lab": label, "ln": lengths}, fetch_list=[nll, path]
+    )
+    got_nll = np.asarray(got_nll).reshape(-1)
+    got_path = np.asarray(got_path)
+    for b in range(B):
+        L = int(lengths[b])
+        ref_nll, ref_path = _brute(em[b], trans, label[b], L)
+        np.testing.assert_allclose(got_nll[b], ref_nll, rtol=1e-4,
+                                   err_msg=f"nll seq {b}")
+        assert list(got_path[b, :L]) == ref_path, f"viterbi seq {b}"
+        assert (got_path[b, L:] == 0).all()
+
+
+def test_crf_decoding_label_mask():
+    rng = np.random.RandomState(1)
+    em = rng.randn(B, T, D).astype("float32")
+    e = fluid.data("e", [B, T, D])
+    lab = fluid.data("lab", [B, T], "int64")
+    path = layers.crf_decoding(
+        e, param_attr=fluid.ParamAttr(name="crf_w2")
+    )
+    mask = layers.crf_decoding(
+        e, param_attr=fluid.ParamAttr(name="crf_w2"), label=lab
+    )
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    label = rng.randint(0, D, (B, T)).astype("int64")
+    p, m = exe.run(feed={"e": em, "lab": label}, fetch_list=[path, mask])
+    np.testing.assert_array_equal(
+        np.asarray(m), (np.asarray(p) == label).astype(np.int64)
+    )
+
+
+def test_label_semantic_roles_book():
+    """Sequence tagging: tag[t] = (word[t] + word[t-1]) % D — needs context,
+    which the LSTM+CRF stack provides (reference book test shape)."""
+    V, H, NB, NT, ND = 30, 32, 8, 8, 4
+    words = fluid.data("words", [NB, NT], "int64")
+    target = fluid.data("target", [NB, NT], "int64")
+    emb = layers.embedding(words, size=[V, H])
+    hidden, _, _ = layers.lstm(emb, H)
+    emission = layers.fc(hidden, ND, num_flatten_dims=2)
+    nll = layers.linear_chain_crf(
+        emission, target, param_attr=fluid.ParamAttr(name="crf_book")
+    )
+    loss = layers.mean(nll)
+    path = layers.crf_decoding(
+        emission, param_attr=fluid.ParamAttr(name="crf_book")
+    )
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    w = rng.randint(0, V, (NB, NT)).astype("int64")
+    prev = np.concatenate([np.zeros((NB, 1), np.int64), w[:, :-1]], 1)
+    tags = ((w + prev) % ND).astype("int64")
+    feed = {"words": w, "target": tags}
+    vals = []
+    for _ in range(120):
+        (lv,) = exe.run(feed=feed, fetch_list=[loss])
+        vals.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert vals[-1] < 0.3 * vals[0], (vals[0], vals[-1])
+    (decoded,) = exe.run(feed=feed, fetch_list=[path])
+    acc = (np.asarray(decoded) == tags).mean()
+    assert acc > 0.9, acc
