@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI driver (reference paddle/scripts/paddle_build.sh role, reduced to what
+# a pure-Python+JAX framework needs): unit tests on the 8-virtual-device
+# CPU mesh, the benchmark smoke (CPU-sized when no TPU), the driver entry
+# compile checks, and the op-surface report.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== pytest (8 virtual CPU devices via tests/conftest.py) =="
+python -m pytest tests/ -q
+
+echo "== bench smoke =="
+python bench.py
+
+echo "== driver entry points =="
+python __graft_entry__.py
+
+echo "== op surface =="
+python tools/check_op_surface.py || true

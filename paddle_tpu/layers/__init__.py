@@ -74,11 +74,14 @@ from .detection import (  # noqa: F401
     anchor_generator,
     box_clip,
     box_coder,
+    density_prior_box,
+    generate_proposals,
     iou_similarity,
     multiclass_nms,
     prior_box,
     roi_align,
     roi_pool,
+    sigmoid_focal_loss,
     yolo_box,
     yolov3_loss,
 )

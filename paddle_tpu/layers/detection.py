@@ -143,3 +143,46 @@ def box_clip(input, im_info, name=None):
         {},
         out_slots=("Output",),
     )
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25, name=None):
+    return _simple(
+        "sigmoid_focal_loss",
+        {"X": [x], "Label": [label], "FgNum": [fg_num]},
+        {"gamma": gamma, "alpha": alpha},
+    )
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=None, clip=False,
+                      steps=None, offset=0.5, name=None):
+    steps = steps or [0.0, 0.0]
+    return _simple(
+        "density_prior_box",
+        {"Input": [input], "Image": [image]},
+        {"densities": list(densities or [1]),
+         "fixed_sizes": list(fixed_sizes or [32.0]),
+         "fixed_ratios": list(fixed_ratios or [1.0]),
+         "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+         "clip": clip, "step_w": steps[0], "step_h": steps[1],
+         "offset": offset},
+        out_slots=("Boxes", "Variances"),
+        stop_gradient=True,
+    )
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, name=None):
+    """RPN proposals ([N, post_nms_top_n, 4] padded + probs + valid
+    counts; the reference emits variable-length LoD rois)."""
+    return _simple(
+        "generate_proposals",
+        {"Scores": [scores], "BboxDeltas": [bbox_deltas],
+         "ImInfo": [im_info], "Anchors": [anchors],
+         "Variances": [variances]},
+        {"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+         "nms_thresh": nms_thresh, "min_size": min_size},
+        out_slots=("RpnRois", "RpnRoiProbs", "RpnRoisNum"),
+        stop_gradient=True,
+    )

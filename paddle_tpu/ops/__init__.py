@@ -16,6 +16,7 @@ from . import (  # noqa: F401
     detection,
     fused,
     math,
+    math_ext,
     metrics,
     nn,
     optimizer_ops,

@@ -192,6 +192,21 @@ def _yolo_box(ctx, op, ins):
     return {"Boxes": [boxes], "Scores": [score]}
 
 
+def _greedy_nms(boxes_k, keep_pred, nms_thresh):
+    """alive mask over rank-ordered boxes [k, 4]: box i survives iff
+    keep_pred[i] and it overlaps no surviving higher-ranked box (the
+    sequential suppression loop of multiclass_nms_op.cc as a lax.scan)."""
+    k = boxes_k.shape[0]
+    iou = _iou_matrix(boxes_k, boxes_k)
+
+    def step(alive, i):
+        sup = jnp.any((iou[i] > nms_thresh) & alive & (jnp.arange(k) < i))
+        return alive.at[i].set(jnp.logical_and(~sup, keep_pred[i])), None
+
+    alive, _ = lax.scan(step, jnp.zeros(k, bool), jnp.arange(k))
+    return alive
+
+
 @register_op(
     "multiclass_nms", inputs=["BBoxes", "Scores"],
     outputs=["Out", "NmsRoisNum"], differentiable=False,
@@ -214,18 +229,7 @@ def _multiclass_nms(ctx, op, ins):
     def one_class(b_boxes, c_scores):
         sc, idx = lax.top_k(c_scores, k)
         bx = b_boxes[idx]
-        iou = _iou_matrix(bx, bx)
-        # greedy suppression as a scan over rank order: box i dies if it
-        # overlaps any surviving higher-ranked box
-        def step(alive, i):
-            sup = jnp.any(
-                (iou[i] > nms_thresh) & alive & (jnp.arange(k) < i)
-            )
-            keep_i = jnp.logical_and(~sup, sc[i] > score_thresh)
-            return alive.at[i].set(keep_i), None
-
-        alive0 = jnp.zeros(k, bool)
-        alive, _ = lax.scan(step, alive0, jnp.arange(k))
+        alive = _greedy_nms(bx, sc > score_thresh, nms_thresh)
         return sc * alive, idx
 
     def one_image(b_boxes, b_scores):
@@ -635,3 +639,166 @@ def _box_clip(ctx, op, ins):
     lo = jnp.stack([zero, zero, zero, zero], -1)[:, None, :]
     hi = jnp.stack([wmax, hmax, wmax, hmax], -1)[:, None, :]
     return {"Output": [jnp.clip(boxes, lo, hi)]}
+
+
+@register_op("sigmoid_focal_loss", inputs=["X", "Label", "FgNum"],
+             outputs=["Out"])
+def _sigmoid_focal_loss(ctx, op, ins):
+    """RetinaNet focal loss (detection/sigmoid_focal_loss_op.cu): X [N, C]
+    logits, Label [N, 1] in {0..C} (0 = background), FgNum [1] foreground
+    count; per-class sigmoid CE weighted by alpha/(1-alpha) and
+    (1-p_t)^gamma, normalized by fg_num."""
+    x = ins["X"][0].astype(jnp.float32)
+    label = ins["Label"][0].astype(jnp.int32).reshape(-1)
+    fg = jnp.maximum(ins["FgNum"][0].astype(jnp.float32).reshape(()), 1.0)
+    gamma = float(op.attr("gamma", 2.0))
+    alpha = float(op.attr("alpha", 0.25))
+    N, C = x.shape
+    # target[n, c] = 1 iff label[n] == c+1 (class ids are 1-based; 0 = bg)
+    t = (label[:, None] == (jnp.arange(C)[None, :] + 1)).astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * t + (1 - p) * (1 - t)
+    a_t = alpha * t + (1 - alpha) * (1 - t)
+    return {"Out": [a_t * ((1 - p_t) ** gamma) * ce / fg]}
+
+
+@register_op("density_prior_box", inputs=["Input", "Image"],
+             outputs=["Boxes", "Variances"], differentiable=False)
+def _density_prior_box(ctx, op, ins):
+    """Densified SSD priors (detection/density_prior_box_op.h): per cell,
+    for each (fixed_size, density) pair lay a density x density grid of
+    shifted boxes scaled by fixed_ratios."""
+    feat, img = ins["Input"][0], ins["Image"][0]
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    fixed_sizes = [float(v) for v in op.attr("fixed_sizes")]
+    fixed_ratios = [float(v) for v in op.attr("fixed_ratios", [1.0])]
+    densities = [int(v) for v in op.attr("densities")]
+    variances = [float(v) for v in op.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(op.attr("clip", False))
+    step_w = op.attr("step_w", 0.0) or IW / W
+    step_h = op.attr("step_h", 0.0) or IH / H
+    offset = float(op.attr("offset", 0.5))
+
+    # the density grid spans one STEP cell, not the box size
+    # (density_prior_box_op.h:69-101: shift = step_average / density,
+    # centers at -step_average/2 + shift/2 + j*shift from the cell center)
+    step_average = int(0.5 * (step_w + step_h))
+    whs, shifts = [], []
+    for size, density in zip(fixed_sizes, densities):
+        for ar in fixed_ratios:
+            bw = size * np.sqrt(ar)
+            bh = size / np.sqrt(ar)
+            shift = step_average / density
+            for di in range(density):
+                for dj in range(density):
+                    whs.append((bw, bh))
+                    shifts.append((
+                        -step_average / 2.0 + shift / 2.0 + dj * shift,
+                        -step_average / 2.0 + shift / 2.0 + di * shift,
+                    ))
+    whs = jnp.asarray(whs, jnp.float32)      # [P, 2]
+    shifts = jnp.asarray(shifts, jnp.float32)
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    centers = jnp.stack([cxg, cyg], -1)[:, :, None, :] + shifts[None, None]
+    half = whs[None, None] / 2.0
+    mins = (centers - half) / jnp.asarray([IW, IH], jnp.float32)
+    maxs = (centers + half) / jnp.asarray([IW, IH], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], -1)  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op(
+    "generate_proposals",
+    inputs=["Scores", "BboxDeltas", "ImInfo", "Anchors", "Variances"],
+    outputs=["RpnRois", "RpnRoiProbs", "RpnRoisNum"],
+    differentiable=False,
+)
+def _generate_proposals(ctx, op, ins):
+    """RPN proposal generation (detection/generate_proposals_op.cc),
+    static-shape re-design: decode all anchors, clip to the image, mask
+    degenerate boxes, take pre_nms_topN by score, greedy-NMS on the fixed
+    set, emit exactly post_nms_topN rois per image (padded; RpnRoisNum
+    counts the valid ones) — the reference emits a variable count via LoD.
+    """
+    scores = ins["Scores"][0]          # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]      # [N, A*4, H, W]
+    im_info = ins["ImInfo"][0].astype(jnp.float32)  # [N, 3]
+    anchors = ins["Anchors"][0].reshape(-1, 4).astype(jnp.float32)
+    variances = ins["Variances"][0].reshape(-1, 4).astype(jnp.float32)
+    pre_n = int(op.attr("pre_nms_topN", 6000))
+    post_n = int(op.attr("post_nms_topN", 1000))
+    nms_thresh = float(op.attr("nms_thresh", 0.7))
+    min_size = float(op.attr("min_size", 0.1))
+
+    N, A, H, W = scores.shape
+    M = A * H * W
+    sc = scores.transpose(0, 2, 3, 1).reshape(N, M)
+    dl = (
+        deltas.reshape(N, A, 4, H, W).transpose(0, 3, 4, 1, 2).reshape(N, M, 4)
+    )
+
+    # decode (anchor + variance-scaled deltas; generate_proposals box coder)
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+    cx = variances[:, 0] * dl[..., 0] * aw + acx
+    cy = variances[:, 1] * dl[..., 1] * ah + acy
+    w = jnp.exp(jnp.minimum(variances[:, 2] * dl[..., 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(variances[:, 3] * dl[..., 3], 10.0)) * ah
+    x0 = cx - 0.5 * w
+    y0 = cy - 0.5 * h
+    x1 = cx + 0.5 * w - 1.0
+    y1 = cy + 0.5 * h - 1.0
+
+    imh = im_info[:, 0:1]
+    imw = im_info[:, 1:2]
+    x0 = jnp.clip(x0, 0.0, imw - 1.0)
+    x1 = jnp.clip(x1, 0.0, imw - 1.0)
+    y0 = jnp.clip(y0, 0.0, imh - 1.0)
+    y1 = jnp.clip(y1, 0.0, imh - 1.0)
+    boxes = jnp.stack([x0, y0, x1, y1], -1)  # [N, M, 4]
+
+    # FilterBoxes (generate_proposals_op.cc:168): keep iff the box size in
+    # ORIGINAL image units (w/im_scale + 1) reaches max(min_size, 1)
+    ms = max(min_size, 1.0)
+    im_scale = im_info[:, 2:3]
+    keep = ((x1 - x0) / im_scale + 1.0 >= ms) & (
+        (y1 - y0) / im_scale + 1.0 >= ms
+    )
+    sc = jnp.where(keep, sc, -jnp.inf)
+
+    k = min(pre_n, M)
+    top_sc, top_i = lax.top_k(sc, k)  # [N, k]
+    top_boxes = jnp.take_along_axis(boxes, top_i[..., None], axis=1)
+
+    alive = jax.vmap(
+        lambda bx, s: _greedy_nms(bx, jnp.isfinite(s), nms_thresh)
+    )(top_boxes, top_sc)  # [N, k]
+    # stable-order select the first post_n survivors (already score-sorted)
+    rank = jnp.cumsum(alive.astype(jnp.int32), axis=1) - 1
+    out_boxes = jnp.zeros((N, post_n, 4), boxes.dtype)
+    out_probs = jnp.zeros((N, post_n), sc.dtype)
+    n_idx = jnp.arange(N)[:, None].repeat(k, 1)
+    # suppressed boxes and rank overflow both land on the dump row post_n
+    sel_cl = jnp.where(alive & (rank < post_n), rank, post_n)
+    out_boxes = jnp.concatenate(
+        [out_boxes, jnp.zeros((N, 1, 4), boxes.dtype)], axis=1
+    ).at[n_idx, sel_cl].set(top_boxes, mode="drop")[:, :post_n]
+    out_probs = jnp.concatenate(
+        [out_probs, jnp.zeros((N, 1), sc.dtype)], axis=1
+    ).at[n_idx, sel_cl].set(top_sc, mode="drop")[:, :post_n]
+    num = jnp.minimum(jnp.sum(alive, axis=1), post_n).astype(jnp.int32)
+    return {
+        "RpnRois": [out_boxes],
+        "RpnRoiProbs": [out_probs[..., None]],
+        "RpnRoisNum": [num],
+    }

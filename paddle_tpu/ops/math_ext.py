@@ -1,0 +1,517 @@
+"""Extended math/tensor op surface (reference operators/ root: the long
+tail of small ops — addmm_op.cc, cos_sim_op.cc, kron_op.cc, one_hot_op.cc,
+pixel_shuffle_op.cc, ...). Each is a direct XLA emitter; gradients come
+from the generic vjp. Static-shape-friendly subset only — ops whose
+reference semantics require dynamic output shapes (unique, where_index,
+edit_distance with LoD) stay with their subsystem re-designs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+from ._helpers import fluid_broadcast
+
+
+# ---------------------------------------------------------------------------
+# linalg / tensor construction
+# ---------------------------------------------------------------------------
+
+
+@register_op("addmm", inputs=["Input", "X", "Y"], outputs=["Out"])
+def _addmm(ctx, op, ins):
+    inp, x, y = ins["Input"][0], ins["X"][0], ins["Y"][0]
+    return {
+        "Out": [
+            op.attr("Beta", 1.0) * inp
+            + op.attr("Alpha", 1.0) * (x @ y)
+        ]
+    }
+
+
+@register_op("cholesky", inputs=["X"], outputs=["Out"])
+def _cholesky(ctx, op, ins):
+    c = jnp.linalg.cholesky(ins["X"][0])
+    if op.attr("upper", False):
+        c = jnp.swapaxes(c, -1, -2)
+    return {"Out": [c]}
+
+
+@register_op("inverse", inputs=["Input"], outputs=["Output"])
+def _inverse(ctx, op, ins):
+    return {"Output": [jnp.linalg.inv(ins["Input"][0])]}
+
+
+@register_op("kron", inputs=["X", "Y"], outputs=["Out"])
+def _kron(ctx, op, ins):
+    return {"Out": [jnp.kron(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("cross", inputs=["X", "Y"], outputs=["Out"])
+def _cross(ctx, op, ins):
+    x = ins["X"][0]
+    # reference sentinel for "auto-pick the first size-3 axis" is
+    # DDim::kMaxRank (9), NOT -1 — negative dims are valid explicit axes
+    # (cross_op.cc:54 accepts dim >= -rank)
+    dim = op.attr("dim", 9)
+    if dim is None or dim >= 9:
+        dim = next(
+            (i for i, s in enumerate(x.shape) if s == 3), len(x.shape) - 1
+        )
+    elif dim < 0:
+        dim += x.ndim
+    return {"Out": [jnp.cross(x, ins["Y"][0], axis=dim)]}
+
+
+@register_op("eye", inputs=[], outputs=["Out"])
+def _eye(ctx, op, ins):
+    from ..core.dtypes import to_numpy_dtype
+
+    n = int(op.attr("num_rows"))
+    m = int(op.attr("num_columns", -1))
+    dt = to_numpy_dtype(op.attr("dtype", "float32"))
+    return {"Out": [jnp.eye(n, n if m in (-1, None) else m, dtype=dt)]}
+
+
+@register_op("meshgrid", inputs=["X"], outputs=["Out"])
+def _meshgrid(ctx, op, ins):
+    return {"Out": list(jnp.meshgrid(*ins["X"], indexing="ij"))}
+
+
+@register_op("diag_v2", inputs=["X"], outputs=["Out"])
+def _diag_v2(ctx, op, ins):
+    x = ins["X"][0]
+    k = int(op.attr("offset", 0))
+    if x.ndim == 1:
+        out = jnp.diag(x, k=k)
+        pad = op.attr("padding_value", 0.0)
+        if pad:
+            mask = jnp.diag(jnp.ones_like(x), k=k)
+            out = out + (1 - mask) * pad
+        return {"Out": [out]}
+    return {"Out": [jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)]}
+
+
+@register_op("diag_embed", inputs=["Input"], outputs=["Out"])
+def _diag_embed(ctx, op, ins):
+    x = ins["Input"][0]
+    k = int(op.attr("offset", 0))
+    n = x.shape[-1] + abs(k)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-k, 0)
+    c = idx + max(k, 0)
+    return {"Out": [out.at[..., r, c].set(x)]}
+
+
+@register_op("trace", inputs=["Input"], outputs=["Out"])
+def _trace(ctx, op, ins):
+    return {
+        "Out": [
+            jnp.trace(
+                ins["Input"][0],
+                offset=op.attr("offset", 0),
+                axis1=op.attr("axis1", 0),
+                axis2=op.attr("axis2", 1),
+            )
+        ]
+    }
+
+
+@register_op("flatten", inputs=["X"], outputs=["Out"])
+def _flatten(ctx, op, ins):
+    x = ins["X"][0]
+    ax = int(op.attr("axis", 1))
+    lead = int(np.prod(x.shape[:ax])) if ax else 1
+    return {"Out": [x.reshape(lead, -1)]}
+
+
+@register_op("one_hot", inputs=["X"], outputs=["Out"])
+def _one_hot(ctx, op, ins):
+    x = ins["X"][0]
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return {"Out": [jax.nn.one_hot(x, int(op.attr("depth")))]}
+
+
+@register_op("fill_zeros_like", inputs=["X"], outputs=["Out"])
+def _fill_zeros_like(ctx, op, ins):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register_op("shard_index", inputs=["X"], outputs=["Out"])
+def _shard_index(ctx, op, ins):
+    x = ins["X"][0]
+    index_num = int(op.attr("index_num"))
+    nshards = int(op.attr("nshards"))
+    shard_id = int(op.attr("shard_id"))
+    ignore = int(op.attr("ignore_value", -1))
+    size = (index_num + nshards - 1) // nshards
+    inside = (x // size) == shard_id
+    return {"Out": [jnp.where(inside, x % size, ignore)]}
+
+
+@register_op("size", inputs=["Input"], outputs=["Out"])
+def _size(ctx, op, ins):
+    return {
+        "Out": [jnp.asarray(int(np.prod(ins["Input"][0].shape)), jnp.int64)]
+    }
+
+
+# ---------------------------------------------------------------------------
+# elementwise / comparison extras
+# ---------------------------------------------------------------------------
+
+
+@register_op("allclose", inputs=["Input", "Other"], outputs=["Out"])
+def _allclose(ctx, op, ins):
+    return {
+        "Out": [
+            jnp.allclose(
+                ins["Input"][0],
+                ins["Other"][0],
+                rtol=float(op.attr("rtol", 1e-5)),
+                atol=float(op.attr("atol", 1e-8)),
+                equal_nan=bool(op.attr("equal_nan", False)),
+            )
+        ]
+    }
+
+
+@register_op("minus", inputs=["X", "Y"], outputs=["Out"])
+def _minus(ctx, op, ins):
+    x, y = fluid_broadcast(ins["X"][0], ins["Y"][0], -1)
+    return {"Out": [x - y]}
+
+
+@register_op("label_smooth", inputs=["X", "PriorDist"], outputs=["Out"])
+def _label_smooth(ctx, op, ins):
+    x = ins["X"][0]
+    eps = float(op.attr("epsilon", 0.0))
+    prior = (
+        ins["PriorDist"][0]
+        if ins.get("PriorDist") and ins["PriorDist"][0] is not None
+        else 1.0 / x.shape[-1]
+    )
+    return {"Out": [(1.0 - eps) * x + eps * prior]}
+
+
+@register_op("multiplex", inputs=["X", "Ids"], outputs=["Out"])
+def _multiplex(ctx, op, ins):
+    stacked = jnp.stack(ins["X"], axis=0)  # [K, B, ...]
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    return {"Out": [stacked[ids, jnp.arange(ids.shape[0])]]}
+
+
+# ---------------------------------------------------------------------------
+# norms / similarity
+# ---------------------------------------------------------------------------
+
+
+@register_op("cos_sim", inputs=["X", "Y"], outputs=["Out"])
+def _cos_sim(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    num = jnp.sum(x * y, axis=-1, keepdims=True)
+    return {"Out": [num / jnp.maximum(xn * yn, 1e-12)]}
+
+
+@register_op("l1_norm", inputs=["X"], outputs=["Out"])
+def _l1_norm(ctx, op, ins):
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0]))]}
+
+
+@register_op("norm", inputs=["X"], outputs=["Out", "Norm"])
+def _norm(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attr("axis", -1))
+    eps = float(op.attr("epsilon", 1e-10))
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": [x / n], "Norm": [n]}
+
+
+@register_op("p_norm", inputs=["X"], outputs=["Out"])
+def _p_norm(ctx, op, ins):
+    x = ins["X"][0]
+    p = float(op.attr("porder", 2.0))
+    axis = int(op.attr("axis", -1))
+    keep = bool(op.attr("keepdim", False))
+    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keep) ** (1.0 / p)
+    return {"Out": [out]}
+
+
+@register_op("squared_l2_distance", inputs=["X", "Y"], outputs=["Out", "sub_result"])
+def _squared_l2_distance(ctx, op, ins):
+    d = ins["X"][0] - ins["Y"][0]
+    return {
+        "Out": [jnp.sum(d * d, axis=-1, keepdims=True)],
+        "sub_result": [d],
+    }
+
+
+@register_op("dist", inputs=["X", "Y"], outputs=["Out"])
+def _dist(ctx, op, ins):
+    d = jnp.abs(ins["X"][0] - ins["Y"][0])
+    p = float(op.attr("p", 2.0))
+    if p == float("inf"):
+        return {"Out": [jnp.max(d)]}
+    if p == 0.0:
+        return {"Out": [jnp.sum((d != 0).astype(d.dtype))]}
+    return {"Out": [jnp.sum(d ** p) ** (1.0 / p)]}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+@register_op("bce_loss", inputs=["X", "Label"], outputs=["Out"])
+def _bce_loss(ctx, op, ins):
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-12
+    return {
+        "Out": [
+            -(label * jnp.log(jnp.maximum(x, eps))
+              + (1 - label) * jnp.log(jnp.maximum(1 - x, eps)))
+        ]
+    }
+
+
+@register_op("nll_loss", inputs=["X", "Label", "Weight"], outputs=["Out", "Total_weight"])
+def _nll_loss(ctx, op, ins):
+    x, label = ins["X"][0], ins["Label"][0].astype(jnp.int32)
+    w = (
+        ins["Weight"][0]
+        if ins.get("Weight") and ins["Weight"][0] is not None
+        else jnp.ones((x.shape[1],), x.dtype)
+    )
+    ignore = int(op.attr("ignore_index", -100))
+    picked = -x[jnp.arange(x.shape[0]), label] * w[label]
+    valid = label != ignore
+    picked = jnp.where(valid, picked, 0.0)
+    tw = jnp.sum(jnp.where(valid, w[label], 0.0))
+    red = op.attr("reduction", "mean")
+    if red == "mean":
+        out = jnp.sum(picked) / jnp.maximum(tw, 1e-12)
+    elif red == "sum":
+        out = jnp.sum(picked)
+    else:
+        out = picked
+    return {"Out": [out], "Total_weight": [tw]}
+
+
+@register_op("hinge_loss", inputs=["Logits", "Labels"], outputs=["Loss"])
+def _hinge_loss(ctx, op, ins):
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0)]}
+
+
+@register_op("modified_huber_loss", inputs=["X", "Y"],
+             outputs=["Out", "IntermediateVal"])
+def _modified_huber_loss(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(
+        z < -1.0, -4.0 * z, jnp.square(jnp.maximum(1.0 - z, 0.0))
+    )
+    return {"Out": [loss], "IntermediateVal": [z]}
+
+
+@register_op("margin_rank_loss", inputs=["X1", "X2", "Label"],
+             outputs=["Out", "Activated"])
+def _margin_rank_loss(ctx, op, ins):
+    x1, x2, label = ins["X1"][0], ins["X2"][0], ins["Label"][0]
+    margin = float(op.attr("margin", 0.0))
+    out = jnp.maximum(-label * (x1 - x2) + margin, 0.0)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("rank_loss", inputs=["Left", "Right", "Label"], outputs=["Out"])
+def _rank_loss(ctx, op, ins):
+    left, right, label = ins["Left"][0], ins["Right"][0], ins["Label"][0]
+    d = left - right
+    return {"Out": [jnp.maximum(d, 0.0) - d * label + jnp.log1p(jnp.exp(-jnp.abs(d)))]}
+
+
+@register_op("bpr_loss", inputs=["X", "Label"], outputs=["Y"])
+def _bpr_loss(ctx, op, ins):
+    x, label = ins["X"][0], ins["Label"][0].astype(jnp.int32)
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label[:, 0]
+    pos = x[jnp.arange(x.shape[0]), label][:, None]
+    # mean over negative items of -log sigmoid(pos - neg), excluding pos
+    diff = pos - x
+    lo = -jax.nn.log_sigmoid(diff)
+    mask = jax.nn.one_hot(label, x.shape[1], dtype=x.dtype)
+    out = jnp.sum(lo * (1 - mask), axis=1, keepdims=True) / (x.shape[1] - 1)
+    return {"Y": [out]}
+
+
+# ---------------------------------------------------------------------------
+# vision extras
+# ---------------------------------------------------------------------------
+
+
+@register_op("pixel_shuffle", inputs=["X"], outputs=["Out"])
+def _pixel_shuffle(ctx, op, ins):
+    x = ins["X"][0]
+    r = int(op.attr("upscale_factor", 1))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": [x.reshape(n, c // (r * r), h * r, w * r)]}
+
+
+@register_op("affine_channel", inputs=["X", "Scale", "Bias"], outputs=["Out"])
+def _affine_channel(ctx, op, ins):
+    x, scale, bias = ins["X"][0], ins["Scale"][0], ins["Bias"][0]
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    return {"Out": [x * scale.reshape(shape) + bias.reshape(shape)]}
+
+
+@register_op("maxout", inputs=["X"], outputs=["Out"])
+def _maxout(ctx, op, ins):
+    x = ins["X"][0]
+    groups = int(op.attr("groups"))
+    n, c, h, w = x.shape
+    return {"Out": [jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2)]}
+
+
+@register_op("max_pool2d_with_index", inputs=["X"], outputs=["Out", "Mask"])
+def _max_pool2d_with_index(ctx, op, ins):
+    """Mask holds flat indices into the UNPADDED input, matching
+    pool_with_index_op.cc (padded window positions can never win: they
+    read -inf)."""
+    x = ins["X"][0]
+    k = [int(v) for v in op.attr("ksize")]
+    s = [int(v) for v in op.attr("strides", k)]
+    p = [int(v) for v in op.attr("paddings", [0, 0])]
+    if op.attr("global_pooling", False):
+        k = [int(x.shape[2]), int(x.shape[3])]
+        s, p = [1, 1], [0, 0]
+    n, c, h, w = x.shape
+    oh = (h + 2 * p[0] - k[0]) // s[0] + 1
+    ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+    rows = (jnp.arange(oh) * s[0] - p[0])[:, None] + jnp.arange(k[0])[None, :]
+    cols = (jnp.arange(ow) * s[1] - p[1])[:, None] + jnp.arange(k[1])[None, :]
+    rvalid = (rows >= 0) & (rows < h)
+    cvalid = (cols >= 0) & (cols < w)
+    rc = jnp.clip(rows, 0, h - 1)
+    cc = jnp.clip(cols, 0, w - 1)
+    win = x[:, :, rc[:, None, :, None], cc[None, :, None, :]]
+    valid = rvalid[:, None, :, None] & cvalid[None, :, None, :]
+    win = jnp.where(valid[None, None], win, -jnp.inf)
+    flat = win.reshape(n, c, oh, ow, k[0] * k[1])
+    arg = jnp.argmax(flat, axis=-1)
+    out = jnp.max(flat, axis=-1)
+    ky, kx = arg // k[1], arg % k[1]
+    gy = jnp.clip(jnp.arange(oh)[None, None, :, None] * s[0] - p[0] + ky, 0, h - 1)
+    gx = jnp.clip(jnp.arange(ow)[None, None, None, :] * s[1] - p[1] + kx, 0, w - 1)
+    return {"Out": [out], "Mask": [(gy * w + gx).astype(jnp.int32)]}
+
+
+@register_op("lrn", inputs=["X"], outputs=["Out", "MidOut"])
+def _lrn(ctx, op, ins):
+    x = ins["X"][0]
+    n = int(op.attr("n", 5))
+    alpha = float(op.attr("alpha", 1e-4))
+    beta = float(op.attr("beta", 0.75))
+    k = float(op.attr("k", 1.0))
+    half = n // 2
+    sq = jnp.square(x)
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(
+        pad[:, i:i + x.shape[1]] for i in range(n)
+    )
+    mid = k + alpha * acc
+    return {"Out": [x / mid ** beta], "MidOut": [mid]}
+
+
+@register_op("grid_sampler", inputs=["X", "Grid"], outputs=["Output"])
+def _grid_sampler(ctx, op, ins):
+    """Bilinear grid sampling with zero padding and align_corners=True
+    (grid_sampler_op.cc defaults); grid values in [-1, 1]."""
+    x, grid = ins["X"][0], ins["Grid"][0]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0  # [N, Ho, Wo]
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+
+    def corner(yi, xi):
+        inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1)
+        xc = jnp.clip(xi, 0, w - 1)
+        v = x[jnp.arange(n)[:, None, None], :, yc, xc]  # [N, Ho, Wo, C]
+        return jnp.where(inb[..., None], v, 0.0)
+
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    lx = gx - x0
+    ly = gy - y0
+    out = (
+        corner(y0, x0) * ((1 - ly) * (1 - lx))[..., None]
+        + corner(y0, x0 + 1) * ((1 - ly) * lx)[..., None]
+        + corner(y0 + 1, x0) * (ly * (1 - lx))[..., None]
+        + corner(y0 + 1, x0 + 1) * (ly * lx)[..., None]
+    )
+    return {"Output": [out.transpose(0, 3, 1, 2)]}
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+
+@register_op("index_select", inputs=["X", "Index"], outputs=["Out"])
+def _index_select(ctx, op, ins):
+    return {
+        "Out": [
+            jnp.take(
+                ins["X"][0],
+                ins["Index"][0].astype(jnp.int32),
+                axis=int(op.attr("dim", 0)),
+            )
+        ]
+    }
+
+
+@register_op("index_sample", inputs=["X", "Index"], outputs=["Out"])
+def _index_sample(ctx, op, ins):
+    x = ins["X"][0]
+    idx = ins["Index"][0].astype(jnp.int32)
+    return {"Out": [jnp.take_along_axis(x, idx, axis=1)]}
+
+
+@register_op("histogram", inputs=["X"], outputs=["Out"])
+def _histogram(ctx, op, ins):
+    x = ins["X"][0].ravel()
+    bins = int(op.attr("bins", 100))
+    lo = float(op.attr("min", 0))
+    hi = float(op.attr("max", 0))
+    if lo == 0 and hi == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return {"Out": [hist.astype(jnp.int64)]}
+
+
+@register_op("gather_tree", inputs=["Ids", "Parents"], outputs=["Out"])
+def _gather_tree(ctx, op, ins):
+    """Beam-search ancestry walk (gather_tree_op.cc): ids/parents
+    [T, B, beam] -> full sequences via reverse backtrack."""
+    ids, parents = ins["Ids"][0], ins["Parents"][0].astype(jnp.int32)
+    T, B, K = ids.shape
+    b_idx = jnp.arange(B)[:, None]
+
+    def step(beam, t):
+        out = ids[t, b_idx, beam]
+        prev = parents[t, b_idx, beam]
+        return prev, out
+
+    k0 = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None], (B, K))
+    _, outs = lax.scan(step, k0, jnp.arange(T - 1, -1, -1))
+    return {"Out": [outs[::-1]]}

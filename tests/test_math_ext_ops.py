@@ -1,0 +1,219 @@
+"""Extended op surface (ops/math_ext.py) against numpy references."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import unique_name
+from paddle_tpu.framework.registry import get_op_def
+from paddle_tpu.framework.registry import OpView
+from paddle_tpu.framework.registry import EmitContext
+
+import jax.numpy as jnp
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield
+
+
+def run_op(op_type, ins, attrs=None, outs=("Out",)):
+    """Drive an emitter directly (the micro harness pattern of
+    tests/op_test.py)."""
+    ctx = EmitContext(is_test=True)
+    op = OpView(op_type, attrs or {})
+    got = get_op_def(op_type).emit(
+        ctx, op, {k: [jnp.asarray(x) for x in v] for k, v in ins.items()}
+    )
+    return [np.asarray(got[o][0]) for o in outs]
+
+
+RNG = np.random.RandomState(0)
+
+
+def test_linalg_family():
+    a = RNG.randn(4, 5).astype("f4")
+    b = RNG.randn(5, 3).astype("f4")
+    inp = RNG.randn(4, 3).astype("f4")
+    (out,) = run_op("addmm", {"Input": [inp], "X": [a], "Y": [b]},
+                    {"Alpha": 2.0, "Beta": 0.5})
+    np.testing.assert_allclose(out, 0.5 * inp + 2.0 * (a @ b), rtol=1e-5)
+
+    m = RNG.randn(4, 4).astype("f4")
+    spd = m @ m.T + 4 * np.eye(4, dtype="f4")
+    (c,) = run_op("cholesky", {"X": [spd]})
+    np.testing.assert_allclose(c @ c.T, spd, rtol=1e-4, atol=1e-4)
+    (inv,) = run_op("inverse", {"Input": [spd]}, outs=("Output",))
+    np.testing.assert_allclose(inv @ spd, np.eye(4), atol=1e-4)
+
+    x = RNG.randn(2, 3).astype("f4")
+    y = RNG.randn(3, 2).astype("f4")
+    (k,) = run_op("kron", {"X": [x], "Y": [y]})
+    np.testing.assert_allclose(k, np.kron(x, y), rtol=1e-6)
+
+    v = RNG.randn(6, 3).astype("f4")
+    w = RNG.randn(6, 3).astype("f4")
+    (cr,) = run_op("cross", {"X": [v], "Y": [w]})
+    np.testing.assert_allclose(cr, np.cross(v, w), rtol=1e-5)
+
+    sq = RNG.randn(5, 5).astype("f4")
+    (tr,) = run_op("trace", {"Input": [sq]})
+    np.testing.assert_allclose(tr, np.trace(sq), rtol=1e-5)
+
+    d = RNG.randn(4).astype("f4")
+    (de,) = run_op("diag_embed", {"Input": [d]}, {"offset": 1})
+    np.testing.assert_allclose(de, np.diag(d, k=1), rtol=1e-6)
+
+    (e,) = run_op("eye", {}, {"num_rows": 3, "num_columns": 5,
+                              "dtype": "float32"})
+    np.testing.assert_array_equal(e, np.eye(3, 5))
+
+
+def test_elementwise_and_indexing():
+    x = RNG.randn(4, 6).astype("f4")
+    (oh,) = run_op("one_hot", {"X": [np.array([[1], [3]], "i8")]},
+                   {"depth": 5})
+    np.testing.assert_array_equal(oh, np.eye(5, dtype="f4")[[1, 3]])
+
+    (f,) = run_op("flatten", {"X": [RNG.randn(2, 3, 4).astype("f4")]},
+                  {"axis": 1})
+    assert f.shape == (2, 12)
+
+    idx = np.array([2, 0], "i4")
+    (sel,) = run_op("index_select", {"X": [x], "Index": [idx]}, {"dim": 0})
+    np.testing.assert_allclose(sel, x[[2, 0]])
+
+    samp_idx = RNG.randint(0, 6, (4, 3)).astype("i4")
+    (samp,) = run_op("index_sample", {"X": [x], "Index": [samp_idx]})
+    np.testing.assert_allclose(samp, np.take_along_axis(x, samp_idx, 1))
+
+    (sh,) = run_op("shard_index", {"X": [np.array([[1], [7], [15]], "i8")]},
+                   {"index_num": 20, "nshards": 2, "shard_id": 0,
+                    "ignore_value": -1})
+    np.testing.assert_array_equal(sh, [[1], [7], [-1]])
+
+    xs = [RNG.randn(3, 4).astype("f4") for _ in range(3)]
+    ids = np.array([[2], [0], [1]], "i4")
+    (mx,) = run_op("multiplex", {"X": xs, "Ids": [ids]})
+    ref = np.stack([xs[2][0], xs[0][1], xs[1][2]])
+    np.testing.assert_allclose(mx, ref)
+
+    (hist,) = run_op("histogram", {"X": [np.array([0.1, 0.5, 0.9, 0.55],
+                                                  "f4")]},
+                     {"bins": 2, "min": 0.0, "max": 1.0})
+    np.testing.assert_array_equal(hist, [1, 3])
+
+
+def test_norms_similarity_losses():
+    x = RNG.randn(4, 8).astype("f4")
+    y = RNG.randn(4, 8).astype("f4")
+    (cs,) = run_op("cos_sim", {"X": [x], "Y": [y]})
+    ref = (x * y).sum(-1, keepdims=True) / (
+        np.linalg.norm(x, axis=-1, keepdims=True)
+        * np.linalg.norm(y, axis=-1, keepdims=True)
+    )
+    np.testing.assert_allclose(cs, ref, rtol=1e-5)
+
+    (pn,) = run_op("p_norm", {"X": [x]}, {"porder": 3.0, "axis": 1})
+    np.testing.assert_allclose(
+        pn, (np.abs(x) ** 3).sum(1) ** (1 / 3), rtol=1e-5
+    )
+    (nrm, nval) = run_op("norm", {"X": [x]}, {"axis": 1}, ("Out", "Norm"))
+    np.testing.assert_allclose(np.linalg.norm(nrm, axis=1), 1.0, rtol=1e-5)
+
+    (dst,) = run_op("dist", {"X": [x], "Y": [y]}, {"p": 2.0})
+    np.testing.assert_allclose(dst, np.linalg.norm((x - y).ravel()),
+                               rtol=1e-5)
+
+    p = 1 / (1 + np.exp(-x))
+    lab = (RNG.rand(4, 8) > 0.5).astype("f4")
+    (bce,) = run_op("bce_loss", {"X": [p], "Label": [lab]})
+    ref = -(lab * np.log(p) + (1 - lab) * np.log(1 - p))
+    np.testing.assert_allclose(bce, ref, rtol=1e-4)
+
+    logp = np.log(np.abs(RNG.rand(5, 7).astype("f4")) + 0.1)
+    labels = RNG.randint(0, 7, (5,)).astype("i8")
+    (nll, tw) = run_op(
+        "nll_loss", {"X": [logp], "Label": [labels]},
+        {"reduction": "mean"}, ("Out", "Total_weight"),
+    )
+    np.testing.assert_allclose(
+        nll, -logp[np.arange(5), labels].mean(), rtol=1e-5
+    )
+
+    scores = RNG.randn(4, 6).astype("f4")
+    blab = RNG.randint(0, 6, (4, 1)).astype("i8")
+    (bpr,) = run_op("bpr_loss", {"X": [scores], "Label": [blab]},
+                    outs=("Y",))
+    assert bpr.shape == (4, 1) and np.isfinite(bpr).all()
+
+
+def test_vision_family():
+    x = RNG.randn(2, 8, 3, 3).astype("f4")
+    (ps,) = run_op("pixel_shuffle", {"X": [x]}, {"upscale_factor": 2})
+    assert ps.shape == (2, 2, 6, 6)
+    # block (0,0) of channel 0 comes from channels 0..3 at pixel (0,0)
+    np.testing.assert_allclose(
+        ps[0, 0, :2, :2].ravel(), x[0, :4, 0, 0], rtol=1e-6
+    )
+
+    (mo,) = run_op("maxout", {"X": [RNG.randn(2, 6, 4, 4).astype("f4")]},
+                   {"groups": 3})
+    assert mo.shape == (2, 2, 4, 4)
+
+    xm = RNG.randn(1, 1, 4, 4).astype("f4")
+    (out, mask) = run_op(
+        "max_pool2d_with_index", {"X": [xm]},
+        {"ksize": [2, 2], "strides": [2, 2]}, ("Out", "Mask"),
+    )
+    np.testing.assert_allclose(out[0, 0, 0, 0], xm[0, 0, :2, :2].max())
+    flat_idx = int(mask[0, 0, 0, 0])
+    np.testing.assert_allclose(
+        xm[0, 0].ravel()[flat_idx], out[0, 0, 0, 0]
+    )
+
+    ac_x = RNG.randn(2, 3, 4, 4).astype("f4")
+    sc, bi = RNG.randn(3).astype("f4"), RNG.randn(3).astype("f4")
+    (ac,) = run_op("affine_channel", {"X": [ac_x], "Scale": [sc],
+                                      "Bias": [bi]})
+    np.testing.assert_allclose(
+        ac, ac_x * sc[None, :, None, None] + bi[None, :, None, None],
+        rtol=1e-5,
+    )
+
+    # identity grid reproduces the input (align_corners=True)
+    gx, gy = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4))
+    grid = np.stack([gx, gy], -1)[None].astype("f4")
+    gs_x = RNG.randn(1, 2, 4, 4).astype("f4")
+    (gs,) = run_op("grid_sampler", {"X": [gs_x], "Grid": [grid]},
+                   outs=("Output",))
+    np.testing.assert_allclose(gs, gs_x, rtol=1e-4, atol=1e-5)
+
+
+def test_gather_tree():
+    # two steps, one batch, beam 2: chain endpoints back to their roots
+    ids = np.array([[[1, 2]], [[3, 4]]], "i8")  # [T=2, B=1, K=2]
+    parents = np.array([[[0, 0]], [[1, 0]]], "i8")
+    (out,) = run_op("gather_tree", {"Ids": [ids], "Parents": [parents]})
+    # beam 0 at t=1 has parent 1 -> its t=0 token is ids[0,0,1]=2
+    np.testing.assert_array_equal(out, [[[2, 1]], [[3, 4]]])
+
+
+def test_label_smooth_and_lrn():
+    oh = np.eye(4, dtype="f4")[[0, 2]]
+    (ls,) = run_op("label_smooth", {"X": [oh]}, {"epsilon": 0.1})
+    np.testing.assert_allclose(ls, 0.9 * oh + 0.1 / 4, rtol=1e-5)
+
+    x = RNG.randn(1, 5, 3, 3).astype("f4")
+    (lr, mid) = run_op("lrn", {"X": [x]},
+                       {"n": 3, "alpha": 0.1, "beta": 0.5, "k": 1.0},
+                       ("Out", "MidOut"))
+    # channel 0: window = channels {0, 1}
+    ref_mid = 1.0 + 0.1 * (x[0, 0] ** 2 + x[0, 1] ** 2)
+    np.testing.assert_allclose(mid[0, 0], ref_mid, rtol=1e-5)
+    np.testing.assert_allclose(lr[0, 0], x[0, 0] / np.sqrt(ref_mid),
+                               rtol=1e-5)

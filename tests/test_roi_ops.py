@@ -212,3 +212,87 @@ def test_box_clip():
     )  # clipped to [0, w-1=199] x [0, h-1=99]
     assert got[0, 0, 2] <= 199.0 and got[0, 0, 3] <= 99.0
     np.testing.assert_allclose(got[0, 1], boxes[0, 1], atol=1e-6)
+
+
+def test_sigmoid_focal_loss_matches_numpy():
+    rng = np.random.RandomState(5)
+    N, C = 10, 4
+    x = rng.randn(N, C).astype("f4")
+    lab = rng.randint(0, C + 1, (N, 1)).astype("i4")  # 0 = background
+    fg = np.array([max((lab > 0).sum(), 1)], "i4")
+    gamma, alpha = 2.0, 0.25
+    t = (lab == np.arange(1, C + 1)[None, :]).astype("f4")
+    p = 1 / (1 + np.exp(-x))
+    ce = np.maximum(x, 0) - x * t + np.log1p(np.exp(-np.abs(x)))
+    p_t = p * t + (1 - p) * (1 - t)
+    a_t = alpha * t + (1 - alpha) * (1 - t)
+    ref = a_t * (1 - p_t) ** gamma * ce / fg[0]
+
+    xv = fluid.data("x", [N, C])
+    lv = fluid.data("l", [N, 1], "int32")
+    fv = fluid.data("f", [1], "int32")
+    out = layers.sigmoid_focal_loss(xv, lv, fv, gamma=gamma, alpha=alpha)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (got,) = exe.run(feed={"x": x, "l": lab, "f": fg}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-6)
+
+
+def test_density_prior_box_shapes_and_center_box():
+    H, W = 2, 2
+    feat = fluid.data("feat", [1, 4, H, W])
+    img = fluid.data("img", [1, 3, 32, 32])
+    boxes, vars_ = layers.density_prior_box(
+        feat, img, densities=[2], fixed_sizes=[8.0], fixed_ratios=[1.0],
+        steps=[16.0, 16.0],
+    )
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    b, v = exe.run(
+        feed={"feat": np.zeros((1, 4, H, W), np.float32),
+              "img": np.zeros((1, 3, 32, 32), np.float32)},
+        fetch_list=[boxes, vars_],
+    )
+    b = np.asarray(b)
+    assert b.shape == (H, W, 4, 4)  # density^2 = 4 priors per cell
+    # cell (0,0): center 8,8; step_average=16, shift=8 -> offsets +-4;
+    # first box center (4,4), half-size 4 (density_prior_box_op.h grid)
+    np.testing.assert_allclose(
+        b[0, 0, 0] * 32, [0, 0, 8, 8], atol=1e-4
+    )
+
+
+def test_generate_proposals_small_case():
+    """3 anchors on a 1x1 map, one image: NMS keeps the two non-overlapping
+    high scorers, padded to post_nms_top_n."""
+    anchors = np.array(
+        [[0, 0, 9, 9], [1, 1, 10, 10], [20, 20, 29, 29]], np.float32
+    ).reshape(3, 1, 1, 4).transpose(1, 0, 2, 3)  # -> [A=3,1,1,4] layout
+    anchors = anchors.reshape(3, 1, 1, 4)
+    var = np.full_like(anchors, 1.0)
+    scores = np.array([0.9, 0.8, 0.7], np.float32).reshape(1, 3, 1, 1)
+    deltas = np.zeros((1, 12, 1, 1), np.float32)
+    im_info = np.array([[40.0, 40.0, 1.0]], np.float32)
+
+    sv = fluid.data("s", [1, 3, 1, 1])
+    dv = fluid.data("d", [1, 12, 1, 1])
+    iv = fluid.data("i", [1, 3])
+    av = fluid.data("a", [3, 1, 1, 4])
+    vv = fluid.data("v", [3, 1, 1, 4])
+    rois, probs, num = layers.generate_proposals(
+        sv, dv, iv, av, vv, pre_nms_top_n=3, post_nms_top_n=4,
+        nms_thresh=0.5, min_size=0.0,
+    )
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    r, p, n = exe.run(
+        feed={"s": scores, "d": deltas, "i": im_info, "a": anchors,
+              "v": var},
+        fetch_list=[rois, probs, num],
+    )
+    r, p, n = np.asarray(r), np.asarray(p), np.asarray(n)
+    assert int(n[0]) == 2  # box 1 suppressed by box 0 (IoU ~0.65)
+    np.testing.assert_allclose(r[0, 0], [0, 0, 9, 9], atol=1e-4)
+    np.testing.assert_allclose(r[0, 1], [20, 20, 29, 29], atol=1e-4)
+    np.testing.assert_allclose(p[0, :2, 0], [0.9, 0.7], atol=1e-5)
+    assert (r[0, 2:] == 0).all()

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Op-surface checker (reference tools/check_op_desc.py +
+print_signatures.py role): compares this framework's registered op set
+against the reference operator library and reports coverage, grouped by
+the reference's operator directories.
+
+Usage:
+    python tools/check_op_surface.py [--reference /root/reference] [--missing]
+
+The reference registers ops in C++ via REGISTER_OPERATOR/REGISTER_OP_*
+macros; this scans those macro invocations. Ops our design subsumes by
+construction (device/memory/scaffolding ops that exist only because the
+reference interprets graphs op-by-op on CUDA) are listed in SUBSUMED with
+the mechanism that replaces them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# reference ops that have no emitter HERE by design — each entry names the
+# mechanism that delivers the capability instead
+SUBSUMED = {
+    # memory/scheduling scaffolding: whole-block XLA compilation
+    "memcpy": "XLA buffer assignment",
+    "fetch": "Executor fetch_list",
+    "feed": "Executor feed dict",
+    "share_data": "XLA aliasing/donation",
+    # reader ops: the DataLoader/Dataset host pipeline (reader.py)
+    "create_py_reader": "DataLoader.from_generator",
+    "read": "DataLoader iteration",
+    "create_double_buffer_reader": "dataloader device double-buffering",
+    # PS RPC graph ops: sharded in-HBM tables + ICI (ops/sparse.py)
+    "listen_and_serv": "fleet/parameter_server.py (sync over ICI)",
+    "send": "XLA collectives",
+    "recv": "XLA collectives",
+    "send_barrier": "jax.distributed barrier",
+    "fetch_barrier": "jax.distributed barrier",
+    "gen_nccl_id": "jax.distributed coordination service",
+    "c_gen_nccl_id": "jax.distributed coordination service",
+    "c_comm_init": "parallel/mesh.py Mesh construction",
+    "c_comm_init_all": "parallel/mesh.py Mesh construction",
+    "c_sync_calc_stream": "XLA stream scheduling",
+    "c_sync_comm_stream": "XLA stream scheduling",
+    "c_wait_comm": "XLA stream scheduling",
+    "c_wait_compute": "XLA stream scheduling",
+    # hand-fused CUDA kernels: XLA fuses the unfused graph (plus Pallas
+    # attention in kernels/flash_attention.py); fc = mul+elementwise_add
+    "fc": "XLA fusion of mul + elementwise_add",
+    "coalesce_tensor": "XLA buffer assignment",
+    # LoD machinery: sequences are padded [B,T,...] + lengths here
+    # (layers/sequence_lod.py); tensor arrays become lax.scan state
+    "lod_reset": "padded+lengths design",
+    "lod_rank_table": "padded+lengths design",
+    "lod_array_length": "lax.scan carries",
+    "lod_tensor_to_array": "lax.scan carries",
+    "array_to_lod_tensor": "lax.scan carries",
+    "merge_lod_tensor": "lax.cond/select on dense tensors",
+    "split_lod_tensor": "lax.cond/select on dense tensors",
+    "max_sequence_len": "padded+lengths design",
+    "im2sequence": "padded+lengths design",
+    # persistence ops: io.py save/load execute host-side
+    "load": "io.load_persistables",
+    "load_combine": "io.load_persistables",
+    "save": "io.save_persistables",
+    "save_combine": "io.save_persistables",
+    # cudnn/xpu-specific kernels with generic equivalents here
+    "cudnn_lstm": "ops/rnn.py lstm (lax.scan)",
+    # PS-RPC graph ops: the whole parameter-server RPC plane is replaced
+    # by sharded in-HBM tables + ICI collectives (fleet/parameter_server.py)
+    "broadcast": "c_broadcast (ops/collective.py)",
+    "checkpoint_notify": "fleet checkpoint rotation",
+    "fake_init": "sharded-table init (parallel/sparse.py)",
+    "fl_listen_and_serv": "PS plane subsumed (sync over ICI)",
+    "merge_ids": "PS plane subsumed",
+    "split_ids": "PS plane subsumed",
+    "split_byref": "PS plane subsumed",
+    "prefetch": "PS plane subsumed",
+    "recv_save": "PS plane subsumed",
+    "ref_by_trainer_id": "PS plane subsumed",
+    "dgc": "intentional degrade: bf16 grads over ICI (fleet strategy doc)",
+    "dgc_clip_by_norm": "intentional degrade (see dgc)",
+    "dgc_momentum": "intentional degrade (see dgc)",
+}
+
+# directory-wide subsumption: every op under these reference directories is
+# delivered by a different mechanism here
+SUBSUMED_DIRS = {
+    "sequence_ops": "layers/sequence_lod.py masked-dense compositions",
+    "fused": "XLA fusion + Pallas attention (kernels/)",
+    "reader": "DataLoader/Dataset host pipeline",
+    "tensorrt": "XLA is the inference compiler",
+    "lite": "XLA is the inference compiler",
+    "nccl": "ICI collectives via XLA",
+    "mkldnn": "XLA CPU backend",
+}
+
+
+def reference_ops(ref_root):
+    """op name -> first file registering it, from REGISTER_* macros."""
+    pat = re.compile(
+        r"REGISTER_(?:OPERATOR|OP_WITHOUT_GRADIENT|OP_CPU_KERNEL_FUNCTOR)"
+        r"\(\s*([a-z0-9_]+)"
+    )
+    ops = {}
+    base = os.path.join(ref_root, "paddle", "fluid", "operators")
+    for dirpath, _, files in os.walk(base):
+        for fn in files:
+            if not fn.endswith((".cc", ".cu")):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                text = open(path, errors="ignore").read()
+            except OSError:
+                continue
+            for m in pat.finditer(text):
+                ops.setdefault(m.group(1), os.path.relpath(path, base))
+    return ops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference", default="/root/reference")
+    ap.add_argument("--missing", action="store_true",
+                    help="list every uncovered op")
+    args = ap.parse_args()
+
+    import paddle_tpu  # noqa: F401  (registers all emitters)
+    from paddle_tpu.framework.registry import registered_ops
+
+    ours = set(registered_ops())
+    # grad ops are synthesized generically here; count fwd names only
+    ref = {
+        name: where
+        for name, where in reference_ops(args.reference).items()
+        if not name.endswith("_grad")
+    }
+
+    by_dir = {}
+    for name, where in ref.items():
+        d = os.path.dirname(where) or "."
+        row = by_dir.setdefault(d, {"total": 0, "covered": 0, "missing": []})
+        row["total"] += 1
+        if name in ours or name in SUBSUMED or d in SUBSUMED_DIRS:
+            row["covered"] += 1
+        else:
+            row["missing"].append(name)
+
+    total = sum(r["total"] for r in by_dir.values())
+    covered = sum(r["covered"] for r in by_dir.values())
+    print(f"reference fwd ops: {total}; covered (emitter or subsumed): "
+          f"{covered} ({covered / total:.0%}); our registry: {len(ours)} ops")
+    print(f"{'directory':32s} {'covered':>9s}")
+    for d in sorted(by_dir, key=lambda k: -by_dir[k]["total"]):
+        row = by_dir[d]
+        print(f"{d:32s} {row['covered']:4d}/{row['total']:<4d}")
+        if args.missing and row["missing"]:
+            for name in sorted(row["missing"]):
+                print(f"    - {name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
