@@ -515,3 +515,202 @@ def _gather_tree(ctx, op, ins):
     k0 = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None], (B, K))
     _, outs = lax.scan(step, k0, jnp.arange(T - 1, -1, -1))
     return {"Out": [outs[::-1]]}
+
+
+# ---------------------------------------------------------------------------
+# batch 2: 3-D conv, RNN cells, misc vision/sequence extras
+# ---------------------------------------------------------------------------
+
+
+@register_op("conv3d", inputs=["Input", "Filter"], outputs=["Output"])
+def _conv3d(ctx, op, ins):
+    """NCDHW conv (conv3d variant of conv_op.cc); computed in NDHWC
+    internally like the 2-D emitters."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+
+    def trip(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    strides = trip(op.attr("strides", [1, 1, 1]))
+    pads = [(p, p) for p in trip(op.attr("paddings", [0, 0, 0]))]
+    dil = trip(op.attr("dilations", [1, 1, 1]))
+    g = op.attr("groups", 1) or 1
+    out = lax.conv_general_dilated(
+        jnp.transpose(x, (0, 2, 3, 4, 1)),
+        jnp.transpose(w, (2, 3, 4, 1, 0)),
+        window_strides=strides,
+        padding=pads,
+        rhs_dilation=dil,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        feature_group_count=g,
+    )
+    return {"Output": [jnp.transpose(out, (0, 4, 1, 2, 3))]}
+
+
+@register_op(
+    "gru_unit",
+    inputs=["Input", "HiddenPrev", "Weight", "Bias"],
+    outputs=["Gate", "ResetHiddenPrev", "Hidden"],
+)
+def _gru_unit(ctx, op, ins):
+    """Single GRU step (gru_unit_op.cc): Input [B, 3D] = x projections,
+    Weight [D, 3D] (update/reset gates in the first 2D columns, candidate
+    in the last D), gate_activation sigmoid, activation tanh."""
+    x = ins["Input"][0]
+    hp = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    b = (
+        ins["Bias"][0]
+        if ins.get("Bias") and ins["Bias"][0] is not None
+        else jnp.zeros((x.shape[-1],), x.dtype)
+    )
+    d = hp.shape[-1]
+    g = x + b.reshape(1, -1)
+    uh = hp @ w[:, : 2 * d]
+    u = jax.nn.sigmoid(g[:, :d] + uh[:, :d])
+    r = jax.nn.sigmoid(g[:, d:2 * d] + uh[:, d:])
+    rh = r * hp
+    c = jnp.tanh(g[:, 2 * d:] + rh @ w[:, 2 * d:])
+    h = u * c + (1.0 - u) * hp
+    gate = jnp.concatenate([u, r, c], axis=-1)
+    return {"Gate": [gate], "ResetHiddenPrev": [rh], "Hidden": [h]}
+
+
+@register_op(
+    "lstm_unit", inputs=["X", "C_prev"], outputs=["C", "H"]
+)
+def _lstm_unit(ctx, op, ins):
+    """Single LSTM cell step (lstm_unit_op.cc): X [B, 4D] pre-activations
+    in i,f,c,o order; forget_bias added to f."""
+    x, c_prev = ins["X"][0], ins["C_prev"][0]
+    d = c_prev.shape[-1]
+    fb = float(op.attr("forget_bias", 0.0))
+    i = jax.nn.sigmoid(x[:, :d])
+    f = jax.nn.sigmoid(x[:, d:2 * d] + fb)
+    g = jnp.tanh(x[:, 2 * d:3 * d])
+    o = jax.nn.sigmoid(x[:, 3 * d:])
+    c = f * c_prev + i * g
+    return {"C": [c], "H": [o * jnp.tanh(c)]}
+
+
+@register_op("bilinear_tensor_product", inputs=["X", "Y", "Weight", "Bias"],
+             outputs=["Out"])
+def _bilinear_tensor_product(ctx, op, ins):
+    """out[b, k] = x[b] @ W[k] @ y[b] + bias (bilinear_tensor_product_op.cc)."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0].reshape(1, -1)
+    return {"Out": [out]}
+
+
+@register_op("pad_constant_like", inputs=["X", "Y"], outputs=["Out"])
+def _pad_constant_like(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    val = float(op.attr("pad_value", 0.0))
+    pads = [(0, xa - ya) for xa, ya in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads, constant_values=val)]}
+
+
+@register_op("mean_iou", inputs=["Predictions", "Labels"],
+             outputs=["OutMeanIou", "OutWrong", "OutCorrect"],
+             differentiable=False)
+def _mean_iou(ctx, op, ins):
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    lab = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    n = int(op.attr("num_classes"))
+    oh_p = jax.nn.one_hot(pred, n, dtype=jnp.float32)
+    oh_l = jax.nn.one_hot(lab, n, dtype=jnp.float32)
+    inter = jnp.sum(oh_p * oh_l, axis=0)
+    union = jnp.sum(oh_p, axis=0) + jnp.sum(oh_l, axis=0) - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    wrong = jnp.sum(oh_p, axis=0) - inter
+    return {
+        "OutMeanIou": [miou.astype(jnp.float32)],
+        "OutWrong": [wrong.astype(jnp.int32)],
+        "OutCorrect": [inter.astype(jnp.int32)],
+    }
+
+
+@register_op("temporal_shift", inputs=["X"], outputs=["Out"])
+def _temporal_shift(ctx, op, ins):
+    """TSM shift (temporal_shift_op.cc): x [N*T, C, H, W]; first C/4
+    channels shift t-1, next C/4 shift t+1, rest stay."""
+    x = ins["X"][0]
+    t = int(op.attr("seg_num"))
+    ratio = float(op.attr("shift_ratio", 0.25))
+    nt, c, h, w = x.shape
+    n = nt // t
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    xr = x.reshape(n, t, c, h, w)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xr[:, :1, :c1]), xr[:, :-1, :c1]], axis=1
+    )
+    bwd = jnp.concatenate(
+        [xr[:, 1:, c1:c2], jnp.zeros_like(xr[:, :1, c1:c2])], axis=1
+    )
+    out = jnp.concatenate([fwd, bwd, xr[:, :, c2:]], axis=2)
+    return {"Out": [out.reshape(nt, c, h, w)]}
+
+
+@register_op("space_to_depth", inputs=["X"], outputs=["Out"])
+def _space_to_depth(ctx, op, ins):
+    x = ins["X"][0]
+    bs = int(op.attr("blocksize"))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": [x.reshape(n, c * bs * bs, h // bs, w // bs)]}
+
+
+@register_op("shuffle_channel", inputs=["X"], outputs=["Out"])
+def _shuffle_channel(ctx, op, ins):
+    x = ins["X"][0]
+    g = int(op.attr("group", 1))
+    n, c, h, w = x.shape
+    return {
+        "Out": [
+            x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+            .reshape(n, c, h, w)
+        ]
+    }
+
+
+@register_op("add_position_encoding", inputs=["X"], outputs=["Out"])
+def _add_position_encoding(ctx, op, ins):
+    """Sinusoidal position encoding added in place
+    (add_position_encoding_op.cc): out = alpha*x + beta*pe."""
+    x = ins["X"][0]
+    alpha = float(op.attr("alpha", 1.0))
+    beta = float(op.attr("beta", 1.0))
+    b, t, d = x.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    pe = jnp.concatenate(
+        [jnp.sin(pos / div), jnp.cos(pos / div)], axis=-1
+    )
+    return {"Out": [alpha * x + beta * pe[None].astype(x.dtype)]}
+
+
+@register_op("squared_l2_norm", inputs=["X"], outputs=["Out"])
+def _squared_l2_norm(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(x * x)]}
+
+
+@register_op("cvm", inputs=["X", "CVM"], outputs=["Y"])
+def _cvm(ctx, op, ins):
+    """Click-value model feature adjust (cvm_op.cc): the first two columns
+    are show/click counts; use_cvm=True keeps log-adjusted counts,
+    False drops them."""
+    x = ins["X"][0]
+    use_cvm = bool(op.attr("use_cvm", True))
+    show = jnp.log(x[:, :1] + 1.0)
+    click = jnp.log(x[:, 1:2] + 1.0) - show
+    if use_cvm:
+        return {"Y": [jnp.concatenate([show, click, x[:, 2:]], axis=1)]}
+    return {"Y": [x[:, 2:]]}

@@ -217,3 +217,103 @@ def test_label_smooth_and_lrn():
     np.testing.assert_allclose(mid[0, 0], ref_mid, rtol=1e-5)
     np.testing.assert_allclose(lr[0, 0], x[0, 0] / np.sqrt(ref_mid),
                                rtol=1e-5)
+
+
+def test_batch2_rnn_cells_and_conv3d():
+    # gru_unit vs manual
+    B, D = 3, 5
+    x = RNG.randn(B, 3 * D).astype("f4")
+    hp = RNG.randn(B, D).astype("f4")
+    w = RNG.randn(D, 3 * D).astype("f4")
+    (gate, rh, h) = run_op(
+        "gru_unit", {"Input": [x], "HiddenPrev": [hp], "Weight": [w]},
+        outs=("Gate", "ResetHiddenPrev", "Hidden"),
+    )
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    uh = hp @ w[:, :2 * D]
+    u = sig(x[:, :D] + uh[:, :D])
+    r = sig(x[:, D:2 * D] + uh[:, D:])
+    c = np.tanh(x[:, 2 * D:] + (r * hp) @ w[:, 2 * D:])
+    np.testing.assert_allclose(h, u * c + (1 - u) * hp, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(rh, r * hp, rtol=1e-4, atol=1e-5)
+
+    # lstm_unit vs manual
+    x4 = RNG.randn(B, 4 * D).astype("f4")
+    cp = RNG.randn(B, D).astype("f4")
+    (c_out, h_out) = run_op(
+        "lstm_unit", {"X": [x4], "C_prev": [cp]}, {"forget_bias": 1.0},
+        ("C", "H"),
+    )
+    i, f = sig(x4[:, :D]), sig(x4[:, D:2 * D] + 1.0)
+    g, o = np.tanh(x4[:, 2 * D:3 * D]), sig(x4[:, 3 * D:])
+    cr = f * cp + i * g
+    np.testing.assert_allclose(c_out, cr, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h_out, o * np.tanh(cr), rtol=1e-4, atol=1e-5)
+
+    # conv3d: 1x1x1 kernel equals a channel mix
+    xv = RNG.randn(1, 2, 3, 4, 4).astype("f4")
+    wv = RNG.randn(3, 2, 1, 1, 1).astype("f4")
+    (out,) = run_op("conv3d", {"Input": [xv], "Filter": [wv]},
+                    outs=("Output",))
+    ref = np.einsum("ncdhw,kc->nkdhw", xv, wv[:, :, 0, 0, 0])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batch2_misc():
+    # bilinear tensor product
+    x = RNG.randn(2, 3).astype("f4")
+    y = RNG.randn(2, 4).astype("f4")
+    w = RNG.randn(5, 3, 4).astype("f4")
+    (out,) = run_op("bilinear_tensor_product",
+                    {"X": [x], "Y": [y], "Weight": [w]})
+    np.testing.assert_allclose(out, np.einsum("bi,kij,bj->bk", x, w, y),
+                               rtol=1e-4)
+
+    # pad_constant_like
+    big = np.zeros((3, 5), "f4")
+    small = RNG.randn(2, 3).astype("f4")
+    (p,) = run_op("pad_constant_like", {"X": [big], "Y": [small]},
+                  {"pad_value": 7.0})
+    assert p.shape == (3, 5) and p[2, 4] == 7.0
+    np.testing.assert_allclose(p[:2, :3], small)
+
+    # mean_iou: perfect prediction -> 1.0
+    lab = RNG.randint(0, 3, (10,)).astype("i4")
+    (miou, wrong, correct) = run_op(
+        "mean_iou", {"Predictions": [lab], "Labels": [lab]},
+        {"num_classes": 3}, ("OutMeanIou", "OutWrong", "OutCorrect"),
+    )
+    np.testing.assert_allclose(miou, 1.0)
+    assert (wrong == 0).all()
+
+    # space_to_depth / shuffle_channel round shapes
+    xs = RNG.randn(1, 2, 4, 4).astype("f4")
+    (sd,) = run_op("space_to_depth", {"X": [xs]}, {"blocksize": 2})
+    assert sd.shape == (1, 8, 2, 2)
+    (sc,) = run_op("shuffle_channel", {"X": [RNG.randn(1, 6, 2, 2)
+                                             .astype("f4")]}, {"group": 3})
+    assert sc.shape == (1, 6, 2, 2)
+
+    # temporal_shift: static channels unchanged
+    xt = RNG.randn(4, 8, 2, 2).astype("f4")  # N=2, T=2
+    (ts,) = run_op("temporal_shift", {"X": [xt]},
+                   {"seg_num": 2, "shift_ratio": 0.25})
+    np.testing.assert_allclose(ts[:, 4:], xt[:, 4:])  # last half static
+    # fwd-shifted channels: t=0 gets zeros
+    assert (ts.reshape(2, 2, 8, 2, 2)[:, 0, :2] == 0).all()
+
+    # add_position_encoding: beta=0 is identity
+    xa = RNG.randn(2, 5, 8).astype("f4")
+    (ap,) = run_op("add_position_encoding", {"X": [xa]},
+                   {"alpha": 1.0, "beta": 0.0})
+    np.testing.assert_allclose(ap, xa)
+
+    (sl2,) = run_op("squared_l2_norm", {"X": [x]})
+    np.testing.assert_allclose(sl2, (x ** 2).sum(), rtol=1e-5)
+
+    # cvm log-adjusts the first two columns
+    xc = np.abs(RNG.randn(3, 5)).astype("f4")
+    (cv,) = run_op("cvm", {"X": [xc], "CVM": [xc[:, :2]]},
+                   {"use_cvm": True}, ("Y",))
+    np.testing.assert_allclose(cv[:, 0], np.log(xc[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(cv[:, 2:], xc[:, 2:])
