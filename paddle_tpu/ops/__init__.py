@@ -13,16 +13,21 @@ from . import (  # noqa: F401
     collective,
     control_flow,
     crf,
+    ctr_ops,
     detection,
     fused,
+    loss_ext,
     math,
     math_ext,
     metrics,
     nn,
+    nn_ext,
     optimizer_ops,
+    quant_ops,
     random,
     rnn,
     sparse,
+    tensor_ext,
     tensor_ops,
 )
 

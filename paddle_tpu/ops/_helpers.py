@@ -77,3 +77,33 @@ def stable_sigmoid_ce(x, z):
     import jax
 
     return jnp.maximum(x, 0) - x * z + jax.nn.softplus(-jnp.abs(x))
+
+
+def op_key(ctx, op):
+    """Per-op RNG key: explicit seed attr wins (reference per-op seed
+    semantics), else the counter-based ctx stream. Single definition —
+    random.py / loss_ext.py / ctr_ops.py all share it."""
+    import jax
+
+    seed = op.attr("seed", 0)
+    if seed:
+        return jax.random.key(seed + op.uid)
+    return ctx.key_for(op.uid, op.type)
+
+
+def hash_mix(key_u32, num_hash):
+    """Deterministic multiply-xorshift integer mix: num_hash parallel
+    hashes of a uint32 key tensor -> [..., num_hash] uint32. Shared by the
+    hash and pyramid_hash emitters (the reference links xxhash; this mix
+    has the same contract — fixed, well-distributed, vectorizable)."""
+    import numpy as np
+
+    consts = jnp.asarray(
+        np.array([0x9E3779B1 + 2 * k + 1 for k in range(num_hash)],
+                 dtype=np.uint32)
+    )
+    h = key_u32[..., None] * consts
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x85EBCA77)
+    h = h ^ (h >> 13)
+    return h

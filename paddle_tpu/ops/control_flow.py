@@ -205,3 +205,160 @@ def _scan_block(ctx, op, ins):
         step, (jnp.zeros((), jnp.int32), mem0), seq_vals
     )
     return {"Out": list(stacked), "LastMem": list(last_mems)}
+
+
+# ---------------------------------------------------------------------------
+# tensor-array ops (controlflow/tensor_array_read_write_op.cc,
+# tensor_array_to_tensor_op.cc). A LoDTensorArray here is a dense stacked
+# tensor [capacity, ...] — writes are dynamic_update_slice at a runtime
+# index, reads dynamic_slice, both differentiable (scatter/gather vjps),
+# so arrays inside scan/while bodies stay on-device with static shapes.
+# ---------------------------------------------------------------------------
+
+
+@register_op("write_to_array", inputs=["X", "I", "Array"], outputs=["Out"])
+def _write_to_array(ctx, op, ins):
+    """Fixed-capacity contract: the array is [capacity, ...] (capacity
+    attr, default 32) — size the capacity to the loop's trip bound. An
+    out-of-range index is a host-checked error (the reference
+    LoDTensorArray grows dynamically; XLA shapes cannot)."""
+    x = ins["X"][0]
+    i = ins["I"][0].reshape(()).astype(jnp.int32)
+    arr = ins.get("Array", [None])
+    arr = arr[0] if arr else None
+    if arr is None or (hasattr(arr, "size") and arr.size == 0):
+        cap = int(op.attr("capacity", 32))
+        arr = jnp.zeros((cap,) + x.shape, x.dtype)
+    if not ctx.abstract:
+        cap = arr.shape[0]
+
+        def _check(idx):
+            if int(idx) >= cap or int(idx) < 0:
+                raise IndexError(
+                    f"write_to_array index {int(idx)} outside the fixed "
+                    f"capacity {cap}; raise the op's capacity attr to the "
+                    "loop's trip bound"
+                )
+
+        jax.debug.callback(_check, i)
+    return {"Out": [lax.dynamic_update_slice(
+        arr, x[None].astype(arr.dtype), (i,) + (0,) * x.ndim
+    )]}
+
+
+@register_op("read_from_array", inputs=["X", "I"], outputs=["Out"])
+def _read_from_array(ctx, op, ins):
+    arr = ins["X"][0]
+    i = ins["I"][0].reshape(()).astype(jnp.int32)
+    out = lax.dynamic_slice(
+        arr, (i,) + (0,) * (arr.ndim - 1), (1,) + arr.shape[1:]
+    )
+    return {"Out": [out[0]]}
+
+
+@register_op(
+    "tensor_array_to_tensor", inputs=["X"], outputs=["Out", "OutIndex"]
+)
+def _tensor_array_to_tensor(ctx, op, ins):
+    arr = ins["X"][0]  # [T, ...]
+    axis = op.attr("axis", 0)
+    if op.attr("use_stack", False):
+        out = jnp.moveaxis(arr, 0, axis) if axis else arr
+    else:
+        parts = [arr[t] for t in range(arr.shape[0])]
+        out = jnp.concatenate(parts, axis=axis)
+    if op.attr("use_stack", False):
+        sizes = 1
+    else:
+        sizes = arr.shape[1 + axis] if arr.ndim > 1 else 1
+    idx = jnp.full((arr.shape[0],), sizes, jnp.int32)
+    return {"Out": [out], "OutIndex": [idx]}
+
+
+# ---------------------------------------------------------------------------
+# conditional_block (controlflow/conditional_block_op.cc): run the
+# sub-block only when Cond holds. XLA form: both lax.cond branches are
+# compiled; the skip branch emits zeros of the matching shapes (shapes via
+# abstract eval of the true branch — no compute).
+# ---------------------------------------------------------------------------
+
+
+def _conditional_block_impl(ctx, op, ins):
+    blk = _sub_block(ctx, op)
+    # attrs when built by our Python layer; fall back to the op's own
+    # Input/Out var lists (the reference op desc carries only those, so a
+    # translated program has no *_names attrs)
+    in_names = op.attr("in_names", None) or op.inputs.get("Input", [])
+    out_names = op.attr("out_names", None) or op.outputs.get("Out", [])
+    vals = tuple(ins.get("Input", []))
+    cond = ins["Cond"][0]
+    if op.attr("is_scalar_condition", False):
+        pred = cond.reshape(()).astype(bool)
+    else:
+        pred = jnp.all(cond.astype(bool))
+
+    def true_f(operands):
+        env = dict(zip(in_names, operands))
+        _run_block(ctx, blk, env)
+        return tuple(env[n] for n in out_names)
+
+    shapes = jax.eval_shape(true_f, vals)
+
+    def false_f(operands):
+        return tuple(jnp.zeros(s.shape, s.dtype) for s in shapes)
+
+    outs = lax.cond(pred, true_f, false_f, vals)
+    return {"Out": list(outs)}
+
+
+@register_op(
+    "conditional_block", inputs=["Cond", "Input"], outputs=["Out"]
+)
+def _conditional_block(ctx, op, ins):
+    return _conditional_block_impl(ctx, op, ins)
+
+
+@register_op(
+    "conditional_block_infer", inputs=["Cond", "Input"], outputs=["Out"]
+)
+def _conditional_block_infer(ctx, op, ins):
+    # inference variant (no grad bookkeeping needed — same lowering)
+    return _conditional_block_impl(ctx, op, ins)
+
+
+# ---------------------------------------------------------------------------
+# select_input / select_output (controlflow/select_input_op.cc — the
+# case/switch-case plumbing) and get_places (operators/get_places_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("select_input", inputs=["X", "Mask"], outputs=["Out"])
+def _select_input(ctx, op, ins):
+    xs = ins["X"]
+    mask = ins["Mask"][0].reshape(()).astype(jnp.int32)
+    if len(xs) == 2:
+        out = lax.cond(mask == 0, lambda o: o[0], lambda o: o[1], tuple(xs))
+    else:
+        out = lax.switch(mask, [lambda o, k=k: o[k] for k in range(len(xs))],
+                         tuple(xs))
+    return {"Out": [out]}
+
+
+@register_op("select_output", inputs=["X", "Mask"], outputs=["Out"])
+def _select_output(ctx, op, ins):
+    x = ins["X"][0]
+    mask = ins["Mask"][0].reshape(()).astype(jnp.int32)
+    n = op.attr("num_branches", 2)
+    outs = [
+        jnp.where(mask == k, x, jnp.zeros_like(x)) for k in range(n)
+    ]
+    return {"Out": outs}
+
+
+@register_op("get_places", inputs=[], outputs=["Out"], differentiable=False)
+def _get_places(ctx, op, ins):
+    """get_places_op.cc: device enumeration for ParallelDo-era graphs.
+    Returns the local device ordinals (mesh construction is
+    parallel/mesh.py's job; this op exists for graph parity)."""
+    n = op.attr("device_count", 0) or jax.local_device_count()
+    return {"Out": [jnp.arange(n, dtype=jnp.int32)]}

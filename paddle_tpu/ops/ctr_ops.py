@@ -1,0 +1,189 @@
+"""CTR / tree-retrieval long-tail ops (reference operators/
+tdm_child_op.h, tdm_sampler_op.h, filter_by_instag_op.h,
+pyramid_hash_op.cc).
+
+Static-shape re-designs: filter_by_instag keeps the dense frame and
+returns a 0/1 LossWeight instead of resizing (the reference compacts rows
+via LoD); tdm_sampler draws its per-layer negatives with the counter-based
+ctx RNG.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op(
+    "tdm_child", inputs=["X", "TreeInfo"], outputs=["Child", "LeafMask"],
+    differentiable=False,
+)
+def _tdm_child(ctx, op, ins):
+    """TreeInfo rows: [item_id, layer_id, ancestor_id, child_0..child_{N-1}]
+    (tdm_child_op.h:63). Child ids of each input node; LeafMask marks
+    children that are leaves (their own item_id != 0)."""
+    x = ins["X"][0].astype(jnp.int32)
+    info = ins["TreeInfo"][0].astype(jnp.int32)
+    child_nums = op.attr("child_nums", 2)
+    flat = x.reshape(-1)
+    rows = info[flat]  # [N, 3 + C]
+    child = rows[:, 3:3 + child_nums]  # [N, C]
+    has_child = (flat != 0) & (rows[:, 3] != 0)
+    child = jnp.where(has_child[:, None], child, 0)
+    leaf = (info[child][:, :, 0] != 0) & (child != 0)
+    # reference output shape: [..., last_dim * child_nums]
+    # (tdm_child_op.cc InferShape)
+    if x.ndim > 1:
+        shape = x.shape[:-1] + (x.shape[-1] * child_nums,)
+    else:
+        shape = (x.shape[0], child_nums)
+    return {
+        "Child": [child.reshape(shape).astype(jnp.int64)],
+        "LeafMask": [leaf.reshape(shape).astype(jnp.int64)],
+    }
+
+
+@register_op(
+    "tdm_sampler",
+    inputs=["X", "Travel", "Layer"],
+    outputs=["Out", "Labels", "Mask"],
+    differentiable=False,
+)
+def _tdm_sampler(ctx, op, ins):
+    """tdm_sampler_op.h: per tree layer emit the travel-path positive plus
+    `neg_samples_num_list[i]` negatives drawn from that layer's node list
+    (rejection of the positive via resample-shift)."""
+    x = ins["X"][0].astype(jnp.int32).reshape(-1)  # [N]
+    travel = ins["Travel"][0].astype(jnp.int32)  # [item_num, L]
+    layer = ins["Layer"][0].astype(jnp.int32).reshape(-1)  # flat node list
+    neg_nums = op.attr("neg_samples_num_list", [1])
+    layer_offsets = op.attr("layer_offset_lod", None)
+    L = travel.shape[1]
+    N = x.shape[0]
+    from ._helpers import op_key
+
+    key = op_key(ctx, op)
+    outs, labels, masks = [], [], []
+    for i in range(L):
+        pos = travel[x, i]  # [N]
+        valid = pos != 0
+        k = int(neg_nums[i]) if i < len(neg_nums) else 1
+        if layer_offsets is not None:
+            lo, hi = int(layer_offsets[i]), int(layer_offsets[i + 1])
+        else:
+            lo, hi = 0, layer.shape[0]
+        key, sub = jax.random.split(key)
+        idx = jax.random.randint(sub, (N, k), lo, max(hi, lo + 1))
+        neg = layer[idx]  # [N, k]
+        # avoid sampling the positive: shift colliding draws by one slot
+        collide = neg == pos[:, None]
+        alt = layer[jnp.where(idx + 1 < hi, idx + 1, lo)]
+        neg = jnp.where(collide, alt, neg)
+        grp = jnp.concatenate([pos[:, None], neg], axis=1)  # [N, 1+k]
+        lab = jnp.concatenate(
+            [jnp.ones((N, 1), jnp.int32), jnp.zeros((N, k), jnp.int32)],
+            axis=1,
+        )
+        m = jnp.broadcast_to(valid[:, None], grp.shape)
+        outs.append(jnp.where(m, grp, 0))
+        labels.append(jnp.where(m, lab, 0))
+        masks.append(m.astype(jnp.int32))
+    out = jnp.concatenate(outs, axis=1)
+    return {
+        "Out": [out.astype(jnp.int64).reshape(N, -1, 1)],
+        "Labels": [
+            jnp.concatenate(labels, axis=1).astype(jnp.int64).reshape(N, -1, 1)
+        ],
+        "Mask": [
+            jnp.concatenate(masks, axis=1).astype(jnp.int64).reshape(N, -1, 1)
+        ],
+    }
+
+
+@register_op(
+    "filter_by_instag",
+    inputs=["Ins", "Ins_tag", "Filter_tag"],
+    outputs=["Out", "LossWeight", "IndexMap"],
+)
+def _filter_by_instag(ctx, op, ins):
+    """filter_by_instag_op.h compacts matching rows via LoD resize; the
+    static-shape contract keeps every row and zeroes the non-matching ones,
+    with LossWeight carrying the 0/1 keep mask (downstream losses multiply
+    by LossWeight, so training math is identical)."""
+    rows = ins["Ins"][0]  # [N, D]
+    tags = ins["Ins_tag"][0].astype(jnp.int64)  # [N, T] (-1 padded)
+    filt = ins["Filter_tag"][0].astype(jnp.int64).reshape(-1)  # [F]
+    match = (tags[:, :, None] == filt[None, None, :]) & (
+        tags[:, :, None] >= 0
+    )
+    keep = match.any(axis=(1, 2))  # [N]
+    out = jnp.where(keep[:, None], rows, 0)
+    n = rows.shape[0]
+    index_map = jnp.stack(
+        [jnp.arange(n, dtype=jnp.int64)] * 2
+        + [keep.astype(jnp.int64)], axis=1
+    )
+    return {
+        "Out": [out],
+        "LossWeight": [keep.astype(rows.dtype).reshape(n, 1)],
+        "IndexMap": [index_map],
+    }
+
+
+@register_op(
+    "pyramid_hash",
+    inputs=["X", "W", "WhiteList", "BlackList"],
+    outputs=["Out", "DropPos", "X_Temp_Out"],
+)
+def _pyramid_hash(ctx, op, ins):
+    """pyramid_hash_op.cc (text n-gram hash embedding): every n-gram
+    (n = 2..max_pyramid_layer) hashes into `num_hash` rows of the
+    embedding blob W [space_len, emb_dim/num_hash ...]; the token's
+    embedding is the mean over n-grams. Dense re-derivation with the same
+    multiply-xorshift mix as our hash op (the reference uses xxhash);
+    white/black lists are host-side vocabulary filters, not modeled."""
+    x = ins["X"][0].astype(jnp.uint32)  # [B, T] token ids (padded 0)
+    w = ins["W"][0]
+    num_hash = op.attr("num_hash", 1)
+    space_len = w.shape[0]
+    emb = op.attr("num_emb", w.shape[-1])
+    max_layer = op.attr("max_pyramid_layer", 2)
+    if x.ndim == 1:
+        x = x[None, :]
+    B, T = x.shape
+    from ._helpers import hash_mix
+
+    total = None
+    cnt = 0
+    for n in range(2, max_layer + 1):
+        if n > T:
+            break
+        # combine n consecutive ids into one key (order-sensitive mix)
+        key = x[:, : T - n + 1].astype(jnp.uint32)
+        for j in range(1, n):
+            key = key * jnp.uint32(1000003) + x[:, j: T - n + 1 + j]
+        h = hash_mix(key, num_hash)
+        idx = (h % jnp.uint32(space_len)).astype(jnp.int32)  # [B, L, K]
+        g = w[idx]  # [B, L, K, emb]
+        g = g.mean(axis=2)  # combine hash slots
+        # scatter n-gram embedding onto its first token position
+        pad = jnp.zeros((B, T - g.shape[1], g.shape[-1]), g.dtype)
+        total = (
+            jnp.concatenate([g, pad], axis=1)
+            if total is None
+            else total + jnp.concatenate([g, pad], axis=1)
+        )
+        cnt += 1
+    if total is None:
+        total = jnp.zeros((B, T, emb), w.dtype)
+        cnt = 1
+    out = total / cnt
+    return {
+        "Out": [out],
+        "DropPos": [jnp.ones((B, T, 1), jnp.int32)],
+        "X_Temp_Out": [x.astype(jnp.int64)],
+    }

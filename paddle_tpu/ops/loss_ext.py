@@ -1,0 +1,799 @@
+"""Sampled/structured losses and sequence-eval metrics.
+
+Reference files (paddle/fluid/operators/): nce_op.cc,
+hierarchical_sigmoid_op.cc, sample_logits_op.cc,
+teacher_student_sigmoid_loss_op.h, center_loss_op.cc, warpctc_op.cc,
+ctc_align_op.cc, edit_distance_op.cc, chunk_eval_op.cc,
+cross_entropy_op.cc (cross_entropy2), metrics/precision_recall_op.cc,
+positive_negative_pair_op.cc, detection/detection_map_op.cc.
+
+TPU-native formulations: class sampling uses the counter-based ctx RNG;
+CTC is a log-space alpha recursion under lax.scan (replacing the warpctc
+CUDA library); edit distance is a static Levenshtein DP scanned row-wise;
+dynamic-size outputs (ctc_align) left-pack into the input-length frame
+with -1 padding.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+from ._helpers import op_key as _key
+from ._helpers import stable_sigmoid_ce
+
+
+def _log_uniform_probs(n):
+    # P(c) = (log(c+2) - log(c+1)) / log(n+1)  (sample_logits_op.cc sampler)
+    c = np.arange(n, dtype=np.float64)
+    return jnp.asarray(
+        (np.log(c + 2) - np.log(c + 1)) / np.log(n + 1.0), jnp.float32
+    )
+
+
+# ---------------------------------------------------------------------------
+# NCE (nce_op.cc): binary logistic loss on true vs k sampled noise classes
+# with the noise-corrected logit  s(x,c) - log(k * P_noise(c)).
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "nce",
+    inputs=["Input", "Label", "Weight", "Bias", "SampleWeight"],
+    outputs=["Cost", "SampleLogits", "SampleLabels"],
+)
+def _nce(ctx, op, ins):
+    x = ins["Input"][0]  # [B, D]
+    label = ins["Label"][0].astype(jnp.int32)  # [B, num_true]
+    w = ins["Weight"][0]  # [C, D]
+    bias = ins.get("Bias", [None])[0]
+    num_classes = op.attr("num_total_classes", w.shape[0])
+    k = op.attr("num_neg_samples", 10)
+    sampler = op.attr("sampler", 0)  # 0 uniform, 1 log_uniform
+    B = x.shape[0]
+    num_true = label.shape[1] if label.ndim > 1 else 1
+    label = label.reshape(B, num_true)
+
+    if sampler == 1:
+        probs = _log_uniform_probs(num_classes)
+        neg = jax.random.categorical(
+            _key(ctx, op), jnp.log(probs)[None, :], shape=(B, k)
+        )
+    else:
+        probs = jnp.full((num_classes,), 1.0 / num_classes)
+        neg = jax.random.randint(_key(ctx, op), (B, k), 0, num_classes)
+
+    classes = jnp.concatenate([label, neg], axis=1)  # [B, num_true + k]
+    logits = jnp.einsum("bd,bcd->bc", x, w[classes])
+    if bias is not None:
+        logits = logits + bias[classes]
+    corrected = logits - jnp.log(k * probs[classes] + 1e-20)
+    labels01 = jnp.concatenate(
+        [jnp.ones((B, num_true)), jnp.zeros((B, k))], axis=1
+    )
+    ce = stable_sigmoid_ce(corrected, labels01)
+    cost = ce[:, :num_true].mean(axis=1, keepdims=True) + ce[:, num_true:].sum(
+        axis=1, keepdims=True
+    ) / num_true
+    return {
+        "Cost": [cost],
+        "SampleLogits": [logits],
+        "SampleLabels": [classes],
+    }
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid (hierarchical_sigmoid_op.cc default tree: the
+# word2vec complete-binary-heap code — node for class c at depth i is
+# (c + num_classes) >> (i+1), code bit ((c + num_classes) >> i) & 1).
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "hierarchical_sigmoid",
+    inputs=["X", "Label", "W", "Bias", "PathTable", "PathCode"],
+    outputs=["Out", "PreOut"],
+)
+def _hierarchical_sigmoid(ctx, op, ins):
+    x = ins["X"][0]  # [B, D]
+    label = ins["Label"][0].astype(jnp.int32).reshape(-1)  # [B]
+    w = ins["W"][0]  # [num_classes-1, D]
+    bias = ins.get("Bias", [None])[0]
+    path_table = ins.get("PathTable", [None])[0]
+    path_code = ins.get("PathCode", [None])[0]
+    num_classes = op.attr("num_classes")
+
+    if path_table is not None and path_code is not None:
+        nodes = path_table.astype(jnp.int32)  # [B, L], -1 padded
+        codes = path_code.astype(jnp.float32)
+        valid = (nodes >= 0).astype(x.dtype)
+        nodes = jnp.maximum(nodes, 0)
+    else:
+        depth = max(int(math.ceil(math.log2(num_classes))), 1)
+        heap = label + num_classes  # 1-based heap position of the leaf
+        levels = jnp.arange(1, depth + 1)
+        anc = heap[:, None] >> levels[None, :]  # ancestors bottom-up
+        codes = ((heap[:, None] >> (levels - 1)[None, :]) & 1).astype(
+            jnp.float32
+        )
+        valid = (anc >= 1).astype(x.dtype)
+        nodes = jnp.maximum(anc - 1, 0)  # heap pos -> row in W
+
+    pre = jnp.einsum("bd,bld->bl", x, w[nodes])
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[nodes]
+    # binary CE per node: code bit is the target
+    ce = stable_sigmoid_ce(pre, codes)
+    out = jnp.sum(ce * valid, axis=1, keepdims=True)
+    return {"Out": [out], "PreOut": [pre]}
+
+
+# ---------------------------------------------------------------------------
+# sampled softmax helper (sample_logits_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "sample_logits",
+    inputs=["Logits", "Labels"],
+    outputs=["Samples", "Probabilities", "SampledLogits", "SampledLabel"],
+)
+def _sample_logits(ctx, op, ins):
+    logits = ins["Logits"][0]  # [B, C]
+    labels = ins["Labels"][0].astype(jnp.int32)  # [B, num_true]
+    B, C = logits.shape
+    num_true = labels.shape[1]
+    S = op.attr("num_samples", 10)
+    probs = _log_uniform_probs(C)
+    if op.attr("uniq", True):
+        # one shared sample set per batch (the reference samples per-row but
+        # dedups; a shared set is the standard TPU-friendly variant)
+        neg = jax.random.categorical(
+            _key(ctx, op), jnp.log(probs)[None, :], shape=(1, S)
+        )
+        neg = jnp.broadcast_to(neg, (B, S))
+    else:
+        neg = jax.random.categorical(
+            _key(ctx, op), jnp.log(probs)[None, :], shape=(B, S)
+        )
+    samples = jnp.concatenate([labels, neg], axis=1)  # [B, num_true + S]
+    q = probs[samples]
+    sampled = jnp.take_along_axis(logits, samples, axis=1)
+    if op.attr("remove_accidental_hits", True):
+        hit = (neg[:, None, :] == labels[:, :, None]).any(axis=1)  # [B, S]
+        pad = jnp.zeros((B, num_true), bool)
+        sampled = sampled - jnp.concatenate([pad, hit], axis=1) * 1e20
+    sampled = sampled - jnp.log(q + 1e-20)
+    new_label = jnp.broadcast_to(
+        jnp.arange(num_true, dtype=jnp.int32)[None, :], (B, num_true)
+    )
+    return {
+        "Samples": [samples],
+        "Probabilities": [q],
+        "SampledLogits": [sampled],
+        "SampledLabel": [new_label],
+    }
+
+
+@register_op(
+    "teacher_student_sigmoid_loss", inputs=["X", "Label"], outputs=["Y"]
+)
+def _teacher_student_sigmoid_loss(ctx, op, ins):
+    """Exact piecewise form of teacher_student_sigmoid_loss_op.h:57-94:
+    label<-1: CE(x,0); -1<=label<0: CE(x,1); 0<=label<1: CE(x,0)+CE(x,label);
+    label>=1: CE(x,1)+CE(x,label-1), with CE the stable sigmoid CE."""
+    x = ins["X"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+
+    def ce(z):
+        return stable_sigmoid_ce(x, z)
+
+    y = jnp.where(
+        label < -1.0,
+        ce(0.0),
+        jnp.where(
+            label < 0.0,
+            ce(1.0),
+            jnp.where(
+                label < 1.0,
+                ce(0.0) + ce(label),
+                ce(1.0) + ce(label - 1.0),
+            ),
+        ),
+    )
+    return {"Y": [y.reshape(-1, 1)]}
+
+
+@register_op(
+    "center_loss",
+    inputs=["X", "Label", "Centers", "CenterUpdateRate"],
+    outputs=["Loss", "SampleCenterDiff", "CentersOut"],
+    mutates=(("CentersOut", "Centers"),),
+)
+def _center_loss(ctx, op, ins):
+    x = ins["X"][0]  # [B, D]
+    label = ins["Label"][0].astype(jnp.int32).reshape(-1)
+    centers = ins["Centers"][0]  # [C, D]
+    alpha = ins["CenterUpdateRate"][0].reshape(())
+    diff = x - centers[label]
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if op.attr("need_update", True):
+        # center update: c_j += alpha * sum_{i: y_i=j} diff_i / (1 + n_j)
+        upd = jnp.zeros_like(centers).at[label].add(diff)
+        cnt = jnp.zeros((centers.shape[0],)).at[label].add(1.0)
+        centers_out = centers + alpha * upd / (1.0 + cnt)[:, None]
+    else:
+        centers_out = centers
+    return {
+        "Loss": [loss],
+        "SampleCenterDiff": [diff],
+        "CentersOut": [centers_out],
+    }
+
+
+# ---------------------------------------------------------------------------
+# CTC family. warpctc_op.cc wraps the warp-ctc CUDA library; here the
+# standard log-space alpha recursion runs under lax.scan over time with the
+# padded-label frame [B, L] — fully differentiable through the scan, so the
+# gradient the reference gets from warpctc's backward comes from the
+# generic vjp.
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "warpctc",
+    inputs=["Logits", "Label", "LogitsLength", "LabelLength"],
+    outputs=["Loss", "WarpCTCGrad"],
+)
+def _warpctc(ctx, op, ins):
+    logits = ins["Logits"][0]  # [B, T, C] padded dense (TPU contract)
+    label = ins["Label"][0].astype(jnp.int32)  # [B, L]
+    B, T, C = logits.shape
+    L = label.shape[1]
+    logit_len = ins.get("LogitsLength", [None])[0]
+    label_len = ins.get("LabelLength", [None])[0]
+    logit_len = (
+        jnp.full((B,), T, jnp.int32)
+        if logit_len is None
+        else logit_len.reshape(-1).astype(jnp.int32)
+    )
+    label_len = (
+        jnp.full((B,), L, jnp.int32)
+        if label_len is None
+        else label_len.reshape(-1).astype(jnp.int32)
+    )
+    blank = op.attr("blank", 0)
+    norm_by_times = op.attr("norm_by_times", False)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    # extended label sequence: blank l1 blank l2 ... lL blank (S = 2L+1)
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    # transition allowed from s-2: ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    skip_ok = (ext != blank) & (ext != ext_m2)
+
+    neg_inf = -1e30
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_len > 0, logp[jnp.arange(B), 0, ext[:, 1]], neg_inf)
+    )
+
+    def lse(a, b):
+        m = jnp.maximum(a, b)
+        return m + jnp.log(
+            jnp.exp(a - m) + jnp.exp(b - m)
+        )
+
+    def step(alpha, t):
+        a_shift1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=neg_inf)[
+            :, :S
+        ]
+        a_shift2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=neg_inf)[
+            :, :S
+        ]
+        a = lse(alpha, a_shift1)
+        a = jnp.where(skip_ok, lse(a, a_shift2), a)
+        emit = jnp.take_along_axis(logp[:, t], ext, axis=1)
+        new = a + emit
+        # frozen past the per-sample logit length
+        new = jnp.where((t < logit_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    last = 2 * label_len  # index of final blank
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1
+    )[:, 0]
+    ll = lse(a_last, jnp.where(label_len > 0, a_prev, neg_inf))
+    loss = -ll
+    if norm_by_times:
+        loss = loss / logit_len.astype(loss.dtype)
+    return {"Loss": [loss.reshape(B, 1)], "WarpCTCGrad": []}
+
+
+@register_op(
+    "ctc_align", inputs=["Input", "InputLength"], outputs=["Output", "OutputLength"],
+    differentiable=False,
+)
+def _ctc_align(ctx, op, ins):
+    """ctc_align_op.cc: merge repeats then drop blanks. Static-shape form:
+    left-packed into the input frame, -1 padded, plus lengths."""
+    x = ins["Input"][0].astype(jnp.int32)  # [B, T]
+    blank = op.attr("blank", 0)
+    merge = op.attr("merge_repeated", True)
+    B, T = x.shape
+    prev = jnp.pad(x, ((0, 0), (1, 0)), constant_values=-1)[:, :T]
+    keep = x != blank
+    if merge:
+        keep = keep & (x != prev)
+    pos = jnp.cumsum(keep, axis=1) - 1  # target slot per kept element
+    out = jnp.full((B, T), -1, jnp.int32)
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    out = out.at[b_idx, jnp.where(keep, pos, T - 1)].set(
+        jnp.where(keep, x, -1), mode="drop"
+    )
+    # rows where nothing was kept must stay -1; scatter of -1 handles it
+    out_len = keep.sum(axis=1).astype(jnp.int64)
+    return {"Output": [out], "OutputLength": [out_len.reshape(B, 1)]}
+
+
+@register_op(
+    "edit_distance",
+    inputs=["Hyps", "Refs", "HypsLength", "RefsLength"],
+    outputs=["Out", "SequenceNum"],
+    differentiable=False,
+)
+def _edit_distance(ctx, op, ins):
+    """edit_distance_op.cc: Levenshtein DP. The [L1+1, L2+1] table rolls
+    over a lax.scan across hypothesis positions; per-sample lengths mask
+    the padded tail."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)  # [B, L1]
+    ref = ins["Refs"][0].astype(jnp.int32)  # [B, L2]
+    B, L1 = hyp.shape
+    L2 = ref.shape[1]
+    hyp_len = ins.get("HypsLength", [None])[0]
+    ref_len = ins.get("RefsLength", [None])[0]
+    hyp_len = (
+        jnp.full((B,), L1, jnp.int32)
+        if hyp_len is None
+        else hyp_len.reshape(-1).astype(jnp.int32)
+    )
+    ref_len = (
+        jnp.full((B,), L2, jnp.int32)
+        if ref_len is None
+        else ref_len.reshape(-1).astype(jnp.int32)
+    )
+
+    js = jnp.arange(L2 + 1, dtype=jnp.float32)
+    row0 = jnp.broadcast_to(js, (B, L2 + 1))  # dp[0, j] = j
+
+    def step(row, i):
+        # row = dp[i-1, :]; compute dp[i, :]
+        sub_cost = (hyp[:, i - 1, None] != ref).astype(jnp.float32)
+        diag = row[:, :-1] + sub_cost  # substitution
+        up = row[:, 1:] + 1.0  # deletion from hyp
+
+        def inner(carry, j):
+            left = carry  # dp[i, j-1]
+            val = jnp.minimum(jnp.minimum(diag[:, j], up[:, j]), left + 1.0)
+            return val, val
+
+        first = jnp.full((B,), i, jnp.float32)  # dp[i, 0] = i
+        _, rest = lax.scan(inner, first, jnp.arange(L2))
+        new_row = jnp.concatenate(
+            [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
+        )
+        # freeze rows past each sample's hyp length
+        return jnp.where((i <= hyp_len)[:, None], new_row, row), None
+
+    row, _ = lax.scan(step, row0, jnp.arange(1, L1 + 1))
+    dist = jnp.take_along_axis(row, ref_len[:, None], axis=1)[:, 0]
+    # empty-ref convention (edit_distance_op.h): distance = hyp_len
+    dist = jnp.where(ref_len == 0, hyp_len.astype(dist.dtype), dist)
+    if op.attr("normalized", True):
+        dist = dist / jnp.maximum(ref_len.astype(dist.dtype), 1.0)
+    return {
+        "Out": [dist.reshape(B, 1)],
+        "SequenceNum": [jnp.asarray(B, jnp.int64)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval (chunk_eval_op.cc): chunk-level precision/recall/F1 for
+# sequence labeling. Supports the IOB/IOE/IOBES/plain schemes via the same
+# (type, tag) encoding the reference uses: tag = label % num_tag_types,
+# type = label / num_tag_types.
+# ---------------------------------------------------------------------------
+
+
+def _chunk_bounds(labels, lengths, scheme, num_types):
+    """Returns (is_begin, is_end, chunk_type, inside) maps [B, T]. Labels
+    >= num_types * num_tag_types are "outside" (the O tag in IOB — the
+    reference's other_chunk_type, chunk_eval_op.h GetSegments skips them);
+    outside positions belong to no chunk."""
+    B, T = labels.shape
+    tag_types = {"iob": 2, "ioe": 2, "iobes": 4, "plain": 1}[scheme]
+    tag = labels % tag_types
+    typ = labels // tag_types
+    valid = jnp.arange(T)[None, :] < lengths[:, None]
+    inside = valid & (labels < num_types * tag_types) & (labels >= 0)
+    # outside/invalid positions get type -1 so any neighbor comparison
+    # against them reads as a type change (chunk boundary)
+    typ = jnp.where(inside, typ, -1)
+    prev_tag = jnp.pad(tag, ((0, 0), (1, 0)), constant_values=-1)[:, :T]
+    prev_typ = jnp.pad(typ, ((0, 0), (1, 0)), constant_values=-1)[:, :T]
+    next_tag = jnp.pad(tag, ((0, 0), (0, 1)), constant_values=-1)[:, 1:]
+    next_typ = jnp.pad(typ, ((0, 0), (0, 1)), constant_values=-1)[:, 1:]
+    if scheme == "iob":  # tag 0 = B, 1 = I
+        begin = (tag == 0) | ((tag == 1) & (prev_typ != typ))
+        end = (next_typ != typ) | (next_tag == 0)
+    elif scheme == "ioe":  # tag 0 = I, 1 = E
+        end = (tag == 1) | (next_typ != typ)
+        begin = (prev_typ != typ) | (prev_tag == 1)
+    elif scheme == "iobes":  # 0=B 1=I 2=E 3=S
+        begin = (tag == 0) | (tag == 3)
+        end = (tag == 2) | (tag == 3)
+    else:  # plain: every maximal same-type run is a chunk
+        begin = prev_typ != typ
+        end = next_typ != typ
+    return begin & inside, end & inside, typ, inside
+
+
+@register_op(
+    "chunk_eval",
+    inputs=["Inference", "Label", "SeqLength"],
+    outputs=[
+        "Precision", "Recall", "F1-Score",
+        "NumInferChunks", "NumLabelChunks", "NumCorrectChunks",
+    ],
+    differentiable=False,
+)
+def _chunk_eval(ctx, op, ins):
+    inf = ins["Inference"][0].astype(jnp.int32)
+    lab = ins["Label"][0].astype(jnp.int32)
+    if inf.ndim > 2:
+        inf = inf.reshape(inf.shape[0], -1)
+        lab = lab.reshape(lab.shape[0], -1)
+    B, T = inf.shape
+    lens = ins.get("SeqLength", [None])[0]
+    lens = (
+        jnp.full((B,), T, jnp.int32)
+        if lens is None
+        else lens.reshape(-1).astype(jnp.int32)
+    )
+    scheme = op.attr("chunk_scheme", "IOB").lower()
+    num_types = op.attr("num_chunk_types", 1)
+    excluded = op.attr("excluded_chunk_types", []) or []
+
+    ib, ie, ityp, _ = _chunk_bounds(inf, lens, scheme, num_types)
+    lb, le, ltyp, _ = _chunk_bounds(lab, lens, scheme, num_types)
+
+    def count(beg, end, typ):
+        ok = beg
+        for ex in excluded:
+            ok = ok & (typ != ex)
+        return ok.sum()
+
+    # a chunk matches when begin, end and type all coincide; compare via
+    # begin-aligned segment ids: same begin position + same end position.
+    # end position of the chunk starting at t: the first end >= t. Compute
+    # via segment scan: chunk id = cumsum(begin); chunks match if for the
+    # same begin position both sequences end at the same place with same
+    # type.
+    def chunk_sig(beg, end, typ):
+        # for every position where beg: find its end index
+        idx = jnp.arange(T)[None, :]
+        # next end at or after t: min over j>=t of j where end[j]
+        endpos = jnp.where(end, idx, T + 1)
+        # suffix min
+        endpos = lax.cummin(endpos[:, ::-1], axis=1)[:, ::-1]
+        return jnp.where(beg, endpos, -1), jnp.where(beg, typ, -1)
+
+    iend, ityp_s = chunk_sig(ib, ie, ityp)
+    lend, ltyp_s = chunk_sig(lb, le, ltyp)
+    correct = (
+        (iend >= 0) & (iend == lend) & (ityp_s == ltyp_s)
+    )
+    for ex in excluded:
+        correct = correct & (ityp_s != ex)
+    n_inf = count(ib, ie, ityp).astype(jnp.int64)
+    n_lab = count(lb, le, ltyp).astype(jnp.int64)
+    n_cor = correct.sum().astype(jnp.int64)
+    p = jnp.where(n_inf > 0, n_cor / jnp.maximum(n_inf, 1), 0.0).astype(
+        jnp.float32
+    )
+    r = jnp.where(n_lab > 0, n_cor / jnp.maximum(n_lab, 1), 0.0).astype(
+        jnp.float32
+    )
+    f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+    return {
+        "Precision": [p],
+        "Recall": [r],
+        "F1-Score": [f1],
+        "NumInferChunks": [n_inf],
+        "NumLabelChunks": [n_lab],
+        "NumCorrectChunks": [n_cor],
+    }
+
+
+@register_op("cross_entropy2", inputs=["X", "Label"], outputs=["Y", "XShape", "MatchX"])
+def _cross_entropy2(ctx, op, ins):
+    """cross_entropy_op.cc v2 kernel: hard-label -log(x[label]) without
+    soft-label support; MatchX saves the matched prob for the grad."""
+    x = ins["X"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    ignore_index = op.attr("ignore_index", -100)
+    lab = label.reshape(*x.shape[:-1])
+    match = jnp.take_along_axis(
+        x, jnp.maximum(lab, 0)[..., None], axis=-1
+    )[..., 0]
+    y = -jnp.log(jnp.maximum(match, 1e-20))
+    y = jnp.where(lab == ignore_index, 0.0, y)
+    return {"Y": [y[..., None]], "XShape": [], "MatchX": [match[..., None]]}
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "precision_recall",
+    inputs=["MaxProbs", "Indices", "Labels", "Weights", "StatesInfo"],
+    outputs=["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
+    differentiable=False,
+)
+def _precision_recall(ctx, op, ins):
+    """metrics/precision_recall_op.cc: per-class TP/FP/TN/FN accumulation +
+    macro/micro-averaged P/R/F1 (6 metrics)."""
+    idx = ins["Indices"][0].astype(jnp.int32).reshape(-1)  # predicted class
+    labels = ins["Labels"][0].astype(jnp.int32).reshape(-1)
+    weights = ins.get("Weights", [None])[0]
+    states = ins.get("StatesInfo", [None])[0]
+    C = op.attr("class_number")
+    w = (
+        jnp.ones_like(idx, jnp.float32)
+        if weights is None
+        else weights.reshape(-1).astype(jnp.float32)
+    )
+    correct = idx == labels
+    tp = jnp.zeros((C,)).at[labels].add(w * correct)
+    fn = jnp.zeros((C,)).at[labels].add(w * (~correct))
+    fp = jnp.zeros((C,)).at[idx].add(w * (~correct))
+    total = w.sum()
+    tn_all = total - tp - fp - fn  # per class
+    batch_states = jnp.stack([tp, fp, tn_all, fn], axis=1)  # [C, 4]
+    accum_states = (
+        batch_states if states is None else batch_states + states
+    )
+
+    def metrics(st):
+        tp_, fp_, tn_, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12), 0.0)
+        f1 = jnp.where(
+            prec + rec > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0
+        )
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        tps, fps, fns = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = jnp.where(tps + fps > 0, tps / jnp.maximum(tps + fps, 1e-12), 0.0)
+        mr = jnp.where(tps + fns > 0, tps / jnp.maximum(tps + fns, 1e-12), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr, 1e-12), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    return {
+        "BatchMetrics": [metrics(batch_states)],
+        "AccumMetrics": [metrics(accum_states)],
+        "AccumStatesInfo": [accum_states],
+    }
+
+
+@register_op(
+    "positive_negative_pair",
+    inputs=["Score", "Label", "QueryID", "Weight",
+            "AccumulatePositivePair", "AccumulateNegativePair",
+            "AccumulateNeutralPair"],
+    outputs=["PositivePair", "NegativePair", "NeutralPair"],
+    differentiable=False,
+)
+def _positive_negative_pair(ctx, op, ins):
+    """positive_negative_pair_op.cc (LTR PN-pair metric): over all
+    same-query item pairs with different labels, count score-order
+    agreement (positive), disagreement (negative), ties (neutral)."""
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    qid = ins["QueryID"][0].reshape(-1)
+    weight = ins.get("Weight", [None])[0]
+    w = (
+        jnp.ones_like(score)
+        if weight is None
+        else weight.reshape(-1).astype(score.dtype)
+    )
+    same_q = qid[:, None] == qid[None, :]
+    diff_label = label[:, None] != label[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q, dtype=bool), k=1)
+    pair_mask = same_q & diff_label & upper
+    pw = 0.5 * (w[:, None] + w[None, :])
+    s_diff = score[:, None] - score[None, :]
+    l_diff = label[:, None] - label[None, :]
+    agree = (s_diff * l_diff) > 0
+    tie = s_diff == 0
+    pos = jnp.sum(pair_mask * agree * ~tie * pw)
+    neu = jnp.sum(pair_mask * tie * pw)
+    neg = jnp.sum(pair_mask * ~agree * ~tie * pw)
+    ap = ins.get("AccumulatePositivePair", [None])[0]
+    an = ins.get("AccumulateNegativePair", [None])[0]
+    au = ins.get("AccumulateNeutralPair", [None])[0]
+    if ap is not None:
+        pos = pos + ap.reshape(())
+        neg = neg + an.reshape(())
+        neu = neu + au.reshape(())
+    one = lambda v: v.reshape(1)
+    return {
+        "PositivePair": [one(pos)],
+        "NegativePair": [one(neg)],
+        "NeutralPair": [one(neu)],
+    }
+
+
+@register_op(
+    "detection_map",
+    inputs=["DetectRes", "Label", "HasState", "PosCount", "TruePos", "FalsePos"],
+    outputs=["MAP", "AccumPosCount", "AccumTruePos", "AccumFalsePos"],
+    differentiable=False,
+)
+def _detection_map(ctx, op, ins):
+    """detection/detection_map_op.cc re-derived for dense tensors:
+    detections [N, 6] (label, score, x1, y1, x2, y2), gt [M, 5]
+    (label, x1, y1, x2, y2) for ONE image batch (the reference walks LoD
+    images; the dense form matches our multiclass_nms output). 11-point or
+    integral AP averaged over classes present in gt.
+
+    Cross-batch accumulation (the reference's PosCount/TruePos/FalsePos
+    LoD state): static-shape form keeps per-class fixed-capacity
+    (score, flag) buffers [C, K, 2] sorted by score desc, padded with
+    score = -1; PosCount is [C, 1]. Feed Accum* outputs back as the next
+    step's inputs — MAP is then the running dataset mAP. Per-class
+    matching is one lax.scan vmapped over the class axis (not C unrolled
+    scans)."""
+    from .detection import _iou_matrix
+
+    det = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    thresh = op.attr("overlap_threshold", 0.5)
+    C = op.attr("class_num")
+    ap_type = op.attr("ap_type", "11point")
+    det_label = det[:, 0]
+    det_score = det[:, 1]
+    det_box = det[:, 2:6]
+    gt_label = gt[:, 0]
+    gt_box = gt[:, 1:5]
+    valid_det = det_label >= 0
+    valid_gt = gt_label >= 0
+    iou = _iou_matrix(det_box, gt_box)  # [N, M]
+    N = det.shape[0]
+    order = jnp.argsort(-det_score)
+
+    det_c = (det_label[None, :] == jnp.arange(C)[:, None]) & valid_det  # [C,N]
+    gt_c = (gt_label[None, :] == jnp.arange(C)[:, None]) & valid_gt  # [C,M]
+    n_gt_batch = gt_c.sum(axis=1)  # [C]
+
+    def one_class(det_mask, gt_mask):
+        def body(carry, i):
+            used, tp = carry
+            d = order[i]
+            is_c = det_mask[d]
+            ious = jnp.where(gt_mask & ~used, iou[d], -1.0)
+            best = jnp.argmax(ious)
+            hit = (ious[best] >= thresh) & is_c
+            used = used.at[best].set(used[best] | hit)
+            tp = tp.at[d].set(jnp.where(is_c, hit.astype(jnp.float32), 0.0))
+            return (used, tp), None
+
+        (used, tp), _ = lax.scan(
+            body, (jnp.zeros_like(gt_mask), jnp.zeros((N,))), jnp.arange(N)
+        )
+        return tp  # [N] tp flag per detection slot (this class only)
+
+    tp_flags = jax.vmap(one_class)(det_c, gt_c)  # [C, N]
+
+    # per-class (score, flag) rows for this batch; non-class slots padded out
+    batch_scores = jnp.where(det_c, det_score[None, :], -1.0)  # [C, N]
+
+    prev_tp = ins.get("TruePos", [None])[0]
+    prev_fp = ins.get("FalsePos", [None])[0]
+    prev_pos = ins.get("PosCount", [None])[0]
+    has_state = ins.get("HasState", [None])[0]
+
+    if prev_tp is not None and prev_tp.ndim == 3:
+        keep = (
+            jnp.ones((), bool)
+            if has_state is None
+            else (has_state.reshape(()) != 0)
+        )
+        K = prev_tp.shape[1]
+        prev_scores = jnp.where(keep, prev_tp[:, :, 0], -1.0)
+        prev_flags = jnp.where(keep, prev_tp[:, :, 1], 0.0)
+        # fp buffer rows mirror tp rows with flag 0 at the same scores —
+        # one merged (score, tp-flag) list is sufficient for AP, so the
+        # fp buffer contributes its scores with flag 0
+        fp_scores = (
+            jnp.where(keep, prev_fp[:, :, 0], -1.0)
+            if prev_fp is not None and prev_fp.ndim == 3
+            else jnp.full((C, 0), -1.0)
+        )
+        scores = jnp.concatenate(
+            [batch_scores, prev_scores, fp_scores], axis=1
+        )
+        flags = jnp.concatenate(
+            [tp_flags, prev_flags, jnp.zeros_like(fp_scores)], axis=1
+        )
+        n_gt = n_gt_batch.astype(jnp.float32) + jnp.where(
+            keep, prev_pos.reshape(C).astype(jnp.float32), 0.0
+        )
+        out_k = K
+    else:
+        scores = batch_scores
+        flags = tp_flags
+        n_gt = n_gt_batch.astype(jnp.float32)
+        out_k = N
+
+    # sort each class's rows by score desc; padded rows (score -1) sink
+    sort_idx = jnp.argsort(-scores, axis=1)
+    scores = jnp.take_along_axis(scores, sort_idx, axis=1)
+    flags = jnp.take_along_axis(flags, sort_idx, axis=1)
+
+    live = scores >= 0
+    tp_cum = jnp.cumsum(flags * live, axis=1)
+    fp_cum = jnp.cumsum((1.0 - flags) * live, axis=1)
+    rec = tp_cum / jnp.maximum(n_gt[:, None], 1.0)
+    prec = tp_cum / jnp.maximum(tp_cum + fp_cum, 1e-12)
+
+    if ap_type == "integral":
+        d_rec = jnp.diff(
+            jnp.concatenate([jnp.zeros((C, 1)), rec], axis=1), axis=1
+        )
+        aps = jnp.sum(jnp.where(live, prec * d_rec, 0.0), axis=1)
+    else:  # 11point
+        pts = jnp.linspace(0, 1, 11)
+        p_at = jax.vmap(
+            lambda r: jnp.max(
+                jnp.where(live & (rec >= r), prec, 0.0), axis=1
+            )
+        )(pts)  # [11, C]
+        aps = p_at.mean(axis=0)
+
+    present = n_gt > 0
+    mean_ap = jnp.sum(jnp.where(present, aps, 0.0)) / jnp.maximum(
+        present.sum(), 1
+    )
+
+    # publish the merged state truncated to the carry capacity
+    out_scores = scores[:, :out_k]
+    out_flags = flags[:, :out_k]
+    accum_tp = jnp.stack(
+        [jnp.where(out_flags > 0, out_scores, -1.0),
+         out_flags], axis=2
+    )
+    accum_fp = jnp.stack(
+        [jnp.where((out_flags == 0) & (out_scores >= 0), out_scores, -1.0),
+         jnp.zeros_like(out_flags)], axis=2
+    )
+    return {
+        "MAP": [mean_ap.reshape(1)],
+        "AccumPosCount": [n_gt.reshape(C, 1)],
+        "AccumTruePos": [accum_tp],
+        "AccumFalsePos": [accum_fp],
+    }

@@ -1,0 +1,1024 @@
+"""NN-surface long tail: prelu/data_norm/spectral_norm, 3-D conv/pool
+family, remaining interpolation modes, deformable ops, position-sensitive
+ROI pooling, distillation (fsp), CTR batched-FC ops, and text-matching
+convolutions.
+
+Reference files (paddle/fluid/operators/): prelu_op.cc, data_norm_op.cc,
+spectral_norm_op.cc, row_conv_op.cc, unpool_op.cc, spp_op.cc, pool_op.cc
+(pool3d), max_pool_with_index_op.cc, conv_transpose_op.cc
+(conv3d_transpose / depthwise_conv2d_transpose), inplace_abn_op.cc,
+sync_batch_norm_op.cu, affine_grid_op.cc, interpolate_op.cc
+(linear/bicubic/trilinear), similarity_focus_op.cc, batch_fc_op.cc,
+rank_attention_op.cc, fsp_op.cc, deformable_conv_op.cu,
+deformable_conv_v1_op.cu, deformable_psroi_pooling_op.cu, prroi_pool_op.cc,
+psroi_pool_op.cc, tree_conv_op.cc, var_conv_2d_op.cc,
+match_matrix_tensor_op.cc, lstmp_op.cc, attention_lstm_op.cc.
+
+TPU-native formulations: data-dependent loops become dense gathers +
+einsums (deformable sampling, PS-ROI bins use a fixed sample grid like our
+roi_align); sequence LoD contracts become padded [B, T, ...] + lengths.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+from .nn import _nhwc_conv, _pair
+
+
+# ---------------------------------------------------------------------------
+# activations / normalizers
+# ---------------------------------------------------------------------------
+
+
+@register_op("prelu", inputs=["X", "Alpha"], outputs=["Out"])
+def _prelu(ctx, op, ins):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = op.attr("mode", "all")
+    if mode == "channel":
+        shape = [1] * x.ndim
+        shape[1] = x.shape[1]
+        alpha = alpha.reshape(shape)
+    elif mode == "element":
+        alpha = alpha.reshape((1,) + x.shape[1:])
+    # "all": scalar broadcasts as-is
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@register_op(
+    "data_norm",
+    inputs=["X", "BatchSize", "BatchSum", "BatchSquareSum"],
+    outputs=["Y", "Means", "Scales"],
+)
+def _data_norm(ctx, op, ins):
+    """data_norm_op.cc:  means = sum/size, scales = sqrt(size/square_sum);
+    the CTR show-skip path (slot_dim) zeroes slots whose leading "show"
+    stat is 0."""
+    x = ins["X"][0]
+    size = ins["BatchSize"][0]
+    s = ins["BatchSum"][0]
+    sq = ins["BatchSquareSum"][0]
+    means = s / size
+    scales = jnp.sqrt(size / sq)
+    y = (x - means) * scales
+    slot_dim = op.attr("slot_dim", -1)
+    if slot_dim > 0:
+        C = x.shape[-1]
+        show = x[..., 0::slot_dim]  # leading stat of each slot
+        live = (jnp.abs(show) >= 1e-7).astype(x.dtype)
+        live = jnp.repeat(live, slot_dim, axis=-1)[..., :C]
+        y = y * live
+    return {"Y": [y], "Means": [means], "Scales": [scales]}
+
+
+@register_op(
+    "spectral_norm", inputs=["Weight", "U", "V"], outputs=["Out"]
+)
+def _spectral_norm(ctx, op, ins):
+    w, u, v = ins["Weight"][0], ins["U"][0], ins["V"][0]
+    dim = op.attr("dim", 0)
+    power_iters = op.attr("power_iters", 1)
+    eps = op.attr("eps", 1e-12)
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+
+    def _l2(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    def body(i, uv):
+        uu, vv = uv
+        vv = _l2(wm.T @ uu)
+        uu = _l2(wm @ vv)
+        return uu, vv
+
+    u, v = lax.fori_loop(0, power_iters, body, (u, v)) if power_iters else (u, v)
+    sigma = u @ wm @ v
+    out = jnp.transpose(
+        (wm / sigma).reshape([w.shape[dim]] + [w.shape[i] for i in perm[1:]]),
+        np.argsort(perm),
+    )
+    return {"Out": [out]}
+
+
+@register_op(
+    "sync_batch_norm",
+    inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    mutates=(("MeanOut", "Mean"), ("VarianceOut", "Variance")),
+)
+def _sync_batch_norm(ctx, op, ins):
+    """Cross-replica BN (sync_batch_norm_op.cu's NCCL allreduce of the
+    partial sums): under shard_map the per-device moments are psum-averaged
+    over the data axis, elsewhere it is exactly batch_norm (GSPMD inserts
+    the cross-device reduction itself when X is batch-sharded)."""
+    x, scale, bias, mean, var = (
+        ins[k][0] for k in ("X", "Scale", "Bias", "Mean", "Variance")
+    )
+    eps = op.attr("epsilon", 1e-5)
+    momentum = op.attr("momentum", 0.9)
+    layout = op.attr("data_layout", "NCHW")
+    ch = 1 if layout == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch)
+    bshape = [1] * x.ndim
+    bshape[ch] = x.shape[ch]
+    if op.attr("is_test", False) or op.attr("use_global_stats", False):
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+    else:
+        use_mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+        from ..parallel.mesh import DATA_AXIS
+
+        if DATA_AXIS in ctx.mesh_axes:
+            use_mean = lax.pmean(use_mean, DATA_AXIS)
+            m2 = lax.pmean(m2, DATA_AXIS)
+        use_var = jnp.maximum(m2 - jnp.square(use_mean), 0.0)
+        mean_out = mean * momentum + use_mean.astype(mean.dtype) * (1 - momentum)
+        var_out = var * momentum + use_var.astype(var.dtype) * (1 - momentum)
+    inv = lax.rsqrt(use_var.astype(jnp.float32) + eps)
+    a = scale.astype(jnp.float32) * inv
+    b = bias.astype(jnp.float32) - use_mean.astype(jnp.float32) * a
+    y = x * a.astype(x.dtype).reshape(bshape) + b.astype(x.dtype).reshape(bshape)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [use_mean],
+        "SavedVariance": [use_var],
+    }
+
+
+@register_op(
+    "inplace_abn",
+    inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    mutates=(("MeanOut", "Mean"), ("VarianceOut", "Variance")),
+)
+def _inplace_abn(ctx, op, ins):
+    """inplace_abn_op.cc: BN + activation fused to save activation memory.
+    In-place-ness is XLA's buffer assignment here; the fusion is free."""
+    outs = _sync_batch_norm(ctx, op, ins)
+    act = op.attr("activation", "identity")
+    y = outs["Y"][0]
+    if act in ("leaky_relu", "leakyrelu"):
+        alpha = op.attr("alpha", 0.01)
+        y = jnp.where(y > 0, y, alpha * y)
+    elif act == "elu":
+        alpha = op.attr("alpha", 1.0)
+        y = jnp.where(y > 0, y, alpha * (jnp.exp(y) - 1))
+    elif act == "identity":
+        pass
+    else:
+        y = getattr(jax.nn, act)(y)
+    outs["Y"] = [y]
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# row_conv (row_conv_op.cc, DeepSpeech2 lookahead convolution):
+# Out[b, t, d] = sum_{j=0..k} W[j, d] * X[b, t+j, d]
+# ---------------------------------------------------------------------------
+
+
+@register_op("row_conv", inputs=["X", "Filter"], outputs=["Out"])
+def _row_conv(ctx, op, ins):
+    x, w = ins["X"][0], ins["Filter"][0]  # [B,T,D], [k+1, D]
+    k = w.shape[0]
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    T = x.shape[1]
+    for j in range(k):  # k is small & static: unrolled adds fuse into one pass
+        out = out + xp[:, j:j + T, :] * w[j]
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv/pool family
+# ---------------------------------------------------------------------------
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v, v]
+
+
+def _ncdhw_conv(x, w_oidhw, **kw):
+    out = lax.conv_general_dilated(
+        jnp.transpose(x, (0, 2, 3, 4, 1)),
+        jnp.transpose(w_oidhw, (2, 3, 4, 1, 0)),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        **kw,
+    )
+    return jnp.transpose(out, (0, 4, 1, 2, 3))
+
+
+@register_op(
+    "conv3d_transpose", inputs=["Input", "Filter"], outputs=["Output"]
+)
+def _conv3d_transpose(ctx, op, ins):
+    x, w = ins["Input"][0], ins["Filter"][0]  # w: [in_c, out_c/g, kd, kh, kw]
+    strides = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    g = op.attr("groups", 1) or 1
+    in_c, oc_g, kd, kh, kw = w.shape
+    pads = [
+        (kd - 1 - p[0], kd - 1 - p[0]),
+        (kh - 1 - p[1], kh - 1 - p[1]),
+        (kw - 1 - p[2], kw - 1 - p[2]),
+    ]
+    w_t = jnp.flip(w, axis=(2, 3, 4)).reshape(g, in_c // g, oc_g, kd, kh, kw)
+    w_t = w_t.transpose(0, 2, 1, 3, 4, 5).reshape(
+        g * oc_g, in_c // g, kd, kh, kw
+    )
+    out = _ncdhw_conv(
+        x,
+        w_t,
+        window_strides=[1, 1, 1],
+        padding=pads,
+        lhs_dilation=strides,
+        feature_group_count=g,
+    )
+    return {"Output": [out]}
+
+
+@register_op(
+    "depthwise_conv2d_transpose", inputs=["Input", "Filter"], outputs=["Output"]
+)
+def _depthwise_conv2d_transpose(ctx, op, ins):
+    from .nn import _conv2d_transpose
+
+    op.attrs.setdefault("groups", ins["Input"][0].shape[1])
+    return _conv2d_transpose(ctx, op, ins)
+
+
+@register_op("pool3d", inputs=["X"], outputs=["Out"])
+def _pool3d(ctx, op, ins):
+    x = ins["X"][0]
+    ptype = op.attr("pooling_type", "max")
+    if op.attr("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(x, axis=(2, 3, 4), keepdims=True)]}
+    ksize = _triple(op.attr("ksize"))
+    strides = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    if op.attr("adaptive", False):
+        n, c, d, h, w = x.shape
+        od, oh, ow = ksize
+        xr = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(xr, axis=(3, 5, 7))]}
+    pads = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+    dims = (1, 1, *ksize)
+    strd = (1, 1, *strides)
+    if ptype == "max":
+        return {"Out": [lax.reduce_window(x, -jnp.inf, lax.max, dims, strd, pads)]}
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strd, pads)
+    if op.attr("exclusive", True) and any(p):
+        counts = lax.reduce_window(
+            jnp.ones_like(x), 0.0, lax.add, dims, strd, pads
+        )
+        return {"Out": [summed / counts]}
+    return {"Out": [summed / math.prod(ksize)]}
+
+
+def _paired_max_reduce(x, idx, dims, strd, pads):
+    """reduce_window over (value, index) pairs: max by value, min index on
+    ties (the reference kernel's scan order) — one XLA variadic reduce."""
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = (bv > av) | ((bv == av) & (bi < ai))
+        return (
+            jnp.where(take_b, bv, av),
+            jnp.where(take_b, bi, ai),
+        )
+
+    return lax.reduce_window(
+        (x, idx),
+        (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(jnp.inf, idx.dtype)),
+        reducer,
+        dims,
+        strd,
+        pads,
+    )
+
+
+@register_op(
+    "max_pool3d_with_index", inputs=["X"], outputs=["Out", "Mask"],
+    differentiable=False,
+)
+def _max_pool3d_with_index(ctx, op, ins):
+    x = ins["X"][0]
+    ksize = _triple(op.attr("ksize"))
+    strides = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    pads = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+    dims = (1, 1, *ksize)
+    strd = (1, 1, *strides)
+    n, c, d, h, w = x.shape
+    flat = jnp.broadcast_to(
+        jnp.arange(d * h * w, dtype=jnp.float32).reshape(1, 1, d, h, w), x.shape
+    )
+    mx_val, mx_idx = _paired_max_reduce(x, flat, dims, strd, pads)
+    return {"Out": [mx_val], "Mask": [mx_idx.astype(jnp.int32)]}
+
+
+@register_op("unpool", inputs=["X", "Indices"], outputs=["Out"])
+def _unpool(ctx, op, ins):
+    """unpool_op.cc (max-unpool2d): scatter pooled values back to the
+    argmax positions recorded by max_pool2d_with_index."""
+    x, idx = ins["X"][0], ins["Indices"][0]
+    n, c, h, w = x.shape
+    oh, ow = op.attr("unpooled_height", 0), op.attr("unpooled_width", 0)
+    if not oh:
+        ksize = _pair(op.attr("ksize"))
+        strides = _pair(op.attr("strides", ksize))
+        oh, ow = h * strides[0], w * strides[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1),
+    ].set(x.reshape(n, c, -1))
+    return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+def _adaptive_bin_matrix(size, bins):
+    """Static [bins, size] 0/1 membership: bin i covers
+    floor(i*size/bins) .. ceil((i+1)*size/bins) (spp_op.h's adaptive
+    windows — never empty, unlike fixed kernel+pad reshaping)."""
+    m = np.zeros((bins, size), np.float32)
+    for i in range(bins):
+        lo = (i * size) // bins
+        hi = -(-((i + 1) * size) // bins)  # ceil
+        m[i, lo:max(hi, lo + 1)] = 1.0
+    return m
+
+
+@register_op("spp", inputs=["X"], outputs=["Out"])
+def _spp(ctx, op, ins):
+    """spp_op.cc spatial pyramid pooling: adaptive pools at 1,2,..,2^(L-1)
+    bins per side, flattened and concatenated. Bins come from static
+    membership matrices so every bin is non-empty for any feature size."""
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    L = op.attr("pyramid_height", 1)
+    ptype = op.attr("pooling_type", "max")
+    outs = []
+    for lvl in range(L):
+        bins = 2 ** lvl
+        mh = jnp.asarray(_adaptive_bin_matrix(h, bins))  # [bins, h]
+        mw = jnp.asarray(_adaptive_bin_matrix(w, bins))  # [bins, w]
+        if ptype == "max":
+            # mask-max over rows then cols
+            t = jnp.max(
+                jnp.where(mh[None, None, :, :, None] > 0, x[:, :, None], -jnp.inf),
+                axis=3,
+            )  # [n, c, bins, w]
+            pooled = jnp.max(
+                jnp.where(mw[None, None, None, :, :] > 0, t[:, :, :, None], -jnp.inf),
+                axis=4,
+            )  # [n, c, bins, bins]
+        else:
+            s = jnp.einsum("nchw,ih,jw->ncij", x, mh, mw)
+            cnt = jnp.outer(mh.sum(1), mw.sum(1))  # [bins, bins]
+            pooled = s / cnt
+        outs.append(pooled.reshape(n, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+# ---------------------------------------------------------------------------
+# interpolation modes (interpolate_op.cc; nearest/bilinear live in nn.py)
+# ---------------------------------------------------------------------------
+
+
+def _interp_size(op, in_sizes):
+    scale = op.attr("scale", 0.0) or 0.0
+    names = ["out_d", "out_h", "out_w"][-len(in_sizes):]
+    out = []
+    for name, s in zip(names, in_sizes):
+        o = op.attr(name, 0) or 0
+        if not o:
+            o = int(s * scale)
+        out.append(o)
+    return out
+
+
+@register_op("linear_interp", inputs=["X"], outputs=["Out"])
+def _linear_interp(ctx, op, ins):
+    x = ins["X"][0]  # [N, C, W]
+    (ow,) = _interp_size(op, x.shape[2:])
+    return {
+        "Out": [jax.image.resize(x, (*x.shape[:2], ow), method="linear")]
+    }
+
+
+@register_op("bicubic_interp", inputs=["X"], outputs=["Out"])
+def _bicubic_interp(ctx, op, ins):
+    x = ins["X"][0]
+    oh, ow = _interp_size(op, x.shape[2:])
+    return {
+        "Out": [jax.image.resize(x, (*x.shape[:2], oh, ow), method="cubic")]
+    }
+
+
+@register_op("trilinear_interp", inputs=["X"], outputs=["Out"])
+def _trilinear_interp(ctx, op, ins):
+    x = ins["X"][0]  # [N, C, D, H, W]
+    od, oh, ow = _interp_size(op, x.shape[2:])
+    return {
+        "Out": [
+            jax.image.resize(x, (*x.shape[:2], od, oh, ow), method="linear")
+        ]
+    }
+
+
+@register_op("affine_grid", inputs=["Theta", "OutputShape"], outputs=["Output"])
+def _affine_grid(ctx, op, ins):
+    """affine_grid_op.cc: sampling grid for a spatial transformer. Grid
+    coords are normalized to [-1, 1]; align_corners semantics follow the
+    reference default (True)."""
+    theta = ins["Theta"][0]  # [N, 2, 3]
+    shape = op.attr("output_shape", None)
+    if not shape:
+        # XLA needs a static grid shape: a runtime OutputShape tensor is
+        # only usable when it is a trace-time constant (weak check via
+        # concrete_or_error keeps the error actionable)
+        shape = jax.core.concrete_or_error(
+            np.asarray, ins["OutputShape"][0],
+            "affine_grid needs a static output shape under jit; pass the "
+            "output_shape attr (layers.affine_grid does) instead of a "
+            "computed OutputShape tensor.",
+        )
+    n, c, h, w = [int(v) for v in shape]
+    align = op.attr("align_corners", True)
+    if align:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+        xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    out = jnp.einsum("hwk,njk->nhwj", base, theta)  # [N, H, W, 2]
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# similarity_focus (similarity_focus_op.cc): for each (axis-)slice, mark
+# the channels where that slice attains the per-position max; output is a
+# 0/1 focus mask of X's shape.
+# ---------------------------------------------------------------------------
+
+
+def _greedy_focus_mask(m):
+    """m: [A, B]. The reference's procedure (similarity_focus_op.h): walk
+    values in descending order, tag a position when neither its row nor its
+    column is already tagged. lax.scan over the sorted order — each row and
+    column contributes at most one tag."""
+    a, b = m.shape
+    order = jnp.argsort(-m.reshape(-1))
+
+    def step(carry, k):
+        row_used, col_used, mask = carry
+        i = order[k] // b
+        j = order[k] % b
+        take = (~row_used[i]) & (~col_used[j])
+        return (
+            row_used.at[i].set(row_used[i] | take),
+            col_used.at[j].set(col_used[j] | take),
+            mask.at[i, j].set(jnp.where(take, 1.0, mask[i, j])),
+        ), None
+
+    (_, _, mask), _ = lax.scan(
+        step,
+        (jnp.zeros((a,), bool), jnp.zeros((b,), bool), jnp.zeros((a, b))),
+        jnp.arange(a * b),
+    )
+    return mask
+
+
+@register_op("similarity_focus", inputs=["X"], outputs=["Out"])
+def _similarity_focus(ctx, op, ins):
+    x = ins["X"][0]  # [N, C, A, B]
+    axis = op.attr("axis", 1)
+    indexes = op.attr("indexes", [0])
+    if axis != 1:
+        # reference supports axis in {1, 2, 3}; normalize to channel-axis
+        x = jnp.moveaxis(x, axis, 1)
+    masks = []
+    for idx in indexes:
+        masks.append(jax.vmap(_greedy_focus_mask)(x[:, idx]))
+    mask = jnp.clip(sum(masks), 0.0, 1.0)  # [N, A, B]
+    out = jnp.broadcast_to(mask[:, None].astype(x.dtype), x.shape)
+    if axis != 1:
+        out = jnp.moveaxis(out, 1, axis)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# CTR batched FC family (batch_fc_op.cc, rank_attention_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("batch_fc", inputs=["Input", "W", "Bias"], outputs=["Out"])
+def _batch_fc(ctx, op, ins):
+    x, w, b = ins["Input"][0], ins["W"][0], ins["Bias"][0]
+    # [S, B, in] @ [S, in, out] + [S, 1, out] — one bmm on the MXU vs the
+    # reference's per-slot cublas loop (batch_fc_op.cu:30)
+    return {"Out": [jnp.einsum("sbi,sio->sbo", x, w) + b]}
+
+
+@register_op(
+    "rank_attention",
+    inputs=["X", "RankOffset", "RankParam"],
+    outputs=["Out", "InputHelp", "InsRank"],
+)
+def _rank_attention(ctx, op, ins):
+    """rank_attention_op.cu: every instance picks per-(own-rank, other-rank)
+    weight blocks from RankParam and averages x @ W_block over its valid
+    interaction pairs. RankOffset row: [ins_rank, idx0, rank0, idx1, rank1,
+    ...] with -1 padding (CTR position-bias modeling)."""
+    x = ins["X"][0]  # [N, D]
+    offset = ins["RankOffset"][0].astype(jnp.int32)  # [N, 1+2*M]
+    param = ins["RankParam"][0]  # [max_rank*max_rank*D, out]
+    max_rank = op.attr("MaxRank", 3)
+    out_dim = param.shape[-1]
+    n, d = x.shape
+    m = (offset.shape[1] - 1) // 2
+    ins_rank = offset[:, 0]  # [N]
+    other_rank = offset[:, 2::2]  # [N, M] (-1 = absent)
+    valid = (other_rank >= 0) & (ins_rank[:, None] >= 0)
+    # block index of pair (ins_rank i, other_rank j): (i*max_rank + j)
+    blk = jnp.clip(ins_rank[:, None] * max_rank + other_rank, 0,
+                   max_rank * max_rank - 1)
+    w = param.reshape(max_rank * max_rank, d, out_dim)
+    wsel = w[blk]  # [N, M, D, out]
+    y = jnp.einsum("nd,nmdo->nmo", x, wsel)
+    y = jnp.where(valid[..., None], y, 0.0)
+    cnt = jnp.maximum(valid.sum(axis=1, keepdims=True), 1)
+    out = y.sum(axis=1) / cnt
+    return {
+        "Out": [out],
+        "InputHelp": [x],
+        "InsRank": [ins_rank.astype(x.dtype)[:, None]],
+    }
+
+
+@register_op("fsp", inputs=["X", "Y"], outputs=["Out"])
+def _fsp(ctx, op, ins):
+    """fsp_op.cc (flow-of-solution-procedure matrix for distillation):
+    Out[b] = X_flat @ Y_flat^T / (H*W)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    h, w = x.shape[2], x.shape[3]
+    return {
+        "Out": [
+            jnp.einsum("bchw,bdhw->bcd", x, y) / (h * w)
+        ]
+    }
+
+
+# ---------------------------------------------------------------------------
+# deformable ops: bilinear sampling at learned offsets. The CUDA kernels
+# (deformable_conv_op.cu modulated_deformable_im2col) become one dense
+# gather-weighted sum; everything stays static-shape.
+# ---------------------------------------------------------------------------
+
+
+def _gather_nchw(x, yi, xi):
+    """x [C,H,W], yi/xi [S,OH,OW] int -> [C,S,OH,OW]"""
+    return x[:, yi, xi]
+
+
+def _deform_sample(x, py, px):
+    """x [N,C,H,W]; py/px [N,S,OH,OW] -> [N,C,S,OH,OW] bilinear, 0 outside."""
+    n, c, h, w = x.shape
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy1 = py - y0
+    wx1 = px - x0
+    acc = None
+    for dy, wy in ((0.0, 1 - wy1), (1.0, wy1)):
+        for dx, wx in ((0.0, 1 - wx1), (1.0, wx1)):
+            yy = y0 + dy
+            xx = x0 + dx
+            inside = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            g = jax.vmap(_gather_nchw)(x, yi, xi)  # [N,C,S,OH,OW]
+            wgt = (wy * wx * inside.astype(x.dtype))[:, None]
+            acc = g * wgt if acc is None else acc + g * wgt
+    return acc
+
+
+def _deformable_conv_impl(ctx, op, ins, modulated):
+    x, offset, w = ins["Input"][0], ins["Offset"][0], ins["Filter"][0]
+    mask = ins.get("Mask", [None])[0] if modulated else None
+    kh, kw = w.shape[2], w.shape[3]
+    sh, sw = _pair(op.attr("strides", [1, 1]))
+    ph, pw = _pair(op.attr("paddings", [0, 0]))
+    dh, dw = _pair(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1) or 1
+    dg = op.attr("deformable_groups", 1) or 1
+    n, c, h, wd = x.shape
+    oh, ow = offset.shape[2], offset.shape[3]
+    # offsets: [N, 2*dg*kh*kw, OH, OW] -> y/x per (dg, kh*kw)
+    off = offset.reshape(n, dg, kh * kw, 2, oh, ow)
+    off_y = off[:, :, :, 0]
+    off_x = off[:, :, :, 1]
+    kyy = np.repeat(np.arange(kh) * dh, kw)  # [kh*kw]
+    kxx = np.tile(np.arange(kw) * dw, kh)
+    py = (
+        off_y
+        + jnp.asarray(
+            (np.arange(oh) * sh - ph)[None, None, None, :, None]
+            + kyy[None, None, :, None, None]
+        )
+    )  # [N, dg, kh*kw, OH, OW]
+    px = (
+        off_x
+        + jnp.asarray(
+            (np.arange(ow) * sw - pw)[None, None, None, None, :]
+            + kxx[None, None, :, None, None]
+        )
+    )
+    cg = c // dg  # channels per deformable group
+    outs = []
+    for g in range(dg):  # dg is small (1-4) and static
+        xg = x[:, g * cg:(g + 1) * cg]
+        sampled = _deform_sample(
+            xg, py[:, g], px[:, g]
+        )  # [N, cg, kh*kw, OH, OW]
+        if mask is not None:
+            mg = mask.reshape(n, dg, kh * kw, oh, ow)[:, g]
+            sampled = sampled * mg[:, None]
+        outs.append(sampled)
+    cols = jnp.concatenate(outs, axis=1)  # [N, C, kh*kw, OH, OW]
+    # grouped conv as einsum: w [OC, C/groups, kh, kw]
+    oc = w.shape[0]
+    wg = w.reshape(groups, oc // groups, c // groups, kh * kw)
+    colsg = cols.reshape(n, groups, c // groups, kh * kw, oh, ow)
+    out = jnp.einsum("ngckhw,gock->ngohw", colsg, wg).reshape(n, oc, oh, ow)
+    return {"Output": [out]}
+
+
+@register_op(
+    "deformable_conv",
+    inputs=["Input", "Offset", "Mask", "Filter"],
+    outputs=["Output"],
+)
+def _deformable_conv(ctx, op, ins):
+    return _deformable_conv_impl(ctx, op, ins, modulated=True)
+
+
+@register_op(
+    "deformable_conv_v1",
+    inputs=["Input", "Offset", "Filter"],
+    outputs=["Output"],
+)
+def _deformable_conv_v1(ctx, op, ins):
+    return _deformable_conv_impl(ctx, op, ins, modulated=False)
+
+
+# ---------------------------------------------------------------------------
+# position-sensitive / precise ROI pooling (psroi_pool_op.cc,
+# prroi_pool_op.cc, deformable_psroi_pooling_op.cu). Fixed sample grids
+# per bin (roi_align-style) replace data-dependent bin loops.
+# ---------------------------------------------------------------------------
+
+
+def _roi_bin_sample(x_img, roi, ph, pw, scale, samples):
+    """x_img [C,H,W]; roi [4] (x1,y1,x2,y2) in image coords; fixed
+    samples x samples bilinear grid per bin, averaged -> [C, ph, pw]."""
+    c, h, w = x_img.shape
+    x1, y1, x2, y2 = roi[0] * scale, roi[1] * scale, roi[2] * scale, roi[3] * scale
+    rh = jnp.maximum(y2 - y1, 0.1) / ph
+    rw = jnp.maximum(x2 - x1, 0.1) / pw
+    s = samples
+    iy = (jnp.arange(s) + 0.5) / s
+    ix = (jnp.arange(s) + 0.5) / s
+    by = y1 + rh * (jnp.arange(ph)[:, None] + iy[None, :])  # [ph, s]
+    bx = x1 + rw * (jnp.arange(pw)[:, None] + ix[None, :])  # [pw, s]
+    py = jnp.broadcast_to(by[:, None, :, None], (ph, pw, s, s))
+    px = jnp.broadcast_to(bx[None, :, None, :], (ph, pw, s, s))
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy1 = py - y0
+    wx1 = px - x0
+    acc = 0.0
+    for dy, wy in ((0.0, 1 - wy1), (1.0, wy1)):
+        for dx, wx in ((0.0, 1 - wx1), (1.0, wx1)):
+            yy = jnp.clip(y0 + dy, 0, h - 1).astype(jnp.int32)
+            xx = jnp.clip(x0 + dx, 0, w - 1).astype(jnp.int32)
+            inside = ((y0 + dy >= 0) & (y0 + dy <= h - 1)
+                      & (x0 + dx >= 0) & (x0 + dx <= w - 1))
+            g = x_img[:, yy, xx]  # [C, ph, pw, s, s]
+            acc = acc + g * (wy * wx * inside)[None]
+    vals = acc.mean(axis=(-2, -1))  # [C, ph, pw]
+    return vals
+
+
+@register_op(
+    "psroi_pool", inputs=["X", "ROIs", "RoisNum"], outputs=["Out"]
+)
+def _psroi_pool(ctx, op, ins):
+    x = ins["X"][0]
+    rois = ins["ROIs"][0].astype(jnp.float32)
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    out_c = op.attr("output_channels")
+    scale = op.attr("spatial_scale", 1.0)
+    from .detection import _roi_batch_idx
+
+    R = rois.shape[0]
+    batch_idx = _roi_batch_idx(
+        ins.get("RoisNum", [None])[0], R, x.shape[0], ctx.abstract
+    )
+
+    def one(roi, bi):
+        vals = _roi_bin_sample(x[bi], roi, ph, pw, scale, 2)
+        # position-sensitive channel select: bin (i,j) of output channel k
+        # reads input channel k*ph*pw + i*pw + j
+        sel = vals.reshape(out_c, ph * pw, ph, pw)
+        pos = jnp.arange(ph * pw).reshape(ph, pw)
+        return sel[:, pos, jnp.arange(ph)[:, None], jnp.arange(pw)[None, :]]
+
+    out = jax.vmap(one)(rois, batch_idx)
+    return {"Out": [out]}
+
+
+@register_op(
+    "prroi_pool", inputs=["X", "ROIs", "BatchRoINums"], outputs=["Out"]
+)
+def _prroi_pool(ctx, op, ins):
+    """Precise ROI pooling: exact bilinear integral over each bin. The
+    fixed-grid average (4x4 samples/bin) converges to the same integral and
+    keeps shapes static (prroi_pool_op.cc computes it analytically on CPU)."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0].astype(jnp.float32)
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    scale = op.attr("spatial_scale", 1.0)
+    from .detection import _roi_batch_idx
+
+    R = rois.shape[0]
+    batch_idx = _roi_batch_idx(
+        ins.get("BatchRoINums", [None])[0], R, x.shape[0], ctx.abstract
+    )
+
+    def one(roi, bi):
+        return _roi_bin_sample(x[bi], roi, ph, pw, scale, 4)
+
+    return {"Out": [jax.vmap(one)(rois, batch_idx)]}
+
+
+@register_op(
+    "deformable_psroi_pooling",
+    inputs=["Input", "ROIs", "Trans"],
+    outputs=["Output", "TopCount"],
+)
+def _deformable_psroi_pooling(ctx, op, ins):
+    x = ins["Input"][0]
+    rois = ins["ROIs"][0].astype(jnp.float32)
+    trans = ins.get("Trans", [None])[0]
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    out_c = op.attr("output_dim")
+    scale = op.attr("spatial_scale", 1.0)
+    trans_std = op.attr("trans_std", 0.1)
+    no_trans = op.attr("no_trans", False) or trans is None
+    from .detection import _roi_batch_idx
+
+    R = rois.shape[0]
+    batch_idx = _roi_batch_idx(None, R, x.shape[0], ctx.abstract)
+
+    def one(i, roi, bi):
+        img = x[bi]
+        c, h, w = img.shape
+        x1, y1 = roi[0] * scale, roi[1] * scale
+        rw = jnp.maximum((roi[2] - roi[0]) * scale, 0.1) / pw
+        rh = jnp.maximum((roi[3] - roi[1]) * scale, 0.1) / ph
+        if no_trans:
+            dy = dx = jnp.zeros((ph, pw))
+        else:
+            # trans [R, 2, ph, pw]: learned per-bin shifts in roi units
+            dy = trans[i, 0] * trans_std * rh * ph
+            dx = trans[i, 1] * trans_std * rw * pw
+        s = 2
+        off = (jnp.arange(s) + 0.5) / s
+        py = (y1 + rh * (jnp.arange(ph)[:, None] + off[None, :]))  # [ph,s]
+        px = (x1 + rw * (jnp.arange(pw)[:, None] + off[None, :]))  # [pw,s]
+        py = py[:, None, :, None] + dy[:, :, None, None]  # [ph,pw,s,1]
+        px = px[None, :, None, :] + dx[:, :, None, None]  # [ph,pw,1,s]
+        py = jnp.broadcast_to(py, (ph, pw, s, s))
+        px = jnp.broadcast_to(px, (ph, pw, s, s))
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy1, wx1 = py - y0, px - x0
+        acc = 0.0
+        for ddy, wy in ((0.0, 1 - wy1), (1.0, wy1)):
+            for ddx, wx in ((0.0, 1 - wx1), (1.0, wx1)):
+                yy = jnp.clip(y0 + ddy, 0, h - 1).astype(jnp.int32)
+                xx = jnp.clip(x0 + ddx, 0, w - 1).astype(jnp.int32)
+                inside = ((y0 + ddy >= 0) & (y0 + ddy <= h - 1)
+                          & (x0 + ddx >= 0) & (x0 + ddx <= w - 1))
+                acc = acc + img[:, yy, xx] * (wy * wx * inside)[None]
+        vals = acc.mean(axis=(-2, -1))  # [C, ph, pw]
+        sel = vals.reshape(out_c, ph * pw, ph, pw)
+        pos = jnp.arange(ph * pw).reshape(ph, pw)
+        return sel[:, pos, jnp.arange(ph)[:, None], jnp.arange(pw)[None, :]]
+
+    out = jax.vmap(one)(jnp.arange(R), rois, batch_idx)
+    top = jnp.ones((R, out_c, ph, pw), x.dtype)
+    return {"Output": [out], "TopCount": [top]}
+
+
+# ---------------------------------------------------------------------------
+# text-matching convs (PyramidDNN family: var_conv_2d_op.cc,
+# match_matrix_tensor_op.cc, tree_conv_op.cc). LoD-variable images become
+# padded dense tensors + masks.
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "match_matrix_tensor", inputs=["X", "Y", "W"], outputs=["Out", "Tmp"]
+)
+def _match_matrix_tensor(ctx, op, ins):
+    x, y, w = ins["X"][0], ins["Y"][0], ins["W"][0]
+    # x [B, Lx, D1], y [B, Ly, D2], w [D1, T, D2]
+    tmp = jnp.einsum("bld,dte->blte", x, w)
+    out = jnp.einsum("blte,bme->btlm", tmp, y)  # [B, T, Lx, Ly]
+    return {"Out": [out], "Tmp": [tmp]}
+
+
+@register_op("var_conv_2d", inputs=["X", "ROW", "COLUMN", "W"], outputs=["Out", "Col"])
+def _var_conv_2d(ctx, op, ins):
+    """var_conv_2d_op.cc: conv over per-sample variable-size 1-channel
+    match images. Padded-dense form: X [B, H, W] with masks via ROW/COLUMN
+    lengths handled upstream; plain conv here."""
+    x, w = ins["X"][0], ins["W"][0]
+    oc = op.attr("OutputChannel")
+    ic = op.attr("InputChannel", 1)
+    kh, kw = op.attr("KernelH", 3), op.attr("KernelW", 3)
+    sh, sw = op.attr("StrideH", 1), op.attr("StrideW", 1)
+    if x.ndim == 3:
+        x = x[:, None]  # [B, 1, H, W]
+    wf = w.reshape(oc, ic, kh, kw)
+    out = _nhwc_conv(
+        x, wf,
+        window_strides=[sh, sw],
+        padding=[((kh - 1) // 2,) * 2, ((kw - 1) // 2,) * 2],
+    )
+    return {"Out": [out], "Col": [x]}
+
+
+@register_op(
+    "tree_conv", inputs=["NodesVector", "EdgeSet", "Filter"], outputs=["Out"]
+)
+def _tree_conv(ctx, op, ins):
+    """tree_conv_op.cc (tree-based convolution, TBCNN): each node aggregates
+    its continuous-binary-tree neighborhood with position-interpolated
+    weights Wt/Wl/Wr. Dense form: adjacency from EdgeSet [B, E, 2]
+    (parent<-child), max_depth 2 window."""
+    nodes = ins["NodesVector"][0]  # [B, N, D]
+    edges = ins["EdgeSet"][0].astype(jnp.int32)  # [B, E, 2]
+    filt = ins["Filter"][0]  # [D, 3, out, num_filters] per reference
+    b, n, d = nodes.shape
+    wt, wl, wr = filt[:, 0], filt[:, 1], filt[:, 2]  # [D, out, F]
+
+    # adjacency: A[b, p, c] = 1 for each edge (p, c)
+    def adj_one(e):
+        a = jnp.zeros((n, n))
+        return a.at[e[:, 0], e[:, 1]].set(1.0)
+
+    A = jax.vmap(adj_one)(edges)
+    deg = jnp.maximum(A.sum(-1, keepdims=True), 1.0)
+    child_mean = (A @ nodes) / deg  # [B, N, D]
+    out = (
+        jnp.einsum("bnd,dof->bnof", nodes, wt)
+        + 0.5 * jnp.einsum("bnd,dof->bnof", child_mean, wl)
+        + 0.5 * jnp.einsum("bnd,dof->bnof", child_mean, wr)
+    )
+    b_, n_, o_, f_ = out.shape
+    return {"Out": [jnp.tanh(out).reshape(b_, n_, o_ * f_)]}
+
+
+# ---------------------------------------------------------------------------
+# lstmp (lstmp_op.cc: LSTM with recurrent projection, Google LVCSR) and
+# attention_lstm (attention_lstm_op.cc fused CTR attention + LSTM)
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "lstmp",
+    inputs=["X", "WIH", "WHH", "ProjWeight", "Bias", "H0", "C0", "SeqLen"],
+    outputs=["Projection", "Out", "LastH", "LastC"],
+)
+def _lstmp(ctx, op, ins):
+    from .rnn import _seq_mask
+
+    x = ins["X"][0]  # [B, T, D]
+    wih, whh = ins["WIH"][0], ins["WHH"][0]  # [4H, D], [4H, P]
+    wproj = ins["ProjWeight"][0]  # [H, P]
+    bias = ins.get("Bias", [None])[0]
+    B, T, D = x.shape
+    H = wproj.shape[0]
+    P = wproj.shape[1]
+    h0 = ins.get("H0", [None])[0]
+    c0 = ins.get("C0", [None])[0]
+    r0 = jnp.zeros((B, P), x.dtype) if h0 is None else h0
+    c0 = jnp.zeros((B, H), x.dtype) if c0 is None else c0
+    lens = ins.get("SeqLen", [None])[0]
+    xs = jnp.swapaxes(x, 0, 1)
+    xproj = jnp.einsum("tbd,gd->tbg", xs, wih)
+    if bias is not None:
+        xproj = xproj + bias
+    mask = _seq_mask(lens, B, T)
+
+    def step(carry, inp):
+        r, c = carry
+        xp, m = inp
+        gates = xp + r @ whh.T
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        r_new = h_new @ wproj
+        act_p = op.attr("proj_activation", "identity")
+        if act_p == "tanh":
+            r_new = jnp.tanh(r_new)
+        r_out = m * r_new + (1 - m) * r
+        c_out = m * c_new + (1 - m) * c
+        return (r_out, c_out), (r_out, h_new * m)
+
+    (r_last, c_last), (rs, hs) = lax.scan(step, (r0, c0), (xproj, mask))
+    return {
+        "Projection": [jnp.swapaxes(rs, 0, 1)],
+        "Out": [jnp.swapaxes(hs, 0, 1)],
+        "LastH": [r_last],
+        "LastC": [c_last],
+    }
+
+
+@register_op(
+    "attention_lstm",
+    inputs=[
+        "X", "C0", "H0", "AttentionWeight", "AttentionBias",
+        "AttentionScalar", "AttentionScalarBias", "LSTMWeight", "LSTMBias",
+        "SeqLen",
+    ],
+    outputs=["Hidden", "Cell"],
+)
+def _attention_lstm(ctx, op, ins):
+    """attention_lstm_op.cc: at every step, attention over the whole input
+    sequence conditioned on the previous cell state selects a context
+    vector that feeds one LSTM step. Padded re-derivation of the fused CPU
+    kernel: scores = scalar * act(concat(x_j, c_prev) @ Wa + ba) + bs."""
+    from .rnn import _seq_mask
+
+    x = ins["X"][0]  # [B, T, D]
+    c0 = ins["C0"][0]
+    h0 = ins.get("H0", [None])[0]
+    wa = ins["AttentionWeight"][0]  # [D + C, 1]
+    ba = ins.get("AttentionBias", [None])[0]
+    ws = ins.get("AttentionScalar", [None])[0]
+    bs = ins.get("AttentionScalarBias", [None])[0]
+    wl = ins["LSTMWeight"][0]  # [D + H, 4H]
+    bl = ins.get("LSTMBias", [None])[0]
+    B, T, D = x.shape
+    H = wl.shape[1] // 4
+    h0 = jnp.zeros((B, H), x.dtype) if h0 is None else h0
+    lens = ins.get("SeqLen", [None])[0]
+    mask = _seq_mask(lens, B, T)  # [T, B, 1]
+    seq_mask = jnp.swapaxes(mask, 0, 1)[..., 0]  # [B, T]
+
+    def step(carry, _):
+        h, c = carry
+        # attention: score each x_j against current cell state
+        cexp = jnp.broadcast_to(c[:, None, :], (B, T, c.shape[-1]))
+        feat = jnp.concatenate([x, cexp], axis=-1)  # [B, T, D+C]
+        e = jnp.tanh(feat @ wa + (ba if ba is not None else 0.0))[..., 0]
+        if ws is not None:
+            e = ws.reshape(()) * e + (bs.reshape(()) if bs is not None else 0.0)
+        e = jnp.where(seq_mask > 0, e, -1e9)
+        alpha = jax.nn.softmax(e, axis=-1)  # [B, T]
+        context = jnp.einsum("bt,btd->bd", alpha, x)
+        inp = jnp.concatenate([context, h], axis=-1)
+        gates = inp @ wl + (bl if bl is not None else 0.0)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (h_last, c_last), hs = lax.scan(step, (h0, c0), None, length=T)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "Cell": [c_last]}

@@ -317,3 +317,107 @@ def _dpsgd(ctx, op, ins):
     noise = sigma * clip * jax.random.normal(ctx.key_for(op.uid, op.type), g.shape, g.dtype)
     update = (g + noise) / batch_size
     return {"ParamOut": [(p - _lr(ins) * update).astype(p.dtype)]}
+
+
+@register_op(
+    "proximal_gd",
+    inputs=["Param", "Grad", "LearningRate"],
+    outputs=["ParamOut"],
+    differentiable=False,
+)
+def _proximal_gd(ctx, op, ins):
+    """optimizers/proximal_gd_op.cc: prox = p - lr*g, then soft-threshold
+    by lr*l1 and shrink by 1/(1 + lr*l2)."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins)
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    prox = p - lr * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (
+        1.0 + lr * l2
+    )
+    return {"ParamOut": [out.astype(p.dtype)]}
+
+
+@register_op(
+    "proximal_adagrad",
+    inputs=["Param", "Grad", "Moment", "LearningRate"],
+    outputs=["ParamOut", "MomentOut"],
+    differentiable=False,
+)
+def _proximal_adagrad(ctx, op, ins):
+    """optimizers/proximal_adagrad_op.cc: adagrad-scaled lr feeding the
+    proximal_gd update."""
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    m_out = m + g * g
+    lr_eff = _lr(ins) / jnp.sqrt(m_out + 1e-10)
+    prox = p - lr_eff * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_eff * l1, 0.0) / (
+        1.0 + lr_eff * l2
+    )
+    return {"ParamOut": [out.astype(p.dtype)], "MomentOut": [m_out]}
+
+
+@register_op(
+    "average_accumulates",
+    inputs=[
+        "param", "in_sum_1", "in_sum_2", "in_sum_3",
+        "in_num_accumulates", "in_old_num_accumulates", "in_num_updates",
+    ],
+    outputs=[
+        "out_sum_1", "out_sum_2", "out_sum_3",
+        "out_num_accumulates", "out_old_num_accumulates", "out_num_updates",
+    ],
+    differentiable=False,
+    mutates=(
+        ("out_sum_1", "in_sum_1"), ("out_sum_2", "in_sum_2"),
+        ("out_sum_3", "in_sum_3"),
+        ("out_num_accumulates", "in_num_accumulates"),
+        ("out_old_num_accumulates", "in_old_num_accumulates"),
+        ("out_num_updates", "in_num_updates"),
+    ),
+)
+def _average_accumulates(ctx, op, ins):
+    """average_accumulates_op.h (ModelAverage state machine): sum_1
+    accumulates params; every 16384 updates sum_1 spills into sum_2
+    (precision); when the window outgrows max(min_window,
+    min(max_window, num_updates*ratio)) the current sums retire into
+    sum_3. All three branches become jnp.where selects — identical state
+    trajectory, no host control flow."""
+    kmax = 16384
+    p = ins["param"][0]
+    s1, s2, s3 = ins["in_sum_1"][0], ins["in_sum_2"][0], ins["in_sum_3"][0]
+    num_acc = ins["in_num_accumulates"][0].reshape(()).astype(jnp.int64)
+    old_acc = ins["in_old_num_accumulates"][0].reshape(()).astype(jnp.int64)
+    num_upd = ins["in_num_updates"][0].reshape(()).astype(jnp.int64)
+    ratio = op.attr("average_window", 0.0)
+    max_w = op.attr("max_average_window", kmax)
+    min_w = op.attr("min_average_window", 10000)
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + p
+    spill = (num_upd % kmax) == 0
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(
+        jnp.asarray(max_w, jnp.float64).astype(jnp.float32),
+        num_upd.astype(jnp.float32) * ratio,
+    )
+    retire = (num_acc >= min_w) & (num_acc.astype(jnp.float32) >= window)
+    s3 = jnp.where(retire, s1 + s2, s3)
+    s1 = jnp.where(retire, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(retire, jnp.zeros_like(s2), s2)
+    old_acc = jnp.where(retire, num_acc, old_acc)
+    num_acc = jnp.where(retire, jnp.zeros_like(num_acc), num_acc)
+    i64 = lambda v: v.reshape([1]).astype(jnp.int64)
+    return {
+        "out_sum_1": [s1],
+        "out_sum_2": [s2],
+        "out_sum_3": [s3],
+        "out_num_accumulates": [i64(num_acc)],
+        "out_old_num_accumulates": [i64(old_acc)],
+        "out_num_updates": [i64(num_upd)],
+    }

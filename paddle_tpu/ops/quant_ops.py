@@ -1,0 +1,270 @@
+"""Quantization op family (reference operators/fake_quantize_op.cc,
+fake_dequantize_op.cc, mkldnn quantize_op.cc/dequantize_op.cc/
+requantize_op.cc, dequantize_abs_max_op.cc, dequantize_log_op.cc,
+lookup_table_dequant_op.cc).
+
+All fake-quant training ops use the straight-through estimator: forward
+carries the quantization error, backward is identity via
+x + stop_gradient(q - x) — the reference gets the same STE from its
+hand-written grad kernels. Scale observers (range / moving-average) keep
+their state as explicit outputs so the executor's persistable write-back
+updates them in place (no mutable buffers inside jit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _bound(op):
+    return float(2 ** (op.attr("bit_length", 8) - 1) - 1)
+
+
+def _ste(x, q):
+    return x + jax.lax.stop_gradient(q - x)
+
+
+@register_op(
+    "fake_quantize_abs_max", inputs=["X"], outputs=["Out", "OutScale"]
+)
+def _fake_quantize_abs_max(ctx, op, ins):
+    x = ins["X"][0]
+    bound = _bound(op)
+    scale = jnp.max(jnp.abs(x)) + 1e-9
+    q = jnp.clip(jnp.round(x / scale * bound), -bound, bound)
+    return {"Out": [_ste(x, q)], "OutScale": [scale.reshape([1])]}
+
+
+@register_op(
+    "fake_channel_wise_quantize_abs_max",
+    inputs=["X"],
+    outputs=["Out", "OutScale"],
+)
+def _fake_channel_wise_quantize_abs_max(ctx, op, ins):
+    x = ins["X"][0]
+    bound = _bound(op)
+    axis = op.attr("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True) + 1e-9
+    q = jnp.clip(jnp.round(x / scale * bound), -bound, bound)
+    return {"Out": [_ste(x, q)], "OutScale": [scale.reshape(-1)]}
+
+
+@register_op(
+    "fake_quantize_range_abs_max",
+    inputs=["X", "InScale", "Iter"],
+    outputs=["Out", "OutScale", "OutScales"],
+    mutates=(("OutScale", "InScale"),),
+)
+def _fake_quantize_range_abs_max(ctx, op, ins):
+    """Range observer (fake_quantize_op.cc FindRangeAbsMax): at train time
+    the running scale is max(cur_abs_max, in_scale); is_test freezes it.
+    The reference's window-of-scales ring buffer becomes the single running
+    max (window eviction is a CPU-side bookkeeping detail; the max over the
+    window is what the quantizer consumes)."""
+    x = ins["X"][0]
+    bound = _bound(op)
+    in_scale = ins["InScale"][0].reshape(())
+    if op.attr("is_test", False) or ctx.is_test:
+        scale = in_scale
+    else:
+        scale = jnp.maximum(jnp.max(jnp.abs(x)), in_scale) + 1e-9
+    q = jnp.clip(jnp.round(x / scale * bound), -bound, bound)
+    out_scale = scale.reshape([1])
+    return {"Out": [_ste(x, q)], "OutScale": [out_scale], "OutScales": []}
+
+
+def _moving_scale(ctx, op, x, ins):
+    in_accum = ins["InAccum"][0].reshape(())
+    in_state = ins["InState"][0].reshape(())
+    rate = op.attr("moving_rate", 0.9)
+    cur = jnp.max(jnp.abs(x))
+    state = rate * in_state + 1.0
+    accum = rate * in_accum + cur
+    scale = accum / state + 1e-9
+    return scale, accum.reshape([1]), state.reshape([1])
+
+
+@register_op(
+    "fake_quantize_moving_average_abs_max",
+    inputs=["X", "InScale", "InAccum", "InState"],
+    outputs=["Out", "OutScale", "OutAccum", "OutState"],
+    mutates=(("OutAccum", "InAccum"), ("OutState", "InState")),
+)
+def _fake_quantize_moving_average_abs_max(ctx, op, ins):
+    x = ins["X"][0]
+    bound = _bound(op)
+    if op.attr("is_test", False) or ctx.is_test:
+        scale = ins["InScale"][0].reshape(())
+        accum = ins["InAccum"][0].reshape([1])
+        state = ins["InState"][0].reshape([1])
+    else:
+        scale, accum, state = _moving_scale(ctx, op, x, ins)
+    q = jnp.clip(jnp.round(x / scale * bound), -bound, bound)
+    return {
+        "Out": [_ste(x, q)],
+        "OutScale": [scale.reshape([1])],
+        "OutAccum": [accum],
+        "OutState": [state],
+    }
+
+
+@register_op(
+    "fake_quantize_dequantize_moving_average_abs_max",
+    inputs=["X", "InScale", "InAccum", "InState"],
+    outputs=["Out", "OutScale", "OutAccum", "OutState"],
+    mutates=(("OutAccum", "InAccum"), ("OutState", "InState")),
+)
+def _fake_qdq_moving_average_abs_max(ctx, op, ins):
+    x = ins["X"][0]
+    bound = _bound(op)
+    if op.attr("is_test", False) or ctx.is_test:
+        scale = ins["InScale"][0].reshape(())
+        accum = ins["InAccum"][0].reshape([1])
+        state = ins["InState"][0].reshape([1])
+    else:
+        scale, accum, state = _moving_scale(ctx, op, x, ins)
+    q = jnp.clip(jnp.round(x / scale * bound), -bound, bound) * scale / bound
+    return {
+        "Out": [_ste(x, q)],
+        "OutScale": [scale.reshape([1])],
+        "OutAccum": [accum],
+        "OutState": [state],
+    }
+
+
+@register_op(
+    "moving_average_abs_max_scale",
+    inputs=["X", "InAccum", "InState"],
+    outputs=["Out", "OutScale", "OutAccum", "OutState"],
+    mutates=(("OutAccum", "InAccum"), ("OutState", "InState")),
+)
+def _moving_average_abs_max_scale(ctx, op, ins):
+    """Scale observer only — X passes through untouched (used to record
+    activation ranges for PTQ)."""
+    x = ins["X"][0]
+    if ctx.is_test:
+        accum = ins["InAccum"][0].reshape([1])
+        state = ins["InState"][0].reshape([1])
+        scale = accum / jnp.maximum(state, 1e-9)
+    else:
+        scale, accum, state = _moving_scale(ctx, op, x, ins)
+    return {
+        "Out": [x],
+        "OutScale": [jnp.reshape(scale, [1])],
+        "OutAccum": [accum],
+        "OutState": [state],
+    }
+
+
+@register_op(
+    "fake_dequantize_max_abs", inputs=["X", "Scale"], outputs=["Out"]
+)
+def _fake_dequantize_max_abs(ctx, op, ins):
+    x, scale = ins["X"][0], ins["Scale"][0]
+    max_range = op.attr("max_range", 127.0)
+    return {"Out": [x.astype(jnp.float32) * scale.reshape(()) / max_range]}
+
+
+@register_op(
+    "fake_channel_wise_dequantize_max_abs",
+    inputs=["X", "Scales"],
+    outputs=["Out"],
+)
+def _fake_channel_wise_dequantize_max_abs(ctx, op, ins):
+    x = ins["X"][0].astype(jnp.float32)
+    scales = ins["Scales"]
+    bits = op.attr("quant_bits", [8])
+    axis = op.attr("quant_axis", 0)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    out = x * scales[0].reshape(shape) / float(2 ** (bits[0] - 1) - 1)
+    if len(scales) > 1 and scales[1] is not None:
+        # second-level (whole-tensor) scale from the producing matmul
+        out = out * scales[1].reshape(()) / float(2 ** (bits[1] - 1) - 1)
+    return {"Out": [out]}
+
+
+# --- mkldnn-style int8 pipeline ops: XLA handles int8 natively ---
+
+
+@register_op("quantize", inputs=["Input"], outputs=["Output"])
+def _quantize(ctx, op, ins):
+    x = ins["Input"][0]
+    scale = op.attr("Scale", 1.0)
+    shift = op.attr("Shift", 0.0)
+    q = jnp.round(x * scale + shift)
+    if op.attr("is_negative_input", True) and not shift:
+        q = jnp.clip(q, -128, 127).astype(jnp.int8)
+    else:
+        q = jnp.clip(q, 0, 255).astype(jnp.uint8)
+    return {"Output": [q]}
+
+
+@register_op("dequantize", inputs=["Input"], outputs=["Output"])
+def _dequantize(ctx, op, ins):
+    x = ins["Input"][0]
+    scale = op.attr("Scale", 1.0)
+    shift = op.attr("Shift", 0.0)
+    return {"Output": [(x.astype(jnp.float32) - shift) / scale]}
+
+
+@register_op("requantize", inputs=["Input"], outputs=["Output"])
+def _requantize(ctx, op, ins):
+    x = ins["Input"][0]
+    s_in = op.attr("Scale_in", 1.0)
+    s_out = op.attr("Scale_out", 1.0)
+    q = jnp.round(x.astype(jnp.float32) * (s_out / s_in))
+    return {"Output": [jnp.clip(q, -128, 127).astype(x.dtype)]}
+
+
+@register_op("dequantize_abs_max", inputs=["X", "Scale"], outputs=["Out"])
+def _dequantize_abs_max(ctx, op, ins):
+    x, scale = ins["X"][0], ins["Scale"][0]
+    max_range = op.attr("max_range", 127.0)
+    return {"Out": [x.astype(jnp.float32) * scale.reshape(()) / max_range]}
+
+
+@register_op("dequantize_log", inputs=["X", "Dict"], outputs=["Out"])
+def _dequantize_log(ctx, op, ins):
+    """dequantize_log_op.cc: int8 codes index a 128-entry log table;
+    negative codes mirror with sign (x<0 -> -dict[x+128])."""
+    x = ins["X"][0].astype(jnp.int32)
+    table = ins["Dict"][0]
+    out = jnp.where(
+        x < 0, -table[jnp.clip(x + 128, 0, 127)], table[jnp.clip(x, 0, 127)]
+    )
+    return {"Out": [out]}
+
+
+# --- quantized embedding variants ---
+
+
+@register_op("lookup_table_dequant", inputs=["W", "Ids"], outputs=["Out"])
+def _lookup_table_dequant(ctx, op, ins):
+    """lookup_table_dequant_op.cc: rows store [min, max, uint8 codes];
+    out = min + (max - min) * code / 255, gathered then dequantized (one
+    fused gather+affine here)."""
+    w, ids = ins["W"][0], ins["Ids"][0].astype(jnp.int32)
+    ids_flat = ids.reshape(-1)
+    rows = w[ids_flat]  # [N, 2 + D_packed]
+    lo = rows[:, 0:1]
+    hi = rows[:, 1:2]
+    codes = rows[:, 2:].astype(jnp.float32)
+    out = lo + (hi - lo) * codes / 255.0
+    return {"Out": [out.reshape(*ids.shape[:-1], -1)]}
+
+
+@register_op("lookup_sparse_table", inputs=["W", "Ids"], outputs=["Out"])
+def _lookup_sparse_table(ctx, op, ins):
+    """lookup_sparse_table_op.cc (auto-growing PS table): the sharded-table
+    design (ops/sparse.py) pre-sizes tables, so this is a plain row gather;
+    unseen ids map to the init rows already materialized."""
+    w, ids = ins["W"][0], ins["Ids"][0].astype(jnp.int32)
+    out = w[ids.reshape(-1)]
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        out = out.reshape(*ids.shape[:-1], -1)
+    return {"Out": [out]}

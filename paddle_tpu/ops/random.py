@@ -24,11 +24,7 @@ def _shape_attr(op, ctx=None):
     return shape
 
 
-def _key(ctx, op):
-    seed = op.attr("seed", 0)
-    if seed:
-        return jax.random.key(seed + op.uid)
-    return ctx.key_for(op.uid, op.type)
+from ._helpers import op_key as _key
 
 
 @register_op("gaussian_random", inputs=[], outputs=["Out"], differentiable=False)
@@ -84,3 +80,89 @@ def _shuffle_batch(ctx, op, ins):
     x = ins["X"][0]
     perm = jax.random.permutation(_key(ctx, op), x.shape[0])
     return {"Out": [jnp.take(x, perm, axis=0)]}
+
+
+# --- batch-size-like random fills (operators/uniform_random_batch_size_like
+# _op.cc, gaussian_random_batch_size_like_op.cc): shape attr with the batch
+# dim copied from Input at runtime-build time ---
+
+
+def _bsl_shape(op, ins):
+    x = ins["Input"][0]
+    shape = [int(s) for s in op.attr("shape")]
+    shape[op.attr("output_dim_idx", 0)] = x.shape[op.attr("input_dim_idx", 0)]
+    return shape
+
+
+@register_op(
+    "uniform_random_batch_size_like",
+    inputs=["Input"],
+    outputs=["Out"],
+    differentiable=False,
+)
+def _uniform_random_bsl(ctx, op, ins):
+    dtype = to_numpy_dtype(op.attr("dtype", "float32"))
+    out = jax.random.uniform(
+        _key(ctx, op),
+        _bsl_shape(op, ins),
+        minval=op.attr("min", -1.0),
+        maxval=op.attr("max", 1.0),
+        dtype=jnp.float32,
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op(
+    "gaussian_random_batch_size_like",
+    inputs=["Input"],
+    outputs=["Out"],
+    differentiable=False,
+)
+def _gaussian_random_bsl(ctx, op, ins):
+    dtype = to_numpy_dtype(op.attr("dtype", "float32"))
+    out = op.attr("mean", 0.0) + op.attr("std", 1.0) * jax.random.normal(
+        _key(ctx, op), _bsl_shape(op, ins), dtype=jnp.float32
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("sampling_id", inputs=["X"], outputs=["Out"], differentiable=False)
+def _sampling_id(ctx, op, ins):
+    # X rows are probabilities (sampling_id_op.cc draws u~U(min,max) and
+    # walks the cumsum); categorical over log-probs is the vectorized form
+    x = ins["X"][0]
+    ids = jax.random.categorical(_key(ctx, op), jnp.log(x + 1e-20), axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+@register_op(
+    "random_crop", inputs=["X", "Seed"], outputs=["Out", "SeedOut"],
+    differentiable=False,
+)
+def _random_crop(ctx, op, ins):
+    x = ins["X"][0]
+    shape = [int(s) for s in op.attr("shape")]
+    # leading dims (batch etc.) are kept; the trailing len(shape) dims crop
+    # at a random offset (random_crop_op.h ComputeRandomCrop)
+    lead = x.ndim - len(shape)
+    key = _key(ctx, op)
+    keys = jax.random.split(key, len(shape))
+    starts = [jnp.zeros((), jnp.int32)] * lead + [
+        jax.random.randint(k, (), 0, x.shape[lead + i] - s + 1)
+        for i, (k, s) in enumerate(zip(keys, shape))
+    ]
+    sizes = list(x.shape[:lead]) + shape
+    out = jax.lax.dynamic_slice(x, starts, sizes)
+    seed = ins.get("Seed", [None])[0]
+    seed_out = seed if seed is not None else jnp.zeros((1,), jnp.int64)
+    return {"Out": [out], "SeedOut": [seed_out]}
+
+
+@register_op("seed", inputs=[], outputs=["Out"], differentiable=False)
+def _seed(ctx, op, ins):
+    # emits the derived per-op seed (seed_op.cc feeds dropout determinism in
+    # the reference; here RNG is counter-based so this is informational)
+    s = op.attr("seed", 0)
+    if not s:
+        s = op.uid
+    return {"Out": [jnp.asarray([s], dtype=jnp.int32)]}

@@ -1,0 +1,448 @@
+"""Tensor-surface long tail: v1 aliases of the *2 ops, crop/diag/unbind,
+static-shape unique, scaffolding ops (print/assert/is_empty), and the
+SelectedRows utility trio.
+
+Reference files (paddle/fluid/operators/): reshape_op.cc, transpose_op.cc,
+squeeze_op.cc, unsqueeze_op.cc, unbind_op.cc, reverse_op.cc, fill_op.cc,
+fill_zeros_like_op.cc (fill_zeros_like2), crop_op.cc, crop_tensor_op.cc,
+diag_op.cc, is_empty_op.cc, reduce_ops/frobenius_norm_op.cc,
+partial_concat_op.cc, partial_sum_op.cc, unfold_op.cc, unique_op.cc,
+unique_with_counts_op.cc, scatter_nd_add_op.cc, hash_op.cc, print_op.cc,
+assert_op.cc, conv_shift_op.cc, get_tensor_from_selected_rows_op.cc,
+merge_selected_rows_op.cc, split_selected_rows_op.cc, py_func_op.cc.
+
+Dynamic-output-shape ops (unique) use jnp.unique's static `size=` form: the
+output is padded to the input length and an explicit count is returned —
+the TPU-native contract for ops whose reference semantics resize tensors.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import to_numpy_dtype
+from ..framework.registry import register_op
+
+
+def _resolve_shape(shape, x_shape):
+    out = []
+    for i, s in enumerate(shape):
+        out.append(int(x_shape[i]) if s == 0 else int(s))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# v1 aliases of the *2 ops (reference keeps both registrations; the v1 form
+# has no XShape output — reshape_op.cc vs reshape2 in the same file)
+# ---------------------------------------------------------------------------
+
+
+@register_op("reshape", inputs=["X"], outputs=["Out"])
+def _reshape(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": [jnp.reshape(x, _resolve_shape(op.attr("shape"), x.shape))]}
+
+
+@register_op("transpose", inputs=["X"], outputs=["Out"])
+def _transpose(ctx, op, ins):
+    return {"Out": [jnp.transpose(ins["X"][0], op.attr("axis"))]}
+
+
+@register_op("squeeze", inputs=["X"], outputs=["Out"])
+def _squeeze(ctx, op, ins):
+    x = ins["X"][0]
+    axes = op.attr("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+        out = jnp.squeeze(x, axis=axes) if axes else x
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": [out]}
+
+
+@register_op("unsqueeze", inputs=["X"], outputs=["Out"])
+def _unsqueeze(ctx, op, ins):
+    out = ins["X"][0]
+    for a in sorted(op.attr("axes")):
+        out = jnp.expand_dims(out, axis=a)
+    return {"Out": [out]}
+
+
+@register_op("unbind", inputs=["X"], outputs=["Out"])
+def _unbind(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", 0) % x.ndim
+    return {
+        "Out": [
+            jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, x.shape[axis], axis=axis)
+        ]
+    }
+
+
+@register_op("reverse", inputs=["X"], outputs=["Out"])
+def _reverse(ctx, op, ins):
+    x = ins["X"][0]
+    axes = [a % x.ndim for a in op.attr("axis")]
+    return {"Out": [jnp.flip(x, axis=axes)]}
+
+
+@register_op("fill", inputs=[], outputs=["Out"], differentiable=False)
+def _fill(ctx, op, ins):
+    dt = to_numpy_dtype(op.attr("dtype", "float32"))
+    data = np.asarray(op.attr("value"), dtype=np.float64)
+    return {"Out": [jnp.asarray(data.reshape(op.attr("shape")), dtype=dt)]}
+
+
+@register_op(
+    "fill_zeros_like2", inputs=["X"], outputs=["Out"], differentiable=False
+)
+def _fill_zeros_like2(ctx, op, ins):
+    x = ins["X"][0]
+    dt = op.attr("dtype", None)
+    dt = x.dtype if dt in (None, -1) else to_numpy_dtype(dt)
+    return {"Out": [jnp.zeros_like(x, dtype=dt)]}
+
+
+# ---------------------------------------------------------------------------
+# crop family (crop_op.cc: offsets attr or Offsets input; crop_tensor_op.cc
+# adds Shape/ShapeTensor inputs). Slice sizes are static (output shape comes
+# from attrs/graph-build), offsets may be runtime tensors → dynamic_slice.
+# ---------------------------------------------------------------------------
+
+
+def _crop_common(x, out_shape, offsets):
+    out_shape = [int(s) for s in out_shape]
+    if isinstance(offsets, (list, tuple)) or (
+        isinstance(offsets, np.ndarray)
+    ):
+        start = [int(o) for o in offsets]
+        idx = tuple(
+            slice(s, s + L) for s, L in zip(start, out_shape)
+        )
+        return x[idx]
+    # runtime offsets tensor → dynamic_slice with static sizes
+    starts = [offsets[i] for i in range(len(out_shape))]
+    return jax.lax.dynamic_slice(x, starts, out_shape)
+
+
+@register_op("crop", inputs=["X", "Y", "Offsets"], outputs=["Out"])
+def _crop(ctx, op, ins):
+    x = ins["X"][0]
+    y = ins.get("Y", [None])
+    shape = op.attr("shape", None)
+    if (not shape) and y and y[0] is not None:
+        shape = y[0].shape
+    offs = ins.get("Offsets", [None])
+    offsets = offs[0] if offs and offs[0] is not None else op.attr(
+        "offsets", [0] * x.ndim
+    )
+    return {"Out": [_crop_common(x, shape, offsets)]}
+
+
+@register_op(
+    "crop_tensor",
+    inputs=["X", "Shape", "Offsets"],
+    outputs=["Out"],
+)
+def _crop_tensor(ctx, op, ins):
+    x = ins["X"][0]
+    # output shape must be static under XLA: take the attr (graph-build
+    # value); a runtime Shape tensor only confirms it (crop_tensor_op.cc
+    # allows either — the static component is always present in the attr)
+    shape = op.attr("shape", None) or list(x.shape)
+    shape = _resolve_shape(shape, x.shape)
+    offs = ins.get("Offsets", [None])
+    offsets = offs[0] if offs and offs[0] is not None else op.attr(
+        "offsets", [0] * x.ndim
+    )
+    return {"Out": [_crop_common(x, shape, offsets)]}
+
+
+@register_op("diag", inputs=["Diagonal"], outputs=["Out"])
+def _diag(ctx, op, ins):
+    return {"Out": [jnp.diag(ins["Diagonal"][0])]}
+
+
+@register_op("is_empty", inputs=["X"], outputs=["Out"], differentiable=False)
+def _is_empty(ctx, op, ins):
+    return {"Out": [jnp.asarray(ins["X"][0].size == 0)]}
+
+
+@register_op("frobenius_norm", inputs=["X"], outputs=["Out"])
+def _frobenius_norm(ctx, op, ins):
+    x = ins["X"][0]
+    if op.attr("reduce_all", False):
+        axes = None
+    else:
+        dim = op.attr("dim", [0])
+        axes = tuple(d % x.ndim for d in (dim if dim else range(x.ndim)))
+    out = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes,
+                           keepdims=op.attr("keep_dim", False)))
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# partial concat/sum (CTR models: take a column window of every feature
+# matrix — partial_concat_op.cc:147, partial_sum_op.cc:147)
+# ---------------------------------------------------------------------------
+
+
+def _partial_slices(ins, op):
+    start = op.attr("start_index", 0)
+    length = op.attr("length", -1)
+    outs = []
+    for x in ins["X"]:
+        s = start % x.shape[1] if start < 0 else start
+        e = x.shape[1] if length == -1 else s + length
+        outs.append(x[:, s:e])
+    return outs
+
+
+@register_op("partial_concat", inputs=["X"], outputs=["Out"])
+def _partial_concat(ctx, op, ins):
+    return {"Out": [jnp.concatenate(_partial_slices(ins, op), axis=1)]}
+
+
+@register_op("partial_sum", inputs=["X"], outputs=["Out"])
+def _partial_sum(ctx, op, ins):
+    parts = _partial_slices(ins, op)
+    out = parts[0]
+    for p in parts[1:]:
+        out = out + p
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# unfold (im2col as an op, unfold_op.cc:23): [N,C,H,W] -> [N, C*kh*kw, L]
+# ---------------------------------------------------------------------------
+
+
+@register_op("unfold", inputs=["X"], outputs=["Y"])
+def _unfold(ctx, op, ins):
+    x = ins["X"][0]
+    kh, kw = op.attr("kernel_sizes")
+    sh, sw = op.attr("strides", [1, 1])
+    pads = op.attr("paddings", [0, 0, 0, 0])
+    dh, dw = op.attr("dilations", [1, 1])
+    if len(pads) == 2:
+        pads = [pads[0], pads[1], pads[0], pads[1]]
+    n, c, h, w = x.shape
+    x = jnp.pad(
+        x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3]))
+    )
+    oh = (x.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (x.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+    # extract patches via gather of strided windows: build index grids once
+    # (static), one gather per axis — XLA lowers this to an efficient copy
+    rows = (np.arange(oh)[:, None] * sh + np.arange(kh)[None, :] * dh)
+    cols = (np.arange(ow)[:, None] * sw + np.arange(kw)[None, :] * dw)
+    patches = x[:, :, rows, :][:, :, :, :, cols]
+    # [n, c, oh, kh, ow, kw] -> [n, c, kh, kw, oh*ow]
+    patches = jnp.transpose(patches, (0, 1, 3, 5, 2, 4))
+    return {"Y": [patches.reshape(n, c * kh * kw, oh * ow)]}
+
+
+# ---------------------------------------------------------------------------
+# unique (static-size form). The reference resizes Out to the number of
+# distinct values (unique_op.cc); under XLA output shapes are static, so Out
+# keeps the input length: the first `count` entries are the unique values
+# (first-occurrence order is NOT preserved — sorted order, as jnp.unique),
+# the tail is padded with the first unique value. Index maps each input
+# element to its position in Out, so gather(Out, Index) == X always holds.
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "unique", inputs=["X"], outputs=["Out", "Index"], differentiable=False
+)
+def _unique(ctx, op, ins):
+    x = ins["X"][0].reshape(-1)
+    out, inv = jnp.unique(x, return_inverse=True, size=x.size)
+    idx_dt = to_numpy_dtype(op.attr("dtype", "int64"))
+    return {"Out": [out], "Index": [inv.astype(idx_dt)]}
+
+
+@register_op(
+    "unique_with_counts",
+    inputs=["X"],
+    outputs=["Out", "Index", "Count"],
+    differentiable=False,
+)
+def _unique_with_counts(ctx, op, ins):
+    x = ins["X"][0].reshape(-1)
+    out, inv, counts = jnp.unique(
+        x, return_inverse=True, return_counts=True, size=x.size
+    )
+    idx_dt = to_numpy_dtype(op.attr("dtype", "int64"))
+    return {
+        "Out": [out],
+        "Index": [inv.astype(idx_dt)],
+        "Count": [counts.astype(idx_dt)],
+    }
+
+
+@register_op("scatter_nd_add", inputs=["X", "Index", "Updates"], outputs=["Out"])
+def _scatter_nd_add(ctx, op, ins):
+    x, index, updates = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    idx = tuple(index[..., i] for i in range(index.shape[-1]))
+    return {"Out": [x.at[idx].add(updates)]}
+
+
+# ---------------------------------------------------------------------------
+# hash (hash_op.cc:53 num_hash/mod_by; reference uses xxhash over raw bytes).
+# TPU-native: a multiply-xorshift integer mix with a distinct odd constant
+# per hash slot — same contract (num_hash deterministic hashes mod mod_by),
+# vectorized over the id tensor instead of a per-row CPU loop.
+# ---------------------------------------------------------------------------
+
+
+@register_op("hash", inputs=["X"], outputs=["Out"], differentiable=False)
+def _hash(ctx, op, ins):
+    from ._helpers import hash_mix
+
+    x = ins["X"][0].astype(jnp.uint32)
+    num_hash = op.attr("num_hash", 1)
+    mod_by = op.attr("mod_by", 100000)
+    h = hash_mix(x, num_hash)  # [..., cols, num_hash]
+    # combine the id columns of each slot (reference hashes the whole row)
+    h = jnp.sum(jnp.swapaxes(h, -1, -2), axis=-1, keepdims=True,
+                dtype=jnp.uint32)
+    out = (h % jnp.uint32(mod_by)).astype(jnp.int64)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# conv_shift (conv_shift_op.cc: circular correlation, NTM addressing):
+# X [B, M], Y [B, N] (N odd, N <= M) -> Out[b, i] = sum_j Y[b,j] *
+# X[b, (i + j - N/2) mod M]
+# ---------------------------------------------------------------------------
+
+
+@register_op("conv_shift", inputs=["X", "Y"], outputs=["Out"])
+def _conv_shift(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    m, n = x.shape[1], y.shape[1]
+    half = n // 2
+    # gather the N circularly-shifted copies of X once: [B, N, M]
+    shift_idx = (np.arange(m)[None, :] + np.arange(n)[:, None] - half) % m
+    shifted = x[:, shift_idx]  # [B, N, M]
+    return {"Out": [jnp.einsum("bn,bnm->bm", y, shifted)]}
+
+
+# ---------------------------------------------------------------------------
+# scaffolding: print / assert / delete_var (print_op.cc, assert_op.cc,
+# controlflow/op_variant.cc delete_var). print forwards its input and logs
+# via jax.debug.print (works inside jit; the reference prints on the host
+# between op dispatches — same observable effect).
+# ---------------------------------------------------------------------------
+
+
+@register_op("print", inputs=["In"], outputs=["Out"])
+def _print(ctx, op, ins):
+    x = ins["In"][0]
+    if not ctx.abstract:
+        jax.debug.print(
+            op.attr("message", "") + " {}", x, ordered=False
+        )
+    return {"Out": [x]}
+
+
+@register_op("assert", inputs=["Cond", "Data"], outputs=[], differentiable=False)
+def _assert(ctx, op, ins):
+    cond = ins["Cond"][0]
+    summarize = op.attr("summarize", -1)
+    if not ctx.abstract:
+        def _check(c):
+            if not np.all(np.asarray(c)):
+                raise AssertionError(
+                    f"assert op failed (summarize={summarize})"
+                )
+
+        jax.debug.callback(_check, cond)
+    return {}
+
+
+@register_op("delete_var", inputs=["X"], outputs=[], differentiable=False)
+def _delete_var(ctx, op, ins):
+    # buffer lifetime is XLA's job here (donation + liveness); the op exists
+    # for graph parity and is a no-op at trace time
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows utilities. This framework's sparse design keeps gradients
+# dense (or row-sharded tables, ops/sparse.py), so a "SelectedRows" at the
+# op surface is the (rows, ids) pair the emitters already produce; the
+# utility trio operates on the dense form.
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "get_tensor_from_selected_rows", inputs=["X"], outputs=["Out"]
+)
+def _get_tensor_from_selected_rows(ctx, op, ins):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("merge_selected_rows", inputs=["X"], outputs=["Out"])
+def _merge_selected_rows(ctx, op, ins):
+    # dense rows are already merged (duplicate ids summed by scatter-add at
+    # producer site, merge_selected_rows_op.cc role)
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("split_selected_rows", inputs=["X"], outputs=["Out"])
+def _split_selected_rows(ctx, op, ins):
+    x = ins["X"][0]
+    height_sections = op.attr("height_sections", [])
+    if not height_sections:
+        return {"Out": [x]}
+    idx = np.cumsum(height_sections[:-1]).tolist()
+    return {"Out": list(jnp.split(x, idx, axis=0))}
+
+
+# ---------------------------------------------------------------------------
+# py_func (py_func_op.cc): host python inside the compiled graph via
+# jax.pure_callback — the TPU-native replacement for the reference's
+# pybind-trampoline kernel. The callable is looked up in a host registry
+# by the op's handle attr.
+# ---------------------------------------------------------------------------
+
+PY_FUNC_REGISTRY: list = []
+
+
+def register_py_func(fn) -> int:
+    """Register fn; returns the handle to store in the op's attr."""
+    PY_FUNC_REGISTRY.append(fn)
+    return len(PY_FUNC_REGISTRY) - 1
+
+
+@register_op("py_func", inputs=["X"], outputs=["Out"], differentiable=False)
+def _py_func(ctx, op, ins):
+    fn = PY_FUNC_REGISTRY[int(op.attr("forward_callable_id"))]
+    xs = ins["X"]
+    out_block_shapes = op.attr("out_shapes", None)
+    out_dtypes = op.attr("out_dtypes", None)
+    if out_block_shapes is None:
+        # same-shape contract when undeclared
+        result_shape = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs]
+    else:
+        result_shape = [
+            jax.ShapeDtypeStruct(tuple(s), to_numpy_dtype(d))
+            for s, d in zip(out_block_shapes, out_dtypes)
+        ]
+
+    def host_fn(*arrays):
+        res = fn(*arrays)
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return [np.asarray(r) for r in res]
+
+    outs = jax.pure_callback(host_fn, result_shape, *xs)
+    return {"Out": list(outs)}

@@ -17,3 +17,39 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import copy  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_global_state():
+    """Snapshot/restore every piece of process-global framework state so a
+    test that mutates flags, the active mesh, the current scope, or the
+    default programs cannot leak into later tests (order-dependent failures,
+    e.g. the round-2 test_compiled_program_data_parallel_runs flake)."""
+    from paddle_tpu import flags as _flags
+    from paddle_tpu.framework import program as _prog
+    from paddle_tpu.framework import scope as _scope
+    from paddle_tpu.framework import unique_name as _un
+    from paddle_tpu.parallel import mesh as _mesh
+
+    saved_flags = copy.deepcopy(_flags._FLAGS)
+    saved_mesh = _mesh._current_mesh
+    saved_scope = _scope._current_scope
+    saved_main = _prog._main_program
+    saved_startup = _prog._startup_program
+    saved_device = _prog._current_device
+    saved_gen = _un._generator
+    try:
+        yield
+    finally:
+        _flags._FLAGS.clear()
+        _flags._FLAGS.update(saved_flags)
+        _mesh._current_mesh = saved_mesh
+        _scope._current_scope = saved_scope
+        _prog._main_program = saved_main
+        _prog._startup_program = saved_startup
+        _prog._current_device = saved_device
+        _un._generator = saved_gen

@@ -86,6 +86,34 @@ SUBSUMED = {
     "dgc": "intentional degrade: bf16 grads over ICI (fleet strategy doc)",
     "dgc_clip_by_norm": "intentional degrade (see dgc)",
     "dgc_momentum": "intentional degrade (see dgc)",
+    # host data-queue plumbing: the native DataLoader/Dataset pipeline
+    # (dataloader/, dataset/) owns queues; no in-graph queue ops exist
+    "enqueue": "dataloader host queues",
+    "dequeue": "dataloader host queues",
+    "queue_generator": "dataloader host queues",
+    # BoxPS / PS fetch-push plane: capability delivered by the sharded
+    # in-HBM tables + async PS engine (ops/sparse.py,
+    # fleet/parameter_server.py, distributed_lookup_table 18/18 covered)
+    "pull_box_sparse": "sharded tables (ops/sparse.py)",
+    "pull_box_extended_sparse": "sharded tables (ops/sparse.py)",
+    "push_box_sparse": "sharded tables (ops/sparse.py)",
+    "push_box_extended_sparse": "sharded tables (ops/sparse.py)",
+    "pull_sparse": "sharded tables (ops/sparse.py)",
+    "pull_sparse_v2": "sharded tables (ops/sparse.py)",
+    "push_sparse": "sharded tables (ops/sparse.py)",
+    "push_sparse_v2": "sharded tables (ops/sparse.py)",
+    "push_dense": "sharded tables (ops/sparse.py)",
+    # RNN-era scaffolding replaced by scan_block (ops/control_flow.py)
+    "recurrent": "scan_block (StaticRNN -> lax.scan)",
+    "rnn_memory_helper": "scan_block carries",
+    "shrink_rnn_memory": "padded+lengths design (masked carries)",
+    "reorder_lod_tensor_by_rank": "padded+lengths design",
+    "merge_lod_tensor_infer": "lax.cond/select on dense tensors",
+    # dygraph-to-static execution: @declarative jit capture
+    "run_program": "dygraph/dygraph_to_static.py jit capture",
+    # grad kernel registered as a standalone op name in the reference;
+    # grads here are synthesized by the generic __vjp__ machinery
+    "cross_entropy_grad2": "generic __vjp__ grad synthesis",
 }
 
 # directory-wide subsumption: every op under these reference directories is
