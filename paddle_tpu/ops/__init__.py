@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     crf,
     ctr_ops,
     detection,
+    detection_ext,
     fused,
     loss_ext,
     math,

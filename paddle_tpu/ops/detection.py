@@ -207,22 +207,15 @@ def _greedy_nms(boxes_k, keep_pred, nms_thresh):
     return alive
 
 
-@register_op(
-    "multiclass_nms", inputs=["BBoxes", "Scores"],
-    outputs=["Out", "NmsRoisNum"], differentiable=False,
-)
-def _multiclass_nms(ctx, op, ins):
-    """Fixed-size NMS (multiclass_nms_op.cc re-designed for static shapes):
-    per class, greedy-suppress by IoU, keep score_threshold survivors, then
-    global keep_top_k by score. Out [B, keep_top_k, 6] rows
-    [label, score, x0, y0, x1, y1], invalid rows label=-1; NmsRoisNum [B].
-    """
-    boxes = ins["BBoxes"][0]  # [B, N, 4]
-    scores = ins["Scores"][0]  # [B, C, N] (reference layout)
-    score_thresh = op.attr("score_threshold", 0.0)
-    nms_thresh = op.attr("nms_threshold", 0.3)
-    nms_top_k = op.attr("nms_top_k", 64)
-    keep_top_k = op.attr("keep_top_k", 16)
+def multiclass_nms_core(boxes, scores, attrs):
+    """Shared NMS core for multiclass_nms / multiclass_nms2: per class,
+    greedy-suppress by IoU, then global keep_top_k by score. Returns
+    (out [B, kk, 6], num [B], in_idx [B, kk]) where in_idx is the kept
+    row's index into the INPUT box set (-1 for padded rows)."""
+    score_thresh = attrs.get("score_threshold", 0.0)
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", 64)
+    keep_top_k = attrs.get("keep_top_k", 16)
     B, C, N = scores.shape
     k = min(nms_top_k, N)
 
@@ -243,13 +236,28 @@ def _multiclass_nms(ctx, op, ins):
         top_sc, top_i = lax.top_k(flat_scores, kk)
         valid = top_sc > jnp.maximum(score_thresh, 0.0)
         lab = jnp.where(valid, labels[top_i], -1).astype(jnp.float32)
-        bx = b_boxes[flat_idx[top_i]]
+        src = flat_idx[top_i]
+        bx = b_boxes[src]
         out = jnp.concatenate(
             [lab[:, None], top_sc[:, None], bx], axis=-1
         )
-        return out, valid.sum().astype(jnp.int32)
+        in_idx = jnp.where(valid, src, -1).astype(jnp.int32)
+        return out, valid.sum().astype(jnp.int32), in_idx
 
-    out, num = jax.vmap(one_image)(boxes, scores)
+    return jax.vmap(one_image)(boxes, scores)
+
+
+@register_op(
+    "multiclass_nms", inputs=["BBoxes", "Scores"],
+    outputs=["Out", "NmsRoisNum"], differentiable=False,
+)
+def _multiclass_nms(ctx, op, ins):
+    """Fixed-size NMS (multiclass_nms_op.cc re-designed for static shapes):
+    Out [B, keep_top_k, 6] rows [label, score, x0, y0, x1, y1], invalid
+    rows label=-1; NmsRoisNum [B]."""
+    out, num, _ = multiclass_nms_core(
+        ins["BBoxes"][0], ins["Scores"][0], op.attrs
+    )
     return {"Out": [out], "NmsRoisNum": [num]}
 
 

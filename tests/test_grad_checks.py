@@ -140,6 +140,48 @@ CASES = [
     ("clip", "clip",
      {"X": smooth(3, 4) * 0.4}, {"min": -0.9, "max": 0.9}, ["X"], {}),
     ("dot", "dot", {"X": smooth(5), "Y": smooth(5)}, {}, ["X", "Y"], {}),
+    # --- round-3 op-surface additions ---
+    ("prelu", "prelu",
+     {"X": smooth(2, 3, 4), "Alpha": positive(1)}, {"mode": "all"},
+     ["X", "Alpha"], {}),
+    ("row_conv", "row_conv",
+     {"X": smooth(2, 5, 3), "Filter": smooth(2, 3)}, {},
+     ["X", "Filter"], {}),
+    ("conv_shift", "conv_shift",
+     {"X": smooth(2, 8), "Y": smooth(2, 3)}, {}, ["X", "Y"], {}),
+    ("unfold", "unfold",
+     {"X": smooth(1, 2, 4, 4)},
+     {"kernel_sizes": [2, 2], "strides": [1, 1],
+      "paddings": [0, 0, 0, 0], "dilations": [1, 1]},
+     ["X"], {"output_slot": "Y"}),
+    ("partial_sum", "partial_sum",
+     {"X": smooth(2, 5)}, {"start_index": 1, "length": 2}, ["X"], {}),
+    ("frobenius_norm", "frobenius_norm",
+     {"X": positive(3, 4)}, {"reduce_all": True}, ["X"], {}),
+    ("fsp", "fsp",
+     {"X": smooth(2, 3, 4, 4), "Y": smooth(2, 2, 4, 4)}, {},
+     ["X", "Y"], {}),
+    ("batch_fc", "batch_fc",
+     {"Input": smooth(2, 3, 4), "W": smooth(2, 4, 5), "Bias": smooth(2, 1, 5)},
+     {}, ["Input", "W", "Bias"], {}),
+    ("warpctc", "warpctc",
+     {"Logits": smooth(2, 5, 4), "Label": np.asarray([[1, 2], [2, 3]], np.int64),
+      "LogitsLength": np.asarray([5, 5], np.int64),
+      "LabelLength": np.asarray([2, 2], np.int64)},
+     {"blank": 0}, ["Logits"], {"output_slot": "Loss", "rtol": 3e-2}),
+    ("teacher_student_sigmoid_loss", "teacher_student_sigmoid_loss",
+     {"X": smooth(4, 1), "Label": positive(4, 1) * 0.5}, {},
+     ["X"], {"output_slot": "Y"}),
+    ("spectral_norm", "spectral_norm",
+     {"Weight": smooth(3, 4), "U": smooth(3), "V": smooth(4)},
+     {"dim": 0, "power_iters": 0, "eps": 1e-12},
+     ["Weight"], {"rtol": 3e-2, "atol": 3e-4}),
+    ("spp_avg", "spp",
+     {"X": smooth(1, 2, 5, 5)},
+     {"pyramid_height": 2, "pooling_type": "avg"}, ["X"], {}),
+    ("scatter_nd_add", "scatter_nd_add",
+     {"X": smooth(3, 3), "Index": np.asarray([[0, 0], [1, 2]], np.int64),
+      "Updates": smooth(2)}, {}, ["X", "Updates"], {}),
 ]
 
 

@@ -1,0 +1,83 @@
+"""Mask R-CNN assembly test (VERDICT r2 item 3): tiny-config train step
+with finite losses that decrease, plus the inference decode path.
+Mirrors tests/test_yolov3.py's shape: one synthetic image, dense gt
+contract (boxes + classes + per-gt bitmap masks)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+from paddle_tpu.models import mask_rcnn
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup
+
+
+def _feed(rng, size=64, n_gt=2):
+    img = rng.rand(1, 3, size, size).astype(np.float32)
+    gt_boxes = np.array([[4, 4, 30, 30], [34, 34, 60, 60]], np.float32)
+    gt_classes = np.array([1, 2], np.int32)
+    is_crowd = np.zeros(2, np.int32)
+    segms = np.zeros((2, size, size), np.float32)
+    segms[0, 4:31, 4:31] = 1
+    segms[1, 34:61, 34:61] = 1
+    im_info = np.array([[size, size, 1.0]], np.float32)
+    return {"image": img, "gt_boxes": gt_boxes, "gt_classes": gt_classes,
+            "is_crowd": is_crowd, "gt_segms": segms, "im_info": im_info}
+
+
+def test_mask_rcnn_train_step_converges(fresh):
+    cfg = mask_rcnn.MaskRCNNConfig.tiny()
+    image = fluid.data("image", [1, 3, 64, 64])
+    gt_boxes = fluid.data("gt_boxes", [2, 4])
+    gt_classes = fluid.data("gt_classes", [2], dtype="int32")
+    is_crowd = fluid.data("is_crowd", [2], dtype="int32")
+    gt_segms = fluid.data("gt_segms", [2, 64, 64])
+    im_info = fluid.data("im_info", [1, 3])
+
+    losses = mask_rcnn.mask_rcnn_train(
+        image, gt_boxes, gt_classes, is_crowd, gt_segms, im_info, cfg
+    )
+    total = losses[0]
+    fluid.optimizer.SGD(0.01).minimize(total)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = _feed(rng)
+    vals = []
+    for _ in range(12):
+        out = exe.run(feed=feed, fetch_list=list(losses))
+        vals.append([float(np.asarray(v).reshape(-1)[0]) for v in out])
+    totals = [v[0] for v in vals]
+    assert all(np.isfinite(v) for row in vals for v in row), vals[0]
+    # the per-step RNG re-samples the fg/bg minibatch (reference
+    # use_random=True), so compare a trailing average, not single steps
+    assert np.mean(totals[-3:]) < totals[0], totals
+
+
+def test_mask_rcnn_infer_shapes(fresh):
+    cfg = mask_rcnn.MaskRCNNConfig.tiny()
+    image = fluid.data("image", [1, 3, 64, 64])
+    im_info = fluid.data("im_info", [1, 3])
+    out, mlogits = mask_rcnn.mask_rcnn_infer(image, im_info, cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    dets, masks = exe.run(
+        feed={"image": rng.rand(1, 3, 64, 64).astype(np.float32),
+              "im_info": np.array([[64, 64, 1.0]], np.float32)},
+        fetch_list=[out, mlogits],
+    )
+    dets = np.asarray(dets)
+    masks = np.asarray(masks)
+    assert dets.ndim >= 2 and dets.shape[-1] == 6
+    assert masks.shape[1] == cfg.class_num
