@@ -57,12 +57,13 @@ def supports(seq_len: int, head_dim: int, dtype) -> bool:
 
 
 def _probs(q, k, bias_row, scale, causal):
-    """fp32 softmax probabilities for one head: q [S,D], k [S,D], bias [1,S]."""
-    s = jnp.dot(
-        q.astype(jnp.float32),
-        k.astype(jnp.float32).T,
-        preferred_element_type=jnp.float32,
-    ) * scale
+    """fp32 softmax probabilities for one head: q [S,D], k [S,D], bias [1,S].
+
+    Matmul inputs keep the MODEL dtype (bf16 under AMP) with fp32
+    accumulation (preferred_element_type) — upcasting the inputs would run
+    the MXU in fp32 mode at a fraction of bf16 throughput; softmax math on
+    the fp32 scores is unchanged either way."""
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     s = s + bias_row  # [1,S] broadcasts over query rows
     if causal:
         n = s.shape[0]
@@ -72,6 +73,22 @@ def _probs(q, k, bias_row, scale, causal):
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     return p / jnp.sum(p, axis=-1, keepdims=True)
+
+
+def _probs_unnorm(q, k, bias_row, scale, causal):
+    """(exp(s - m), rowsum) — normalization deferred so the forward can
+    scale the [S, D] output instead of the [S, S] probabilities (one less
+    full-tile VPU pass; softmax cost dominates the kernel at D=64)."""
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    s = s + bias_row
+    if causal:
+        n = s.shape[0]
+        row = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+        s = jnp.where(col <= row, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    return e, jnp.sum(e, axis=-1, keepdims=True)
 
 
 def _seed_prng(seed_ref):
@@ -107,36 +124,40 @@ def _apply_dropout(p, rate, is_test, upscale):
 def _head_fwd(q, k, v, bias_row, scale, rate, is_test, upscale, causal):
     """One head's attention output [S, D] (fp32). Draws ONE dropout mask
     from the already-seeded PRNG when training with dropout — callers must
-    keep the per-head call order identical between forward and backward."""
-    p = _probs(q, k, bias_row, scale, causal)
-    p = _apply_dropout(p, rate, is_test, upscale)
-    return jnp.dot(p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
+    keep the per-head call order identical between forward and backward.
+
+    The softmax division is applied to the [S, D] OUTPUT rows (1/l), not
+    the [S, S] probabilities — dropout commutes with the row-scale, so the
+    math is identical and a full score-tile VPU pass disappears."""
+    e, l = _probs_unnorm(q, k, bias_row, scale, causal)
+    e = _apply_dropout(e, rate, is_test, upscale)
+    out = jnp.dot(e.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    return out / l
 
 
 def _head_bwd(q, k, v, bias_row, do, scale, rate, is_test, upscale, causal):
     """One head's (dq, dk, dv [S,D] fp32, dbias [1,S]); same single PRNG
     draw as _head_fwd."""
     p = _probs(q, k, bias_row, scale, causal)
-    kf = k.astype(jnp.float32)
-    qf = q.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
+    dob = do.astype(v.dtype)  # bf16 MXU inputs, fp32 accumulation
     if rate > 0.0 and not is_test:
         keep = _keep_mask(p.shape, rate)
         inv = 1.0 / (1.0 - rate) if upscale else 1.0
         pm = jnp.where(keep, p * inv, 0.0)
-        dpm = jnp.dot(do, vf.T, preferred_element_type=jnp.float32)
+        dpm = jnp.dot(dob, v.T, preferred_element_type=jnp.float32)
         dp = jnp.where(keep, dpm * inv, 0.0)
     else:
         test_scale = 1.0 if (rate == 0.0 or upscale) else 1.0 - rate
         pm = p * test_scale
-        dpm = jnp.dot(do, vf.T, preferred_element_type=jnp.float32)
+        dpm = jnp.dot(dob, v.T, preferred_element_type=jnp.float32)
         dp = dpm * test_scale
-    dv = jnp.dot(pm.T, do, preferred_element_type=jnp.float32)
+    dv = jnp.dot(pm.astype(v.dtype).T, dob, preferred_element_type=jnp.float32)
     # softmax backward: dS = P * (dP - rowsum(dP * P))
     d = jnp.sum(dp * p, axis=-1, keepdims=True)
     ds = p * (dp - d)
-    dq = jnp.dot(ds, kf, preferred_element_type=jnp.float32) * scale
-    dk = jnp.dot(ds.T, qf, preferred_element_type=jnp.float32) * scale
+    dsb = ds.astype(v.dtype)
+    dq = jnp.dot(dsb, k, preferred_element_type=jnp.float32) * scale
+    dk = jnp.dot(dsb.T, q, preferred_element_type=jnp.float32) * scale
     return dq, dk, dv, jnp.sum(ds, axis=0, keepdims=True)
 
 
@@ -460,6 +481,26 @@ def fused_attention_qkv(
         and supports_packed(S, num_heads, D, qkv.dtype)
     )
     if not use_pallas:
+        from .flash_tiled import flash_tiled, supports_tiled
+
+        if (
+            not force_reference
+            and (interpret or jax.default_backend() == "tpu")
+            and S > MAX_SEQ
+            and supports_tiled(S, num_heads, D, qkv.dtype)
+        ):
+            # beyond the whole-row cap: KV-tiled online-softmax kernel
+            # (flash_tiled.py) — same packed layout, any S
+            if interpret and training_dropout:
+                raise ValueError(
+                    "fused_attention_qkv: training dropout is unsupported "
+                    "in interpret mode (interpreter PRNG is a stub)"
+                )
+            seed = _seed_words(rng_key)
+            return flash_tiled(
+                qkv, bias, seed, num_heads, D, tuple(statics.items()),
+                interpret,
+            )
         if (
             not force_reference
             and not interpret
@@ -539,6 +580,24 @@ def attention_grads_qkv(qkv, num_heads, key_bias, d_out, rng_key, *,
         seed = _seed_words(rng_key)
         return _pallas_bwd_qkv(
             qkv, bias, seed, d_out, num_heads, D, statics, interpret
+        )
+    from .flash_tiled import flash_tiled_bwd, flash_tiled_fwd, supports_tiled
+
+    if (
+        not force_reference
+        and (interpret or jax.default_backend() == "tpu")
+        and S > MAX_SEQ
+        and supports_tiled(S, num_heads, D, qkv.dtype)
+    ):
+        # tiled path: re-run the (cheap relative to bwd) forward for the
+        # saved logsumexp, then the two-kernel tiled backward
+        seed = _seed_words(rng_key)
+        out, lse = flash_tiled_fwd(
+            qkv, bias, seed, num_heads, D, statics, interpret
+        )
+        return flash_tiled_bwd(
+            qkv, bias, seed, d_out, out, lse, num_heads, D, statics,
+            interpret,
         )
     if (
         not force_reference

@@ -1,0 +1,451 @@
+"""KV-block-tiled flash attention (online softmax) — removes the
+whole-row kernel's MAX_SEQ cap (flash_attention.py keeps an [S, S] score
+tile in VMEM; here VMEM holds one [BQ, BK] tile regardless of S).
+
+Layout matches the packed-QKV kernels: qkv [B, S, 3*H*D] indexed in place,
+one 128-lane head group (G = 128//D heads) per grid step, out [B, S, H*D].
+
+Forward: grid (B, groups, S//BQ, S//BK), kv innermost. Scratch carries the
+online-softmax state (running max m, running sum l, unnormalized
+accumulator acc) across kv steps; the output block (indexed by q) is
+written on the LAST kv step. The row logsumexp L = m + log(l) is saved for
+the backward.
+
+Backward: flash attention's standard two-kernel split (dq needs a sum over
+kv, dk/dv over q — one grid cannot accumulate both):
+  * dkv kernel: grid (..., KB, QB), q innermost; p recomputed per tile
+    from the saved L (no renormalization pass), dk/dv accumulate in
+    scratch, written on the last q step. Per-q-block partial dbias rows
+    emit to a [QB, S] buffer summed outside.
+  * dq kernel: grid (..., QB, KB), kv innermost; dq accumulates in
+    scratch. Needs delta = rowsum(do * o), precomputed outside (cheap
+    elementwise XLA pass, the FlashAttention-2 formulation).
+
+Dropout regenerates per-tile masks from a seed mixed with
+(batch, head, q-block, kv-block) — order-independent, so the three kernels
+(fwd, dkv, dq) draw identical masks for the same tile regardless of their
+different loop orders. Semantics match fluid dropout exactly as in
+flash_attention.py.
+
+Reference role: operators/fused/multihead_matmul_op.cu — but that kernel
+is whole-row too; the tiled form is what long-context needs
+(sequence-parallel ring attention composes on top, parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+BQ = 512   # query rows per tile
+BK = 512   # kv rows per tile
+
+
+def supports_tiled(seq_len: int, num_heads: int, head_dim: int, dtype):
+    g = 128 // head_dim if head_dim and 128 % head_dim == 0 else 0
+    return (
+        g > 0
+        and num_heads % g == 0
+        and seq_len % BQ == 0
+        and seq_len % BK == 0
+        and jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                 jnp.dtype(jnp.bfloat16))
+    )
+
+
+def _mix(*words):
+    acc = jnp.uint32(0x9E3779B9)
+    for w in words:
+        acc = (acc ^ w.astype(jnp.uint32)) * jnp.uint32(0x85EBCA6B)
+        acc = acc ^ (acc >> 13)
+    return acc
+
+
+def _seed_tile(seed_ref, head, qb, kb):
+    b = pl.program_id(0).astype(jnp.uint32)
+    s0 = seed_ref[0] + _mix(b, head, qb.astype(jnp.uint32))
+    s1 = seed_ref[1] ^ _mix(kb.astype(jnp.uint32), head, b)
+    pltpu.prng_seed(s0, s1)
+
+
+def _keep(shape, rate):
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    thresh = np.uint32(min(int(rate * 2**32), 0xFFFFFFFF))
+    return bits >= thresh
+
+
+def _tile_scores(q, k, bias_tile, scale, causal, qb, kb):
+    """[BQ, BK] fp32 scores for one head; causal mask in global coords."""
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    s = s + bias_tile
+    if causal:
+        row = qb * BQ + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = kb * BK + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col <= row, s, NEG_INF)
+    return s
+
+
+def _dropout_tile(e, rate, is_test, upscale, seed_ref, head, qb, kb):
+    if rate == 0.0:
+        return e
+    if is_test:
+        return e if upscale else e * (1.0 - rate)
+    _seed_tile(seed_ref, head, qb, kb)
+    keep = _keep(e.shape, rate)
+    return jnp.where(keep, e / (1.0 - rate) if upscale else e, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, D, scale, rate, is_test, upscale, causal):
+    qb = pl.program_id(2)
+    kb = pl.program_id(3)
+    nk = pl.num_programs(3)
+    G = 128 // D
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal tiles above the diagonal contribute 0 via the NEG_INF mask;
+    # skipping them with pl.when would need the same scratch updates, so
+    # masking is simpler and the wasted tiles are < half the grid
+    bias_tile = bias_ref[0]  # [1, BK]
+    for i in range(G):
+        sl = slice(i * D, (i + 1) * D)
+        q = q_ref[0, :, sl]
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
+        head = (pl.program_id(1) * G + i)
+        s = _tile_scores(q, k, bias_tile, scale, causal, qb, kb)
+        m_prev = m_scr[:, sl][:, :1]  # [BQ, 1] (per-head col block)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # rescale of previous state
+        e = jnp.exp(s - m_new)
+        l_prev = l_scr[:, sl][:, :1]
+        l_new = l_prev * alpha + jnp.sum(e, axis=-1, keepdims=True)
+        ed = _dropout_tile(
+            e, rate, is_test, upscale, seed_ref, head.astype(jnp.uint32),
+            qb, kb,
+        )
+        pv = jnp.dot(ed.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        acc_scr[:, sl] = acc_scr[:, sl] * alpha + pv
+        m_scr[:, sl] = jnp.broadcast_to(m_new, (m_new.shape[0], D))
+        l_scr[:, sl] = jnp.broadcast_to(l_new, (l_new.shape[0], D))
+
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        for i in range(G):
+            sl = slice(i * D, (i + 1) * D)
+            l = l_scr[:, sl][:, :1]
+            o_ref[0, :, sl] = (
+                acc_scr[:, sl] / jnp.maximum(l, 1e-30)
+            ).astype(o_ref.dtype)
+            # row logsumexp for the backward: L = m + log(l)
+            lse_ref[0, :, sl] = jnp.broadcast_to(
+                m_scr[:, sl][:, :1] + jnp.log(jnp.maximum(l, 1e-30)),
+                (l.shape[0], D),
+            )
+
+
+def _q_spec(section, num_groups):
+    return pl.BlockSpec(
+        (1, BQ, 128),
+        lambda b, g, qb, kb: (b, qb, section * num_groups + g),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _kv_spec(section, num_groups):
+    return pl.BlockSpec(
+        (1, BK, 128),
+        lambda b, g, qb, kb: (b, kb, section * num_groups + g),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _bias_spec():
+    return pl.BlockSpec(
+        (1, 1, BK), lambda b, g, qb, kb: (b, 0, kb),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _out_spec():
+    return pl.BlockSpec(
+        (1, BQ, 128), lambda b, g, qb, kb: (b, qb, g),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def flash_tiled_fwd(qkv, bias, seed, H, D, statics, interpret=False):
+    """qkv [B, S, 3*H*D]; bias [B, S] -> (out [B, S, H*D], lse [B, S, H*D])."""
+    B, S, _ = qkv.shape
+    G = H * D // 128
+    bias3 = bias.reshape(B, 1, S)
+    kern = functools.partial(_fwd_kernel, D=D, **statics)
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(B, G, S // BQ, S // BK),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            _q_spec(0, G),
+            _kv_spec(1, G),
+            _kv_spec(2, G),
+            _bias_spec(),
+        ],
+        out_specs=[_out_spec(), _out_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
+            jax.ShapeDtypeStruct((B, S, H * D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BQ, 128), jnp.float32),
+            pltpu.VMEM((BQ, 128), jnp.float32),
+            pltpu.VMEM((BQ, 128), jnp.float32),
+        ],
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed, qkv, qkv, qkv, bias3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _tile_probs_from_lse(q, k, bias_tile, lse_col, scale, causal, qb, kb):
+    s = _tile_scores(q, k, bias_tile, scale, causal, qb, kb)
+    return jnp.exp(s - lse_col)  # [BQ, BK] normalized probabilities
+
+
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                delta_ref, dk_ref, dv_ref, dbias_ref, dk_scr, dv_scr,
+                *, D, scale, rate, is_test, upscale, causal):
+    kb = pl.program_id(2)
+    qb = pl.program_id(3)
+    nq = pl.num_programs(3)
+    G = 128 // D
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    bias_tile = bias_ref[0]
+    db_rows = jnp.zeros((1, BK), jnp.float32)
+    for i in range(G):
+        sl = slice(i * D, (i + 1) * D)
+        q = q_ref[0, :, sl]
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
+        do = do_ref[0, :, sl]
+        lse_col = lse_ref[0, :, sl][:, :1]
+        delta_col = delta_ref[0, :, sl][:, :1]
+        head = pl.program_id(1) * G + i
+        p = _tile_probs_from_lse(q, k, bias_tile, lse_col, scale, causal,
+                                 qb, kb)
+        if rate > 0.0 and not is_test:
+            _seed_tile(seed_ref, head.astype(jnp.uint32), qb, kb)
+            keep = _keep(p.shape, rate)
+            inv = 1.0 / (1.0 - rate) if upscale else 1.0
+            pm = jnp.where(keep, p * inv, 0.0)
+            dpm = jnp.dot(do.astype(v.dtype), v.T,
+                          preferred_element_type=jnp.float32)
+            dp = jnp.where(keep, dpm * inv, 0.0)
+        else:
+            ts = 1.0 if (rate == 0.0 or upscale) else 1.0 - rate
+            pm = p * ts
+            dp = jnp.dot(do.astype(v.dtype), v.T,
+                         preferred_element_type=jnp.float32) * ts
+        dv_scr[:, sl] += jnp.dot(
+            pm.astype(v.dtype).T, do.astype(v.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_col)
+        dsb = ds.astype(v.dtype)
+        dk_scr[:, sl] += jnp.dot(
+            dsb.T, q, preferred_element_type=jnp.float32
+        ) * scale
+        db_rows = db_rows + jnp.sum(ds, axis=0, keepdims=True)
+    dbias_ref[0, 0] = db_rows
+
+    @pl.when(qb == nq - 1)
+    def _write():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, dq_scr,
+               *, D, scale, rate, is_test, upscale, causal):
+    qb = pl.program_id(2)
+    kb = pl.program_id(3)
+    nk = pl.num_programs(3)
+    G = 128 // D
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    bias_tile = bias_ref[0]
+    for i in range(G):
+        sl = slice(i * D, (i + 1) * D)
+        q = q_ref[0, :, sl]
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
+        do = do_ref[0, :, sl]
+        lse_col = lse_ref[0, :, sl][:, :1]
+        delta_col = delta_ref[0, :, sl][:, :1]
+        head = pl.program_id(1) * G + i
+        p = _tile_probs_from_lse(q, k, bias_tile, lse_col, scale, causal,
+                                 qb, kb)
+        if rate > 0.0 and not is_test:
+            _seed_tile(seed_ref, head.astype(jnp.uint32), qb, kb)
+            keep = _keep(p.shape, rate)
+            inv = 1.0 / (1.0 - rate) if upscale else 1.0
+            dpm = jnp.dot(do.astype(v.dtype), v.T,
+                          preferred_element_type=jnp.float32)
+            dp = jnp.where(keep, dpm * inv, 0.0)
+        else:
+            ts = 1.0 if (rate == 0.0 or upscale) else 1.0 - rate
+            dp = jnp.dot(do.astype(v.dtype), v.T,
+                         preferred_element_type=jnp.float32) * ts
+        ds = p * (dp - delta_col)
+        dq_scr[:, sl] += jnp.dot(
+            ds.astype(v.dtype), k, preferred_element_type=jnp.float32
+        ) * scale
+
+    @pl.when(kb == nk - 1)
+    def _write():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def flash_tiled_bwd(qkv, bias, seed, do, out, lse, H, D, statics,
+                    interpret=False):
+    """-> (dqkv [B, S, 3HD], dbias [B, S])."""
+    B, S, _ = qkv.shape
+    G = H * D // 128
+    bias3 = bias.reshape(B, 1, S)
+    # delta = rowsum(do * o) per head, broadcast to the lane layout
+    do3 = do.reshape(B, S, H, D)
+    o3 = out.reshape(B, S, H, D)
+    delta = jnp.sum(
+        do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
+    )  # [B, S, H]
+    delta = jnp.repeat(delta, D, axis=-1)  # [B, S, H*D] column-replicated
+
+    dkv_kern = functools.partial(_dkv_kernel, D=D, **statics)
+    dk, dv, dbias_parts = pl.pallas_call(
+        dkv_kern,
+        grid=(B, G, S // BK, S // BQ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            # q-indexed operands use the INNER axis (qb = program_id(3))
+            pl.BlockSpec((1, BQ, 128),
+                         lambda b, g, kb, qb: (b, qb, 0 * G + g),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BK, 128),
+                         lambda b, g, kb, qb: (b, kb, 1 * G + g),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BK, 128),
+                         lambda b, g, kb, qb: (b, kb, 2 * G + g),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BK), lambda b, g, kb, qb: (b, 0, kb),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BQ, 128), lambda b, g, kb, qb: (b, qb, g),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BQ, 128), lambda b, g, kb, qb: (b, qb, g),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BQ, 128), lambda b, g, kb, qb: (b, qb, g),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BK, 128), lambda b, g, kb, qb: (b, kb, g),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BK, 128), lambda b, g, kb, qb: (b, kb, g),
+                         memory_space=pltpu.VMEM),
+            # per-(g, kb, qb) partial bias rows; summed below
+            pl.BlockSpec((1, 1, 1, BK),
+                         lambda b, g, kb, qb: (b, g * (S // BQ) + qb, 0, kb),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
+            jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
+            jax.ShapeDtypeStruct((B, G * (S // BQ), 1, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BK, 128), jnp.float32),
+            pltpu.VMEM((BK, 128), jnp.float32),
+        ],
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed, qkv, qkv, qkv, bias3, do, lse, delta)
+
+    dq_kern = functools.partial(_dq_kernel, D=D, **statics)
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(B, G, S // BQ, S // BK),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            _q_spec(0, G),
+            _kv_spec(1, G),
+            _kv_spec(2, G),
+            _bias_spec(),
+            _out_spec(),
+            _out_spec(),
+            _out_spec(),
+        ],
+        out_specs=_out_spec(),
+        out_shape=jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
+        scratch_shapes=[pltpu.VMEM((BQ, 128), jnp.float32)],
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed, qkv, qkv, qkv, bias3, do, lse, delta)
+
+    dbias = jnp.sum(dbias_parts, axis=1).reshape(B, S)
+    dqkv = jnp.concatenate([dq, dk, dv], axis=-1)
+    return dqkv, dbias
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper (same contract as flash_attention._flash_qkv)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_tiled(qkv, bias, seed, H, D, statics, interpret):
+    out, _ = flash_tiled_fwd(qkv, bias, seed, H, D, dict(statics), interpret)
+    return out
+
+
+def _flash_tiled_fwd_rule(qkv, bias, seed, H, D, statics, interpret):
+    out, lse = flash_tiled_fwd(qkv, bias, seed, H, D, dict(statics),
+                               interpret)
+    return out, (qkv, bias, seed, out, lse)
+
+
+def _flash_tiled_bwd_rule(H, D, statics, interpret, res, g):
+    qkv, bias, seed, out, lse = res
+    dqkv, dbias = flash_tiled_bwd(
+        qkv, bias, seed, g, out, lse, H, D, dict(statics), interpret
+    )
+    dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dqkv, dbias, dseed
+
+
+flash_tiled.defvjp(_flash_tiled_fwd_rule, _flash_tiled_bwd_rule)

@@ -55,12 +55,16 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
 
 def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
                    keep_top_k=16, nms_threshold=0.3, normalized=True,
-                   name=None):
+                   background_label=-1, name=None):
+    """background_label: class column skipped by NMS (the reference
+    defaults to 0 = first column is background; -1 disables — YOLO-style
+    heads have no background column)."""
     return _simple(
         "multiclass_nms",
         {"BBoxes": [bboxes], "Scores": [scores]},
         {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
-         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold},
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+         "background_label": background_label},
         out_slots=("Out", "NmsRoisNum"),
         stop_gradient=True,
     )

@@ -227,13 +227,43 @@ def bert_mlm_head(seq, mlm_labels, cfg):
     return layers.elementwise_div(layers.reduce_sum(loss), denom)
 
 
+def bert_mlm_head_gather(seq, mask_pos, mask_labels, cfg):
+    """MLM head over the MASKED positions only (the reference's BERT
+    pretraining gathers mask_pos before the vocab projection — the
+    standard formulation; computing [B*S, V] logits wastes ~85% of the
+    head FLOPs). mask_pos: [P] int32 indices into the flattened [B*S]
+    sequence (padded entries point at any row with label -100);
+    mask_labels: [P] vocab ids with -100 padding."""
+    b, s, h = seq.shape
+    seq2 = layers.reshape(seq, [b * s, h])
+    picked = layers.gather(seq2, mask_pos)  # [P, h]
+    logits = layers.fc(
+        picked,
+        size=cfg.vocab_size,
+        param_attr=ParamAttr(name="mlm_out_w", initializer=_init(cfg)),
+        bias_attr=ParamAttr(name="mlm_out_b"),
+    )
+    labels = layers.reshape(mask_labels, [-1, 1])
+    loss = layers.softmax_with_cross_entropy(logits, labels, ignore_index=-100)
+    ignore = layers.fill_constant([1], "int64", -100)
+    valid = layers.cast(layers.not_equal(labels, ignore), "float32")
+    denom = layers.elementwise_max(
+        layers.reduce_sum(valid), layers.fill_constant([1], "float32", 1.0)
+    )
+    return layers.elementwise_div(layers.reduce_sum(loss), denom)
+
+
 def bert_pretrain(input_ids, token_type_ids, input_mask, mlm_labels, cfg,
-                  is_test=False, checkpoints=None):
-    """End-to-end MLM pretraining loss (encoder + head)."""
+                  is_test=False, checkpoints=None, mask_pos=None):
+    """End-to-end MLM pretraining loss (encoder + head). With mask_pos
+    [P], mlm_labels is the gathered [P] label vector and the vocab
+    projection runs only on masked rows (reference mask_pos contract)."""
     seq = bert_encoder(
         input_ids, token_type_ids, input_mask, cfg, is_test,
         checkpoints=checkpoints,
     )
+    if mask_pos is not None:
+        return bert_mlm_head_gather(seq, mask_pos, mlm_labels, cfg)
     return bert_mlm_head(seq, mlm_labels, cfg)
 
 

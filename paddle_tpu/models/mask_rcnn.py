@@ -324,7 +324,8 @@ def mask_rcnn_infer(image, im_info, cfg=None):
     scores_t = layers.transpose(layers.reshape(probs, [1, R, -1]), [0, 2, 1])
     out, _nums = det.multiclass_nms(shared, scores_t, score_threshold=0.05,
                                     nms_top_k=cfg.rpn_post_nms,
-                                    keep_top_k=100, nms_threshold=0.5)
+                                    keep_top_k=100, nms_threshold=0.5,
+                                    background_label=0)
     # mask head runs on the KEPT detections (reference order: NMS first,
     # then the mask branch on the final boxes), so mask row i IS detection i
     det_boxes = layers.reshape(

@@ -216,8 +216,12 @@ def multiclass_nms_core(boxes, scores, attrs):
     nms_thresh = attrs.get("nms_threshold", 0.3)
     nms_top_k = attrs.get("nms_top_k", 64)
     keep_top_k = attrs.get("keep_top_k", 16)
+    background = attrs.get("background_label", 0)
     B, C, N = scores.shape
     k = min(nms_top_k, N)
+    if background is not None and 0 <= background < C:
+        # reference multiclass_nms_op.cc skips c == background_label
+        scores = scores.at[:, background, :].set(-jnp.inf)
 
     def one_class(b_boxes, c_scores):
         sc, idx = lax.top_k(c_scores, k)

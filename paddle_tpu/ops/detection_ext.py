@@ -77,13 +77,30 @@ def _anchor_assign(ctx, op, ins, *, pos_thresh, neg_thresh, sample_frac,
     anchors = ins["Anchor"][0].reshape(-1, 4).astype(jnp.float32)  # [A,4]
     gt = ins["GtBoxes"][0].astype(jnp.float32)  # [G,4], -1 pad rows
     is_crowd = ins.get("IsCrowd", [None])[0]
+    im_info = ins.get("ImInfo", [None])[0]
     A = anchors.shape[0]
     G = gt.shape[0]
     valid_gt = gt[:, 2] > gt[:, 0]
     if is_crowd is not None:
         valid_gt = valid_gt & (is_crowd.reshape(-1)[:G] == 0)
 
+    # straddle filter (rpn_target_assign_op.cc:99-110): with
+    # rpn_straddle_thresh >= 0, anchors not inside the image (within the
+    # threshold) are excluded from both fg and bg sampling
+    straddle = op.attr("rpn_straddle_thresh", -1.0)
+    inside = jnp.ones((A,), bool)
+    if not retina and im_info is not None and straddle >= 0.0:
+        info = im_info.reshape(-1)
+        h_im, w_im = info[0], info[1]
+        inside = (
+            (anchors[:, 0] >= -straddle)
+            & (anchors[:, 1] >= -straddle)
+            & (anchors[:, 2] < w_im + straddle)
+            & (anchors[:, 3] < h_im + straddle)
+        )
+
     iou = jnp.where(valid_gt[None, :], _iou_matrix(anchors, gt), -1.0)
+    iou = jnp.where(inside[:, None], iou, -1.0)
     a_max = jnp.max(iou, axis=1)  # [A]
     a_arg = jnp.argmax(iou, axis=1)
     g_max = jnp.max(iou, axis=0)  # [G]
@@ -94,8 +111,8 @@ def _anchor_assign(ctx, op, ins, *, pos_thresh, neg_thresh, sample_frac,
         (iou == g_max[None, :]) & (g_max[None, :] > 0) & valid_gt[None, :],
         axis=1,
     )
-    fg = fg | is_best
-    bg = (a_max < neg_thresh) & ~fg
+    fg = (fg | is_best) & inside
+    bg = (a_max < neg_thresh) & ~fg & inside
 
     key = op_key(ctx, op)
     jitter = jax.random.uniform(key, (A,))
