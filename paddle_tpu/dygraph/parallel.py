@@ -12,12 +12,37 @@ to identity exactly like the reference with nranks==1.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
 from .layers import Layer
+
+
+@functools.lru_cache(maxsize=1)
+def _collective_reducer():
+    """Module-level (sharding, jitted reducer) pair: one Mesh and one
+    compiled reduction per process lifetime — a per-call jit closure would
+    retrace every step."""
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("hosts",))
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("hosts")
+    )
+    replicated = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec()
+    )
+    n_local = jax.local_device_count()
+
+    @functools.partial(jax.jit, out_shardings=replicated)
+    def sum_rows(garr):
+        # rows = one copy per device; each process contributed its grad
+        # n_local times -> divide to get the per-process SUM
+        return jnp.sum(garr, axis=0) / n_local
+
+    return sharding, sum_rows
 
 
 class ParallelEnv:
@@ -63,8 +88,15 @@ class DataParallel(Layer):
     def apply_collective_grads(self):
         """Coalesced grad allreduce (reference :384 flattens grads into
         buckets before ncclAllReduce; XLA's collective combiner makes
-        explicit bucketing unnecessary — one psum per grad is combined by
-        the compiler)."""
+        explicit bucketing unnecessary — the per-grad reduces below are
+        combined by the compiler).
+
+        Multi-process path: each process's local grad becomes one shard of
+        a GLOBAL [n_devices, ...] array via
+        jax.make_array_from_process_local_data (each local device carries a
+        copy of its process's grad), and the cross-process sum is a plain
+        axis-0 reduction on the global array — valid on real multi-host
+        meshes, no host-replicated-array tricks."""
         if self.nranks <= 1:
             return
         grads = [
@@ -72,31 +104,13 @@ class DataParallel(Layer):
         ]
         if not grads:
             return
-        # Each *process* contributes one gradient, but the mesh spans every
-        # device and host-replicated inputs make each process's value appear
-        # once per local device — so the psum over-counts by
-        # local_device_count; divide it back out to get the per-process sum.
+        sharding, sum_rows = _collective_reducer()
         n_local = jax.local_device_count()
-        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("hosts",))
-
-        vals = [p._grad for p in grads]
-
-        @jax.jit
-        def _psum_all(vs):
-            f = jax.shard_map(
-                lambda x: [
-                    jax.lax.psum(v, "hosts") / n_local for v in x
-                ],
-                mesh=mesh,
-                in_specs=jax.sharding.PartitionSpec(),
-                out_specs=jax.sharding.PartitionSpec(),
-                check_vma=False,
-            )
-            return f(vs)
-
-        out = _psum_all(vals)
-        for p, g in zip(grads, out):
-            p._grad = g
+        for p in grads:
+            g = np.asarray(p._grad)
+            local = np.broadcast_to(g[None], (n_local,) + g.shape)
+            garr = jax.make_array_from_process_local_data(sharding, local)
+            p._grad = sum_rows(garr)
 
     def state_dict(self, prefix=""):
         return self._layers.state_dict(prefix=prefix)

@@ -1,0 +1,277 @@
+"""Async / Geo parameter-server communicators (reference
+operators/distributed/communicator.h: AsyncCommunicator :237,
+HalfAsyncCommunicator :299, GeoCommunicator :365, and
+transpiler/geo_sgd_transpiler.py).
+
+TPU-native re-design, same capability:
+
+* AsyncCommunicator — the trainer's compiled step STOPS updating the
+  sparse tables in-graph (async_ps_transpile removes those optimizer ops);
+  table grads come back as fetches and are pushed into a bounded queue. A
+  host-side worker thread merges up to `merge_size` pending grads per
+  table and applies the update to the scope-resident table buffers. The
+  trainer reads tables from the scope at each step, so updates land with
+  bounded staleness: `send_queue_size` caps the number of un-applied
+  batches (push blocks when full — set it to 0/1 for the reference's
+  HalfAsyncCommunicator barrier semantics).
+
+* GeoCommunicator — every worker trains on its LOCAL table copy (the
+  in-graph optimizer keeps running); every `update_frequency` steps the
+  workers exchange table DELTAS (cur - base) through a compiled
+  c_allreduce_sum program over the process mesh and rebase:
+  new = base + sum_w(delta_w). This is Geo-SGD's consistency model —
+  divergence bounded by the sync period — with XLA collectives instead of
+  the reference's gRPC delta-send.
+
+The reference's server side (listen_and_serv + per-pserver optimize
+blocks) has no analog here because tables are scope-resident on the
+trainer side (sharded over the mesh in multi-chip runs); the communicator
+IS the server loop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+
+def async_ps_transpile(program, table_names):
+    """Remove in-graph optimizer ops that update the given tables; returns
+    {table_name: grad_var_name} for the communicator to fetch. Mirrors the
+    reference's delete_optimizer_pass (ps_program_builder)."""
+    blk = program.global_block
+    grad_of = {}
+    kept = []
+    for op in blk.ops:
+        params = op.inputs.get("Param", [])
+        if params and params[0] in table_names:
+            grads = op.inputs.get("Grad", [])
+            if grads:
+                grad_of[params[0]] = grads[0]
+            continue  # drop the table's in-graph update
+        kept.append(op)
+    blk.ops[:] = kept
+    missing = [t for t in table_names if t not in grad_of]
+    if missing:
+        raise ValueError(
+            f"async_ps_transpile: no optimizer op found for tables "
+            f"{missing}; run optimizer.minimize first"
+        )
+    return grad_of
+
+
+class _HostSGD:
+    def __init__(self, lr):
+        self.lr = lr
+
+    def apply(self, table, grad):
+        table -= self.lr * grad
+        return table
+
+
+class _HostAdam:
+    def __init__(self, lr, beta1=0.9, beta2=0.999, eps=1e-8):
+        self.lr, self.b1, self.b2, self.eps = lr, beta1, beta2, eps
+        self._m = {}
+        self._v = {}
+        self._t = {}
+
+    def apply(self, table, grad, key="t"):
+        m = self._m.setdefault(key, np.zeros_like(table))
+        v = self._v.setdefault(key, np.zeros_like(table))
+        t = self._t.get(key, 0) + 1
+        self._t[key] = t
+        m += (1 - self.b1) * (grad - m)
+        v += (1 - self.b2) * (grad * grad - v)
+        mhat = m / (1 - self.b1 ** t)
+        vhat = v / (1 - self.b2 ** t)
+        table -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return table
+
+
+class AsyncCommunicator:
+    """Host-side async update engine over scope-resident tables."""
+
+    def __init__(self, scope, grad_of, lr=0.01, optimizer="sgd",
+                 send_queue_size=16, merge_size=4):
+        self._scope = scope
+        self._grad_of = dict(grad_of)
+        self._opts = {
+            t: (_HostAdam(lr) if optimizer == "adam" else _HostSGD(lr))
+            for t in grad_of
+        }
+        # staleness bound: at most send_queue_size un-applied pushes;
+        # 1 ~= half-async (trainer blocks until the previous batch lands)
+        self._q = queue.Queue(maxsize=max(1, send_queue_size))
+        self._merge_size = max(1, merge_size)
+        self._stop = threading.Event()
+        self._thread = None
+        self._applied = 0
+        self._error = None
+
+    # -- trainer side -------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def _check_error(self):
+        if self._error is not None:
+            raise RuntimeError(
+                "AsyncCommunicator apply thread died"
+            ) from self._error
+
+    def push(self, grads):
+        """grads: {table_name: np.ndarray}; blocks when the staleness
+        bound is reached (communicator.h send_queue_size semantics)."""
+        self._check_error()
+        self._q.put({k: np.asarray(v) for k, v in grads.items()})
+
+    def train_step(self, exe, program, feed, fetch_list=None, **kw):
+        """Run one step, fetching table grads alongside the user fetches
+        and pushing them to the apply thread."""
+        fetch_list = list(fetch_list or [])
+        n_user = len(fetch_list)
+        tables = list(self._grad_of)
+        outs = exe.run(
+            program, feed=feed,
+            fetch_list=fetch_list + [self._grad_of[t] for t in tables],
+            **kw,
+        )
+        self.push({t: np.asarray(g) for t, g in zip(tables, outs[n_user:])})
+        return outs[:n_user]
+
+    def flush(self):
+        """Block until every pushed batch has been applied (raises if the
+        apply thread died — every queued item is drained on error, so this
+        cannot hang)."""
+        self._q.join()
+        self._check_error()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._q.put(None)  # wake
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    # -- server side --------------------------------------------------------
+    def _run(self):
+        while not self._stop.is_set():
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                break
+            batch = [item]
+            # merge up to merge_size pending batches into one apply
+            # (communicator.h merge_add)
+            for _ in range(self._merge_size - 1):
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._q.task_done()
+                    self._stop.set()
+                    break
+                batch.append(nxt)
+            try:
+                if self._error is None:
+                    merged = {}
+                    for grads in batch:
+                        for t, g in grads.items():
+                            merged[t] = merged.get(t, 0) + g
+                    for t, g in merged.items():
+                        var = self._scope.find_var(t)
+                        if var is None:
+                            raise KeyError(
+                                f"table {t!r} not found in the scope"
+                            )
+                        new = self._opts[t].apply(np.asarray(var).copy(), g)
+                        self._scope.set_var(t, new)
+                    self._applied += len(batch)
+            except Exception as e:  # record + keep draining: no deadlock
+                self._error = e
+            finally:
+                for _ in batch:
+                    self._q.task_done()
+
+
+class GeoCommunicator:
+    """Geo-SGD periodic delta sync over the process mesh."""
+
+    def __init__(self, table_names, scope, exe, update_frequency=10,
+                 mesh=None):
+        self._tables = list(table_names)
+        self._scope = scope
+        self._exe = exe
+        self._k = int(update_frequency)
+        self._mesh = mesh
+        self._step = 0
+        self._base = {
+            t: np.asarray(scope.find_var(t)).copy() for t in self._tables
+        }
+        self._sync_prog = None
+
+    def _build_sync_program(self):
+        import jax
+
+        import paddle_tpu as fluid
+        from paddle_tpu import layers
+
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            outs = []
+            for t in self._tables:
+                shape = list(np.asarray(self._base[t]).shape)
+                cur = fluid.data(f"cur__{t}", shape)
+                base = fluid.data(f"base__{t}", shape)
+                delta = cur - base
+                blk = prog.global_block
+                summed = blk.create_var(
+                    name=f"sum_delta__{t}", shape=shape, dtype="float32"
+                )
+                blk.append_op(
+                    "c_allreduce_sum",
+                    {"X": [delta.name]},
+                    {"Out": [summed.name]},
+                    {"ring_id": 0, "use_calc_stream": True},
+                )
+                # under a mesh, feeds replicate over each process's local
+                # devices, so the mesh-wide psum counts every process's
+                # delta local_device_count times — undo that factor. With
+                # no mesh the allreduce is a single-device identity.
+                denom = jax.local_device_count() if self._mesh is not None \
+                    else 1.0
+                scaled = layers.scale(
+                    blk.var(summed.name), scale=1.0 / denom
+                )
+                outs.append(base + scaled)
+        if self._mesh is not None:
+            from ..parallel.spmd import shard_program
+
+            shard_program(prog, self._mesh)
+        return prog, outs
+
+    def maybe_sync(self):
+        """Call once per train step; performs the delta exchange every
+        update_frequency steps. Returns True when a sync ran."""
+        self._step += 1
+        if self._step % self._k:
+            return False
+        if self._sync_prog is None:
+            self._sync_prog = self._build_sync_program()
+        prog, outs = self._sync_prog
+        feed = {}
+        for t in self._tables:
+            feed[f"cur__{t}"] = np.asarray(self._scope.find_var(t))
+            feed[f"base__{t}"] = self._base[t]
+        news = self._exe.run(prog, feed=feed, fetch_list=list(outs))
+        for t, new in zip(self._tables, news):
+            arr = np.asarray(new)
+            self._scope.set_var(t, arr)
+            self._base[t] = arr.copy()
+        return True

@@ -24,14 +24,25 @@ from ..parallel.spmd import shard_program
 
 
 class DistributedStrategy:
-    """reference parameter_server/distributed_strategy.py factory modes."""
+    """reference parameter_server/distributed_strategy.py factory modes.
 
-    def __init__(self, mode="sync"):
+    sync        — tables updated in-graph every step (sharded over ICI).
+    async       — in-graph table updates removed; a host-side
+                  AsyncCommunicator applies merged grads with bounded
+                  staleness (fleet/communicator.py).
+    half_async  — async with send_queue_size=1 (barrier semantics).
+    geo         — local training + periodic delta allreduce
+                  (GeoCommunicator, update_frequency steps apart).
+    """
+
+    def __init__(self, mode="sync", update_frequency=100,
+                 send_queue_size=16, merge_size=4):
         if mode not in ("sync", "async", "half_async", "geo"):
             raise ValueError(f"unknown PS mode {mode!r}")
-        # async/half_async/geo traded staleness for gRPC bandwidth; on ICI
-        # the sync path is strictly faster, so every mode runs sync.
         self.mode = mode
+        self.update_frequency = update_frequency
+        self.send_queue_size = 1 if mode == "half_async" else send_queue_size
+        self.merge_size = merge_size
 
 
 class StrategyFactory:
@@ -49,7 +60,7 @@ class StrategyFactory:
 
     @staticmethod
     def create_geo_strategy(update_frequency=100):
-        return DistributedStrategy("geo")
+        return DistributedStrategy("geo", update_frequency=update_frequency)
 
 
 class ParameterServerOptimizer:
@@ -80,6 +91,21 @@ class ParameterServerOptimizer:
                 "dense-only models)"
             )
         shard_program(program, mesh)
+        if self._strategy.mode in ("async", "half_async"):
+            if self._fleet is None:
+                # stripping the in-graph table updates without a fleet to
+                # host the communicator would silently freeze the tables
+                raise ValueError(
+                    "async PS mode needs a fleet: use "
+                    "ParameterServerFleet().init().distributed_optimizer(...)"
+                    " so init_worker() can start the AsyncCommunicator"
+                )
+            from .communicator import async_ps_transpile
+
+            grad_of = async_ps_transpile(program, tables)
+            self._fleet._async_info = (grad_of, self._strategy)
+        elif self._strategy.mode == "geo" and self._fleet is not None:
+            self._fleet._geo_info = (tables, self._strategy)
         return ops, params_grads
 
 
@@ -89,6 +115,9 @@ class ParameterServerFleet:
     def __init__(self):
         self._role = None
         self._mesh = None
+        self._async_info = None
+        self._geo_info = None
+        self.communicator = None
 
     def init(self, role_maker=None, mesh=None):
         self._role = role_maker
@@ -103,14 +132,45 @@ class ParameterServerFleet:
     def init_server(self, *args, **kwargs):
         pass
 
-    def init_worker(self):
-        pass
+    def init_worker(self, scope=None, exe=None, lr=0.01, optimizer="sgd"):
+        """Start the communicator for async/geo strategies (reference
+        fleet.init_worker starts the Communicator singleton)."""
+        from ..framework.scope import global_scope
+
+        if self._async_info is not None:
+            from .communicator import AsyncCommunicator
+
+            grad_of, strategy = self._async_info
+            self.communicator = AsyncCommunicator(
+                scope or global_scope(), grad_of, lr=lr,
+                optimizer=optimizer,
+                send_queue_size=strategy.send_queue_size,
+                merge_size=strategy.merge_size,
+            ).start()
+        elif self._geo_info is not None:
+            from .communicator import GeoCommunicator
+
+            if exe is None:
+                raise ValueError(
+                    "geo PS mode: pass the Executor to init_worker(exe=...) "
+                    "— the periodic delta sync runs a compiled program"
+                )
+            tables, strategy = self._geo_info
+            self.communicator = GeoCommunicator(
+                tables, scope or global_scope(), exe,
+                update_frequency=strategy.update_frequency,
+                mesh=self._mesh,
+            )
+        return self.communicator
 
     def run_server(self):
         pass
 
     def stop_worker(self):
-        pass
+        if self.communicator is not None and hasattr(self.communicator, "stop"):
+            self.communicator.flush()
+            self.communicator.stop()
+        self.communicator = None
 
     def is_server(self):
         return True

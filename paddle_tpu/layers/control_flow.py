@@ -74,14 +74,21 @@ class While:
             assign(less_than(i, n), cond)   # body must refresh cond
 
     Lowered to one `while` op running lax.while_loop (ops/control_flow.py).
-    Non-differentiable (data-dependent trip count) — use StaticRNN for
-    trainable loops.
+
+    Differentiability (reference while_grad parity, while_op.cc +
+    backward.py:843): pass `max_iters=N` to lower onto `bounded_while`
+    (lax.scan over N masked steps) — training loops through the While then
+    backprop, with semantics identical to the unbounded form whenever the
+    true trip count stays <= N. Without max_iters the loop keeps the
+    data-dependent lax.while_loop and is non-differentiable (use StaticRNN
+    or max_iters for trainable loops).
     """
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None, max_iters=None):
         if not isinstance(cond, Variable):
             raise TypeError("While cond must be a bool Variable")
         self.cond_var = cond
+        self.max_iters = max_iters
         self._prog = default_main_program()
 
     @contextlib.contextmanager
@@ -104,15 +111,56 @@ class While:
         for n in _external_reads(sub):
             if n not in carry and n != self.cond_var.name:
                 carry.append(n)
+        attrs = {
+            "sub_block": sub.idx,
+            "carry_names": list(carry),
+            "cond_name": self.cond_var.name,
+        }
+        op_type = "while"
+        in_names = list(carry)
+        if self.max_iters is not None:
+            op_type = "bounded_while"
+            attrs["max_iters"] = int(self.max_iters)
+            # the loop is differentiable: float carries the body WRITES
+            # (the accumulators) must participate in backward even when
+            # their initial value came from a stop_gradient producer
+            # (fill_constant zeros is the idiomatic accumulator init —
+            # reference while_grad treats loop outputs the same way).
+            # Read-only captures keep their flags: flipping a feed var
+            # would drag data gradients into every backward pass.
+            for nm in written:
+                if nm == self.cond_var.name:
+                    continue
+                v = parent._find_var_recursive(nm)
+                if v is not None and str(v.dtype).startswith("float"):
+                    v.stop_gradient = False
+            # fluid While rebinds its outputs onto the SAME names (in-place
+            # semantics) — the generic __vjp__ replays the forward later,
+            # when those names hold post-loop values. Snapshot every
+            # written carry so the op's inputs survive the rebinding (the
+            # reference while_grad equally replays against the per-step
+            # scope stack, not the mutated vars — backward.py:843).
+            written_set = set(written)
+            in_names = []
+            for nm in carry:
+                if nm in written_set:
+                    v = parent._find_var_recursive(nm)
+                    snap = parent.create_var(
+                        name=unique_name.generate(nm + ".loop_in"),
+                        shape=v.shape, dtype=v.dtype,
+                    )
+                    snap.stop_gradient = v.stop_gradient
+                    parent.append_op(
+                        "assign", {"X": [nm]}, {"Out": [snap.name]}, {}
+                    )
+                    in_names.append(snap.name)
+                else:
+                    in_names.append(nm)
         parent.append_op(
-            "while",
-            {"Condition": [self.cond_var.name], "X": list(carry)},
+            op_type,
+            {"Condition": [self.cond_var.name], "X": list(in_names)},
             {"Out": list(carry)},
-            {
-                "sub_block": sub.idx,
-                "carry_names": list(carry),
-                "cond_name": self.cond_var.name,
-            },
+            attrs,
         )
 
 

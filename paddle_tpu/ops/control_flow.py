@@ -362,3 +362,51 @@ def _get_places(ctx, op, ins):
     parallel/mesh.py's job; this op exists for graph parity)."""
     n = op.attr("device_count", 0) or jax.local_device_count()
     return {"Out": [jnp.arange(n, dtype=jnp.int32)]}
+
+
+def _bounded_while_infer(block, inputs, attrs):
+    specs = []
+    for n in inputs.get("X", []):
+        v = block.var(n)
+        specs.append((tuple(v.shape or ()), v.dtype))
+    return {"Out": specs}
+
+
+@register_op(
+    "bounded_while", inputs=["Condition", "X"], outputs=["Out"],
+    infer_shape=_bounded_while_infer,
+)
+def _bounded_while(ctx, op, ins):
+    """Differentiable While (reference while_grad parity,
+    controlflow/while_op.cc + backward.py:843): the data-dependent loop is
+    lowered to lax.scan over a STATIC `max_iters` bound with a mask — each
+    step runs the body and keeps the previous carry where the condition
+    has already gone false. Reverse-mode through the scan IS the
+    while_grad program (the reference re-ran the body per iteration
+    against a scope stack; here BPTT falls out of jax.vjp through scan).
+    Semantics identical to `while` whenever the true trip count is
+    <= max_iters; the wasted masked iterations are the price of a static
+    shape."""
+    blk = _sub_block(ctx, op)
+    names = op.attr("carry_names")
+    cond_name = op.attr("cond_name")
+    max_iters = int(op.attr("max_iters"))
+    init = tuple(ins["X"])
+    cond0 = ins["Condition"][0]
+
+    def step(carry, i):
+        vals, c = carry
+        env = dict(zip(names, vals))
+        env[cond_name] = c
+        _run_block(_loop_ctx(ctx, i), blk, env)
+        active = c.reshape(()).astype(bool)
+        new_vals = tuple(
+            jnp.where(active, env[n], old) for n, old in zip(names, vals)
+        )
+        new_c = jnp.where(active, env[cond_name].reshape(c.shape), c)
+        return (new_vals, new_c), None
+
+    (vals, _c), _ = lax.scan(
+        step, (init, cond0), jnp.arange(max_iters)
+    )
+    return {"Out": list(vals)}

@@ -300,3 +300,83 @@ def test_static_rnn_step_body_error_propagates():
         with rnn.step():
             rnn.step_input(x)
             raise KeyError("user bug")
+
+
+# -- bounded (differentiable) While ---------------------------------------
+
+
+def _build_bounded_loop(n_val, max_iters=8):
+    """s = sum_{i<n} w*x through a While(max_iters=...) loop."""
+    x = fluid.data("x", [4])
+    n = fluid.data("n", [1], dtype="int32")
+    from paddle_tpu.layers.helper import LayerHelper
+
+    w = LayerHelper("loop").create_parameter(
+        fluid.ParamAttr(name="loop_w",
+                        initializer=fluid.initializer.Constant(2.0)),
+        [4], "float32",
+    )
+    i = layers.fill_constant([1], "int32", 0)
+    s = layers.fill_constant([4], "float32", 0.0)
+    cond = layers.less_than(i, n)
+    loop = layers.While(cond, max_iters=max_iters)
+    with loop.block():
+        layers.assign(s + w * x, s)
+        layers.increment(i, value=1)
+        layers.assign(layers.less_than(i, n), cond)
+    return x, n, s, w
+
+
+def test_bounded_while_matches_python_loop():
+    x, n, s, w = _build_bounded_loop(3)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    for n_val in (0, 3, 8):
+        (sv,) = exe.run(feed={"x": xv, "n": np.array([n_val], np.int32)},
+                        fetch_list=[s])
+        np.testing.assert_allclose(
+            np.asarray(sv), n_val * 2.0 * xv, rtol=1e-6
+        )
+
+
+def test_bounded_while_backprop_through_data_dependent_length():
+    """d(sum(s))/dw = n * x — the gradient depends on the RUNTIME trip
+    count (reference while_grad capability, while_op.cc)."""
+    from paddle_tpu.framework.backward import append_backward
+
+    x, n, s, w = _build_bounded_loop(3)
+    loss = layers.reduce_sum(s)
+    append_backward(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    from paddle_tpu.framework.program import grad_var_name
+
+    for n_val in (1, 3, 6):
+        (gw,) = exe.run(
+            feed={"x": xv, "n": np.array([n_val], np.int32)},
+            fetch_list=[grad_var_name("loop_w")],
+        )
+        np.testing.assert_allclose(
+            np.asarray(gw), n_val * xv, rtol=1e-5,
+            err_msg=f"n={n_val}",
+        )
+
+
+def test_bounded_while_trains():
+    """SGD through the bounded While drives w toward zero on
+    loss = sum((sum_{i<n} w*x)^2)."""
+    x, n, s, w = _build_bounded_loop(4)
+    loss = layers.reduce_sum(layers.square(s))
+    fluid.optimizer.SGD(0.01).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.array([1.0, 0.5, 0.25, 1.0], np.float32)
+    feed = {"x": xv, "n": np.array([4], np.int32)}
+    losses = [
+        float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+              .reshape(-1)[0])
+        for _ in range(20)
+    ]
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
