@@ -161,10 +161,11 @@ class AESCipher(Cipher):
         if iv_size != 16:
             raise ValueError("AES-CTR iv must be 16 bytes")
         tag_size = int(tag_size)
-        if not 1 <= tag_size <= 32:
-            # 0 would silently disable authentication; >32 exceeds the
-            # HMAC-SHA256 digest and could never verify
-            raise ValueError("tag_size must be in [1, 32] bytes")
+        if not 12 <= tag_size <= 32:
+            # <12 bytes lets a config silently weaken forgery resistance
+            # (1 byte = 2^-8); >32 exceeds the HMAC-SHA256 digest and
+            # could never verify (advisor r2)
+            raise ValueError("tag_size must be in [12, 32] bytes")
         self.iv_size = iv_size
         self.tag_size = tag_size
 

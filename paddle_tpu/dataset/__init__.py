@@ -9,7 +9,16 @@ paddle.batch-composable) is what the framework tests and examples exercise.
 Each reader documents which mode produced its data via `.synthetic`.
 """
 
-from . import cifar, mnist, uci_housing  # noqa: F401
+from . import (  # noqa: F401
+    cifar,
+    conll05,
+    flowers,
+    imdb,
+    mnist,
+    movielens,
+    uci_housing,
+    wmt16,
+)
 from .factory import (  # noqa: F401
     DatasetFactory,
     InMemoryDataset,

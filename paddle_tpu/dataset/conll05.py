@@ -1,0 +1,70 @@
+"""CoNLL-2005 SRL reader (reference python/paddle/dataset/conll05.py:
+get_dict() -> (word, verb, label) dicts; test() yields the 9-slot SRL
+tuple (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids, mark,
+label_ids) the label_semantic_roles book model consumes).
+
+Synthetic fallback: deterministic sentences with IOB label structure —
+same slot contract."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import synthetic_rng
+
+WORD_DICT_LEN = 500
+VERB_DICT_LEN = 30
+LABEL_DICT_LEN = 13  # B-/I- over 6 roles + O
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_DICT_LEN)}
+    verb_dict = {f"v{i}": i for i in range(VERB_DICT_LEN)}
+    labels = ["O"]
+    for r in range((LABEL_DICT_LEN - 1) // 2):
+        labels += [f"B-A{r}", f"I-A{r}"]
+    label_dict = {l: i for i, l in enumerate(labels)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """[WORD_DICT_LEN, 32] deterministic embedding table (the reference
+    ships pre-trained emb vectors; synthetic stand-in keeps the shape)."""
+    r = synthetic_rng("conll05", "emb")
+    return r.rand(WORD_DICT_LEN, 32).astype(np.float32)
+
+
+def _reader(split, n=150):
+    def read():
+        r = synthetic_rng("conll05", split)
+        for _ in range(n):
+            ln = int(r.randint(5, 20))
+            words = r.randint(0, WORD_DICT_LEN, ln)
+            verb_pos = int(r.randint(0, ln))
+            verb = int(r.randint(0, VERB_DICT_LEN))
+            ctx = [np.roll(words, k) for k in (2, 1, 0, -1, -2)]
+            mark = (np.arange(ln) == verb_pos).astype(np.int64)
+            # one argument span near the verb, rest O (label 0)
+            labels = np.zeros(ln, np.int64)
+            role = int(r.randint(0, (LABEL_DICT_LEN - 1) // 2))
+            start = max(0, verb_pos - 2)
+            labels[start] = 1 + 2 * role
+            if start + 1 < ln:
+                labels[start + 1] = 2 + 2 * role
+            yield (
+                words.astype(np.int64),
+                *[c.astype(np.int64) for c in ctx],
+                np.full(ln, verb, np.int64),
+                mark,
+                labels,
+            )
+
+    return read
+
+
+def test():
+    return _reader("test")
+
+
+def train():
+    return _reader("train")

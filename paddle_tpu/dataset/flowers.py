@@ -1,0 +1,45 @@
+"""Oxford-102 flowers reader (reference python/paddle/dataset/flowers.py:
+train/test/valid yield (3x224x224 float image flattened, label in
+[0, 102))).
+
+Synthetic fallback: per-class color prototypes + noise at a configurable
+resolution (the reference decodes JPEGs through PIL; image decode belongs
+to the data pipeline and the synthetic generator keeps the contract
+offline)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import synthetic_rng
+
+N_CLASSES = 102
+
+
+def _reader(split, n=120, size=224):
+    def read():
+        protos = synthetic_rng("flowers", "protos").rand(
+            N_CLASSES, 3
+        ).astype(np.float32)
+        r = synthetic_rng("flowers", split)
+        for _ in range(n):
+            lab = int(r.randint(0, N_CLASSES))
+            img = (
+                0.7 * protos[lab][:, None, None]
+                + 0.3 * r.rand(3, size, size)
+            ).astype(np.float32)
+            yield img.reshape(-1), lab
+
+    return read
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
+    return _reader("train")
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
+    return _reader("test", n=40)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
+    return _reader("valid", n=40)

@@ -1,0 +1,89 @@
+"""IMDB sentiment reader (reference python/paddle/dataset/imdb.py:
+word_dict() builds a frequency-ranked vocabulary, train/test yield
+(word-id sequence, 0/1 label)).
+
+Download-or-synthetic (dataset/common.py pattern): with the aclImdb
+tarball under DATA_HOME the real corpus is parsed; otherwise a
+deterministic synthetic corpus with class-correlated token distributions
+stands in (same reader contract, usable offline)."""
+
+from __future__ import annotations
+
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from .common import data_path, have_file, synthetic_rng
+
+_TAR = "aclImdb_v1.tar.gz"
+_VOCAB = 2048
+
+
+def _tokenize(text):
+    return re.sub(
+        f"[{re.escape(string.punctuation)}]", " ", text.lower()
+    ).split()
+
+
+def _real_docs(pattern):
+    with tarfile.open(data_path("imdb", _TAR)) as tf:
+        for m in tf.getmembers():
+            if bool(pattern.match(m.name)):
+                yield _tokenize(tf.extractfile(m).read().decode("latin1"))
+
+
+def _synthetic_docs(split, label, n=200):
+    r = synthetic_rng("imdb", f"{split}-{label}")
+    # class-dependent token bias so models can actually learn
+    for _ in range(n):
+        ln = int(r.randint(8, 40))
+        base = r.randint(0, _VOCAB // 2, ln)
+        if label:
+            base = base + _VOCAB // 2  # positive docs use the upper half
+        yield [f"w{t}" for t in base]
+
+
+def word_dict():
+    """token -> id, frequency-ranked (reference imdb.py word_dict)."""
+    freq = {}
+    if have_file("imdb", _TAR):
+        pat = re.compile(r"aclImdb/train/[pn]\w+/.*\.txt$")
+        for doc in _real_docs(pat):
+            for w in doc:
+                freq[w] = freq.get(w, 0) + 1
+    else:
+        for label in (0, 1):
+            for doc in _synthetic_docs("train", label):
+                for w in doc:
+                    freq[w] = freq.get(w, 0) + 1
+    ranked = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+    wd = {w: i for i, (w, _) in enumerate(ranked)}
+    wd["<unk>"] = len(wd)
+    return wd
+
+
+def _reader(split, word_idx):
+    unk = word_idx.get("<unk>", len(word_idx) - 1)
+
+    def read():
+        if have_file("imdb", _TAR):
+            for label, sub in ((0, "neg"), (1, "pos")):
+                pat = re.compile(rf"aclImdb/{split}/{sub}/.*\.txt$")
+                for doc in _real_docs(pat):
+                    yield [word_idx.get(w, unk) for w in doc], label
+        else:
+            for label in (0, 1):
+                for doc in _synthetic_docs(split, label):
+                    yield [word_idx.get(w, unk) for w in doc], label
+
+    return read
+
+
+def train(word_idx):
+    return _reader("train", word_idx)
+
+
+def test(word_idx):
+    return _reader("test", word_idx)

@@ -1,0 +1,76 @@
+"""MovieLens-1M reader (reference python/paddle/dataset/movielens.py:
+train/test yield (user_id, gender_id, age_id, job_id, movie_id,
+category_ids, title_ids, rating); max_user_id/max_movie_id/max_job_id and
+the category/title dicts size the embedding tables — the recommender_system
+book model's inputs).
+
+Synthetic fallback: deterministic users/movies with a low-rank latent
+rating structure so the recommender can actually fit."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import synthetic_rng
+
+_N_USERS = 200
+_N_MOVIES = 120
+_N_JOBS = 21
+_N_CATEGORIES = 18
+_TITLE_VOCAB = 256
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_job_id():
+    return _N_JOBS
+
+
+def movie_categories():
+    return {f"cat{i}": i for i in range(_N_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {f"t{i}": i for i in range(_TITLE_VOCAB)}
+
+
+def _profiles():
+    r = synthetic_rng("movielens", "latent")
+    u = r.rand(_N_USERS + 1, 4)
+    m = r.rand(_N_MOVIES + 1, 4)
+    return u, m
+
+
+def _reader(split, n=400):
+    def read():
+        u_lat, m_lat = _profiles()
+        r = synthetic_rng("movielens", split)
+        for _ in range(n):
+            uid = int(r.randint(1, _N_USERS + 1))
+            mid = int(r.randint(1, _N_MOVIES + 1))
+            gender = uid % 2
+            age = int(uid % len(age_table))
+            job = uid % _N_JOBS
+            cats = [int(mid % _N_CATEGORIES)]
+            title = ((np.arange(3) * 31 + mid) % _TITLE_VOCAB).tolist()
+            rating = float(
+                np.clip(1.0 + 4.0 * u_lat[uid] @ m_lat[mid], 1.0, 5.0)
+            )
+            yield uid, gender, age, job, mid, cats, title, rating
+
+    return read
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test", n=100)

@@ -1,0 +1,53 @@
+"""WMT16 en-de reader (reference python/paddle/dataset/wmt16.py:
+train/test/validation(src_dict_size, trg_dict_size, src_lang) yield
+(src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk> framing;
+get_dict(lang, dict_size) returns the vocab).
+
+Synthetic fallback: deterministic integer "translation" pairs (target =
+reversed source shifted into the target vocab) — enough structure for a
+seq2seq model to learn, same contract offline."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import synthetic_rng
+
+BOS, EOS, UNK = 0, 1, 2
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {"<s>": BOS, "<e>": EOS, "<unk>": UNK}
+    for i in range(3, dict_size):
+        d[f"{lang}{i}"] = i
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _reader(split, src_dict_size, trg_dict_size, n=200):
+    def read():
+        r = synthetic_rng("wmt16", split)
+        for _ in range(n):
+            ln = int(r.randint(3, 12))
+            src = r.randint(3, src_dict_size, ln)
+            # deterministic mapping: "translation" = reversed + re-hashed
+            trg = (src[::-1] * 7 + 3) % max(trg_dict_size - 3, 1) + 3
+            src_ids = [BOS] + src.tolist() + [EOS]
+            trg_ids = [BOS] + trg.tolist()
+            trg_next = trg.tolist() + [EOS]
+            yield src_ids, trg_ids, trg_next
+
+    return read
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("train", src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("test", src_dict_size, trg_dict_size, n=50)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("val", src_dict_size, trg_dict_size, n=50)

@@ -105,12 +105,25 @@ class DataParallel(Layer):
         if not grads:
             return
         sharding, sum_rows = _collective_reducer()
-        n_local = jax.local_device_count()
+        local_devs = jax.local_devices()
+        n_total = jax.device_count()
         for p in grads:
-            g = np.asarray(p._grad)
-            local = np.broadcast_to(g[None], (n_local,) + g.shape)
-            garr = jax.make_array_from_process_local_data(sharding, local)
-            p._grad = sum_rows(garr)
+            g = jnp.asarray(p._grad)
+            # one row per device, each local device holding this process's
+            # grad — built from device buffers (device_put fans out without
+            # a host round-trip, unlike np.broadcast_to + process_local_data)
+            shards = [
+                jax.device_put(g[None], d) for d in local_devs
+            ]
+            garr = jax.make_array_from_single_device_arrays(
+                (n_total,) + g.shape, sharding, shards
+            )
+            out = sum_rows(garr)
+            # the reduction output is replicated across ALL processes'
+            # devices (non-addressable); downstream eager math needs a
+            # process-local array — take this process's replica shard
+            # (still a device buffer, no host copy)
+            p._grad = jnp.asarray(out.addressable_shards[0].data)
 
     def state_dict(self, prefix=""):
         return self._layers.state_dict(prefix=prefix)

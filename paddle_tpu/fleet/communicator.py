@@ -152,11 +152,22 @@ class AsyncCommunicator:
         self._check_error()
 
     def stop(self):
+        """Stops the apply thread. Pending (un-applied) batches are
+        DRAINED AND DROPPED — call flush() first to guarantee every pushed
+        gradient landed (fleet.stop_worker does)."""
         self._stop.set()
         if self._thread is not None:
             self._q.put(None)  # wake
             self._thread.join(timeout=10.0)
             self._thread = None
+        # drain anything the worker never consumed so a later flush()'s
+        # Queue.join() cannot hang on un-task_done'd items
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+            self._q.task_done()
 
     # -- server side --------------------------------------------------------
     def _run(self):

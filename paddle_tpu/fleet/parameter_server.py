@@ -103,7 +103,13 @@ class ParameterServerOptimizer:
             from .communicator import async_ps_transpile
 
             grad_of = async_ps_transpile(program, tables)
-            self._fleet._async_info = (grad_of, self._strategy)
+            # stash the INNER optimizer's lr/type so init_worker's host
+            # applier steps the tables at the same rate as the dense
+            # params (advisor: a silent default lr mismatch converges
+            # wrong with no error)
+            lr = getattr(self._inner, "_learning_rate", 0.01)
+            opt_name = type(self._inner).__name__.lower()
+            self._fleet._async_info = (grad_of, self._strategy, lr, opt_name)
         elif self._strategy.mode == "geo" and self._fleet is not None:
             self._fleet._geo_info = (tables, self._strategy)
         return ops, params_grads
@@ -132,18 +138,26 @@ class ParameterServerFleet:
     def init_server(self, *args, **kwargs):
         pass
 
-    def init_worker(self, scope=None, exe=None, lr=0.01, optimizer="sgd"):
+    def init_worker(self, scope=None, exe=None, lr=None, optimizer=None):
         """Start the communicator for async/geo strategies (reference
-        fleet.init_worker starts the Communicator singleton)."""
+        fleet.init_worker starts the Communicator singleton). lr/optimizer
+        default to the INNER optimizer handed to distributed_optimizer —
+        override only to intentionally train tables at a different rate."""
         from ..framework.scope import global_scope
 
         if self._async_info is not None:
             from .communicator import AsyncCommunicator
 
-            grad_of, strategy = self._async_info
+            grad_of, strategy, inner_lr, inner_opt = self._async_info
+            eff_lr = lr if lr is not None else (
+                inner_lr if isinstance(inner_lr, (int, float)) else 0.01
+            )
+            eff_opt = optimizer or (
+                "adam" if "adam" in inner_opt else "sgd"
+            )
             self.communicator = AsyncCommunicator(
-                scope or global_scope(), grad_of, lr=lr,
-                optimizer=optimizer,
+                scope or global_scope(), grad_of, lr=float(eff_lr),
+                optimizer=eff_opt,
                 send_queue_size=strategy.send_queue_size,
                 merge_size=strategy.merge_size,
             ).start()

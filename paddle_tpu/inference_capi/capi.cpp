@@ -253,15 +253,31 @@ bool PD_PredictorRun(PD_Predictor* predictor, const PD_TensorC* inputs,
   } else {
     int n = static_cast<int>(PyList_Size(res));
     PD_TensorC* outs = new PD_TensorC[n]();
-    for (int i = 0; i < n; ++i) {
+    bool unpack_ok = true;
+    for (int i = 0; i < n && unpack_ok; ++i) {
       PyObject* item = PyList_GetItem(res, i);  // (name, dtype, shape, bytes)
-      const char* nm = PyUnicode_AsUTF8(PyTuple_GetItem(item, 0));
+      // a malformed helper result must surface as an error, not a
+      // strlen(nullptr) crash (advisor r2): null-check every element
+      const char* nm =
+          item != nullptr && PyTuple_Check(item) && PyTuple_Size(item) == 4
+              ? PyUnicode_AsUTF8(PyTuple_GetItem(item, 0))
+              : nullptr;
+      if (nm == nullptr) {
+        set_error_from_python();
+        unpack_ok = false;
+        break;
+      }
       char* nm_copy = new char[std::strlen(nm) + 1];
       std::strcpy(nm_copy, nm);
       outs[i].name = nm_copy;
       outs[i].dtype =
           static_cast<PD_DataType>(PyLong_AsLong(PyTuple_GetItem(item, 1)));
       PyObject* shp = PyTuple_GetItem(item, 2);
+      if (shp == nullptr || !PyList_Check(shp)) {
+        set_error_from_python();
+        unpack_ok = false;
+        break;
+      }
       outs[i].rank = static_cast<int>(PyList_Size(shp));
       int64_t* sh = new int64_t[outs[i].rank];
       for (int d = 0; d < outs[i].rank; ++d) {
@@ -271,14 +287,28 @@ bool PD_PredictorRun(PD_Predictor* predictor, const PD_TensorC* inputs,
       PyObject* payload = PyTuple_GetItem(item, 3);
       char* buf = nullptr;
       Py_ssize_t len = 0;
-      PyBytes_AsStringAndSize(payload, &buf, &len);
+      if (payload == nullptr ||
+          PyBytes_AsStringAndSize(payload, &buf, &len) != 0) {
+        set_error_from_python();
+        unpack_ok = false;
+        break;
+      }
       outs[i].byte_size = static_cast<size_t>(len);
       outs[i].data = new char[len];
       std::memcpy(outs[i].data, buf, static_cast<size_t>(len));
     }
-    *outputs = outs;
-    *out_size = n;
-    ok = true;
+    if (unpack_ok) {
+      *outputs = outs;
+      *out_size = n;
+      ok = true;
+    } else {
+      for (int i = 0; i < n; ++i) {
+        delete[] outs[i].name;
+        delete[] outs[i].shape;
+        delete[] static_cast<char*>(outs[i].data);
+      }
+      delete[] outs;
+    }
     Py_DECREF(res);
   }
   Py_XDECREF(fn);

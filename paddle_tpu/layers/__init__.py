@@ -60,13 +60,17 @@ from .tensor import assign_value, take_along_axis  # noqa: F401
 from . import sequence_lod  # noqa: F401
 from .sequence_lod import (  # noqa: F401
     sequence_concat,
+    sequence_conv,
     sequence_expand,
+    sequence_expand_as,
     sequence_first_step,
     sequence_last_step,
     sequence_mask,
+    sequence_pad,
     sequence_pool,
     sequence_reverse,
     sequence_softmax,
+    sequence_unpad,
 )
 from . import rnn  # noqa: F401
 from .rnn import dynamic_gru, dynamic_lstm, gru, lstm  # noqa: F401
@@ -85,3 +89,24 @@ from .detection import (  # noqa: F401
     yolo_box,
     yolov3_loss,
 )
+from .detection import (  # noqa: F401
+    bipartite_match,
+    box_decoder_and_assign,
+    collect_fpn_proposals,
+    distribute_fpn_proposals,
+    generate_mask_labels,
+    generate_proposal_labels,
+    mine_hard_examples,
+    retinanet_detection_output,
+    retinanet_target_assign,
+    rpn_target_assign,
+    target_assign,
+)
+from .functional_ext import *  # noqa: F401,F403
+from .control_flow import (  # noqa: F401
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)
+from .ssd import multi_box_head, ssd_loss  # noqa: F401

@@ -508,3 +508,62 @@ class StaticRNN:
         if len(self._result) == 1:
             return self._result[0]
         return self._result
+
+
+# -- tensor arrays (reference control_flow.py array_write :1560,
+# array_read :1682, create_array, array_length) over the fixed-capacity
+# array ops (ops/control_flow.py write_to_array/read_from_array) ----------
+
+
+def create_array(dtype="float32", capacity=32):
+    """Returns an (empty) array Variable; the first array_write sizes it
+    [capacity, ...]. The reference LoDTensorArray grows dynamically; the
+    static contract takes an explicit capacity bound."""
+    blk = default_main_program().current_block()
+    v = blk.create_var(
+        name=unique_name.generate("tensor_array"), shape=[0], dtype=dtype
+    )
+    v._array_capacity = capacity
+    return v
+
+
+def array_write(x, i, array=None, capacity=32):
+    blk = default_main_program().current_block()
+    if array is None:
+        array = create_array(x.dtype, capacity)
+    cap = getattr(array, "_array_capacity", capacity)
+    out = blk.create_var(
+        name=unique_name.generate("tensor_array"),
+        shape=[cap] + list(x.shape), dtype=x.dtype,
+    )
+    out._array_capacity = cap
+    first = tuple(array.shape or ()) in ((0,), ())
+    blk.append_op(
+        "write_to_array",
+        {"X": [x.name], "I": [i.name],
+         "Array": [] if first else [array.name]},
+        {"Out": [out.name]},
+        {"capacity": cap},
+    )
+    return out
+
+
+def array_read(array, i):
+    blk = default_main_program().current_block()
+    out = blk.create_var(
+        name=unique_name.generate("array_read"),
+        shape=list(array.shape[1:]), dtype=array.dtype,
+    )
+    blk.append_op(
+        "read_from_array", {"X": [array.name], "I": [i.name]},
+        {"Out": [out.name]}, {},
+    )
+    return out
+
+
+def array_length(array):
+    """Static capacity of the array (the reference returns the dynamic
+    length; the fixed-capacity contract makes it the bound)."""
+    return tensor.fill_constant(
+        [1], "int64", float(array.shape[0] if array.shape else 0)
+    )

@@ -286,3 +286,72 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
         {"box_clip": box_clip},
         out_slots=("DecodeBox", "OutputAssignBox"),
     )
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    return _simple(
+        "bipartite_match", {"DistMat": [dist_matrix]},
+        {"match_type": match_type, "dist_threshold": dist_threshold},
+        out_slots=("ColToRowMatchIndices", "ColToRowMatchDist"),
+        stop_gradient=True,
+    )
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    return _simple(
+        "target_assign",
+        {"X": [input], "MatchIndices": [matched_indices],
+         "NegIndices": [negative_indices]},
+        {"mismatch_value": mismatch_value},
+        out_slots=("Out", "OutWeight"),
+        stop_gradient=True,
+    )
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist=None,
+                       loc_loss=None, neg_pos_ratio=3.0,
+                       neg_dist_threshold=0.5, sample_size=0,
+                       mining_type="max_negative"):
+    return _simple(
+        "mine_hard_examples",
+        {"ClsLoss": [cls_loss], "LocLoss": [loc_loss],
+         "MatchIndices": [match_indices], "MatchDist": [match_dist]},
+        {"neg_pos_ratio": neg_pos_ratio,
+         "neg_dist_threshold": neg_dist_threshold,
+         "sample_size": sample_size, "mining_type": mining_type},
+        out_slots=("NegIndices", "UpdatedMatchIndices"),
+        stop_gradient=True,
+    )
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    return _simple(
+        "retinanet_target_assign",
+        {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+         "GtLabels": [gt_labels], "IsCrowd": [is_crowd],
+         "ImInfo": [im_info]},
+        {"positive_overlap": positive_overlap,
+         "negative_overlap": negative_overlap},
+        out_slots=("LocationIndex", "ScoreIndex", "TargetLabel",
+                   "TargetBBox", "BBoxInsideWeight", "ForegroundNumber"),
+        stop_gradient=True,
+    )
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    return _simple(
+        "retinanet_detection_output",
+        {"BBoxes": list(bboxes), "Scores": list(scores),
+         "Anchors": list(anchors), "ImInfo": [im_info]},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold},
+        stop_gradient=True,
+    )

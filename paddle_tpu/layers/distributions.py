@@ -122,3 +122,59 @@ class Categorical(Distribution):
             -1,
             keep_dim=False,
         )
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)) (reference distributions.py:383): loc [D],
+    scale a diagonal covariance given as a [D, D] matrix whose diagonal
+    carries the variances' square roots (the reference passes the full
+    diagonal matrix; math uses only its diagonal)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_var(loc)
+        self.scale = _as_var(scale)
+
+    def _diag(self, mat):
+        # extract the [D] diagonal of [D, D] through existing ops
+        from ..tensor.creation import eye
+
+        d = mat.shape[0]
+        return tensor.reduce_sum(mat * eye(num_rows=d), dim=1)
+
+    def sample(self, shape, seed=0):
+        d = self.scale.shape[0]
+        eps = tensor.gaussian_random(list(shape) + [d], seed=seed)
+        return self.loc + eps * self._diag(self.scale)
+
+    def entropy(self):
+        """0.5 * (D * (1 + log(2*pi)) + log det(Sigma)) with
+        Sigma = diag(scale)^2 (reference :434 — here the matrix diagonal
+        carries STANDARD DEVIATIONS, so log det(Sigma) = 2*sum(log s))."""
+        d = self.scale.shape[0]
+        log_s = tensor.reduce_sum(tensor.log(self._diag(self.scale)))
+        return 0.5 * (d * (1.0 + math.log(2.0 * math.pi))) + log_s
+
+    def log_prob(self, value):
+        s = self._diag(self.scale)
+        var = tensor.square(s)
+        z = tensor.square(value - self.loc) / var
+        d = self.scale.shape[0]
+        return (
+            -0.5 * tensor.reduce_sum(z, dim=-1)
+            - 0.5 * d * math.log(2.0 * math.pi)
+            - tensor.reduce_sum(tensor.log(s))
+        )
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two diagonal MVNs (reference :451:
+        0.5 * (tr(S2^-1 S1) + (m2-m1)^T S2^-1 (m2-m1) - D + ln det S2/det S1))."""
+        s1 = tensor.square(self._diag(self.scale))
+        s2 = tensor.square(other._diag(other.scale))
+        d = self.scale.shape[0]
+        diff = other.loc - self.loc
+        tr = tensor.reduce_sum(s1 / s2)
+        quad = tensor.reduce_sum(tensor.square(diff) / s2)
+        logdet = tensor.reduce_sum(tensor.log(s2)) - tensor.reduce_sum(
+            tensor.log(s1)
+        )
+        return 0.5 * (tr + quad - float(d) + logdet)

@@ -1,0 +1,871 @@
+"""Functional wrappers over the round-3 op surface (reference
+python/paddle/fluid/layers/nn.py long tail + python/paddle/nn/functional/
+alias targets). Thin LayerHelper bindings: one public function per
+emitter, plus a few pure compositions (activation variants, dice/npair
+losses) where the reference's op is itself a composition."""
+
+from __future__ import annotations
+
+from .helper import LayerHelper
+from .tensor import _simple
+from . import tensor as _t
+
+
+def _unary(op_type, x, attrs=None, out_slot="Out"):
+    return _simple(op_type, {"X": [x]}, attrs or {}, out_slots=(out_slot,))
+
+
+# -- activations -----------------------------------------------------------
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from ..initializer import Constant
+
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1]]
+    else:
+        shape = list(x.shape[1:])
+    alpha = helper.create_parameter(
+        param_attr, shape, x.dtype, default_initializer=Constant(0.25)
+    )
+    return _simple("prelu", {"X": [x], "Alpha": [alpha]}, {"mode": mode})
+
+
+def hard_shrink(x, threshold=0.5):
+    return _unary("hard_shrink", x, {"threshold": threshold})
+
+
+def softshrink(x, alpha=0.5):
+    # x > a: x - a; x < -a: x + a; else 0 (reference softshrink_op)
+    from . import tensor as t
+
+    pos = t.relu(x - alpha)
+    neg = t.relu((0.0 - x) - alpha)
+    return pos - neg
+
+
+def tanh_shrink(x):
+    return _unary("tanh_shrink", x)
+
+
+def thresholded_relu(x, threshold=1.0):
+    return _unary("thresholded_relu", x, {"threshold": threshold})
+
+
+def soft_relu(x, threshold=40.0):
+    from . import tensor as t
+
+    return t.log(1.0 + t.exp(t.clip(x, -threshold, threshold)))
+
+
+def brelu(x, t_min=0.0, t_max=24.0):
+    from . import tensor as t
+
+    return t.clip(x, t_min, t_max)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    from . import tensor as t
+
+    return scale_b * t.tanh(x * scale_a)
+
+
+def maxout(x, groups, axis=1):
+    return _simple("maxout", {"X": [x]}, {"groups": groups, "axis": axis})
+
+
+def erf(x):
+    return _unary("erf", x)
+
+
+# -- norm / conv / pool ----------------------------------------------------
+
+
+def data_norm(input, name=None, epsilon=1e-5, param_attr=None,
+              batch_size_default=1e4, batch_sum_default=0.0,
+              batch_square_sum_default=1e4, slot_dim=-1):
+    from ..initializer import Constant
+
+    helper = LayerHelper("data_norm", name=name)
+    c = input.shape[-1]
+    bsz = helper.create_parameter(
+        None, [c], "float32",
+        default_initializer=Constant(batch_size_default))
+    bsum = helper.create_parameter(
+        None, [c], "float32",
+        default_initializer=Constant(batch_sum_default))
+    bsq = helper.create_parameter(
+        None, [c], "float32",
+        default_initializer=Constant(batch_square_sum_default))
+    out, _, _ = _simple(
+        "data_norm",
+        {"X": [input], "BatchSize": [bsz], "BatchSum": [bsum],
+         "BatchSquareSum": [bsq]},
+        {"epsilon": epsilon, "slot_dim": slot_dim},
+        out_slots=("Y", "Means", "Scales"),
+    )
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..initializer import Normal
+
+    helper = LayerHelper("spectral_norm", name=name)
+    h = weight.shape[dim]
+    import numpy as np
+
+    w = int(np.prod(weight.shape)) // h
+    u = helper.create_parameter(
+        None, [h], "float32", default_initializer=Normal(0.0, 1.0))
+    v = helper.create_parameter(
+        None, [w], "float32", default_initializer=Normal(0.0, 1.0))
+    u.stop_gradient = True
+    v.stop_gradient = True
+    return _simple(
+        "spectral_norm", {"Weight": [weight], "U": [u], "V": [v]},
+        {"dim": dim, "power_iters": power_iters, "eps": eps},
+    )
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    from ..initializer import Xavier
+
+    helper = LayerHelper("row_conv")
+    d = input.shape[-1]
+    filt = helper.create_parameter(
+        param_attr, [future_context_size + 1, d], input.dtype,
+        default_initializer=Xavier(),
+    )
+    out = _simple("row_conv", {"X": [input], "Filter": [filt]}, {})
+    if act:
+        out = _unary(act, out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    from ..initializer import Xavier
+
+    helper = LayerHelper("conv3d", name=name)
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    w = helper.create_parameter(
+        param_attr,
+        [num_filters, input.shape[1] // (groups or 1), *k],
+        input.dtype, default_initializer=Xavier(),
+    )
+    out = _simple(
+        "conv3d", {"Input": [input], "Filter": [w]},
+        {"strides": stride if isinstance(stride, list) else [stride] * 3,
+         "paddings": padding if isinstance(padding, list) else [padding] * 3,
+         "dilations": dilation if isinstance(dilation, list)
+         else [dilation] * 3,
+         "groups": groups or 1},
+        out_slots=("Output",),
+    )
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            bias_attr, [num_filters], input.dtype, is_bias=True)
+        out = _simple(
+            "elementwise_add", {"X": [out], "Y": [b]}, {"axis": 1})
+    if act:
+        out = _unary(act, out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     groups=1, param_attr=None, bias_attr=None, act=None):
+    from ..initializer import Xavier
+
+    helper = LayerHelper("conv3d_transpose")
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    g = groups or 1
+    w = helper.create_parameter(
+        param_attr, [input.shape[1], num_filters // g, *k], input.dtype,
+        default_initializer=Xavier(),
+    )
+    out = _simple(
+        "conv3d_transpose", {"Input": [input], "Filter": [w]},
+        {"strides": stride if isinstance(stride, list) else [stride] * 3,
+         "paddings": padding if isinstance(padding, list) else [padding] * 3,
+         "groups": g},
+        out_slots=("Output",),
+    )
+    if act:
+        out = _unary(act, out)
+    return out
+
+
+def pool3d(input, pool_size=2, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    return _simple(
+        "pool3d", {"X": [input]},
+        {"ksize": pool_size if isinstance(pool_size, list)
+         else [pool_size] * 3,
+         "pooling_type": pool_type,
+         "strides": pool_stride if isinstance(pool_stride, list)
+         else [pool_stride] * 3,
+         "paddings": pool_padding if isinstance(pool_padding, list)
+         else [pool_padding] * 3,
+         "global_pooling": global_pooling},
+    )
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return v if isinstance(v, (list, tuple)) else [v, v]
+
+    p = _pair(paddings)
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    return _simple(
+        "unfold", {"X": [x]},
+        {"kernel_sizes": _pair(kernel_sizes), "strides": _pair(strides),
+         "paddings": p, "dilations": _pair(dilations)},
+        out_slots=("Y",),
+    )
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    return _simple(
+        "affine_grid", {"Theta": [theta], "OutputShape": [None]},
+        {"output_shape": list(out_shape), "align_corners": align_corners},
+        out_slots=("Output",),
+    )
+
+
+def grid_sampler(x, grid, name=None):
+    return _simple("grid_sampler", {"X": [x], "Grid": [grid]}, {})
+
+
+def interpolate(input, out_shape=None, scale=None, resample="BILINEAR",
+                align_corners=True, name=None):
+    op = {"BILINEAR": "bilinear_interp", "NEAREST": "nearest_interp",
+          "TRILINEAR": "trilinear_interp", "BICUBIC": "bicubic_interp",
+          "LINEAR": "linear_interp"}[resample.upper()]
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        names = (["out_w"] if len(out_shape) == 1 else
+                 ["out_h", "out_w"] if len(out_shape) == 2 else
+                 ["out_d", "out_h", "out_w"])
+        attrs.update(dict(zip(names, out_shape)))
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    return _simple(op, {"X": [input]}, attrs)
+
+
+image_resize = interpolate
+
+
+def resize_trilinear(input, out_shape=None, scale=None, align_corners=True,
+                     name=None):
+    return interpolate(input, out_shape, scale, "TRILINEAR", align_corners)
+
+
+def resize_bicubic(input, out_shape=None, scale=None, align_corners=True,
+                   name=None):
+    return interpolate(input, out_shape, scale, "BICUBIC", align_corners)
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _simple("pixel_shuffle", {"X": [x]},
+                   {"upscale_factor": upscale_factor})
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _simple("space_to_depth", {"X": [x]}, {"blocksize": blocksize})
+
+
+def shuffle_channel(x, group, name=None):
+    return _simple("shuffle_channel", {"X": [x]}, {"group": group})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _simple("temporal_shift", {"X": [x]},
+                   {"seg_num": seg_num, "shift_ratio": shift_ratio})
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    return _simple("lrn", {"X": [input]},
+                   {"n": n, "k": k, "alpha": alpha, "beta": beta})
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    return _simple(
+        "affine_channel", {"X": [x], "Scale": [scale], "Bias": [bias]},
+        {"data_layout": data_layout},
+    )
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _simple("add_position_encoding", {"X": [input]},
+                   {"alpha": alpha, "beta": beta})
+
+
+def bilinear_tensor_product(x, y, size, param_attr=None, bias_attr=None,
+                            act=None, name=None):
+    from ..initializer import Xavier
+
+    helper = LayerHelper("bilinear_tensor_product", name=name)
+    w = helper.create_parameter(
+        param_attr, [size, x.shape[1], y.shape[1]], x.dtype,
+        default_initializer=Xavier(),
+    )
+    out = _simple(
+        "bilinear_tensor_product",
+        {"X": [x], "Y": [y], "Weight": [w], "Bias": [None]}, {},
+    )
+    if act:
+        out = _unary(act, out)
+    return out
+
+
+# -- losses ----------------------------------------------------------------
+
+
+def mse_loss(input, label):
+    from . import tensor as t
+
+    return t.reduce_mean(t.square(input - label))
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    from . import tensor as t
+
+    sq = t.reduce_sum(t.square(x), dim=axis, keep_dim=True)
+    return x / t.sqrt(t.elementwise_max(
+        sq, t.fill_constant([1], "float32", epsilon)))
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    from . import tensor as t
+
+    label_f = t.cast(label, input.dtype)
+    inter = t.reduce_sum(input * label_f)
+    union = t.reduce_sum(input) + t.reduce_sum(label_f)
+    return 1.0 - (2.0 * inter + epsilon) / (union + epsilon)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    from . import tensor as t
+
+    sim = t.matmul(anchor, positive, transpose_y=True)  # [B, B]
+    b = anchor.shape[0]
+    tgt = t.reshape(labels, [b, 1])
+    eq = t.cast(t.equal(tgt, t.transpose(tgt, [1, 0])), "float32")
+    tgt_dist = eq / t.reduce_sum(eq, dim=1, keep_dim=True)
+    ce = t.reduce_mean(
+        t.reduce_sum((0.0 - tgt_dist) * t.log_softmax(sim), dim=1)
+    )
+    reg = l2_reg * (t.reduce_mean(t.reduce_sum(t.square(anchor), dim=1))
+                    + t.reduce_mean(t.reduce_sum(t.square(positive), dim=1)))
+    return ce + reg
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return _simple("label_smooth", {"X": [label], "PriorDist": [prior_dist]},
+                   {"epsilon": epsilon})
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return _simple("kldiv_loss", {"X": [x], "Target": [target]},
+                   {"reduction": reduction}, out_slots=("Loss",))
+
+
+def huber_loss(input, label, delta):
+    return _simple("huber_loss", {"X": [input], "Y": [label]},
+                   {"delta": delta}, out_slots=("Out", "Residual"))[0]
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _simple("log_loss", {"Predicted": [input], "Labels": [label]},
+                   {"epsilon": epsilon}, out_slots=("Loss",))
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    return _simple(
+        "smooth_l1_loss", {"X": [x], "Y": [y]},
+        {"sigma": sigma or 1.0}, out_slots=("Out", "Diff"),
+    )[0]
+
+
+def rank_loss(label, left, right, name=None):
+    return _simple("rank_loss",
+                   {"Label": [label], "Left": [left], "Right": [right]}, {})
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return _simple(
+        "margin_rank_loss",
+        {"Label": [label], "X1": [left], "X2": [right]},
+        {"margin": margin},
+    )
+
+
+def bpr_loss(input, label, name=None):
+    return _simple("bpr_loss", {"X": [input], "Label": [label]},
+                   out_slots=("Y",), attrs={})
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    from ..initializer import Normal
+
+    helper = LayerHelper("center_loss")
+    centers = helper.create_parameter(
+        param_attr, [num_classes, input.shape[-1]], input.dtype,
+        default_initializer=Normal(0.0, 1.0),
+    )
+    rate = _t.fill_constant([1], "float32", alpha)
+    loss, _, _ = _simple(
+        "center_loss",
+        {"X": [input], "Label": [label], "Centers": [centers],
+         "CenterUpdateRate": [rate]},
+        {"need_update": update_center},
+        out_slots=("Loss", "SampleCenterDiff", "CentersOut"),
+    )
+    return loss
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    return _simple("teacher_student_sigmoid_loss",
+                   {"X": [input], "Label": [label]}, {}, out_slots=("Y",))
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    from ..initializer import Xavier
+
+    helper = LayerHelper("nce", name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        param_attr, [num_total_classes, d], input.dtype,
+        default_initializer=Xavier(),
+    )
+    b = helper.create_parameter(
+        bias_attr, [num_total_classes], input.dtype, is_bias=True)
+    cost, _, _ = _simple(
+        "nce",
+        {"Input": [input], "Label": [label], "Weight": [w], "Bias": [b],
+         "SampleWeight": [sample_weight]},
+        {"num_total_classes": num_total_classes,
+         "num_neg_samples": num_neg_samples,
+         "sampler": {"uniform": 0, "log_uniform": 1}.get(sampler, 0),
+         "seed": seed},
+        out_slots=("Cost", "SampleLogits", "SampleLabels"),
+    )
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    from ..initializer import Xavier
+
+    helper = LayerHelper("hsigmoid", name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        param_attr, [num_classes - 1, d], input.dtype,
+        default_initializer=Xavier(),
+    )
+    b = helper.create_parameter(
+        bias_attr, [num_classes - 1], input.dtype, is_bias=True)
+    out, _ = _simple(
+        "hierarchical_sigmoid",
+        {"X": [input], "Label": [label], "W": [w], "Bias": [b],
+         "PathTable": [path_table], "PathCode": [path_code]},
+        {"num_classes": num_classes},
+        out_slots=("Out", "PreOut"),
+    )
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    loss, _ = _simple(
+        "warpctc",
+        {"Logits": [input], "Label": [label],
+         "LogitsLength": [input_length], "LabelLength": [label_length]},
+        {"blank": blank, "norm_by_times": norm_by_times},
+        out_slots=("Loss", "WarpCTCGrad"),
+    )
+    return loss
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    return _simple(
+        "edit_distance",
+        {"Hyps": [input], "Refs": [label],
+         "HypsLength": [input_length], "RefsLength": [label_length]},
+        {"normalized": normalized},
+        out_slots=("Out", "SequenceNum"),
+    )
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples, seed=0):
+    from . import tensor as t
+
+    _, _, sampled_logits, sampled_label = _simple(
+        "sample_logits", {"Logits": [logits], "Labels": [label]},
+        {"num_samples": num_samples, "seed": seed},
+        out_slots=("Samples", "Probabilities", "SampledLogits",
+                   "SampledLabel"),
+    )
+    return t.softmax_with_cross_entropy(
+        sampled_logits, t.cast(sampled_label, "int64")
+    )
+
+
+# -- misc ------------------------------------------------------------------
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _simple("hash", {"X": [input]},
+                   {"mod_by": hash_size, "num_hash": num_hash})
+
+
+def random_crop(x, shape, seed=0):
+    out, _ = _simple(
+        "random_crop", {"X": [x], "Seed": [None]},
+        {"shape": list(shape), "seed": seed},
+        out_slots=("Out", "SeedOut"),
+    )
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _simple("similarity_focus", {"X": [input]},
+                   {"axis": axis, "indexes": list(indexes)})
+
+
+def polygon_box_transform(input, name=None):
+    return _simple("polygon_box_transform", {"Input": [input]}, {},
+                   out_slots=("Output",))
+
+
+def fsp_matrix(x, y):
+    return _simple("fsp", {"X": [x], "Y": [y]}, {})
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    # reference cvm op: with use_cvm the [show, click] prefix passes
+    # through (log-transformed upstream); without, it is stripped
+    from . import tensor as t
+
+    if use_cvm:
+        return input
+    return t.slice(input, axes=[1], starts=[2], ends=[input.shape[1]])
+
+
+def linear(x, weight, bias=None, name=None):
+    from . import tensor as t
+
+    out = t.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _simple("pad", {"X": [x]},
+                   {"paddings": list(paddings), "pad_value": pad_value})
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _simple("pad_constant_like", {"X": [x], "Y": [y]},
+                   {"pad_value": pad_value})
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    return _simple(
+        "psroi_pool",
+        {"X": [input], "ROIs": [rois], "RoisNum": [rois_num]},
+        {"output_channels": output_channels, "spatial_scale": spatial_scale,
+         "pooled_height": pooled_height, "pooled_width": pooled_width},
+    )
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    return _simple(
+        "prroi_pool",
+        {"X": [input], "ROIs": [rois], "BatchRoINums": [batch_roi_nums]},
+        {"spatial_scale": spatial_scale, "pooled_height": pooled_height,
+         "pooled_width": pooled_width},
+    )
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    return _simple(
+        "roi_perspective_transform", {"X": [input], "ROIs": [rois]},
+        {"transformed_height": transformed_height,
+         "transformed_width": transformed_width,
+         "spatial_scale": spatial_scale},
+        out_slots=("Out", "Mask", "TransformMatrix"),
+    )
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    from ..initializer import Xavier
+
+    def _pair(v):
+        return v if isinstance(v, (list, tuple)) else [v, v]
+
+    helper = LayerHelper("deformable_conv", name=name)
+    k = _pair(filter_size)
+    w = helper.create_parameter(
+        param_attr, [num_filters, input.shape[1] // (groups or 1), *k],
+        input.dtype, default_initializer=Xavier(),
+    )
+    attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+             "dilations": _pair(dilation), "groups": groups or 1,
+             "deformable_groups": deformable_groups}
+    if modulated:
+        return _simple(
+            "deformable_conv",
+            {"Input": [input], "Offset": [offset], "Mask": [mask],
+             "Filter": [w]},
+            attrs, out_slots=("Output",),
+        )
+    return _simple(
+        "deformable_conv_v1",
+        {"Input": [input], "Offset": [offset], "Filter": [w]},
+        attrs, out_slots=("Output",),
+    )
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           name=None):
+    out, _ = _simple(
+        "deformable_psroi_pooling",
+        {"Input": [input], "ROIs": [rois], "Trans": [trans]},
+        {"no_trans": no_trans, "spatial_scale": spatial_scale,
+         "output_dim": input.shape[1] // (pooled_height * pooled_width)
+         if position_sensitive else input.shape[1],
+         "pooled_height": pooled_height, "pooled_width": pooled_width,
+         "trans_std": trans_std},
+        out_slots=("Output", "TopCount"),
+    )
+    return out
+
+
+# -- second batch: remaining 2.0 functional surface ------------------------
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from ..initializer import Constant
+
+    helper = LayerHelper("group_norm", name=name)
+    c = input.shape[1]
+    scale = helper.create_parameter(
+        param_attr, [c], input.dtype, default_initializer=Constant(1.0))
+    bias = helper.create_parameter(
+        bias_attr, [c], input.dtype, is_bias=True)
+    out = _simple(
+        "group_norm", {"X": [input], "Scale": [scale], "Bias": [bias]},
+        {"groups": groups, "epsilon": epsilon},
+        out_slots=("Y",),
+    )
+    if act:
+        out = _unary(act, out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..initializer import Constant
+
+    helper = LayerHelper("instance_norm", name=name)
+    c = input.shape[1]
+    scale = helper.create_parameter(
+        param_attr, [c], input.dtype, default_initializer=Constant(1.0))
+    bias = helper.create_parameter(
+        bias_attr, [c], input.dtype, is_bias=True)
+    return _simple(
+        "instance_norm", {"X": [input], "Scale": [scale], "Bias": [bias]},
+        {"epsilon": epsilon}, out_slots=("Y",),
+    )
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return _simple(
+        "pad2d", {"X": [input]},
+        {"paddings": list(paddings), "mode": mode, "pad_value": pad_value},
+    )
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    return _simple("diag_embed", {"Input": [input]},
+                   {"offset": offset, "dim1": dim1, "dim2": dim2})
+
+
+def merge_selected_rows(x, name=None):
+    return _simple("merge_selected_rows", {"X": [x]}, {})
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    return _simple(
+        "filter_by_instag",
+        {"Ins": [ins], "Ins_tag": [ins_tag], "Filter_tag": [filter_tag]},
+        {"out_val_if_empty": out_val_if_empty},
+        out_slots=("Out", "LossWeight", "IndexMap"),
+    )
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    return _simple(
+        "tensor_array_to_tensor", {"X": [input]},
+        {"axis": axis, "use_stack": use_stack},
+        out_slots=("Out", "OutIndex"),
+    )
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    return _simple(
+        "pool3d", {"X": [input]},
+        {"ksize": pool_size if isinstance(pool_size, (list, tuple))
+         else [pool_size] * 3,
+         "pooling_type": pool_type, "adaptive": True},
+    )
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    ratio = out_short_len / float(short)
+    return interpolate(
+        input, out_shape=[int(round(h * ratio)), int(round(w * ratio))],
+        resample=resample,
+    )
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  name=None, sequence_length=None):
+    """lstmp over padded [B, T, D] (reference dynamic_lstmp over LoD)."""
+    from ..initializer import Xavier
+
+    helper = LayerHelper("lstmp", name=name)
+    hidden = size // 4
+    d = input.shape[-1]
+    wih = helper.create_parameter(
+        None, [4 * hidden, d], "float32", default_initializer=Xavier())
+    whh = helper.create_parameter(
+        param_attr, [4 * hidden, proj_size], "float32",
+        default_initializer=Xavier())
+    wproj = helper.create_parameter(
+        None, [hidden, proj_size], "float32",
+        default_initializer=Xavier())
+    bias = helper.create_parameter(
+        bias_attr, [4 * hidden], "float32", is_bias=True)
+    proj, out, _, _ = _simple(
+        "lstmp",
+        {"X": [input], "WIH": [wih], "WHH": [whh], "ProjWeight": [wproj],
+         "Bias": [bias], "H0": [None], "C0": [None],
+         "SeqLen": [sequence_length]},
+        {},
+        out_slots=("Projection", "Out", "LastH", "LastC"),
+    )
+    return proj, out
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    """Single GRU step (reference gru_unit_op): runs the gru emitter on a
+    length-1 sequence."""
+    from . import tensor as t
+
+    x3 = t.reshape(input, [input.shape[0], 1, input.shape[-1]])
+    out, last = _t_gru(x3, size // 3, hidden, param_attr, bias_attr)
+    return last, last, last
+
+
+def _t_gru(x, hidden_size, h0, param_attr, bias_attr):
+    from .rnn import gru
+
+    return gru(x, hidden_size, init_h=h0, param_attr=param_attr,
+               bias_attr=bias_attr)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step (reference lstm_unit): fc over [x, h] + raw cell
+    math through existing ops."""
+    from . import tensor as t
+
+    concat_in = t.concat([x_t, hidden_t_prev], axis=1)
+    hidden = hidden_t_prev.shape[-1]
+    gates = t.fc(concat_in, 4 * hidden, param_attr=param_attr,
+                 bias_attr=bias_attr)
+    i, f, c_hat, o = t.split(gates, num_or_sections=4, dim=-1)
+    f = t.sigmoid(f + forget_bias)
+    cell = f * cell_t_prev + t.sigmoid(i) * t.tanh(c_hat)
+    hidden_out = t.sigmoid(o) * t.tanh(cell)
+    return hidden_out, cell
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """SSD decode + NMS composition (reference detection_output: box_coder
+    decode_center_size then multiclass_nms)."""
+    from . import tensor as t
+    from .detection import box_coder, multiclass_nms
+
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    scores_t = t.transpose(scores, [0, 2, 1])  # [N, C, M]
+    out, _ = multiclass_nms(
+        decoded if decoded.shape and len(decoded.shape) == 3
+        else t.reshape(decoded, [1, *decoded.shape]),
+        scores_t,
+        score_threshold=score_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+        background_label=background_label,
+    )
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    # persistable int counter bumped in-graph by the increment emitter
+    from ..framework.program import (
+        default_main_program,
+        default_startup_program,
+    )
+
+    blk = default_main_program().global_block
+    name = counter_name or "@STEP_COUNTER@"
+    if not blk.has_var(name):
+        v = blk.create_parameter(name, [1], "int64", trainable=False)
+        sb = default_startup_program().global_block
+        if not sb.has_var(name):
+            sb.create_parameter(name, [1], "int64", trainable=False)
+            sb.append_op(
+                "fill_constant", {}, {"Out": [name]},
+                {"shape": [1], "dtype": "int64",
+                 "value": float(begin - step)},
+            )
+    else:
+        v = blk.var(name)
+    blk.append_op("increment", {"X": [name]}, {"Out": [name]},
+                  {"step": float(step)})
+    return blk.var(name)

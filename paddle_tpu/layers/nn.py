@@ -189,7 +189,10 @@ def pool2d(
     return helper.create_and_append({"X": [input]}, attrs)
 
 
-def adaptive_pool2d(input, pool_size, pool_type="avg", name=None):
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    # pool_type default follows the fluid reference (nn.py adaptive_pool2d
+    # defaults to max)
     helper = LayerHelper("pool2d", name=name)
     attrs = {
         "ksize": list(pool_size) if isinstance(pool_size, (list, tuple)) else [pool_size] * 2,
@@ -215,7 +218,14 @@ def batch_norm(
 ):
     """moving_mean_name/moving_variance_name (fluid layers/nn.py batch_norm
     params): deterministic running-stat names so a separately built
-    inference program shares the trained statistics."""
+    inference program shares the trained statistics.
+
+    Numerics note (advisor r2): training stats use the single-pass
+    E[x^2]-E[x]^2 form with fp32 accumulation (ops/nn.py batch_norm).
+    Cancellation is benign for the normalized-activation inputs BN sees in
+    practice, but inputs with LARGE channel means (e.g. raw unnormalized
+    images at the first layer) can lose precision — normalize inputs
+    upstream or standardize them before the first BN."""
     helper = LayerHelper("batch_norm", name=name)
     c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
     dtype = input.dtype if input.dtype != "float16" else "float32"

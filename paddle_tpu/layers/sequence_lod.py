@@ -169,3 +169,89 @@ def sequence_expand(x, ref_lengths, maxlen):
 def sequence_concat(xs, axis=1):
     """Concatenate along the time axis (padded tensors)."""
     return tensor.concat(xs, axis=axis)
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, lengths=None):
+    """Padded-dense analog of sequence_pad_op: sequences here are ALREADY
+    the padded [B, T, ...] frame, so this normalizes the pad tail to
+    pad_value using `lengths` and returns (padded, lengths) like the
+    reference's (Out, Length) pair."""
+    from . import tensor as t
+
+    if lengths is None:
+        return x, None
+    B, T = x.shape[0], x.shape[1]
+    mask = sequence_mask(lengths, maxlen=T, dtype=x.dtype)  # [B, T]
+    while len(mask.shape) < len(x.shape):
+        mask = t.unsqueeze(mask, axes=[len(mask.shape)])
+    return x * mask + (1.0 - mask) * pad_value, lengths
+
+
+def sequence_unpad(x, length):
+    """Inverse of sequence_pad under the dense contract: zero the tail
+    beyond each row's length (the reference emits a packed LoD tensor; the
+    dense frame + lengths IS this framework's unpadded form)."""
+    out, _ = sequence_pad(x, 0.0, lengths=length)
+    return out
+
+
+def sequence_expand_as(x, y_lengths, maxlen):
+    """Each row of x repeats across its target sequence's positions
+    (reference sequence_expand_as over LoD): x [B, D] -> [B, maxlen, D]
+    masked by y_lengths."""
+    from . import tensor as t
+
+    xe = t.unsqueeze(x, axes=[1])  # [B, 1, D]
+    xe = t.expand(xe, expand_times=[1, maxlen, 1])
+    mask = sequence_mask(y_lengths, maxlen=maxlen, dtype=x.dtype)
+    return xe * t.unsqueeze(mask, axes=[2])
+
+
+def sequence_conv(input, num_filters, filter_size=3, padding=True,
+                  param_attr=None, bias_attr=None, act=None, lengths=None):
+    if not padding:
+        raise NotImplementedError(
+            "sequence_conv: only same-padded windows are supported in the "
+            "dense frame (padding=False would shrink T, breaking the "
+            "static [B, T, ...] contract)"
+        )
+    """Window conv over time (sequence_conv_op): y_t = sum_j x_{t+j} W_j
+    over a centered window. Dense form: shifted-concat + fc (one matmul on
+    the MXU)."""
+    from . import tensor as t
+    from .helper import LayerHelper
+    from ..initializer import Xavier
+
+    B, T, D = input.shape
+    half = (filter_size - 1) // 2
+    shifts = []
+    for j in range(-half, filter_size - half):
+        if j < 0:
+            sl = t.slice(input, axes=[1], starts=[0], ends=[T + j])
+            pad = t.fill_constant([B, -j, D], input.dtype, 0.0)
+            shifts.append(t.concat([pad, sl], axis=1))
+        elif j > 0:
+            sl = t.slice(input, axes=[1], starts=[j], ends=[T])
+            pad = t.fill_constant([B, j, D], input.dtype, 0.0)
+            shifts.append(t.concat([sl, pad], axis=1))
+        else:
+            shifts.append(input)
+    windows = t.concat(shifts, axis=2)  # [B, T, k*D]
+    helper = LayerHelper("sequence_conv")
+    w = helper.create_parameter(
+        param_attr, [filter_size * D, num_filters], input.dtype,
+        default_initializer=Xavier(),
+    )
+    out = t.matmul(windows, w)
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            bias_attr, [num_filters], input.dtype, is_bias=True)
+        out = out + b
+    if lengths is not None:
+        mask = sequence_mask(lengths, maxlen=T, dtype=input.dtype)
+        out = out * t.unsqueeze(mask, axes=[2])
+    if act:
+        from .tensor import _simple
+
+        out = _simple(act, {"X": [out]}, {})
+    return out

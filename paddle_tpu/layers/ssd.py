@@ -1,0 +1,120 @@
+"""SSD composite heads (reference fluid/layers/detection.py
+multi_box_head :1832 and ssd_loss :1230): compositions over prior_box /
+bipartite_match / target_assign / mine_hard_examples and the conv layers.
+Dense re-design: gt inputs are padded [N, G, 4]/-1 and every stage keeps
+fixed shapes (the matching/mining emitters are ops/detection_ext.py)."""
+
+from __future__ import annotations
+
+from . import tensor as t
+from .detection import (
+    bipartite_match,
+    box_coder,
+    iou_similarity,
+    mine_hard_examples,
+    prior_box,
+    target_assign,
+)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, flip=True,
+                   clip=False, name=None):
+    """Per-feature-map loc/conf convs + priors, concatenated (reference
+    multi_box_head). Returns (mbox_locs, mbox_confs, boxes, variances)."""
+    n_maps = len(inputs)
+    if min_sizes is None:
+        min_ratio, max_ratio = min_ratio or 20, max_ratio or 90
+        step = int((max_ratio - min_ratio) / max(n_maps - 2, 1))
+        min_sizes, max_sizes = [], []
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_maps - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_maps - 1]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, x in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        mins = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        maxs = max_sizes[i] if isinstance(max_sizes[i], (list, tuple)) \
+            else [max_sizes[i]]
+        boxes, variances = prior_box(
+            x, image, mins, maxs, ar, flip=flip, clip=clip, offset=offset,
+        )
+        a = boxes.shape[2] if len(boxes.shape) == 4 else 1
+        num_priors = 1
+        for d in boxes.shape[:-1]:
+            num_priors *= d
+        loc = t.conv2d(x, a * 4, 3, padding=1)
+        conf = t.conv2d(x, a * num_classes, 3, padding=1)
+        n = x.shape[0]
+        locs.append(t.reshape(t.transpose(loc, [0, 2, 3, 1]), [n, -1, 4]))
+        confs.append(t.reshape(t.transpose(conf, [0, 2, 3, 1]),
+                               [n, -1, num_classes]))
+        boxes_all.append(t.reshape(boxes, [-1, 4]))
+        vars_all.append(t.reshape(variances, [-1, 4]))
+    return (
+        t.concat(locs, axis=1),
+        t.concat(confs, axis=1),
+        t.concat(boxes_all, axis=0),
+        t.concat(vars_all, axis=0),
+    )
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_boxes,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None):
+    """SSD training loss (reference ssd_loss): match priors to gts,
+    assign targets, mine hard negatives, smooth-L1 loc + softmax conf.
+    Single-image dense contract (batch handled by vmapped callers):
+    location [1, P, 4], confidence [1, P, C], gt_box [G, 4],
+    gt_label [G, 1]."""
+    iou = iou_similarity(gt_box, prior_boxes)  # [G, P]
+    match_idx, match_dist = bipartite_match(iou, match_type, neg_overlap)
+    # conf loss per prior against assigned labels
+    gt_lab3 = t.reshape(t.cast(gt_label, "float32"), [1, -1, 1])
+    tgt_lab, tgt_lab_w = target_assign(
+        gt_lab3, match_idx, mismatch_value=background_label)
+    conf2 = t.reshape(confidence, [-1, confidence.shape[-1]])
+    lab2 = t.reshape(t.cast(tgt_lab, "int64"), [-1, 1])
+    conf_loss_all = t.softmax_with_cross_entropy(conf2, lab2)  # [P, 1]
+    conf_loss_row = t.reshape(conf_loss_all, [1, -1])
+    neg_idx, updated = mine_hard_examples(
+        conf_loss_row, match_idx, match_dist=match_dist,
+        neg_pos_ratio=neg_pos_ratio, neg_dist_threshold=neg_overlap,
+        sample_size=sample_size or 0, mining_type=mining_type,
+    )
+    pos_mask = t.cast(
+        t.greater_equal(t.cast(match_idx, "float32"),
+                        t.fill_constant([1], "float32", 0.0)),
+        "float32",
+    )  # [1, P]
+    neg_mask = t.cast(neg_idx, "float32")
+    conf_w = pos_mask + neg_mask
+    conf_loss = t.reduce_sum(conf_loss_row * conf_w)
+    # loc loss on matched priors
+    gt_box3 = t.reshape(gt_box, [1, -1, 4])
+    tgt_box, tgt_box_w = target_assign(gt_box3, match_idx, mismatch_value=0)
+    enc = box_coder(prior_boxes, prior_box_var, t.reshape(tgt_box, [-1, 4])) \
+        if prior_box_var is not None else t.reshape(tgt_box, [-1, 4])
+    loc2 = t.reshape(location, [-1, 4])
+    diff = t.abs(loc2 - t.reshape(enc, [-1, 4]))
+    l1 = t.where(
+        t.less_than(diff, t.fill_constant([1], "float32", 1.0) + diff * 0.0),
+        0.5 * diff * diff, diff - 0.5,
+    )
+    loc_loss = t.reduce_sum(
+        t.reduce_sum(l1, dim=1) * t.reshape(pos_mask, [-1])
+    )
+    n_pos = t.elementwise_max(
+        t.reduce_sum(pos_mask), t.fill_constant([1], "float32", 1.0))
+    total = (conf_loss_weight * conf_loss + loc_loss_weight * loc_loss)
+    if normalize:
+        total = total / n_pos
+    return total

@@ -242,7 +242,14 @@ def _p_norm(ctx, op, ins):
     p = float(op.attr("porder", 2.0))
     axis = int(op.attr("axis", -1))
     keep = bool(op.attr("keepdim", False))
-    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keep) ** (1.0 / p)
+    eps = float(op.attr("epsilon", 1e-12))
+    if op.attr("asvector", False):
+        # p_norm_op.cc asvector: reduce over the FLATTENED tensor
+        x = x.reshape(-1)
+        axis = 0
+    out = (jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keep) + eps) ** (
+        1.0 / p
+    )
     return {"Out": [out]}
 
 
@@ -714,3 +721,9 @@ def _cvm(ctx, op, ins):
     if use_cvm:
         return {"Y": [jnp.concatenate([show, click, x[:, 2:]], axis=1)]}
     return {"Y": [x[:, 2:]]}
+
+
+@register_op("einsum", inputs=["Operands"], outputs=["Out"])
+def _einsum(ctx, op, ins):
+    """paddle.einsum (2.0 namespace; XLA contracts directly on the MXU)."""
+    return {"Out": [jnp.einsum(op.attr("equation"), *ins["Operands"])]}

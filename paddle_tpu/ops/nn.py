@@ -219,8 +219,9 @@ def _ln_use_pallas(ctx, x, begin):
     # is a fusion barrier, so the residual add feeding each LN materializes
     # instead of fusing into the normalization pass. The kernel stays for
     # workloads where LN is isolated (enable with
-    # FLAGS_paddle_tpu_pallas_layer_norm=1); the dedicated grad op below is
-    # unconditional and is what actually pays (no forward replay).
+    # FLAGS_paddle_tpu_pallas_layer_norm=1); the dedicated grad op below
+    # follows the same flag via _layer_norm_grad_maker — generic vjp when
+    # the flag is off.
     return (
         bool(flag("paddle_tpu_pallas_layer_norm"))
         and not gspmd_mode

@@ -1,68 +1,37 @@
-"""paddle.tensor 2.0-preview namespace (reference python/paddle/tensor/:
-creation / linalg / logic / manipulation / math / random / search / stat
-stubs re-exporting fluid layers)."""
+"""paddle.tensor 2.0-preview namespace (reference
+python/paddle/tensor/__init__.py): creation / linalg / logic /
+manipulation / math / random / search / stat / attribute alias trees.
+Import parity against the reference __all__ lists is enforced by
+tests/test_namespaces.py."""
 
-from ..layers.tensor import (  # noqa: F401
-    abs,
-    argmax,
-    argmin,
-    argsort,
-    assign,
-    cast,
-    ceil,
-    clip,
-    concat,
-    cos,
-    cumsum,
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .attribute import *  # noqa: F401,F403
+from . import (  # noqa: F401
+    attribute,
+    creation,
+    linalg,
+    logic,
+    manipulation,
+    math,
+    random,
+    search,
+    stat,
+)
+
+# forward-looking 2.x names kept from the round-2 namespace (the reference
+# 1.8 preview exposes the elementwise_* forms; both spellings work here)
+from ..layers import (  # noqa: F401
     elementwise_add as add,
     elementwise_div as divide,
     elementwise_max as maximum,
     elementwise_min as minimum,
     elementwise_mul as multiply,
     elementwise_sub as subtract,
-    equal,
-    exp,
-    expand,
-    fill_constant as full,
-    flatten,
-    floor,
-    gather,
-    gather_nd,
-    greater_equal,
-    greater_than,
-    less_equal,
-    less_than,
-    log,
-    logical_and,
-    logical_not,
-    logical_or,
-    matmul,
-    not_equal,
-    ones,
-    reciprocal,
-    reduce_max as max,
-    reduce_mean as mean,
-    reduce_min as min,
-    reduce_prod as prod,
-    reduce_sum as sum,
-    reshape,
-    round,
-    rsqrt,
-    scale,
-    shape,
-    sign,
-    sin,
-    slice,
-    split,
-    sqrt,
-    square,
-    squeeze,
-    stack,
-    take_along_axis,
-    topk,
-    transpose,
-    unsqueeze,
-    where,
-    zeros,
-    zeros_like,
 )

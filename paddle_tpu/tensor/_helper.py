@@ -1,0 +1,34 @@
+"""Registry-driven function bridge for the 2.0 tensor namespace: any
+registered op with the standard single-output contract becomes a python
+function whose positional args fill the op's input slots in order and
+whose kwargs become attrs (the reference's alias tree hand-writes each of
+these; the registry makes them mechanical)."""
+
+from __future__ import annotations
+
+from ..framework.registry import get_op_def
+from ..layers.helper import LayerHelper
+
+
+def op_fn(op_type, out_slot=None, n_out=1):
+    od = get_op_def(op_type)
+    slots = list(od.input_slots)
+    out = out_slot or od.output_slots[0]
+
+    def fn(*args, name=None, **attrs):
+        ins = {s: [None] for s in slots}
+        for s, a in zip(slots, args):
+            ins[s] = [a]
+        helper = LayerHelper(op_type, name=name)
+        res = helper.create_and_append(
+            {k: v for k, v in ins.items() if v[0] is not None},
+            attrs, op_type=op_type,
+            out_slots=tuple(od.output_slots[:max(n_out, 1)]),
+        )
+        if n_out == 1 and isinstance(res, tuple):
+            return res[0]
+        return res
+
+    fn.__name__ = op_type
+    fn.__doc__ = f"Auto-bridged {op_type} op (framework.registry)."
+    return fn

@@ -1,0 +1,8 @@
+"""paddle.tensor.attribute (reference python/paddle/tensor/attribute.py aliases)."""
+
+from ..layers import shape  # noqa: F401
+
+def rank(input):
+    from ..layers import fill_constant
+
+    return fill_constant([1], "int32", float(len(input.shape or ())))

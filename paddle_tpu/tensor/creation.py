@@ -1,0 +1,35 @@
+"""paddle.tensor.creation (reference python/paddle/tensor/creation.py aliases)."""
+
+from ..layers import fill_constant  # noqa: F401
+from ..layers import fill_constant as full  # noqa: F401
+from ..layers import ones  # noqa: F401
+from ..layers import ones_like  # noqa: F401
+from ..layers import tril  # noqa: F401
+from ..layers import triu  # noqa: F401
+from ..layers import zeros  # noqa: F401
+from ..layers import zeros_like  # noqa: F401
+
+from ._helper import op_fn as _op_fn
+full_like = None  # assigned below (fill_any_like op bridge)
+
+create_tensor = None  # assigned below
+crop_tensor = _op_fn("crop_tensor")
+diag = _op_fn("diag")
+eye = _op_fn("eye")
+linspace = _op_fn("linspace")
+meshgrid = _op_fn("meshgrid")
+get_tensor_from_selected_rows = _op_fn("get_tensor_from_selected_rows")
+arange = _op_fn("range")
+full_like = _op_fn("fill_any_like")
+
+
+def _create_tensor(dtype="float32", name=None):
+    from ..framework.program import default_main_program
+    from ..framework import unique_name
+
+    return default_main_program().current_block().create_var(
+        name=name or unique_name.generate("tensor"), shape=[1], dtype=dtype
+    )
+
+
+create_tensor = _create_tensor

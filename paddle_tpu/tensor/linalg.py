@@ -1,0 +1,62 @@
+"""paddle.tensor.linalg (reference python/paddle/tensor/linalg.py aliases)."""
+
+from ..layers import matmul  # noqa: F401
+from ..layers import transpose as t  # noqa: F401
+from ..layers import transpose  # noqa: F401
+
+from ._helper import op_fn as _op_fn
+
+bmm = _op_fn("bmm")
+cholesky = _op_fn("cholesky")
+cross = _op_fn("cross")
+dist = _op_fn("dist")
+dot = _op_fn("dot")
+histogram = _op_fn("histogram")
+
+
+def einsum(equation, *operands):
+    from ..layers.tensor import _simple
+
+    return _simple("einsum", {"Operands": list(operands)},
+                   {"equation": equation})
+
+
+def tensordot(x, y, axes=2, name=None):
+    # composition: flatten the contracted axes into one matmul
+    from ..layers import matmul, reshape, transpose
+
+    if isinstance(axes, int):
+        ax = list(range(len(x.shape) - axes, len(x.shape)))
+        ay = list(range(axes))
+    else:
+        ax, ay = list(axes[0]), list(axes[1])
+    keep_x = [i for i in range(len(x.shape)) if i not in ax]
+    keep_y = [i for i in range(len(y.shape)) if i not in ay]
+    import numpy as _np
+
+    kx = int(_np.prod([x.shape[i] for i in keep_x] or [1]))
+    cx = int(_np.prod([x.shape[i] for i in ax] or [1]))
+    ky = int(_np.prod([y.shape[i] for i in keep_y] or [1]))
+    xm = reshape(transpose(x, keep_x + ax), [kx, cx])
+    ym = reshape(transpose(y, ay + keep_y), [cx, ky])
+    out = matmul(xm, ym)
+    return reshape(out, [x.shape[i] for i in keep_x]
+                   + [y.shape[i] for i in keep_y])
+from ..layers.tensor import _simple as __simple
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    """paddle.norm: frobenius via the frobenius_norm op, p-norms via
+    p_norm."""
+    if p in ("fro", "FRO", None):
+        return __simple(
+            "frobenius_norm", {"X": [x]},
+            {"reduce_all": axis is None,
+             "dim": [axis] if isinstance(axis, int) else (axis or [0]),
+             "keep_dim": keepdim},
+        )
+    return __simple(
+        "p_norm", {"X": [x]},
+        {"porder": float(p), "axis": axis if axis is not None else -1,
+         "keepdim": keepdim},
+    )

@@ -1,0 +1,26 @@
+"""paddle.tensor.logic (reference python/paddle/tensor/logic.py aliases)."""
+
+from ..layers import equal  # noqa: F401
+from ..layers import greater_equal  # noqa: F401
+from ..layers import greater_than  # noqa: F401
+from ..layers import less_equal  # noqa: F401
+from ..layers import less_than  # noqa: F401
+from ..layers import logical_and  # noqa: F401
+from ..layers import logical_not  # noqa: F401
+from ..layers import logical_or  # noqa: F401
+from ..layers import not_equal  # noqa: F401
+
+from ._helper import op_fn as _op_fn
+
+allclose = _op_fn("allclose")
+is_empty = _op_fn("is_empty")
+isfinite = _op_fn("isfinite")
+logical_xor = _op_fn("logical_xor")
+reduce_all = _op_fn("reduce_all")
+reduce_any = _op_fn("reduce_any")
+
+
+def isnan(x, name=None):
+    from ..layers import logical_not
+
+    return logical_not(_op_fn("isfinite")(x))

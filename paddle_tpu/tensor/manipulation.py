@@ -1,0 +1,37 @@
+"""paddle.tensor.manipulation (reference python/paddle/tensor/manipulation.py aliases)."""
+
+from ..layers import cast  # noqa: F401
+from ..layers import concat  # noqa: F401
+from ..layers import expand  # noqa: F401
+from ..layers import flatten  # noqa: F401
+from ..layers import gather  # noqa: F401
+from ..layers import gather_nd  # noqa: F401
+from ..layers import reshape  # noqa: F401
+from ..layers import scatter  # noqa: F401
+from ..layers import slice  # noqa: F401
+from ..layers import split  # noqa: F401
+from ..layers import squeeze  # noqa: F401
+from ..layers import stack  # noqa: F401
+from ..layers import transpose  # noqa: F401
+from ..layers import unsqueeze  # noqa: F401
+
+from ._helper import op_fn as _op_fn
+
+expand_as = _op_fn("expand_as")
+flip = _op_fn("flip")
+reverse = _op_fn("reverse")
+roll = _op_fn("roll")
+scatter_nd_add = _op_fn("scatter_nd_add")
+shard_index = _op_fn("shard_index")
+strided_slice = _op_fn("strided_slice")
+unbind = _op_fn("unbind", n_out=1)
+unstack = _op_fn("unstack")
+unique = _op_fn("unique", n_out=2)
+unique_with_counts = _op_fn("unique_with_counts", n_out=3)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from ..layers import fill_constant
+
+    zero = fill_constant(list(shape), "float32", 0.0)
+    return scatter_nd_add(zero, index, updates)

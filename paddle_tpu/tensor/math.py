@@ -1,0 +1,82 @@
+"""paddle.tensor.math (reference python/paddle/tensor/math.py aliases)."""
+
+from ..layers import abs  # noqa: F401
+from ..layers import elementwise_add as add  # noqa: F401
+from ..layers import ceil  # noqa: F401
+from ..layers import clip as clamp  # noqa: F401
+from ..layers import cos  # noqa: F401
+from ..layers import cumsum  # noqa: F401
+from ..layers import elementwise_div as div  # noqa: F401
+from ..layers import elementwise_add  # noqa: F401
+from ..layers import elementwise_div  # noqa: F401
+from ..layers import elementwise_max  # noqa: F401
+from ..layers import elementwise_min  # noqa: F401
+from ..layers import elementwise_mod  # noqa: F401
+from ..layers import elementwise_mul  # noqa: F401
+from ..layers import elementwise_pow  # noqa: F401
+from ..layers import elementwise_sub  # noqa: F401
+from ..layers import sums as elementwise_sum  # noqa: F401
+from ..layers import erf  # noqa: F401
+from ..layers import exp  # noqa: F401
+from ..layers import floor  # noqa: F401
+from ..layers import increment  # noqa: F401
+from ..layers import log  # noqa: F401
+from ..layers import reduce_max as max  # noqa: F401
+from ..layers import reduce_min as min  # noqa: F401
+from ..layers import matmul as mm  # noqa: F401
+from ..layers import mul  # noqa: F401
+from ..layers import elementwise_pow as pow  # noqa: F401
+from ..layers import reciprocal  # noqa: F401
+from ..layers import reduce_max  # noqa: F401
+from ..layers import reduce_min  # noqa: F401
+from ..layers import reduce_prod  # noqa: F401
+from ..layers import reduce_sum  # noqa: F401
+from ..layers import round  # noqa: F401
+from ..layers import rsqrt  # noqa: F401
+from ..layers import scale  # noqa: F401
+from ..layers import sign  # noqa: F401
+from ..layers import sin  # noqa: F401
+from ..layers import sqrt  # noqa: F401
+from ..layers import square  # noqa: F401
+from ..layers import stanh  # noqa: F401
+from ..layers import reduce_sum as sum  # noqa: F401
+from ..layers import sums  # noqa: F401
+from ..layers import tanh  # noqa: F401
+
+from ._helper import op_fn as _op_fn
+
+elementwise_floordiv = _op_fn("elementwise_floordiv")
+inverse = _op_fn("inverse")
+kron = _op_fn("kron")
+log1p = _op_fn("log1p")
+multiplex = _op_fn("multiplex")
+trace = _op_fn("trace")
+addmm = _op_fn("addmm")
+
+
+def acos(x, name=None):
+    from ..layers.tensor import _simple
+
+    return _simple("acos", {"X": [x]}, {})
+
+
+def asin(x, name=None):
+    from ..layers.tensor import _simple
+
+    return _simple("asin", {"X": [x]}, {})
+
+
+def atan(x, name=None):
+    from ..layers.tensor import _simple
+
+    return _simple("atan", {"X": [x]}, {})
+
+
+def logsumexp(x, dim=None, keepdim=False, name=None):
+    from ..layers import exp, log, reduce_sum
+
+    return log(reduce_sum(exp(x), dim=dim, keep_dim=keepdim))
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    return input + value * (tensor1 * tensor2)

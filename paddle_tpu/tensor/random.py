@@ -1,0 +1,11 @@
+"""paddle.tensor.random (reference python/paddle/tensor/random.py aliases)."""
+
+from ..layers import uniform_random as rand  # noqa: F401
+from ..layers import gaussian_random as randn  # noqa: F401
+from ..layers import uniform_random as uniform  # noqa: F401
+
+from ._helper import op_fn as _op_fn
+
+randint = _op_fn("randint")
+randperm = _op_fn("randperm")
+shuffle = _op_fn("shuffle_batch")

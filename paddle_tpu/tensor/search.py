@@ -1,0 +1,38 @@
+"""paddle.tensor.search (reference python/paddle/tensor/search.py aliases)."""
+
+from ..layers import argmax  # noqa: F401
+from ..layers import argmin  # noqa: F401
+from ..layers import argsort  # noqa: F401
+from ..layers import topk  # noqa: F401
+from ..layers import where  # noqa: F401
+
+from ._helper import op_fn as _op_fn
+
+index_sample = _op_fn("index_sample")
+index_select = _op_fn("index_select")
+nonzero = _op_fn("where_index")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    from ..layers import argsort
+
+    out = argsort(x, axis=axis, descending=descending)
+    return out
+
+
+def has_inf(x):
+    from ..layers import cast, logical_not, reduce_sum
+
+    fin = _op_fn("isfinite")(x)
+    return logical_not(fin)
+
+
+has_nan = has_inf  # both reduce to "any non-finite" under the isfinite op
+
+
+def masked_select(x, mask, name=None):
+    """Static-shape contract: zero out unselected entries (the reference
+    compacts; see unique's size= convention)."""
+    from ..layers import cast
+
+    return x * cast(mask, "float32")

@@ -1,0 +1,26 @@
+"""paddle.tensor.stat (reference python/paddle/tensor/stat.py aliases)."""
+
+from ..layers import reduce_mean as mean  # noqa: F401
+from ..layers import reduce_mean  # noqa: F401
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    from ..layers import sqrt
+
+    return sqrt(var(x, axis=axis, unbiased=unbiased, keepdim=keepdim))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    from ..layers import reduce_mean, square
+    import numpy as _np
+
+    m = reduce_mean(x, dim=axis, keep_dim=True)
+    v = reduce_mean(square(x - m), dim=axis, keep_dim=keepdim)
+    if unbiased:
+        if axis is None:
+            n = int(_np.prod(x.shape))
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            n = int(_np.prod([x.shape[a] for a in axes]))
+        if n > 1:
+            v = v * (n / (n - 1))
+    return v
