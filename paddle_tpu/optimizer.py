@@ -615,6 +615,55 @@ class DpsgdOptimizer(Optimizer):
         )
 
 
+class ProximalGDOptimizer(Optimizer):
+    """reference optimizers/proximal_gd_op.cc: SGD step + L1 soft-threshold
+    + L2 shrink."""
+
+    def __init__(self, learning_rate, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        lr = self._create_lr(block)
+        return block.append_op(
+            "proximal_gd",
+            {"Param": [p.name], "Grad": [g.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [p.name]},
+            {"l1": self._l1, "l2": self._l2},
+        )
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """reference optimizers/proximal_adagrad_op.cc: adagrad-scaled lr into
+    the proximal update."""
+
+    def __init__(self, learning_rate, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        lr = self._create_lr(block)
+        return block.append_op(
+            "proximal_adagrad",
+            {"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [p.name], "MomentOut": [m.name]},
+            {"l1": self._l1, "l2": self._l2},
+        )
+
+
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adam = AdamOptimizer
@@ -628,6 +677,8 @@ Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
 Dpsgd = DpsgdOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer
 
 
 # ---------------------------------------------------------------------------

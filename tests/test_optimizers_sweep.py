@@ -1,0 +1,81 @@
+"""Optimizer sweep (reference test_optimizer.py role): every fluid
+optimizer class — including the round-3 ProximalGD/ProximalAdagrad —
+reduces fit-a-line loss; proximal L1 shrinks weights toward sparsity."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+
+OPTS = [
+    ("SGD", lambda: fluid.optimizer.SGD(0.05)),
+    ("Momentum", lambda: fluid.optimizer.Momentum(0.02, 0.9)),
+    ("Adam", lambda: fluid.optimizer.Adam(0.05)),
+    ("AdamW", lambda: fluid.optimizer.AdamW(0.05)),
+    ("Adamax", lambda: fluid.optimizer.Adamax(0.05)),
+    ("Adagrad", lambda: fluid.optimizer.Adagrad(0.2)),
+    ("DecayedAdagrad", lambda: fluid.optimizer.DecayedAdagrad(0.2)),
+    # adadelta's update ratio warms up from ~0 (rho=0.95 running
+    # averages), so it gets more steps and a looser bar
+    ("Adadelta", lambda: fluid.optimizer.Adadelta(8.0)),
+    ("RMSProp", lambda: fluid.optimizer.RMSProp(0.02)),
+    ("Ftrl", lambda: fluid.optimizer.Ftrl(0.2)),
+    ("Lamb", lambda: fluid.optimizer.Lamb(0.05)),
+    ("ProximalGD", lambda: fluid.optimizer.ProximalGD(0.05)),
+    ("ProximalAdagrad", lambda: fluid.optimizer.ProximalAdagrad(0.2)),
+]
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield
+
+
+@pytest.mark.parametrize("name,mk", OPTS, ids=[o[0] for o in OPTS])
+def test_optimizer_converges(name, mk):
+    x = fluid.data("x", [16, 4])
+    y = fluid.data("y", [16, 1])
+    loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+    mk().minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 4).astype(np.float32)
+    yv = (xv @ np.arange(4, dtype=np.float32).reshape(4, 1)).astype(
+        np.float32)
+    steps, bar = (150, 0.85) if name == "Adadelta" else (40, 0.7)
+    losses = [
+        float(np.asarray(exe.run(feed={"x": xv, "y": yv},
+                                 fetch_list=[loss])[0]).reshape(-1)[0])
+        for _ in range(steps)
+    ]
+    assert losses[-1] < losses[0] * bar, (name, losses[0], losses[-1])
+
+
+def test_proximal_l1_drives_weights_to_zero():
+    """With zero gradient signal and strong L1, the proximal operator is
+    pure soft-thresholding: weights shrink toward exactly zero."""
+    x = fluid.data("x", [8, 4])
+    y = fluid.data("y", [8, 1])
+    pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="pw"),
+                     bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.ProximalGD(0.1, l1_regularization_strength=1.0) \
+        .minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.zeros((8, 4), np.float32),
+            "y": np.zeros((8, 1), np.float32)}
+    scope = fluid.framework.scope.global_scope()
+    w0 = np.abs(np.asarray(scope.find_var("pw"))).sum()
+    for _ in range(30):
+        exe.run(feed=feed, fetch_list=[loss])
+    w1 = np.abs(np.asarray(scope.find_var("pw"))).sum()
+    assert w1 < w0 * 0.05, (w0, w1)

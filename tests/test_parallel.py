@@ -107,3 +107,62 @@ def test_spmd_collective_allreduce_on_mesh():
     (res,) = exe.run(main, feed={"x": data}, fetch_list=["out"])
     expect = np.tile(data.reshape(8, 1, 4).sum(axis=0), (8, 1))
     np.testing.assert_allclose(res, expect)
+
+
+# -- round 3: TP/SPMD equivalence beyond toy shapes (VERDICT r2 weak #8) --
+
+
+def test_bert_tp_matches_replicated_at_real_width():
+    """BERT-tiny-but-real-width (h=256, 2 layers, s=64) under 4-way tensor
+    parallelism (gspmd) matches the replicated run's loss trajectory."""
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.models import BertConfig, bert_pretrain
+    from paddle_tpu.models.bert import bert_tp_shardings
+    from paddle_tpu.parallel import shard_program
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    b, s = 4, 64
+    cfg = BertConfig(
+        vocab_size=512, hidden_size=256, num_layers=2, num_heads=4,
+        intermediate_size=1024, max_position=128,
+    )
+    rng = np.random.RandomState(0)
+    feed = {
+        "ids": rng.randint(0, cfg.vocab_size, (b, s)).astype("int64"),
+        "types": rng.randint(0, 2, (b, s)).astype("int64"),
+        "mask": np.ones((b, s), "float32"),
+        "labels": rng.randint(0, cfg.vocab_size, (b, s)).astype("int64"),
+    }
+
+    results = {}
+    for mode in ("replicated", "tp"):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        scope = fluid.framework.scope.Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), unique_name.guard():
+            ids = fluid.data("ids", [b, s], "int64")
+            types = fluid.data("types", [b, s], "int64")
+            mask = fluid.data("mask", [b, s], "float32")
+            labels = fluid.data("labels", [b, s], "int64")
+            loss = bert_pretrain(ids, types, mask, labels, cfg,
+                                 is_test=True)  # no dropout: exact compare
+            fluid.optimizer.SGD(0.1).minimize(loss)
+            if mode == "tp":
+                import jax
+
+                shard_program(
+                    main, make_mesh({"mp": 4}, jax.devices()[:4]),
+                    shardings=bert_tp_shardings(cfg), mode="gspmd",
+                )
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            vals = []
+            for _ in range(3):
+                (lv,) = exe.run(main, feed=feed, fetch_list=[loss],
+                                scope=scope)
+                vals.append(float(np.asarray(lv).reshape(-1)[0]))
+        results[mode] = vals
+    np.testing.assert_allclose(
+        results["tp"], results["replicated"], rtol=2e-4
+    )
