@@ -664,6 +664,61 @@ class ProximalAdagradOptimizer(Optimizer):
         )
 
 
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression momentum (reference optimizer.py:1071):
+    top-k sparsified gradient exchange with error feedback, momentum
+    correction and factor masking (ops/optimizer_ops.py dgc_momentum_step).
+    Under a dp mesh the exchange all_gathers (values, indices) pairs —
+    2k*nranks words instead of the dense numel. `sparsity` takes the FINAL
+    ratio of the reference's schedule (static shapes fix k); steps before
+    `rampup_begin_step` run the dense warmup path."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 num_trainers=None, **kw):
+        super().__init__(learning_rate, **kw)
+        if use_nesterov:
+            raise NotImplementedError(
+                "DGCMomentumOptimizer: use_nesterov is not implemented in "
+                "the fused dgc_momentum_step op"
+            )
+        self._momentum = momentum
+        self._rampup_begin = float(rampup_begin_step)
+        self._sparsity = float(sparsity[-1] if isinstance(
+            sparsity, (list, tuple)) else sparsity)
+        self._nranks = num_trainers or 1
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("dgc_u", p)
+            self._add_accumulator("dgc_v", p)
+            self._add_accumulator("dgc_step", p, fill_value=0.0, shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        u = self._get_accumulator("dgc_u", p)
+        v = self._get_accumulator("dgc_v", p)
+        step = self._get_accumulator("dgc_step", p)
+        lr = self._create_lr(block)
+        block.append_op(
+            "increment", {"X": [step.name]}, {"Out": [step.name]},
+            {"step": 1.0},
+        )
+        return block.append_op(
+            "dgc_momentum_step",
+            {"Param": [p.name], "Grad": [g.name], "U": [u.name],
+             "V": [v.name], "LearningRate": [lr.name],
+             "CurrentStep": [step.name]},
+            {"ParamOut": [p.name], "UOut": [u.name], "VOut": [v.name],
+             "SentRatio": [block.create_var(
+                 name=f"{p.name}@DGC_RATIO", shape=[1], dtype="float32"
+             ).name]},
+            {"momentum": self._momentum, "sparsity": self._sparsity,
+             "rampup_begin_step": self._rampup_begin,
+             "nranks": self._nranks},
+        )
+
+
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adam = AdamOptimizer
@@ -679,6 +734,7 @@ LarsMomentum = LarsMomentumOptimizer
 Dpsgd = DpsgdOptimizer
 ProximalGD = ProximalGDOptimizer
 ProximalAdagrad = ProximalAdagradOptimizer
+DGCMomentum = DGCMomentumOptimizer
 
 
 # ---------------------------------------------------------------------------

@@ -83,9 +83,12 @@ SUBSUMED = {
     "prefetch": "PS plane subsumed",
     "recv_save": "PS plane subsumed",
     "ref_by_trainer_id": "PS plane subsumed",
-    "dgc": "intentional degrade: bf16 grads over ICI (fleet strategy doc)",
-    "dgc_clip_by_norm": "intentional degrade (see dgc)",
-    "dgc_momentum": "intentional degrade (see dgc)",
+    # DGC: real implementation — one fused op does compress + sparse
+    # exchange + momentum correction (ops/optimizer_ops.py
+    # dgc_momentum_step; the reference splits it into three ops)
+    "dgc": "dgc_momentum_step (fused compress+exchange+update)",
+    "dgc_clip_by_norm": "dgc_momentum_step + clip_by_norm emitter",
+    "dgc_momentum": "dgc_momentum_step",
     # host data-queue plumbing: the native DataLoader/Dataset pipeline
     # (dataloader/, dataset/) owns queues; no in-graph queue ops exist
     "enqueue": "dataloader host queues",
