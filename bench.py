@@ -180,9 +180,11 @@ def bench_resnet(on_accel):
         (wv,) = exe.run(main_prog, feed=batches[i % 2], fetch_list=[loss],
                         scope=scope, return_numpy=False)
     np.asarray(wv)
+    # the shared tunneled chip makes vision wall-clocks swing 30%+
+    # between rounds; best-of-3 tightens the floor
     n_steps = 20 if on_accel else 3
     dt, final_loss = _timed_loop(
-        exe, main_prog, scope, batches, loss, n_steps, 2 if on_accel else 1
+        exe, main_prog, scope, batches, loss, n_steps, 3 if on_accel else 1
     )
     img_s = n_steps * b / dt
     return {
@@ -242,7 +244,7 @@ def bench_yolov3(on_accel):
     np.asarray(wv)
     n_steps = 10 if on_accel else 3
     dt, final_loss = _timed_loop(
-        exe, main_prog, scope, batches, loss, n_steps, 2 if on_accel else 1
+        exe, main_prog, scope, batches, loss, n_steps, 3 if on_accel else 1
     )
     img_s = n_steps * b / dt
     return {

@@ -115,9 +115,10 @@ class FleetUtil:
         return float(area / (tot_pos * tot_neg))
 
     def get_global_metrics(self, values):
-        """Sum-reduce a dict of host scalars across workers."""
+        """Sum-reduce a dict of host scalars across workers (float64 end
+        to end — the radix-split sum keeps large counts exact)."""
         keys = sorted(values)
-        arr = np.asarray([float(values[k]) for k in keys], np.float32)
+        arr = np.asarray([float(values[k]) for k in keys], np.float64)
         red = self.all_reduce(arr, "sum")
         return dict(zip(keys, red.tolist()))
 

@@ -8,7 +8,17 @@ public layers API only — they double as end-to-end tests of the framework
 """
 
 from .resnet import resnet  # noqa: F401
-from .bert import BertConfig, bert_encoder, bert_pretrain  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig,
+    bert_encoder,
+    bert_pretrain,
+    bert_tp_shardings,
+)
+from .mask_rcnn import (  # noqa: F401
+    MaskRCNNConfig,
+    mask_rcnn_infer,
+    mask_rcnn_train,
+)
 from .deepfm import DeepFMConfig, deepfm  # noqa: F401
 from .gpt import (  # noqa: F401
     GPTConfig,

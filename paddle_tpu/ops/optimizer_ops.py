@@ -462,6 +462,14 @@ def _dgc_momentum_step(ctx, op, ins):
     k = max(1, int(round((1.0 - sparsity) * numel)))
 
     in_mesh = axis in ctx.mesh_axes
+    if in_mesh and nranks > 1:
+        mesh_n = ctx.axis_sizes.get(axis)
+        if mesh_n is not None and mesh_n != nranks:
+            raise ValueError(
+                f"DGC num_trainers={nranks} but mesh axis {axis!r} has "
+                f"{mesh_n} shards; the exchange uses the mesh size — fix "
+                "num_trainers or the mesh"
+            )
 
     # momentum correction + error accumulation on the local gradient
     u_new = m * u + g

@@ -698,17 +698,21 @@ class DGCMomentumOptimizer(Optimizer):
         p, g = pg
         u = self._get_accumulator("dgc_u", p)
         v = self._get_accumulator("dgc_v", p)
-        step = self._get_accumulator("dgc_step", p)
         lr = self._create_lr(block)
-        block.append_op(
-            "increment", {"X": [step.name]}, {"Out": [step.name]},
-            {"step": 1.0},
-        )
+        ins_step = {}
+        if self._rampup_begin > 0:
+            step = self._get_accumulator("dgc_step", p)
+            block.append_op(
+                "increment", {"X": [step.name]}, {"Out": [step.name]},
+                {"step": 1.0},
+            )
+            ins_step = {"CurrentStep": [step.name]}
+        # with no rampup, omitting CurrentStep selects the op's static
+        # sparse-only path (no dead dense branch compiled)
         return block.append_op(
             "dgc_momentum_step",
             {"Param": [p.name], "Grad": [g.name], "U": [u.name],
-             "V": [v.name], "LearningRate": [lr.name],
-             "CurrentStep": [step.name]},
+             "V": [v.name], "LearningRate": [lr.name], **ins_step},
             {"ParamOut": [p.name], "UOut": [u.name], "VOut": [v.name],
              "SentRatio": [block.create_var(
                  name=f"{p.name}@DGC_RATIO", shape=[1], dtype="float32"
