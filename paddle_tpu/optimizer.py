@@ -692,7 +692,9 @@ class DGCMomentumOptimizer(Optimizer):
         for p in parameters:
             self._add_accumulator("dgc_u", p)
             self._add_accumulator("dgc_v", p)
-            self._add_accumulator("dgc_step", p, fill_value=0.0, shape=[1])
+            if self._rampup_begin > 0:  # step counter only drives rampup
+                self._add_accumulator("dgc_step", p, fill_value=0.0,
+                                      shape=[1])
 
     def _append_optimize_op(self, block, pg):
         p, g = pg
