@@ -519,7 +519,9 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples, seed=0):
         out_slots=("Samples", "Probabilities", "SampledLogits",
                    "SampledLabel"),
     )
-    return t.softmax_with_cross_entropy(
+    from .nn import softmax_with_cross_entropy
+
+    return softmax_with_cross_entropy(
         sampled_logits, t.cast(sampled_label, "int64")
     )
 
@@ -814,8 +816,10 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 
     concat_in = t.concat([x_t, hidden_t_prev], axis=1)
     hidden = hidden_t_prev.shape[-1]
-    gates = t.fc(concat_in, 4 * hidden, param_attr=param_attr,
-                 bias_attr=bias_attr)
+    from .nn import fc
+
+    gates = fc(concat_in, 4 * hidden, param_attr=param_attr,
+               bias_attr=bias_attr)
     i, f, c_hat, o = t.split(gates, num_or_sections=4, dim=-1)
     f = t.sigmoid(f + forget_bias)
     cell = f * cell_t_prev + t.sigmoid(i) * t.tanh(c_hat)

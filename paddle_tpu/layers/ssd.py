@@ -7,14 +7,44 @@ fixed shapes (the matching/mining emitters are ops/detection_ext.py)."""
 from __future__ import annotations
 
 from . import tensor as t
+from .nn import conv2d as _conv2d
+from .nn import softmax_with_cross_entropy as _softmax_ce
 from .detection import (
     bipartite_match,
-    box_coder,
     iou_similarity,
     mine_hard_examples,
     prior_box,
     target_assign,
 )
+
+
+def _encode_per_prior(prior, prior_var, matched):
+    """Elementwise center-size encode of each prior's MATCHED gt box
+    (bbox_util.h BoxToDelta semantics; the pairwise box_coder op encodes
+    every (gt, prior) pair, which is not what the loc loss wants)."""
+    def col(v, i):
+        return t.slice(v, axes=[1], starts=[i], ends=[i + 1])
+
+    pw = col(prior, 2) - col(prior, 0)
+    ph = col(prior, 3) - col(prior, 1)
+    pcx = col(prior, 0) + 0.5 * pw
+    pcy = col(prior, 1) + 0.5 * ph
+    gw = col(matched, 2) - col(matched, 0)
+    gh = col(matched, 3) - col(matched, 1)
+    gcx = col(matched, 0) + 0.5 * gw
+    gcy = col(matched, 1) + 0.5 * gh
+    eps = 1e-6
+    enc = t.concat([
+        (gcx - pcx) / (pw + eps),
+        (gcy - pcy) / (ph + eps),
+        t.log(t.elementwise_max(
+            gw / (pw + eps), t.fill_constant([1], "float32", eps))),
+        t.log(t.elementwise_max(
+            gh / (ph + eps), t.fill_constant([1], "float32", eps))),
+    ], axis=1)
+    if prior_var is not None:
+        enc = enc / prior_var
+    return enc
 
 
 def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
@@ -49,8 +79,8 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         num_priors = 1
         for d in boxes.shape[:-1]:
             num_priors *= d
-        loc = t.conv2d(x, a * 4, 3, padding=1)
-        conf = t.conv2d(x, a * num_classes, 3, padding=1)
+        loc = _conv2d(x, a * 4, 3, padding=1)
+        conf = _conv2d(x, a * num_classes, 3, padding=1)
         n = x.shape[0]
         locs.append(t.reshape(t.transpose(loc, [0, 2, 3, 1]), [n, -1, 4]))
         confs.append(t.reshape(t.transpose(conf, [0, 2, 3, 1]),
@@ -83,7 +113,7 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_boxes,
         gt_lab3, match_idx, mismatch_value=background_label)
     conf2 = t.reshape(confidence, [-1, confidence.shape[-1]])
     lab2 = t.reshape(t.cast(tgt_lab, "int64"), [-1, 1])
-    conf_loss_all = t.softmax_with_cross_entropy(conf2, lab2)  # [P, 1]
+    conf_loss_all = _softmax_ce(conf2, lab2)  # [P, 1]
     conf_loss_row = t.reshape(conf_loss_all, [1, -1])
     neg_idx, updated = mine_hard_examples(
         conf_loss_row, match_idx, match_dist=match_dist,
@@ -101,10 +131,11 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_boxes,
     # loc loss on matched priors
     gt_box3 = t.reshape(gt_box, [1, -1, 4])
     tgt_box, tgt_box_w = target_assign(gt_box3, match_idx, mismatch_value=0)
-    enc = box_coder(prior_boxes, prior_box_var, t.reshape(tgt_box, [-1, 4])) \
-        if prior_box_var is not None else t.reshape(tgt_box, [-1, 4])
+    enc = _encode_per_prior(
+        prior_boxes, prior_box_var, t.reshape(tgt_box, [-1, 4])
+    )
     loc2 = t.reshape(location, [-1, 4])
-    diff = t.abs(loc2 - t.reshape(enc, [-1, 4]))
+    diff = t.abs(loc2 - enc)
     l1 = t.where(
         t.less_than(diff, t.fill_constant([1], "float32", 1.0) + diff * 0.0),
         0.5 * diff * diff, diff - 0.5,
