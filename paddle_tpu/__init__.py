@@ -12,6 +12,7 @@ Architecture (TPU-first, not a port):
 """
 
 from . import core  # noqa: F401  (places, dtypes)
+from . import errors  # noqa: F401  (typed error taxonomy, platform/error_codes.proto)
 from .core.place import (  # noqa: F401
     CPUPlace,
     CUDAPlace,

@@ -43,8 +43,23 @@ def _insert_cast(block, op_idx, op, name, dest_dtype, force=False):
     return 1
 
 
+# gray ops that must keep their inputs untouched: control flow re-enters
+# sub-blocks (casts would break capture analysis), cast has an explicit
+# out_dtype contract
+_GRAY_SKIP = {"while", "conditional_block", "cast", "print", "py_func",
+              "assign", "share_data"}
+
+
 def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
-    """Insert casts per black/white lists into the (forward-only) program."""
+    """Insert casts per black/white lists into the (forward-only) program.
+
+    Gray ops (neither list) FOLLOW their inputs, as in the reference
+    rewrite (fp16_lists.py gray set + fp16_utils.py process rule): once any
+    float input is low-precision, the remaining fp32 float inputs (fp32
+    master params, typically a bias) are cast down too. Without this,
+    jnp's type promotion silently lifts every bias-add back to fp32 — the
+    activation stream between matmuls then crosses custom-call fusion
+    barriers at twice the bytes (profiled on BERT-base, BASELINE.md r4)."""
     amp_lists = amp_lists or AutoMixedPrecisionLists()
     block = program.global_block
     i = 0
@@ -54,6 +69,16 @@ def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
             target, force = dest_dtype, False
         elif op.type in amp_lists.black_list:
             target, force = "float32", True
+        elif op.type not in _GRAY_SKIP:
+            dts = set()
+            for n in op.input_names():
+                v = block._find_var_recursive(n)
+                if v is not None and is_float(v.dtype):
+                    dts.add(str(v.dtype))
+            if dest_dtype not in dts:
+                i += 1
+                continue
+            target, force = dest_dtype, False
         else:
             i += 1
             continue

@@ -1,0 +1,127 @@
+"""Typed error taxonomy (reference: platform/error_codes.proto:19-80 Code
+enum, platform/enforce.h:282 EnforceNotMet, platform/errors.cc factory
+functions, pybind/exception.cc:20 BindException).
+
+The reference raises `EnforceNotMet` carrying one of 12 error codes plus
+the offending op and a C++ backtrace. Here every class is an
+`EnforceNotMet` subclass that ALSO inherits the natural Python builtin
+(InvalidArgumentError is a ValueError, OutOfRangeError an IndexError,
+UnimplementedError a NotImplementedError, ...), so callers can catch
+either the framework taxonomy or the builtin they already handle — and
+every pre-taxonomy `except ValueError/RuntimeError` keeps working.
+
+Raise sites attach op provenance (op type + the user line that created
+the op, the `__loc__` attr) via `op=`/`loc=`; `EnforceNotMet.op_type` and
+`.user_loc` expose them for programmatic handling (the reference prints
+them inside the enforce message, enforce.h:282 GetErrorSumaryString).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ErrorCode(enum.IntEnum):
+    """platform/error_codes.proto Code enum (same numbering)."""
+
+    LEGACY = 0
+    INVALID_ARGUMENT = 1
+    NOT_FOUND = 2
+    OUT_OF_RANGE = 3
+    ALREADY_EXISTS = 4
+    RESOURCE_EXHAUSTED = 5
+    PRECONDITION_NOT_MET = 6
+    PERMISSION_DENIED = 7
+    EXECUTION_TIMEOUT = 8
+    UNIMPLEMENTED = 9
+    UNAVAILABLE = 10
+    FATAL = 11
+    EXTERNAL = 12
+
+
+class EnforceNotMet(Exception):
+    """Base of the taxonomy (enforce.h:282). Carries the error code and,
+    when raised from an op context, the op type and the user source line
+    that created the op."""
+
+    code = ErrorCode.LEGACY
+
+    def __init__(self, message, op=None, loc=None):
+        self.op_type = getattr(op, "type", op)
+        self.user_loc = loc if loc is not None else (
+            op.attr("__loc__", None) if hasattr(op, "attr") else None
+        )
+        parts = [str(message)]
+        ctx = []
+        if self.op_type:
+            ctx.append(f"op {self.op_type!r}")
+        if self.user_loc:
+            ctx.append(f"created at {self.user_loc}")
+        if ctx:
+            parts.append(f"  [operator context: {', '.join(ctx)}]")
+        parts.append(f"  [error code: {self.code.name} ({self.code.value})]")
+        self.message = message
+        super().__init__("\n".join(parts))
+
+
+class EOFException(EnforceNotMet):
+    """Reader/queue exhaustion (platform/enforce.h EOFException,
+    pybind/exception.cc:21) — the sentinel fluid readers raise when a
+    blocking queue closes."""
+
+    code = ErrorCode.LEGACY
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    code = ErrorCode.INVALID_ARGUMENT
+
+
+class NotFoundError(EnforceNotMet, RuntimeError):
+    code = ErrorCode.NOT_FOUND
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    code = ErrorCode.OUT_OF_RANGE
+
+
+class AlreadyExistsError(EnforceNotMet, RuntimeError):
+    code = ErrorCode.ALREADY_EXISTS
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    code = ErrorCode.RESOURCE_EXHAUSTED
+
+
+class PreconditionNotMetError(EnforceNotMet, RuntimeError):
+    code = ErrorCode.PRECONDITION_NOT_MET
+
+
+class PermissionDeniedError(EnforceNotMet, RuntimeError):
+    code = ErrorCode.PERMISSION_DENIED
+
+
+class ExecutionTimeoutError(EnforceNotMet, RuntimeError):
+    code = ErrorCode.EXECUTION_TIMEOUT
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    code = ErrorCode.UNIMPLEMENTED
+
+
+class UnavailableError(EnforceNotMet, RuntimeError):
+    code = ErrorCode.UNAVAILABLE
+
+
+class FatalError(EnforceNotMet, SystemError):
+    code = ErrorCode.FATAL
+
+
+class ExternalError(EnforceNotMet, OSError):
+    code = ErrorCode.EXTERNAL
+
+
+def enforce(condition, error):
+    """PADDLE_ENFORCE (enforce.h:282): raise `error` (an EnforceNotMet
+    instance) unless `condition`."""
+    if not condition:
+        raise error

@@ -188,16 +188,191 @@ class Executor:
             if bad.any():
                 idx = int(np.argmax(bad))
                 op = compiled.nan_ops[idx]
-                raise RuntimeError(
+                from ..errors import PreconditionNotMetError
+
+                raise PreconditionNotMetError(
                     f"NaN/Inf detected in outputs of op #{idx} "
-                    f"{op.type!r} (created at "
-                    f"{op.attr('__loc__', '<unknown>')}); outputs: "
+                    f"{op.type!r}; outputs: "
                     f"{op.output_names()} — FLAGS_check_nan_inf mode "
-                    "(reference details/nan_inf_utils_detail.cc)"
+                    "(reference details/nan_inf_utils_detail.cc)",
+                    op=op,
                 )
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    # ------------------------------------------------------------------
+    def flops(self, program=None, feed=None, fetch_list=None, scope=None):
+        """XLA's static FLOP count for ONE step of `program` with this
+        feed — the compiled executable's cost analysis (reference role:
+        the per-op cost tooling of operators/benchmark/op_tester.cc).
+        Reuses the executor's compile cache; run the same (program, feed)
+        once first for a warm lookup. Pallas custom-call FLOPs are NOT
+        visible to XLA — callers benchmarking hand kernels must add that
+        term analytically (bench.py does for the attention kernels)."""
+        (program, scope, block, feed_arrays, _feed_sig, fetch_names,
+         key) = self._prepared(program, feed, fetch_list, scope)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(
+                program, block, set(feed_arrays), fetch_names, scope
+            )
+            self._cache[key] = compiled
+        state_ro = {
+            n: self._from_scope(scope, n, block) for n in compiled.state_ro
+        }
+        state_mut = {
+            n: self._from_scope(scope, n, block) for n in compiled.state_mut
+        }
+        from ..core.random import prng_impl
+
+        step_key = jax.random.key(0, impl=prng_impl())
+        lowered = compiled.fn.lower(
+            feed_arrays, state_mut, state_ro, step_key
+        )
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float((ca or {}).get("flops", 0.0))
+
+    # ------------------------------------------------------------------
+    def _prepared(self, program, feed, fetch_list, scope):
+        """Shared prologue of run/flops/AOT paths: resolve the cache key,
+        compile if needed, and assemble the argument pytrees."""
+        program = program if program is not None else default_main_program()
+        program = getattr(program, "program", program)
+        scope = scope if scope is not None else global_scope()
+        fetch_names = tuple(
+            v.name if isinstance(v, Variable) else str(v)
+            for v in (fetch_list or [])
+        )
+        block = program.global_block
+        feed_arrays = {k: jnp.asarray(v) for k, v in dict(feed or {}).items()}
+        feed_sig = tuple(
+            (k, tuple(a.shape), str(a.dtype))
+            for k, a in sorted(feed_arrays.items())
+        )
+        from ..flags import flag
+
+        check_nan = bool(flag("check_nan_inf"))
+        key = (program, program._version, feed_sig, fetch_names, check_nan)
+        return (program, scope, block, feed_arrays, feed_sig, fetch_names,
+                key)
+
+    def serialize_executable(self, path, program=None, feed=None,
+                             fetch_list=None, scope=None):
+        """AOT-compile ONE step of (program, feed) and write the serialized
+        XLA executable to `path` (reference role: AnalysisConfig's
+        SetOptimCacheDir + the TRT engine serialization,
+        inference/api/paddle_analysis_config.h). `load_executable` in a
+        later process skips XLA compilation entirely for the same program
+        structure + feed signature + device kind."""
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+
+        (program, scope, block, feed_arrays, feed_sig, fetch_names,
+         key) = self._prepared(program, feed, fetch_list, scope)
+        if key[-1]:  # check_nan flag in the cache key
+            from ..errors import PreconditionNotMetError
+
+            raise PreconditionNotMetError(
+                "serialize_executable under FLAGS_check_nan_inf is not "
+                "supported: the nan-flags fetch and its op table cannot be "
+                "serialized; clear the flag around the serialization"
+            )
+        from ..core.random import prng_impl
+
+        step_key = jax.random.key(0, impl=prng_impl())
+        # An executable that was LOADED from the persistent compilation
+        # cache serializes incompletely (XLA:CPU leaves backend
+        # function-registry entries behind — "Function ... not found" on
+        # deserialize). So the serialization pass never touches the serving
+        # jit OR the disk cache: disable the persistent cache, reset its
+        # module-global handle, re-trace the block into a FRESH jit object,
+        # and AOT-compile that.
+        from jax._src import compilation_cache as _cc
+
+        cache_dir = jax.config.jax_compilation_cache_dir
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            _cc.reset_cache()
+            compiled = self._compile(
+                program, block, set(feed_arrays), fetch_names, scope
+            )
+            state_ro = {
+                n: self._from_scope(scope, n, block)
+                for n in compiled.state_ro
+            }
+            state_mut = {
+                n: self._from_scope(scope, n, block)
+                for n in compiled.state_mut
+            }
+            lowered = compiled.fn.lower(feed_arrays, state_mut, state_ro,
+                                        step_key)
+            payload, in_tree, out_tree = se.serialize(lowered.compile())
+        finally:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            _cc.reset_cache()
+        blob = {
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+            "feed_sig": feed_sig,
+            "fetch_names": fetch_names,
+            "state_ro": list(compiled.state_ro),
+            "state_mut": list(compiled.state_mut),
+            "platform": jax.devices()[0].platform,
+        }
+        with open(path, "wb") as f:
+            pickle.dump(blob, f)
+        return path
+
+    def load_executable(self, path, program=None, feed=None,
+                        fetch_list=None, scope=None):
+        """Install a serialized executable (serialize_executable) into this
+        executor's cache for (program, feed signature, fetch set) — the
+        next `run` dispatches it with NO XLA compilation. Raises
+        InvalidArgumentError when the signature does not match."""
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+
+        from ..errors import InvalidArgumentError
+
+        (program, scope, block, feed_arrays, feed_sig, fetch_names,
+         key) = self._prepared(program, feed, fetch_list, scope)
+        if key[-1]:
+            raise InvalidArgumentError(
+                "load_executable under FLAGS_check_nan_inf is not "
+                "supported (serialized executables carry no nan-check op "
+                "table); clear the flag for AOT serving"
+            )
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if blob["feed_sig"] != feed_sig or blob["fetch_names"] != fetch_names:
+            raise InvalidArgumentError(
+                f"serialized executable at {path!r} was built for feed "
+                f"{blob['feed_sig']} / fetches {blob['fetch_names']}, got "
+                f"{feed_sig} / {fetch_names}"
+            )
+        if blob["platform"] != jax.devices()[0].platform:
+            raise InvalidArgumentError(
+                f"serialized executable targets platform "
+                f"{blob['platform']!r}; this process runs "
+                f"{jax.devices()[0].platform!r}"
+            )
+        # pin the execution devices to the single default device the
+        # executable was jit-compiled for — the default (all local devices)
+        # breaks under a forced multi-device CPU (test mesh) topology
+        loaded = se.deserialize_and_load(
+            blob["payload"], blob["in_tree"], blob["out_tree"],
+            execution_devices=[jax.devices()[0]],
+        )
+        self._cache[key] = _Compiled(
+            loaded, blob["state_ro"], blob["state_mut"], fetch_names
+        )
+        return self._cache[key]
 
     # ------------------------------------------------------------------
     def train_from_dataset(
@@ -210,7 +385,11 @@ class Executor:
         op interpreter the reference needed is subsumed by XLA, so
         `thread` only tunes the host-side parse (dataset.set_thread)."""
         if dataset is None:
-            raise ValueError("train_from_dataset requires a dataset")
+            from ..errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "train_from_dataset requires a dataset"
+            )
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [
             getattr(v, "name", str(v)) for v in fetch_list
@@ -245,7 +424,9 @@ class Executor:
         bad = [op.type for op in prog.global_block.ops
                if op.type in update_ops]
         if bad:
-            raise ValueError(
+            from ..errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
                 f"infer_from_dataset got a program with update ops {bad}; "
                 "pass an inference program (clone(for_test=True) before "
                 "minimize, or load_inference_model output)"
@@ -256,12 +437,14 @@ class Executor:
     def _from_scope(self, scope, name, block):
         v = scope.find_var(name)
         if v is None:
+            from ..errors import NotFoundError, PreconditionNotMetError
+
             var = block._find_var_recursive(name)
             if var is not None and var.is_data:
-                raise RuntimeError(
+                raise NotFoundError(
                     f"feed variable {name!r} was not provided in `feed`"
                 )
-            raise RuntimeError(
+            raise PreconditionNotMetError(
                 f"variable {name!r} is not initialized in the scope; "
                 "run the startup program first (exe.run(startup_program))"
             )
@@ -300,10 +483,11 @@ class Executor:
                 try:
                     run_op(ctx, op, env)
                 except KeyError as e:
-                    raise RuntimeError(
-                        f"op #{i} {op.type!r} (created at "
-                        f"{op.attr('__loc__', '<unknown>')}) references "
-                        f"undefined variable {e}"
+                    from ..errors import NotFoundError
+
+                    raise NotFoundError(
+                        f"op #{i} references undefined variable {e}",
+                        op=op,
                     ) from None
                 except Exception as e:
                     # attach op provenance to trace-time failures

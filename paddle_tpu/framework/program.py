@@ -205,7 +205,11 @@ class Block:
     def var(self, name):
         v = self._find_var_recursive(name)
         if v is None:
-            raise KeyError(f"variable {name!r} not found in block {self.idx}")
+            from ..errors import NotFoundError
+
+            raise NotFoundError(
+                f"variable {name!r} not found in block {self.idx}"
+            )
         return v
 
     def has_var(self, name):

@@ -150,7 +150,11 @@ def register_op(type, inputs, outputs, **kw):
 
 def get_op_def(op_type: str) -> OpDef:
     if op_type not in _REGISTRY:
-        raise KeyError(f"op type {op_type!r} is not registered")
+        from ..errors import UnimplementedError
+
+        raise UnimplementedError(
+            f"op type {op_type!r} is not registered"
+        )
     return _REGISTRY[op_type]
 
 

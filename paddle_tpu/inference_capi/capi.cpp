@@ -72,6 +72,29 @@ def run(predictor, names, dtypes, shapes, views):
             (t.name, _DT_INV[a.dtype.name], list(a.shape), a.tobytes())
         )
     return result
+
+
+def run_zero_copy(predictor, names, dtypes, shapes, views):
+    # inputs: np.frombuffer over the caller's memory — no staging copy
+    by_name = {}
+    for name, dt, shape, view in zip(names, dtypes, shapes, views):
+        arr = np.frombuffer(view, dtype=_DT[dt]).reshape(shape)
+        by_name[name] = PaddleTensor(arr, name=name)
+    inputs = [by_name[n] for n in predictor.get_input_names()]
+    out_names, arrays = predictor.run_zero_copy(inputs)
+    kept, result = [], []
+    for n, a in zip(out_names, arrays):
+        if a.dtype.name not in _DT_INV:
+            a = np.ascontiguousarray(a.astype("float32"))
+        kept.append(a)
+        result.append(
+            (n, _DT_INV[a.dtype.name], list(a.shape), a.ctypes.data,
+             a.nbytes)
+        )
+    # outputs stay alive on the predictor until its next run — the C side
+    # reads the buffers in place (PD_TensorC.data borrows them)
+    predictor._last_outputs = kept
+    return result
 )PY";
 
 PyObject* g_helper = nullptr;  // module holding kHelper's globals
@@ -326,6 +349,106 @@ void PD_FreeOutputs(PD_TensorC* outputs, int out_size) {
     delete[] outputs[i].name;
     delete[] outputs[i].shape;
     delete[] static_cast<char*>(outputs[i].data);
+  }
+  delete[] outputs;
+}
+
+bool PD_ZeroCopyRun(PD_Predictor* predictor, const PD_TensorC* inputs,
+                    int in_size, PD_TensorC** outputs, int* out_size) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  bool ok = false;
+  PyObject *names = PyList_New(in_size), *dtypes = PyList_New(in_size),
+           *shapes = PyList_New(in_size), *views = PyList_New(in_size);
+  for (int i = 0; i < in_size; ++i) {
+    const PD_TensorC& t = inputs[i];
+    PyList_SetItem(names, i, PyUnicode_FromString(t.name));
+    PyList_SetItem(dtypes, i, PyLong_FromLong(t.dtype));
+    PyObject* shp = PyTuple_New(t.rank);
+    for (int d = 0; d < t.rank; ++d) {
+      PyTuple_SetItem(shp, d, PyLong_FromLongLong(t.shape[d]));
+    }
+    PyList_SetItem(shapes, i, shp);
+    PyList_SetItem(
+        views, i,
+        PyMemoryView_FromMemory(static_cast<char*>(t.data),
+                                static_cast<Py_ssize_t>(t.byte_size),
+                                PyBUF_READ));
+  }
+  PyObject* fn = helper_fn("run_zero_copy");
+  PyObject* res =
+      fn != nullptr ? PyObject_CallFunctionObjArgs(
+                          fn, predictor->py, names, dtypes, shapes, views,
+                          nullptr)
+                    : nullptr;
+  if (res == nullptr) {
+    set_error_from_python();
+  } else {
+    int n = static_cast<int>(PyList_Size(res));
+    PD_TensorC* outs = new PD_TensorC[n]();
+    bool unpack_ok = true;
+    for (int i = 0; i < n && unpack_ok; ++i) {
+      // (name, dtype, shape, addr, nbytes) — addr borrows the buffer the
+      // predictor keeps alive until its next run
+      PyObject* item = PyList_GetItem(res, i);
+      const char* nm =
+          item != nullptr && PyTuple_Check(item) && PyTuple_Size(item) == 5
+              ? PyUnicode_AsUTF8(PyTuple_GetItem(item, 0))
+              : nullptr;
+      if (nm == nullptr) {
+        set_error_from_python();
+        unpack_ok = false;
+        break;
+      }
+      char* nm_copy = new char[std::strlen(nm) + 1];
+      std::strcpy(nm_copy, nm);
+      outs[i].name = nm_copy;
+      outs[i].dtype =
+          static_cast<PD_DataType>(PyLong_AsLong(PyTuple_GetItem(item, 1)));
+      PyObject* shp = PyTuple_GetItem(item, 2);
+      if (shp == nullptr || !PyList_Check(shp)) {
+        set_error_from_python();
+        unpack_ok = false;
+        break;
+      }
+      outs[i].rank = static_cast<int>(PyList_Size(shp));
+      int64_t* sh = new int64_t[outs[i].rank];
+      for (int d = 0; d < outs[i].rank; ++d) {
+        sh[d] = PyLong_AsLongLong(PyList_GetItem(shp, d));
+      }
+      outs[i].shape = sh;
+      outs[i].data = reinterpret_cast<void*>(
+          PyLong_AsUnsignedLongLong(PyTuple_GetItem(item, 3)));
+      outs[i].byte_size = static_cast<size_t>(
+          PyLong_AsUnsignedLongLong(PyTuple_GetItem(item, 4)));
+    }
+    if (unpack_ok) {
+      *outputs = outs;
+      *out_size = n;
+      ok = true;
+    } else {
+      for (int i = 0; i < n; ++i) {
+        delete[] outs[i].name;
+        delete[] outs[i].shape;
+      }
+      delete[] outs;
+    }
+    Py_DECREF(res);
+  }
+  Py_XDECREF(fn);
+  Py_XDECREF(names);
+  Py_XDECREF(dtypes);
+  Py_XDECREF(shapes);
+  Py_XDECREF(views);
+  PyGILState_Release(gil);
+  return ok;
+}
+
+void PD_FreeZeroCopyOutputs(PD_TensorC* outputs, int out_size) {
+  if (outputs == nullptr) return;
+  for (int i = 0; i < out_size; ++i) {
+    delete[] outputs[i].name;
+    delete[] outputs[i].shape;
+    // data is predictor-owned: NOT freed here
   }
   delete[] outputs;
 }

@@ -69,6 +69,16 @@ bool PD_PredictorRun(PD_Predictor* predictor, const PD_TensorC* inputs,
                      int in_size, PD_TensorC** outputs, int* out_size);
 void PD_FreeOutputs(PD_TensorC* outputs, int out_size);
 
+/* Zero-copy run (reference ZeroCopyTensor,
+ * inference/api/details/zero_copy_tensor.cc): input buffers are read IN
+ * PLACE (no staging copy — keep them alive until this call returns), and
+ * each output's `data` points INTO a buffer owned by the predictor, valid
+ * until the next run on this predictor or PD_DeletePredictor. Release the
+ * metadata (NOT the data) with PD_FreeZeroCopyOutputs. */
+bool PD_ZeroCopyRun(PD_Predictor* predictor, const PD_TensorC* inputs,
+                    int in_size, PD_TensorC** outputs, int* out_size);
+void PD_FreeZeroCopyOutputs(PD_TensorC* outputs, int out_size);
+
 /* Last error message for this thread's most recent failed call ("" if
  * none). Owned by the library. */
 const char* PD_GetLastError(void);

@@ -57,28 +57,33 @@ def supports(seq_len: int, head_dim: int, dtype) -> bool:
 
 
 def _probs(q, k, bias_row, scale, causal):
-    """fp32 softmax probabilities for one head: q [S,D], k [S,D], bias [1,S].
+    """Softmax probabilities for one head: q [S,D], k [S,D], bias [1,S].
 
     Matmul inputs keep the MODEL dtype (bf16 under AMP) with fp32
     accumulation (preferred_element_type) — upcasting the inputs would run
-    the MXU in fp32 mode at a fraction of bf16 throughput; softmax math on
-    the fp32 scores is unchanged either way."""
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-    s = s + bias_row  # [1,S] broadcasts over query rows
-    if causal:
-        n = s.shape[0]
-        row = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
-        col = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
-        s = jnp.where(col <= row, s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    return p / jnp.sum(p, axis=-1, keepdims=True)
+    the MXU in fp32 mode at a fraction of bf16 throughput. The [S, S]
+    elementwise tail (exp, normalize) follows the model dtype too (see
+    _probs_unnorm); the scores and row statistics stay fp32."""
+    e, l = _probs_unnorm(q, k, bias_row, scale, causal)
+    if e.dtype == jnp.float32:
+        return e / l
+    # normalize in the compute dtype: a bf16 divide would promote; an
+    # [S,1] reciprocal broadcast-mul keeps the full-tile pass in bf16
+    return e * (1.0 / l).astype(e.dtype)
 
 
 def _probs_unnorm(q, k, bias_row, scale, causal):
     """(exp(s - m), rowsum) — normalization deferred so the forward can
     scale the [S, D] output instead of the [S, S] probabilities (one less
-    full-tile VPU pass; softmax cost dominates the kernel at D=64)."""
+    full-tile VPU pass; softmax cost dominates the kernel at D=64).
+
+    Under AMP (bf16 q/k/v) the exp and everything downstream of it on the
+    [S, S] tile runs in bf16 — the VPU packs 2x the lanes per op and the
+    later MXU cast disappears. The scores, the row max, and the row sum
+    (fp32 accumulation) stay fp32, so numerical stability is the standard
+    flash-attention argument; what drops to 8 mantissa bits is the
+    normalized probabilities (|p| <= 1), ~0.4% relative noise on an op
+    whose training-mode consumer is a stochastic regularizer anyway."""
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     s = s + bias_row
     if causal:
@@ -87,8 +92,9 @@ def _probs_unnorm(q, k, bias_row, scale, causal):
         col = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
         s = jnp.where(col <= row, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
-    e = jnp.exp(s - m)
-    return e, jnp.sum(e, axis=-1, keepdims=True)
+    edt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
+    e = jnp.exp((s - m).astype(edt))
+    return e, jnp.sum(e, axis=-1, keepdims=True, dtype=jnp.float32)
 
 
 def _seed_prng(seed_ref):
@@ -102,12 +108,7 @@ def _seed_prng(seed_ref):
     pltpu.prng_seed(s0, s1)
 
 
-def _keep_mask(shape, rate):
-    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
-    # clamp to uint32 range: rate=1.0 would otherwise overflow (keeping a
-    # ~2^-32 sliver of probability mass is the cost of the clamp)
-    thresh = np.uint32(min(int(rate * 2**32), 0xFFFFFFFF))
-    return bits >= thresh
+from .prng_mask import keep_mask as _keep_mask  # fwd/bwd mask parity
 
 
 def _apply_dropout(p, rate, is_test, upscale):

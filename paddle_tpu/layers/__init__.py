@@ -4,6 +4,7 @@ python/paddle/fluid/layers/ — 35k LoC across nn.py, tensor.py, loss.py...)."""
 from .nn import *  # noqa: F401,F403
 from .nn import _apply_act  # noqa: F401
 from .attention import (  # noqa: F401
+    fused_dropout_add_ln,
     fused_multihead_attention,
     fused_qkv_attention,
     moe_ffn,

@@ -149,3 +149,41 @@ def moe_shardings(prefix, axis="ep"):
         f"{prefix}_w2": (axis, None, None),
         f"{prefix}_b2": (axis, None),
     }
+
+
+def fused_dropout_add_ln(
+    x,
+    y,
+    dropout_prob=0.0,
+    is_test=False,
+    dropout_implementation="downgrade_in_infer",
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    name=None,
+):
+    """LayerNorm(x + dropout(y)) over the LAST axis as one fused op — the
+    transformer residual tail (reference role: the add+LN fusions of
+    math/bert_encoder_functor.cu). x is the residual stream, y the branch
+    output; LN affine params are created here (same names/shapes as an
+    equivalent layers.layer_norm call, so checkpoints interoperate with
+    the composed formulation)."""
+    import numpy as np
+
+    from ..initializer import Constant
+
+    helper = LayerHelper("fused_dropout_add_ln", name=name)
+    norm_shape = [int(np.prod(x.shape[-1:]))]
+    s = helper.create_parameter(
+        param_attr, norm_shape, x.dtype, default_initializer=Constant(1.0)
+    )
+    b = helper.create_parameter(bias_attr, norm_shape, x.dtype, is_bias=True)
+    return helper.create_and_append(
+        {"X": [x], "Y": [y], "Scale": [s], "LnBias": [b]},
+        {
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "dropout_implementation": dropout_implementation,
+            "epsilon": epsilon,
+        },
+    )

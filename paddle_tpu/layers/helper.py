@@ -59,7 +59,9 @@ class LayerHelper:
             # linear_chain_crf's transition): re-creating would silently
             # drop the first declaration's regularizer/lr/trainable attrs
             if tuple(existing.shape or ()) != tuple(shape):
-                raise ValueError(
+                from ..errors import InvalidArgumentError
+
+                raise InvalidArgumentError(
                     f"parameter {name!r} reused with shape {shape}, but it "
                     f"was created with shape {existing.shape}"
                 )

@@ -34,6 +34,7 @@ class BertConfig:
         attention_dropout=0.1,
         initializer_range=0.02,
         use_fused_attention=True,
+        use_fused_residual=True,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -50,6 +51,10 @@ class BertConfig:
         # sharding tests exercise; the fused op itself degrades to the same
         # math when the kernel cannot run (see ops/fused.py).
         self.use_fused_attention = use_fused_attention
+        # one fused op for the residual tail LN(x + dropout(y)) — the
+        # Pallas kernel in kernels/fused_residual.py; the composed path
+        # stays for gspmd sharding propagation tests
+        self.use_fused_residual = use_fused_residual
 
     @classmethod
     def base(cls):
@@ -122,24 +127,36 @@ def _attention(x, attn_bias, cfg, prefix, is_test):
     return _dense(ctxv, h, f"{prefix}_out", cfg)
 
 
+def _residual_ln(x, branch, cfg, ln_name, is_test):
+    """LN(x + dropout(branch)): one fused op (Pallas residual-tail kernel)
+    or the composed dropout/add/layer_norm ops — same math, same param
+    names either way."""
+    if cfg.use_fused_residual:
+        return layers.fused_dropout_add_ln(
+            x, branch, cfg.hidden_dropout, is_test=is_test,
+            param_attr=ParamAttr(name=f"{ln_name}_scale"),
+            bias_attr=ParamAttr(name=f"{ln_name}_bias"),
+        )
+    branch = layers.dropout(branch, cfg.hidden_dropout, is_test=is_test)
+    return layers.layer_norm(
+        x + branch,
+        begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{ln_name}_scale"),
+        bias_attr=ParamAttr(name=f"{ln_name}_bias"),
+    )
+
+
 def _encoder_layer(x, attn_bias, cfg, prefix, is_test):
     attn = _attention(x, attn_bias, cfg, f"{prefix}_attn", is_test)
-    attn = layers.dropout(attn, cfg.hidden_dropout, is_test=is_test)
-    x = layers.layer_norm(
-        x + attn,
-        begin_norm_axis=2,
-        param_attr=ParamAttr(name=f"{prefix}_ln1_scale"),
-        bias_attr=ParamAttr(name=f"{prefix}_ln1_bias"),
-    )
-    ffn = _dense(x, cfg.intermediate_size, f"{prefix}_ffn_in", cfg, act="gelu")
+    x = _residual_ln(x, attn, cfg, f"{prefix}_ln1", is_test)
+    # tanh-approximate GELU (the original BERT implementation's formula).
+    # On TPU the exact erf lowers to a long VPU polynomial — profiled at
+    # ~0.77 ms/layer fwd on [32,512,3072] (BASELINE.md round 4); tanh is
+    # the canonical-and-cheaper form.
+    ffn = _dense(x, cfg.intermediate_size, f"{prefix}_ffn_in", cfg)
+    ffn = layers.gelu(ffn, approximate=True)
     ffn = _dense(ffn, cfg.hidden_size, f"{prefix}_ffn_out", cfg)
-    ffn = layers.dropout(ffn, cfg.hidden_dropout, is_test=is_test)
-    return layers.layer_norm(
-        x + ffn,
-        begin_norm_axis=2,
-        param_attr=ParamAttr(name=f"{prefix}_ln2_scale"),
-        bias_attr=ParamAttr(name=f"{prefix}_ln2_bias"),
-    )
+    return _residual_ln(x, ffn, cfg, f"{prefix}_ln2", is_test)
 
 
 def _attn_bias(input_mask):

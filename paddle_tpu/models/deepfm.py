@@ -15,16 +15,33 @@ from ..param_attr import ParamAttr
 
 class DeepFMConfig:
     def __init__(self, vocab_size=100000, num_fields=10, embed_dim=16,
-                 mlp_sizes=(64, 32)):
+                 mlp_sizes=(64, 32), dense_dim=0):
         self.vocab_size = vocab_size
         self.num_fields = num_fields
         self.embed_dim = embed_dim
         self.mlp_sizes = tuple(mlp_sizes)
+        # continuous features fed to BOTH the wide (linear) half and the
+        # deep tower (the Criteo layout: 26 sparse + 13 dense)
+        self.dense_dim = dense_dim
+
+    @classmethod
+    def criteo(cls):
+        """The reference CTR benchmark shape (PaddleRec DeepFM on Criteo:
+        26 sparse fields over a ~1M id space + 13 dense, d=10 factors,
+        400x400x400 tower)."""
+        return cls(vocab_size=1000000, num_fields=26, embed_dim=10,
+                   mlp_sizes=(400, 400, 400), dense_dim=13)
 
 
-def deepfm(feat_ids, label, cfg, axis="ps"):
-    """feat_ids: [B, F] int64 global feature ids; label: [B, 1] float32.
-    Returns (avg_logloss, predict)."""
+def deepfm(feat_ids, label, cfg, axis="ps", dense_input=None):
+    """feat_ids: [B, F] int64 global feature ids; label: [B, 1] float32;
+    dense_input: optional [B, dense_dim] float32 continuous features.
+    Returns (avg_logloss, predict).
+
+    The wide half is the FM itself — first-order sparse weights plus the
+    factorized second-order term, which IS all pairwise feature crosses
+    (sum_{i<j} <v_i, v_j> x_i x_j) without materializing the cross matrix;
+    dense features get a linear wide term and join the deep tower input."""
     b, f = feat_ids.shape
 
     # first-order: sharded [V, 1] table
@@ -48,8 +65,16 @@ def deepfm(feat_ids, label, cfg, axis="ps"):
         layers.reduce_sum(sum_sq - sq_sum, 1, keep_dim=True), scale=0.5
     )
 
-    # deep tower
+    # dense wide term (linear) + deep-tower concat
+    wide_dense = None
     deep = layers.reshape(emb, [b, f * cfg.embed_dim])
+    if dense_input is not None:
+        wide_dense = layers.fc(
+            dense_input, 1,
+            param_attr=ParamAttr(name="deepfm_wide_w"),
+            bias_attr=ParamAttr(name="deepfm_wide_b"),
+        )
+        deep = layers.concat([deep, dense_input], axis=1)
     for i, sz in enumerate(cfg.mlp_sizes):
         deep = layers.fc(
             deep, sz, act="relu",
@@ -63,6 +88,8 @@ def deepfm(feat_ids, label, cfg, axis="ps"):
     )
 
     logit = first + fm + deep
+    if wide_dense is not None:
+        logit = logit + wide_dense
     predict = layers.sigmoid(logit)
     loss = layers.mean(
         layers.sigmoid_cross_entropy_with_logits(logit, label)

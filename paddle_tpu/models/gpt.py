@@ -119,7 +119,10 @@ def _decoder_layer(x, cfg, prefix, is_test):
     x = x + layers.dropout(attn, cfg.hidden_dropout, is_test=is_test)
     # pre-LN MLP block
     m = _ln(x, f"{prefix}_ln2")
-    m = _dense(m, cfg.intermediate_size, f"{prefix}_mlp_in", cfg, act="gelu")
+    # tanh-approximate GELU — GPT-2's canonical formula, and ~2x cheaper
+    # than exact erf on the TPU VPU (see models/bert.py)
+    m = _dense(m, cfg.intermediate_size, f"{prefix}_mlp_in", cfg)
+    m = layers.gelu(m, approximate=True)
     m = _dense(m, cfg.hidden_size, f"{prefix}_mlp_out", cfg)
     return x + layers.dropout(m, cfg.hidden_dropout, is_test=is_test)
 

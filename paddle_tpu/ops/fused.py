@@ -231,3 +231,170 @@ def _fused_qkv_attention_grad(ctx, op, ins):
     if op.outputs.get("KeyBiasGrad"):
         outs["KeyBiasGrad"] = [dbias.astype(bias.dtype)]
     return outs
+
+
+# ---------------------------------------------------------------------------
+# fused dropout + residual add + LayerNorm (kernels/fused_residual.py)
+# ---------------------------------------------------------------------------
+
+
+def _fdal_grad_maker(op, block, contribs, finalize, needs_grad=None):
+    """Dedicated grad op (same rationale as the attention grad makers: the
+    backward kernel needs no forward residuals, and a __vjp__ replay would
+    run the forward Mosaic call a second time)."""
+    from ..framework import unique_name
+    from ..framework.backward import _ensure_var
+    from ..framework.program import grad_var_name
+
+    g_out = finalize(op.outputs["Out"][0])
+    if g_out is None:
+        return
+    inputs = {"X": op.inputs["X"], "Y": op.inputs["Y"]}
+    for slot in ("Scale", "LnBias"):
+        if op.inputs.get(slot):
+            inputs[slot] = op.inputs[slot]
+    inputs["OutGrad"] = [g_out]
+    outs = {}
+    for slot in ("X", "Y", "Scale", "LnBias"):
+        if not op.inputs.get(slot):
+            continue
+        n = op.inputs[slot][0]
+        if needs_grad is not None and n not in needs_grad:
+            continue
+        gname = unique_name.generate(grad_var_name(n) + "@RENAME")
+        _ensure_var(block, gname, n)
+        outs[slot + "Grad"] = [gname]
+        contribs.setdefault(n, []).append(gname)
+    if not outs:
+        return
+    attrs = {
+        k: v for k, v in op.attrs.items() if k not in ("__uid__", "__loc__")
+    }
+    attrs["__fwd_uid__"] = op.uid
+    block.append_op("fused_dropout_add_ln_grad", inputs, outs, attrs)
+
+
+def _fdal_statics(op, is_test):
+    return dict(
+        rate=float(op.attr("dropout_prob", 0.0)),
+        is_test=bool(is_test),
+        upscale=op.attr("dropout_implementation", "downgrade_in_infer")
+        == "upscale_in_train",
+        eps=float(op.attr("epsilon", 1e-5)),
+    )
+
+
+def _fdal_use_kernel(ctx, x2d, gspmd_mode):
+    import jax
+
+    from ..kernels import fused_residual as frk
+
+    return (
+        not gspmd_mode
+        and jax.default_backend() == "tpu"
+        and frk.supports(x2d.shape[0], x2d.shape[1], x2d.dtype)
+    )
+
+
+@register_op(
+    "fused_dropout_add_ln",
+    inputs=["X", "Y", "Scale", "LnBias"],
+    outputs=["Out"],
+    grad_maker=_fdal_grad_maker,
+)
+def _fused_dropout_add_ln(ctx, op, ins):
+    """Out = LayerNorm(X + dropout(Y)) over the last axis — the transformer
+    residual tail as ONE kernel (reference role: the add+LN CUDA fusions of
+    math/bert_encoder_functor.cu and operators/fused/
+    fused_embedding_eltwise_layernorm_op.cu). X is the residual, Y the
+    branch output; Scale/LnBias the LN affine params."""
+    import jax.numpy as jnp
+
+    from ..kernels import fused_residual as frk
+    from ..kernels.flash_attention import _seed_words
+
+    x, y = ins["X"][0], ins["Y"][0]
+    g = ins["Scale"][0] if ins.get("Scale") else None
+    c = ins["LnBias"][0] if ins.get("LnBias") else None
+    is_test, rate, gspmd_mode = _attn_ctx(ctx, op)
+    st = _fdal_statics(op, is_test)
+    N = x.shape[-1]
+    x2, y2 = x.reshape(-1, N), y.reshape(-1, N)
+    if _fdal_use_kernel(ctx, x2, gspmd_mode):
+        if rate > 0.0 and not is_test:
+            seed = _seed_words(ctx.key_for(op.uid, op.type))
+        else:
+            seed = jnp.zeros(2, jnp.uint32)
+        gg = g if g is not None else jnp.ones((N,), jnp.float32)
+        cc = c if c is not None else jnp.zeros((N,), jnp.float32)
+        out2 = frk.fused_dropout_add_ln(
+            x2, y2, gg, cc, seed, tuple(st.items()), False
+        )
+    else:
+        key = (
+            ctx.key_for(op.uid, op.type)
+            if rate > 0.0 and not is_test
+            else None
+        )
+        out2 = frk.reference_fwd(x2, y2, g, c, key, **st)
+    return {"Out": [out2.reshape(x.shape)]}
+
+
+@register_op(
+    "fused_dropout_add_ln_grad",
+    inputs=["X", "Y", "Scale", "LnBias", "OutGrad"],
+    outputs=["XGrad", "YGrad", "ScaleGrad", "LnBiasGrad"],
+    differentiable=False,
+)
+def _fused_dropout_add_ln_grad(ctx, op, ins):
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels import fused_residual as frk
+    from ..kernels.flash_attention import _seed_words
+
+    x, y, dout = ins["X"][0], ins["Y"][0], ins["OutGrad"][0]
+    g = ins["Scale"][0] if ins.get("Scale") else None
+    c = ins["LnBias"][0] if ins.get("LnBias") else None
+    is_test, rate, gspmd_mode = _attn_ctx(ctx, op)
+    st = _fdal_statics(op, is_test)
+    N = x.shape[-1]
+    x2, y2, do2 = x.reshape(-1, N), y.reshape(-1, N), dout.reshape(-1, N)
+    fwd_uid = int(op.attr("__fwd_uid__", 0))
+    if _fdal_use_kernel(ctx, x2, gspmd_mode):
+        if rate > 0.0 and not is_test:
+            seed = _seed_words(ctx.key_for(fwd_uid, "fused_dropout_add_ln"))
+        else:
+            seed = jnp.zeros(2, jnp.uint32)
+        gg = g if g is not None else jnp.ones((N,), jnp.float32)
+        dx, dy, dg, dc = frk.fused_dropout_add_ln_bwd(
+            x2, y2, gg, seed, do2, st["rate"], st["is_test"],
+            st["upscale"], st["eps"], False,
+        )
+    else:
+        key = (
+            ctx.key_for(fwd_uid, "fused_dropout_add_ln")
+            if rate > 0.0 and not is_test
+            else None
+        )
+
+        def f(x_, y_, g_, c_):
+            return frk.reference_fwd(x_, y_, g_, c_, key, **st)
+
+        ones = jnp.ones((N,), jnp.float32)
+        zeros = jnp.zeros((N,), jnp.float32)
+        _, vjp = jax.vjp(
+            f, x2, y2, g if g is not None else ones,
+            c if c is not None else zeros,
+        )
+        dx, dy, dg, dc = vjp(do2)
+    outs = {}
+    if op.outputs.get("XGrad"):
+        outs["XGrad"] = [dx.reshape(x.shape)]
+    if op.outputs.get("YGrad"):
+        outs["YGrad"] = [dy.reshape(y.shape)]
+    if op.outputs.get("ScaleGrad") and g is not None:
+        outs["ScaleGrad"] = [dg.astype(g.dtype)]
+    if op.outputs.get("LnBiasGrad") and c is not None:
+        outs["LnBiasGrad"] = [dc.astype(c.dtype)]
+    return outs

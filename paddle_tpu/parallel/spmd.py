@@ -134,9 +134,16 @@ def wrap_gspmd(
     """
 
     jitted = jax.jit(traced, donate_argnums=(1,))
+    multiproc = _spans_processes(mesh)
 
     def put(k, v):
-        return jax.device_put(v, NamedSharding(mesh, spec_for(program, k)))
+        # multi-process gspmd convention: every process holds the FULL
+        # value (feeds are replicated inputs, state came from a local
+        # startup run) — stage_global(local_is_full=True) slices out this
+        # process's addressable part and assembles the global array
+        return stage_global(
+            v, mesh, spec_for(program, k), multiproc, local_is_full=True
+        )
 
     def fn(feeds, smut, sro, step_key):
         feeds = {k: put(k, v) for k, v in feeds.items()}

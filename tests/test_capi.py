@@ -56,6 +56,21 @@ int main(int argc, char** argv) {
   size_t n = outs[0].byte_size / sizeof(float);
   for (size_t i = 0; i < n; i++) printf("%.6f\n", y[i]);
   PD_FreeOutputs(outs, n_out);
+
+  /* zero-copy run: output data points into predictor-owned buffers */
+  PD_TensorC* zouts = NULL;
+  int zn = 0;
+  if (!PD_ZeroCopyRun(pred, &in, 1, &zouts, &zn)) {
+    fprintf(stderr, "zrun: %s\n", PD_GetLastError());
+    return 4;
+  }
+  printf("zero_copy n=%d\n", zn);
+  {
+    const float* zy = (const float*)zouts[0].data;
+    size_t zn_el = zouts[0].byte_size / sizeof(float);
+    for (size_t i = 0; i < zn_el; i++) printf("%.6f\n", zy[i]);
+  }
+  PD_FreeZeroCopyOutputs(zouts, zn);
   PD_DeletePredictor(pred);
   PD_DeleteAnalysisConfig(cfg);
   return 0;
@@ -114,5 +129,10 @@ def test_c_api_end_to_end(tmp_path):
     assert lines[0].startswith("inputs=1 outputs=1 in0=x")
     meta = lines[1]
     assert "n_out=1" in meta and "rank=2" in meta and "dtype=0" in meta
-    got = np.array([float(v) for v in lines[2:]], np.float32).reshape(4, 5)
+    zc = lines.index("zero_copy n=1")
+    got = np.array([float(v) for v in lines[2:zc]], np.float32).reshape(4, 5)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # zero-copy outputs read in place from predictor-owned buffers
+    zgot = np.array([float(v) for v in lines[zc + 1:]],
+                    np.float32).reshape(4, 5)
+    np.testing.assert_allclose(zgot, ref, rtol=1e-5, atol=1e-6)

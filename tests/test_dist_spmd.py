@@ -1,0 +1,237 @@
+"""Cross-process (2 real processes) coverage for TP, ring-attention SP,
+MoE EP, pipeline, and cross-process row-sharded PS tables — VERDICT r3
+item 3: these previously ran only in-process on the virtual mesh. Each
+test launches tests/dist_spmd_worker.py through the real launcher
+(paddle_tpu.distributed.launch --simulate_cpu: gloo CPU collectives +
+jax.distributed rendezvous) and compares against a single-process
+reference computed here.
+
+Reference pattern: tests/unittests/test_dist_base.py:506."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import unique_name
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield
+
+
+def _free_port_pair():
+    import random
+    import socket
+
+    for _ in range(128):
+        base = random.randint(20000, 60000)
+        try:
+            with socket.socket() as a, socket.socket() as b:
+                a.bind(("127.0.0.1", base))
+                b.bind(("127.0.0.1", base + 1))
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair found")
+
+
+def _launch(mode, tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node=2", f"--started_port={_free_port_pair()}",
+            "--simulate_cpu",
+            os.path.join(HERE, "dist_spmd_worker.py"), mode, str(tmp_path),
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=540,
+    )
+    assert proc.returncode == 0, f"stdout:{proc.stdout}\nstderr:{proc.stderr}"
+
+
+def test_tp_two_process_matches_single(tmp_path):
+    """4-way BERT tensor parallelism across 2 processes (gspmd) matches the
+    unsharded single-process loss trajectory."""
+    from paddle_tpu.models import BertConfig, bert_pretrain
+
+    _launch("tp", tmp_path)
+    l0 = json.load(open(tmp_path / "losses_0.json"))
+    l1 = json.load(open(tmp_path / "losses_1.json"))
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+
+    b, s = 4, 64
+    cfg = BertConfig(
+        vocab_size=512, hidden_size=256, num_layers=2, num_heads=4,
+        intermediate_size=1024, max_position=128,
+    )
+    rng = np.random.RandomState(0)
+    feed = {
+        "ids": rng.randint(0, cfg.vocab_size, (b, s)).astype("int64"),
+        "types": rng.randint(0, 2, (b, s)).astype("int64"),
+        "mask": np.ones((b, s), "float32"),
+        "labels": rng.randint(0, cfg.vocab_size, (b, s)).astype("int64"),
+    }
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        ids = fluid.data("ids", [b, s], "int64")
+        types = fluid.data("types", [b, s], "int64")
+        mask = fluid.data("mask", [b, s], "float32")
+        labels = fluid.data("labels", [b, s], "int64")
+        loss = bert_pretrain(ids, types, mask, labels, cfg, is_test=True)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        ref = []
+        for _ in range(3):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            ref.append(float(np.asarray(lv).reshape(-1)[0]))
+    np.testing.assert_allclose(l0, ref, rtol=2e-4)
+
+
+def test_ring_attention_two_process_matches_dense(tmp_path):
+    """sp=4 ring attention across 2 processes, each feeding only its half
+    of the sequence, reassembles to the dense attention output."""
+    _launch("sp", tmp_path)
+    b, h, s, d = 2, 2, 64, 8
+    rng = np.random.RandomState(1)
+    q = rng.randn(b, h, s, d).astype(np.float32)
+    k = rng.randn(b, h, s, d).astype(np.float32)
+    v = rng.randn(b, h, s, d).astype(np.float32)
+    # dense causal reference
+    scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    mask = np.tril(np.ones((s, s), bool))
+    scores = np.where(mask[None, None], scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    expect = np.einsum("bhqk,bhkd->bhqd", p, v)
+
+    got = np.zeros_like(expect)
+    seen = np.zeros(s, bool)
+    for rank in (0, 1):
+        z = np.load(tmp_path / f"out_{rank}.npz")
+        for start, chunk in z.items():
+            st = int(start)
+            got[:, :, st:st + chunk.shape[2]] = chunk
+            seen[st:st + chunk.shape[2]] = True
+    assert seen.all(), "sequence shards from the 2 processes do not cover S"
+    np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_two_process_matches_dense(tmp_path):
+    """ep=4 expert parallelism across 2 processes equals the dense
+    (unsharded) MoE layer output."""
+    _launch("moe", tmp_path)
+    b, s, h, e, f = 1, 16, 8, 8, 16
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(b, s, h).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        x = fluid.data("x", [b, s, h], "float32")
+        out, _aux = layers.moe_ffn(
+            x, num_experts=e, hidden_dim=f, axis_name="ep",
+            param_attr_prefix="m0",
+        )
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        (dense,) = exe.run(main, feed={"x": x_np}, fetch_list=[out],
+                           scope=scope)
+    for rank in (0, 1):
+        got = np.load(tmp_path / f"out_{rank}.npy")
+        np.testing.assert_allclose(got, np.asarray(dense), rtol=2e-5,
+                                   atol=2e-5)
+
+
+def test_pipeline_two_process_matches_plain(tmp_path):
+    """pp=2 pipeline with one stage per PROCESS (boundary activations
+    cross hosts) tracks plain single-process training."""
+    _launch("pipe", tmp_path)
+    l0 = json.load(open(tmp_path / "losses_0.json"))
+    l1 = json.load(open(tmp_path / "losses_1.json"))
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+
+    b, steps = 16, 4
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        x = fluid.data("x", [b, 8])
+        y = fluid.data("y", [b, 1])
+        hh = layers.fc(x, 16, act="relu",
+                       param_attr=fluid.ParamAttr(name="w0"),
+                       bias_attr=fluid.ParamAttr(name="b0"))
+        pred = layers.fc(hh, 1,
+                         param_attr=fluid.ParamAttr(name="w1"),
+                         bias_attr=fluid.ParamAttr(name="b1"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        ref = []
+        for i in range(steps):
+            rngf = np.random.RandomState(i)
+            xv = rngf.randn(b, 8).astype(np.float32)
+            yv = (xv @ rngf.randn(8, 1)).astype(np.float32)
+            (lv,) = exe.run(main, feed={"x": xv, "y": yv},
+                            fetch_list=[loss], scope=scope)
+            ref.append(float(np.asarray(lv).reshape(-1)[0]))
+    # each step draws a fresh random target, so the trajectory is not
+    # monotone — the step-for-step match above is the assertion
+    np.testing.assert_allclose(l0, ref, rtol=2e-5)
+
+
+def test_pstable_two_process_matches_single(tmp_path):
+    """ps=4 row-sharded table across 2 processes — the
+    stage_global(local_is_full=True) multi-host state path — trains to the
+    same losses as the single-process run."""
+    _launch("pstable", tmp_path)
+    l0 = json.load(open(tmp_path / "losses_0.json"))
+    l1 = json.load(open(tmp_path / "losses_1.json"))
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+
+    vocab, dim, b, steps = 64, 8, 16, 3
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        ids = fluid.data("ids", [b], "int64")
+        out = layers.sparse_embedding(
+            ids, [vocab, dim], param_attr=fluid.ParamAttr(name="table"),
+            pad_to_multiple=8,
+        )
+        loss = layers.reduce_mean(layers.square(out))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        ref = []
+        for i in range(steps):
+            rngf = np.random.RandomState(10 + i)
+            idv = rngf.randint(0, vocab, b).astype(np.int64)
+            (lv,) = exe.run(main, feed={"ids": idv}, fetch_list=[loss],
+                            scope=scope)
+            ref.append(float(np.asarray(lv).reshape(-1)[0]))
+    # random id draws per step: the trajectory is not monotone; the
+    # step-for-step match above is the assertion
+    np.testing.assert_allclose(l0, ref, rtol=2e-5)

@@ -1,0 +1,100 @@
+"""Typed error taxonomy (reference platform/error_codes.proto Code enum,
+enforce.h:282 EnforceNotMet, pybind/exception.cc BindException): exception
+type + error code + op provenance + builtin-base compatibility."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import errors, layers
+from paddle_tpu.framework.scope import Scope
+
+
+def test_taxonomy_codes_and_builtin_bases():
+    assert errors.InvalidArgumentError.code == errors.ErrorCode.INVALID_ARGUMENT
+    assert errors.NotFoundError.code == errors.ErrorCode.NOT_FOUND
+    assert errors.UnimplementedError.code == errors.ErrorCode.UNIMPLEMENTED
+    # every class is an EnforceNotMet AND the natural builtin
+    assert issubclass(errors.InvalidArgumentError, ValueError)
+    assert issubclass(errors.OutOfRangeError, IndexError)
+    assert issubclass(errors.ResourceExhaustedError, MemoryError)
+    assert issubclass(errors.UnimplementedError, NotImplementedError)
+    assert issubclass(errors.FatalError, SystemError)
+    assert issubclass(errors.ExternalError, OSError)
+    for n in ("AlreadyExistsError", "PreconditionNotMetError",
+              "PermissionDeniedError", "ExecutionTimeoutError",
+              "UnavailableError", "EOFException"):
+        assert issubclass(getattr(errors, n), errors.EnforceNotMet)
+    # proto numbering preserved (error_codes.proto:19-80)
+    assert int(errors.ErrorCode.EXTERNAL) == 12
+    assert int(errors.ErrorCode.INVALID_ARGUMENT) == 1
+
+
+def test_unregistered_op_is_unimplemented():
+    from paddle_tpu.framework.registry import get_op_def
+
+    with pytest.raises(errors.UnimplementedError, match="not registered"):
+        get_op_def("definitely_not_an_op")
+    # pre-taxonomy catch still works
+    with pytest.raises(NotImplementedError):
+        get_op_def("definitely_not_an_op")
+
+
+def test_missing_feed_is_not_found_with_message():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 3], "float32")
+        y = layers.scale(x, scale=2.0)
+    exe = fluid.Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    with pytest.raises(errors.NotFoundError, match="feed variable 'x'"):
+        exe.run(main, feed={}, fetch_list=[y], scope=scope)
+
+
+def test_uninitialized_scope_is_precondition_not_met():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 2], "float32")
+        y = layers.fc(x, 2)
+    exe = fluid.Executor()
+    scope = Scope()  # startup NOT run
+    with pytest.raises(errors.PreconditionNotMetError, match="startup"):
+        exe.run(main, feed={"x": np.zeros((2, 2), "float32")},
+                fetch_list=[y], scope=scope)
+    # legacy handlers catching RuntimeError still work
+    with pytest.raises(RuntimeError):
+        exe.run(main, feed={"x": np.zeros((2, 2), "float32")},
+                fetch_list=[y], scope=scope)
+
+
+def test_block_var_not_found():
+    main = fluid.Program()
+    with pytest.raises(errors.NotFoundError, match="not found in block"):
+        main.global_block.var("nope")
+
+
+def test_op_provenance_attached():
+    e = errors.InvalidArgumentError("bad shape", op=None, loc="model.py:10")
+    assert e.user_loc == "model.py:10"
+    assert "model.py:10" in str(e)
+    assert "INVALID_ARGUMENT" in str(e)
+
+
+def test_nan_check_is_precondition_not_met():
+    from paddle_tpu import set_flags
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2], "float32")
+        y = layers.log(x)  # log(-1) -> NaN
+    exe = fluid.Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    set_flags({"FLAGS_check_nan_inf": 1})
+    try:
+        with pytest.raises(errors.PreconditionNotMetError, match="NaN/Inf"):
+            exe.run(main, feed={"x": np.array([-1.0, 1.0], "float32")},
+                    fetch_list=[y], scope=scope)
+    finally:
+        set_flags({"FLAGS_check_nan_inf": 0})

@@ -66,3 +66,37 @@ def test_tiled_grads_match_reference(causal):
         err = np.abs(np.asarray(a) - np.asarray(b_)).max()
         scale = np.abs(np.asarray(b_)).max() + 1e-6
         assert err / scale < 2e-4, err / scale
+
+
+def test_adaptive_tile_sizes_fwd_bwd():
+    """r4: S need only be a multiple of 128 (adaptive BQ/BK) and causal
+    tiles above the diagonal are skipped — fwd+bwd vs dense reference at a
+    non-512-multiple S."""
+    import jax
+
+    from paddle_tpu.kernels import flash_attention as fa
+    from paddle_tpu.kernels.flash_tiled import (flash_tiled, flash_tiled_fwd,
+                                                supports_tiled)
+
+    rng = np.random.RandomState(7)
+    H, D, S = 4, 64, 1280
+    assert supports_tiled(S, H, D, jnp.float32)
+    assert supports_tiled(384, H, D, jnp.float32)
+    qkv = jnp.asarray(rng.randn(1, S, 3 * H * D).astype(np.float32)) * 0.3
+    bias = jnp.zeros((1, S), jnp.float32)
+    st = dict(scale=0.125, rate=0.0, is_test=True, upscale=False,
+              causal=True)
+    out, _ = flash_tiled_fwd(qkv, bias, jnp.zeros(2, jnp.uint32), H, D, st,
+                             interpret=True)
+    ref = fa._reference_qkv(qkv, bias, jax.random.key(0), H, **st)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    w = jnp.asarray(rng.randn(1, S, H * D).astype(np.float32))
+    stt = tuple(st.items())
+    g = jax.grad(lambda x: jnp.sum(flash_tiled(
+        x, bias, jnp.zeros(2, jnp.uint32), H, D, stt, True) * w))(qkv)
+    gr = jax.grad(lambda x: jnp.sum(fa._reference_qkv(
+        x, bias, jax.random.key(0), H, **st) * w))(qkv)
+    scale = np.abs(np.asarray(gr)).max()
+    np.testing.assert_allclose(np.asarray(g) / scale, np.asarray(gr) / scale,
+                               atol=1e-4)
