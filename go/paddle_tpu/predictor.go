@@ -1,0 +1,180 @@
+// Go inference client over the paddle_tpu C API (reference
+// go/paddle/predictor.go + config.go + tensor.go, which wrap the C++
+// AnalysisPredictor through paddle_c_api.h the same way).
+//
+// Build: the cgo directives below link libpaddle_tpu_capi.so — build it
+// once with `python -c "from paddle_tpu.inference_capi import build_capi;
+// print(build_capi())"` and point CGO_LDFLAGS at its directory. NOTE: the
+// build image for this repo carries no Go toolchain, so this package is
+// compile-checked against the C header contract only (tests/test_capi.py
+// exercises the identical PD_* calls from C); treat it as the reference
+// treats its Go client — a thin shipped binding, not a tested surface.
+
+package paddle_tpu
+
+// #cgo CFLAGS: -I${SRCDIR}/../../paddle_tpu/inference_capi
+// #cgo LDFLAGS: -L${SRCDIR}/../../paddle_tpu/inference_capi -lpaddle_tpu_capi
+// #include <stdbool.h>
+// #include <stdlib.h>
+// #include "paddle_tpu_capi.h"
+import "C"
+
+import (
+	"runtime"
+	"unsafe"
+)
+
+type DType C.PD_DataType
+
+const (
+	Float32 DType = C.PD_FLOAT32
+	Int32   DType = C.PD_INT32
+	Int64   DType = C.PD_INT64
+	Uint8   DType = C.PD_UINT8
+)
+
+// AnalysisConfig mirrors the reference go/paddle/config.go surface.
+type AnalysisConfig struct {
+	c *C.PD_AnalysisConfig
+}
+
+func NewAnalysisConfig() *AnalysisConfig {
+	cfg := &AnalysisConfig{c: C.PD_NewAnalysisConfig()}
+	runtime.SetFinalizer(cfg, (*AnalysisConfig).finalize)
+	return cfg
+}
+
+func (cfg *AnalysisConfig) finalize() { C.PD_DeleteAnalysisConfig(cfg.c) }
+
+func (cfg *AnalysisConfig) SetModel(modelDir, paramsFile string) {
+	cDir := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cDir))
+	var cParams *C.char
+	if paramsFile != "" {
+		cParams = C.CString(paramsFile)
+		defer C.free(unsafe.Pointer(cParams))
+	}
+	C.PD_SetModel(cfg.c, cDir, nil, cParams)
+}
+
+// Tensor is the host-side value crossing the boundary (PD_TensorC).
+type Tensor struct {
+	Name  string
+	Dtype DType
+	Shape []int64
+	Data  []byte
+}
+
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+func NewPredictor(cfg *AnalysisConfig) *Predictor {
+	p := C.PD_NewPredictor(cfg.c)
+	if p == nil {
+		return nil
+	}
+	pred := &Predictor{c: p}
+	runtime.SetFinalizer(pred, (*Predictor).finalize)
+	return pred
+}
+
+func (p *Predictor) finalize() { C.PD_DeletePredictor(p.c) }
+
+func (p *Predictor) GetInputNum() int  { return int(C.PD_GetInputNum(p.c)) }
+func (p *Predictor) GetOutputNum() int { return int(C.PD_GetOutputNum(p.c)) }
+
+func (p *Predictor) GetInputName(i int) string {
+	return C.GoString(C.PD_GetInputName(p.c, C.int(i)))
+}
+
+func (p *Predictor) GetOutputName(i int) string {
+	return C.GoString(C.PD_GetOutputName(p.c, C.int(i)))
+}
+
+func LastError() string { return C.GoString(C.PD_GetLastError()) }
+
+func toC(ts []Tensor, pin []*C.char) []C.PD_TensorC {
+	ins := make([]C.PD_TensorC, len(ts))
+	for i, t := range ts {
+		pin[i] = C.CString(t.Name)
+		ins[i].name = pin[i]
+		ins[i].dtype = C.PD_DataType(t.Dtype)
+		ins[i].shape = (*C.int64_t)(unsafe.Pointer(&t.Shape[0]))
+		ins[i].rank = C.int(len(t.Shape))
+		ins[i].data = unsafe.Pointer(&t.Data[0])
+		ins[i].byte_size = C.size_t(len(t.Data))
+	}
+	return ins
+}
+
+func fromC(outs *C.PD_TensorC, n C.int, copyData bool) []Tensor {
+	res := make([]Tensor, int(n))
+	sz := unsafe.Sizeof(C.PD_TensorC{})
+	for i := 0; i < int(n); i++ {
+		o := (*C.PD_TensorC)(unsafe.Pointer(
+			uintptr(unsafe.Pointer(outs)) + uintptr(i)*sz))
+		rank := int(o.rank)
+		shape := make([]int64, rank)
+		for d := 0; d < rank; d++ {
+			shape[d] = int64(*(*C.int64_t)(unsafe.Pointer(
+				uintptr(unsafe.Pointer(o.shape)) + uintptr(d)*8)))
+		}
+		data := C.GoBytes(o.data, C.int(o.byte_size))
+		_ = copyData // GoBytes always copies; zero-copy callers keep C ptrs
+		res[i] = Tensor{
+			Name:  C.GoString(o.name),
+			Dtype: DType(o.dtype),
+			Shape: shape,
+			Data:  data,
+		}
+	}
+	return res
+}
+
+// Run mirrors reference Predictor.Run: copies outputs into Go memory.
+func (p *Predictor) Run(inputs []Tensor) ([]Tensor, bool) {
+	pin := make([]*C.char, len(inputs))
+	defer func() {
+		for _, s := range pin {
+			if s != nil {
+				C.free(unsafe.Pointer(s))
+			}
+		}
+	}()
+	ins := toC(inputs, pin)
+	var outs *C.PD_TensorC
+	var n C.int
+	ok := bool(C.PD_PredictorRun(p.c, &ins[0], C.int(len(ins)), &outs, &n))
+	if !ok {
+		return nil, false
+	}
+	res := fromC(outs, n, true)
+	C.PD_FreeOutputs(outs, n)
+	return res, true
+}
+
+// ZeroCopyRun mirrors the reference ZeroCopy API: inputs are read in
+// place, outputs borrow predictor-owned buffers (valid until next run);
+// the returned Go slices are copies of those buffers for memory safety
+// at the Go boundary (the C caller may instead hold the raw pointers).
+func (p *Predictor) ZeroCopyRun(inputs []Tensor) ([]Tensor, bool) {
+	pin := make([]*C.char, len(inputs))
+	defer func() {
+		for _, s := range pin {
+			if s != nil {
+				C.free(unsafe.Pointer(s))
+			}
+		}
+	}()
+	ins := toC(inputs, pin)
+	var outs *C.PD_TensorC
+	var n C.int
+	ok := bool(C.PD_ZeroCopyRun(p.c, &ins[0], C.int(len(ins)), &outs, &n))
+	if !ok {
+		return nil, false
+	}
+	res := fromC(outs, n, true)
+	C.PD_FreeZeroCopyOutputs(outs, n)
+	return res, true
+}

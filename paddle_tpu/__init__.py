@@ -47,6 +47,9 @@ from . import dygraph  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from . import dataloader  # noqa: F401
 from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401  (runtime stat counters)
+from . import debugger  # noqa: F401  (draw_block_graphviz)
+from . import install_check  # noqa: F401  (run_check)
 from .flags import get_flags, set_flags  # noqa: F401
 from . import metrics  # noqa: F401
 from . import nets  # noqa: F401

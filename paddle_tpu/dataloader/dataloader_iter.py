@@ -176,6 +176,9 @@ class _SingleProcessIter(_DataLoaderIterBase):
             self._ahead = self._stage(next(self._it))  # stage one ahead
         except StopIteration:
             self._ahead = None
+        from .. import monitor
+
+        monitor.add("dataloader.batches")  # once per DELIVERED batch
         return out
 
 

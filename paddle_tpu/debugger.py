@@ -1,0 +1,59 @@
+"""Program graph visualization (reference
+python/paddle/fluid/debugger.py:229 draw_block_graphviz): dump a Block as
+a graphviz .dot file — ops as boxes, variables as ellipses, parameters
+highlighted — so a user can see the graph a Program builds before the
+whole block disappears into one XLA computation.
+"""
+
+from __future__ import annotations
+
+
+def _esc(s):
+    return str(s).replace('"', r"\"")
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Write a graphviz dot rendering of `block` to `path` (same signature
+    as the reference's debugger.draw_block_graphviz)."""
+    highlights = set(highlights or ())
+    lines = [
+        "digraph G {",
+        "  rankdir=TB;",
+        '  node [fontsize=10, fontname="Helvetica"];',
+    ]
+    var_nodes = {}
+
+    def var_node(name):
+        if name in var_nodes:
+            return var_nodes[name]
+        nid = f"var_{len(var_nodes)}"
+        var_nodes[name] = nid
+        v = block._find_var_recursive(name)
+        shape = tuple(v.shape or ()) if v is not None else "?"
+        is_param = bool(v is not None and getattr(v, "persistable", False))
+        style = "filled"
+        color = "lightgrey" if is_param else "white"
+        if name in highlights:
+            color = "yellow"
+        lines.append(
+            f'  {nid} [label="{_esc(name)}\\n{_esc(shape)}", '
+            f'shape=ellipse, style={style}, fillcolor={color}];'
+        )
+        return nid
+
+    for i, op in enumerate(block.ops):
+        op_id = f"op_{i}"
+        lines.append(
+            f'  {op_id} [label="{_esc(op.type)}", shape=box, '
+            'style=filled, fillcolor=lightblue];'
+        )
+        for name in op.input_names():
+            if name:
+                lines.append(f"  {var_node(name)} -> {op_id};")
+        for name in op.output_names():
+            if name:
+                lines.append(f"  {op_id} -> {var_node(name)};")
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path

@@ -30,7 +30,9 @@ class DistributedStrategy:
     async       — in-graph table updates removed; a host-side
                   AsyncCommunicator applies merged grads with bounded
                   staleness (fleet/communicator.py).
-    half_async  — async with send_queue_size=1 (barrier semantics).
+    half_async  — async engine + a per-round barrier: every push is
+                  applied before the next step (HalfAsyncCommunicator
+                  protocol, communicator.h:299).
     geo         — local training + periodic delta allreduce
                   (GeoCommunicator, update_frequency steps apart).
     """
@@ -160,6 +162,7 @@ class ParameterServerFleet:
                 optimizer=eff_opt,
                 send_queue_size=strategy.send_queue_size,
                 merge_size=strategy.merge_size,
+                step_barrier=strategy.mode == "half_async",
             ).start()
         elif self._geo_info is not None:
             from .communicator import GeoCommunicator

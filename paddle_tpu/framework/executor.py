@@ -106,29 +106,18 @@ class Executor:
         return_numpy=True,
         use_program_cache=True,
     ):
-        program = program if program is not None else default_main_program()
-        # CompiledProgram shim (compiler.py): run its underlying Program
-        program = getattr(program, "program", program)
-        scope = scope if scope is not None else global_scope()
-        feed = dict(feed or {})
-        fetch_list = fetch_list or []
-        fetch_names = tuple(
-            v.name if isinstance(v, Variable) else str(v) for v in fetch_list
-        )
-        block = program.global_block
+        # the shared prologue keys the cache on the Program OBJECT
+        # (identity hash, strong ref) so a freed Program's recycled id
+        # cannot produce a stale hit; _prepared is the single source of
+        # the key derivation for run/flops/AOT serialize+load
+        (program, scope, block, feed_arrays, _feed_sig, fetch_names,
+         key) = self._prepared(program, feed, fetch_list, scope)
+        from .. import monitor
 
-        feed_arrays = {k: jnp.asarray(v) for k, v in feed.items()}
-        feed_sig = tuple(
-            (k, tuple(a.shape), str(a.dtype)) for k, a in sorted(feed_arrays.items())
-        )
-        from ..flags import flag
-
-        check_nan = bool(flag("check_nan_inf"))
-        # keying on the Program object (identity hash, strong ref) rather than
-        # id() prevents stale hits when a freed Program's id is recycled
-        key = (program, program._version, feed_sig, fetch_names, check_nan)
+        monitor.add("executor.run_steps")
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
+            monitor.add("executor.compile_count")
             compiled = self._compile(program, block, set(feed_arrays), fetch_names, scope)
             if use_program_cache:
                 self._cache[key] = compiled

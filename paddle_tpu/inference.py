@@ -67,7 +67,12 @@ class AnalysisConfig:
     def set_batch_buckets(self, sizes):
         """Pad run() batches up to the nearest of `sizes` so arbitrary
         batch sizes share executables (one compile per bucket, not per
-        batch size). All feeds must share the leading batch axis."""
+        batch size). Contract: all feeds share the LEADING batch axis, and
+        fetches must be per-sample tensors with the batch leading too —
+        un-padding slices axis 0 of batch-sized outputs. A fetch that
+        REDUCES over the batch (a mean loss, say) would silently include
+        the zero padding rows; keep such reductions out of bucketed
+        predictors."""
         sizes = sorted(int(s) for s in sizes)
         if not sizes or sizes[0] <= 0:
             raise InvalidArgumentError(
@@ -255,8 +260,14 @@ class Predictor:
             scope=self._scope,
         )
         if orig_b is not None:
+            bucket = next(iter(feed.values())).shape[0]
+            # un-pad only outputs that are visibly batch-leading (see the
+            # set_batch_buckets contract); anything else passes through
             outs = [
-                o[:orig_b] if getattr(o, "ndim", 0) > 0 else o for o in outs
+                o[:orig_b]
+                if getattr(o, "ndim", 0) > 0 and o.shape[0] == bucket
+                else o
+                for o in outs
             ]
         return [
             PaddleTensor(o, name=n)

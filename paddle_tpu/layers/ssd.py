@@ -49,10 +49,17 @@ def _encode_per_prior(prior, prior_var, matched):
 
 def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                    min_ratio=None, max_ratio=None, min_sizes=None,
-                   max_sizes=None, steps=None, offset=0.5, flip=True,
-                   clip=False, name=None):
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=None, flip=True, clip=False,
+                   kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
     """Per-feature-map loc/conf convs + priors, concatenated (reference
-    multi_box_head). Returns (mbox_locs, mbox_confs, boxes, variances)."""
+    layers/detection.py:2110 multi_box_head — full keyword surface:
+    per-map steps/step_w/step_h, prior variances, loc/conf conv
+    kernel/pad/stride). min_max_aspect_ratios_order is accepted for
+    signature parity; prior ordering here is the emitter's fixed
+    (min, ratios, max) order either way. Returns (mbox_locs, mbox_confs,
+    boxes, variances)."""
     n_maps = len(inputs)
     if min_sizes is None:
         min_ratio, max_ratio = min_ratio or 20, max_ratio or 90
@@ -72,15 +79,22 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
             else [min_sizes[i]]
         maxs = max_sizes[i] if isinstance(max_sizes[i], (list, tuple)) \
             else [max_sizes[i]]
+        if steps is not None:
+            sw = sh = steps[i]
+        else:
+            sw = step_w[i] if step_w else 0.0
+            sh = step_h[i] if step_h else 0.0
         boxes, variances = prior_box(
-            x, image, mins, maxs, ar, flip=flip, clip=clip, offset=offset,
+            x, image, mins, maxs, ar, variance=variance, flip=flip,
+            clip=clip, steps=[float(sw), float(sh)], offset=offset,
         )
         a = boxes.shape[2] if len(boxes.shape) == 4 else 1
         num_priors = 1
         for d in boxes.shape[:-1]:
             num_priors *= d
-        loc = _conv2d(x, a * 4, 3, padding=1)
-        conf = _conv2d(x, a * num_classes, 3, padding=1)
+        loc = _conv2d(x, a * 4, kernel_size, padding=pad, stride=stride)
+        conf = _conv2d(x, a * num_classes, kernel_size, padding=pad,
+                       stride=stride)
         n = x.shape[0]
         locs.append(t.reshape(t.transpose(loc, [0, 2, 3, 1]), [n, -1, 4]))
         confs.append(t.reshape(t.transpose(conf, [0, 2, 3, 1]),

@@ -1,0 +1,56 @@
+"""Native C++ training demo (reference paddle/fluid/train/demo/
+demo_trainer.cc + its README build recipe): `build_demo()` compiles the
+embedded-CPython trainer binary, `save_train_bundle()` writes the
+pickled {main, startup, feeds, loss} bundle it consumes. Exercised
+end-to-end by tests/test_train_demo.py."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "demo_trainer.cc")
+_BIN = os.path.join(_HERE, "demo_trainer")
+
+
+def _embed_flags():
+    cflags = [f"-I{sysconfig.get_path('include')}"]
+    ldver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION"
+    )
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ldflags = [f"-L{libdir}", f"-Wl,-rpath,{libdir}", f"-lpython{ldver}"]
+    return cflags, ldflags
+
+
+def build_demo(force=False):
+    """Compile the demo trainer binary (cached by source mtime)."""
+    if (
+        not force
+        and os.path.exists(_BIN)
+        and os.path.getmtime(_BIN) >= os.path.getmtime(_SRC)
+    ):
+        return _BIN
+    cflags, ldflags = _embed_flags()
+    cmd = ["g++", "-O2", "-std=c++17", *cflags, _SRC, "-o", _BIN, *ldflags]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return _BIN
+
+
+def save_train_bundle(path, main_program, startup_program, feeds, loss_name):
+    """Pickle the training bundle the C++ demo consumes (the analog of the
+    reference's saved ProgramDesc file)."""
+    with open(path, "wb") as f:
+        pickle.dump(
+            {
+                "main": main_program,
+                "startup": startup_program,
+                "feeds": dict(feeds),
+                "loss": str(loss_name),
+            },
+            f,
+        )
+    return path

@@ -1,0 +1,105 @@
+// Native training demo (reference paddle/fluid/train/demo/demo_trainer.cc:
+// a C++ binary that loads a saved training ProgramDesc and drives the
+// Executor through N SGD steps, printing the loss).
+//
+// TPU-native shape: the Program pair is pickled python (Program IR is
+// picklable by design); this binary embeds CPython — exactly as the
+// inference C API does (inference_capi/capi.cpp) — loads the pair, runs
+// the startup program, then drives train steps through the whole-block
+// XLA executor. The C++ side owns main(), argument parsing, and the
+// training loop; the interpreter is the runtime library underneath.
+//
+// Usage: demo_trainer <train_bundle.pkl> [steps]
+// where the bundle is {"main": Program, "startup": Program,
+// "feeds": {name: ndarray}, "loss": varname} (see
+// paddle_tpu/train_demo/__init__.py save_train_bundle).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+namespace {
+
+const char* kHelper = R"PY(
+import pickle
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework.scope import Scope
+
+
+def load_bundle(path):
+    with open(path, "rb") as f:
+        b = pickle.load(f)
+    scope = Scope()
+    exe = fluid.Executor()
+    exe.run(b["startup"], scope=scope)
+    return (b, scope, exe)
+
+
+def train_step(state, step):
+    b, scope, exe = state
+    (lv,) = exe.run(b["main"], feed=b["feeds"], fetch_list=[b["loss"]],
+                    scope=scope)
+    return float(np.asarray(lv).reshape(-1)[0])
+)PY";
+
+PyObject* g_mod = nullptr;
+
+bool init_python() {
+  Py_InitializeEx(0);
+  PyObject* mod = PyModule_New("demo_trainer_helper");
+  PyObject* globals = PyModule_GetDict(mod);
+  PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* res = PyRun_String(kHelper, Py_file_input, globals, globals);
+  if (res == nullptr) {
+    PyErr_Print();
+    return false;
+  }
+  Py_DECREF(res);
+  g_mod = mod;
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <train_bundle.pkl> [steps]\n", argv[0]);
+    return 1;
+  }
+  const int steps = argc > 2 ? std::atoi(argv[2]) : 10;
+  if (!init_python()) return 2;
+
+  PyObject* load = PyObject_GetAttrString(g_mod, "load_bundle");
+  PyObject* state =
+      PyObject_CallFunction(load, "s", argv[1]);
+  Py_DECREF(load);
+  if (state == nullptr) {
+    PyErr_Print();
+    return 3;
+  }
+  PyObject* step_fn = PyObject_GetAttrString(g_mod, "train_step");
+  double first = 0.0, last = 0.0;
+  for (int i = 0; i < steps; ++i) {
+    PyObject* lv = PyObject_CallFunction(step_fn, "Oi", state, i);
+    if (lv == nullptr) {
+      PyErr_Print();
+      return 4;
+    }
+    last = PyFloat_AsDouble(lv);
+    if (i == 0) first = last;
+    std::printf("step %d loss %.6f\n", i, last);
+    Py_DECREF(lv);
+  }
+  Py_DECREF(step_fn);
+  Py_DECREF(state);
+  std::printf("train_demo done: loss %.6f -> %.6f (%s)\n", first, last,
+              last < first ? "decreased" : "NOT decreased");
+  Py_Finalize();
+  return last < first ? 0 : 5;
+}

@@ -110,3 +110,58 @@ def test_check_nan_inf_sees_sharded_state():
     with pytest.raises(RuntimeError, match="NaN/Inf"):
         exe.run(feed={"ids": np.asarray([25], np.int64).repeat(4)},
                 fetch_list=[loss])
+
+
+# -- round 4: monitor counters + graphviz dump + install_check ---------------
+
+
+def test_monitor_counters_count_runs_and_compiles():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, monitor
+    from paddle_tpu.framework.scope import Scope
+
+    monitor.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 2])
+        y = layers.scale(x, scale=3.0)
+    exe = fluid.Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    for _ in range(3):
+        exe.run(main, feed={"x": np.zeros((2, 2), "float32")},
+                fetch_list=[y], scope=scope)
+    stats = monitor.get_int_stats()
+    assert stats["executor.run_steps"] == 4  # startup + 3 steps
+    # 3 identical steps share ONE compile (startup is the other)
+    assert stats["executor.compile_count"] == 2
+    monitor.set_float("test.gauge", 1.5)
+    assert monitor.get_float_stats()["test.gauge"] == 1.5
+    monitor.reset()
+
+
+def test_draw_block_graphviz(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.debugger import draw_block_graphviz
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 4])
+        y = layers.fc(x, 3, act="relu")
+    path = draw_block_graphviz(main.global_block,
+                               highlights=[y.name],
+                               path=str(tmp_path / "g.dot"))
+    dot = open(path).read()
+    assert dot.startswith("digraph G {") and dot.rstrip().endswith("}")
+    assert '"mul"' in dot and '"relu"' in dot
+    assert "yellow" in dot  # highlighted output var
+    assert "lightgrey" in dot  # parameter node
+
+
+def test_install_check_run_check(capsys):
+    from paddle_tpu.install_check import run_check
+
+    assert run_check() is True
+    out = capsys.readouterr().out
+    assert "installed successfully" in out

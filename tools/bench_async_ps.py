@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Async vs sync parameter-server throughput (VERDICT r3 item 6).
+
+Trains the same DeepFM config through the ParameterServerFleet in "sync"
+mode (every step waits for the table apply) and "async" mode (the
+AsyncCommunicator queues merged applies on a host thread, reference
+operators/distributed/communicator.h:237 AsyncCommunicator), and prints
+steps/sec for each plus the async/sync ratio as one JSON line.
+
+Runs on the CPU backend (the PS data plane is host-side either way);
+launch with the same env as pytest for the 8-device virtual mesh. The
+async win here is pipelining: train_step returns as soon as the gradient
+is queued, so the (deliberately slowed) apply overlaps the next step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.fleet import parameter_server as ps
+    from paddle_tpu.models.deepfm import DeepFMConfig, deepfm
+
+    cfg = DeepFMConfig(vocab_size=4096, num_fields=8, embed_dim=16,
+                       mlp_sizes=(64, 32))
+    b, steps = 256, 120
+
+    rng = np.random.RandomState(0)
+    feeds = []
+    for _ in range(8):
+        idv = rng.randint(0, cfg.vocab_size, (b, cfg.num_fields))
+        lab = (idv[:, :1] % 2 == 0).astype(np.float32)
+        feeds.append({"feat_ids": idv.astype(np.int64), "label": lab})
+
+    results = {}
+    for mode in ("sync", "async"):
+        main_prog, startup = fluid.Program(), fluid.Program()
+        main_prog.random_seed = startup.random_seed = 11
+        scope = fluid.framework.scope.Scope()
+        with fluid.program_guard(main_prog, startup), \
+                fluid.scope_guard(scope), unique_name.guard():
+            ids = fluid.data("feat_ids", [b, cfg.num_fields], "int64")
+            label = fluid.data("label", [b, 1], "float32")
+            loss, _ = deepfm(ids, label, cfg)
+            fleet = ps.ParameterServerFleet().init()
+            strategy = ps.DistributedStrategy(
+                mode, send_queue_size=8, merge_size=4
+            )
+            opt = fleet.distributed_optimizer(
+                fluid.optimizer.SGD(0.1), strategy
+            )
+            opt.minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            comm = fleet.init_worker(scope=scope, exe=exe, lr=0.1)
+
+            def one(i):
+                f = feeds[i % len(feeds)]
+                if comm is not None and hasattr(comm, "train_step"):
+                    (lv,) = comm.train_step(exe, main_prog, f, [loss],
+                                            scope=scope)
+                else:
+                    (lv,) = exe.run(main_prog, feed=f, fetch_list=[loss],
+                                    scope=scope)
+                return lv
+
+            for i in range(5):
+                one(i)
+            t0 = time.perf_counter()
+            for i in range(steps):
+                lv = one(i)
+            final = float(np.asarray(lv).reshape(-1)[0])
+            dt = time.perf_counter() - t0
+            fleet.stop_worker()
+        results[mode] = {
+            "steps_per_sec": round(steps / dt, 2),
+            "final_loss": round(final, 4),
+        }
+    results["async_over_sync"] = round(
+        results["async"]["steps_per_sec"] / results["sync"]["steps_per_sec"],
+        3,
+    )
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

