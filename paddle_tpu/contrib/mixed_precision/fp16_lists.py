@@ -22,7 +22,9 @@ WHITE_LIST = {
 }
 
 BLACK_LIST = {
-    "softmax_with_cross_entropy",
+    # NOT softmax_with_cross_entropy: its emitter reduces in fp32 from
+    # bf16 logits (ops/nn.py) — black-listing it forced a full fp32
+    # [N, V] logits cast+materialization at LM heads
     "cross_entropy",
     "sigmoid_cross_entropy_with_logits",
     "mean",

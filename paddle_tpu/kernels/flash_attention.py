@@ -278,6 +278,21 @@ def _pallas_bwd(q, k, v, bias, seed, do, statics, interpret):
 # (G = 128//D heads) so the lane dimension is full.
 
 
+def uses_tiled_path(seq_len: int, num_heads: int, head_dim: int, dtype):
+    """True when fused_attention_qkv will dispatch the KV-tiled kernel for
+    these static properties on the TPU backend — the op builder uses this
+    at graph-build time to wire the saved (Out, Lse) into the dedicated
+    grad op (ops/fused.py), so it must mirror the dispatch below."""
+    from .flash_tiled import supports_tiled
+
+    return (
+        jax.default_backend() == "tpu"
+        and not supports_packed(seq_len, num_heads, head_dim, dtype)
+        and seq_len > MAX_SEQ
+        and supports_tiled(seq_len, num_heads, head_dim, dtype)
+    )
+
+
 def supports_packed(seq_len: int, num_heads: int, head_dim: int, dtype):
     g = 128 // head_dim if head_dim and 128 % head_dim == 0 else 0
     return (
@@ -451,10 +466,13 @@ def fused_attention_qkv(
     rng_key=None,
     interpret=False,
     force_reference=False,
+    return_lse=False,
 ):
     """Attention over a packed qkv projection [B, S, 3*H*D] -> [B, S, H*D].
     Same semantics as fused_attention; the packed layout avoids every
-    head-split transpose/copy around the kernel."""
+    head-split transpose/copy around the kernel. With return_lse=True the
+    TILED path returns (out, lse) so a later backward can skip the
+    forward re-run; every other path returns (out, None)."""
     B, S, three_hd = qkv.shape
     D = three_hd // 3 // num_heads
     if scale is None:
@@ -498,6 +516,13 @@ def fused_attention_qkv(
                     "in interpret mode (interpreter PRNG is a stub)"
                 )
             seed = _seed_words(rng_key)
+            if return_lse:
+                from .flash_tiled import flash_tiled_outs
+
+                return flash_tiled_outs(
+                    qkv, bias, seed, num_heads, D, tuple(statics.items()),
+                    interpret,
+                )
             return flash_tiled(
                 qkv, bias, seed, num_heads, D, tuple(statics.items()),
                 interpret,
@@ -515,17 +540,20 @@ def fused_attention_qkv(
             seed = _seed_words(rng_key)
             out4 = _flash(q, k, v, bias, seed, tuple(statics.items()), False)
             B_, H_, S_, D_ = out4.shape
-            return out4.transpose(0, 2, 1, 3).reshape(B_, S_, H_ * D_)
-        return _reference_qkv(qkv, bias, rng_key, num_heads, **statics)
+            out = out4.transpose(0, 2, 1, 3).reshape(B_, S_, H_ * D_)
+            return (out, None) if return_lse else out
+        out = _reference_qkv(qkv, bias, rng_key, num_heads, **statics)
+        return (out, None) if return_lse else out
     if interpret and training_dropout:
         raise ValueError(
             "fused_attention_qkv: training dropout is unsupported in "
             "interpret mode (interpreter PRNG is a stub)"
         )
     seed = _seed_words(rng_key)
-    return _flash_qkv(
+    out = _flash_qkv(
         qkv, bias, seed, num_heads, D, tuple(statics.items()), interpret
     )
+    return (out, None) if return_lse else out
 
 
 def _unpack_qkv(qkv, H):
@@ -541,7 +569,7 @@ def attention_grads_qkv(qkv, num_heads, key_bias, d_out, rng_key, *,
                         scale=None, dropout_rate=0.0, is_test=True,
                         dropout_implementation="downgrade_in_infer",
                         causal=False, force_reference=False,
-                        interpret=False):
+                        interpret=False, saved_out=None, saved_lse=None):
     """(dqkv, dbias) without re-running the forward kernel (see
     attention_grads)."""
     B, S, three_hd = qkv.shape
@@ -590,12 +618,16 @@ def attention_grads_qkv(qkv, num_heads, key_bias, d_out, rng_key, *,
         and S > MAX_SEQ
         and supports_tiled(S, num_heads, D, qkv.dtype)
     ):
-        # tiled path: re-run the (cheap relative to bwd) forward for the
-        # saved logsumexp, then the two-kernel tiled backward
         seed = _seed_words(rng_key)
-        out, lse = flash_tiled_fwd(
-            qkv, bias, seed, num_heads, D, statics, interpret
-        )
+        if saved_out is not None and saved_lse is not None:
+            # the forward op saved (out, lse): straight to the two-kernel
+            # tiled backward — no forward re-run (a full extra fwd per
+            # layer per step otherwise; XLA does not CSE custom calls)
+            out, lse = saved_out, saved_lse
+        else:
+            out, lse = flash_tiled_fwd(
+                qkv, bias, seed, num_heads, D, statics, interpret
+            )
         return flash_tiled_bwd(
             qkv, bias, seed, d_out, out, lse, num_heads, D, statics,
             interpret,

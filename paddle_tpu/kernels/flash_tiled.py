@@ -479,19 +479,23 @@ def flash_tiled_bwd(qkv, bias, seed, do, out, lse, H, D, statics,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_tiled(qkv, bias, seed, H, D, statics, interpret):
-    out, _ = flash_tiled_fwd(qkv, bias, seed, H, D, dict(statics), interpret)
-    return out
+def flash_tiled_outs(qkv, bias, seed, H, D, statics, interpret):
+    """(out, lse): the row logsumexp is a SECOND output so the static
+    graph can hand it to the dedicated grad op — without it the grad op
+    must re-run the forward kernel to recover lse (XLA does not CSE
+    custom calls), a full extra fwd per layer per step."""
+    return flash_tiled_fwd(qkv, bias, seed, H, D, dict(statics), interpret)
 
 
-def _flash_tiled_fwd_rule(qkv, bias, seed, H, D, statics, interpret):
+def _flash_tiled_outs_fwd(qkv, bias, seed, H, D, statics, interpret):
     out, lse = flash_tiled_fwd(qkv, bias, seed, H, D, dict(statics),
                                interpret)
-    return out, (qkv, bias, seed, out, lse)
+    return (out, lse), (qkv, bias, seed, out, lse)
 
 
-def _flash_tiled_bwd_rule(H, D, statics, interpret, res, g):
+def _flash_tiled_outs_bwd(H, D, statics, interpret, res, gs):
     qkv, bias, seed, out, lse = res
+    g, _g_lse = gs  # lse is auxiliary: cotangents on it are discarded
     dqkv, dbias = flash_tiled_bwd(
         qkv, bias, seed, g, out, lse, H, D, dict(statics), interpret
     )
@@ -499,4 +503,11 @@ def _flash_tiled_bwd_rule(H, D, statics, interpret, res, g):
     return dqkv, dbias, dseed
 
 
-flash_tiled.defvjp(_flash_tiled_fwd_rule, _flash_tiled_bwd_rule)
+flash_tiled_outs.defvjp(_flash_tiled_outs_fwd, _flash_tiled_outs_bwd)
+
+
+def flash_tiled(qkv, bias, seed, H, D, statics, interpret):
+    """out-only wrapper: ONE vjp pair of record (flash_tiled_outs); the
+    discarded lse costs nothing extra — the kernel always computes it."""
+    out, _ = flash_tiled_outs(qkv, bias, seed, H, D, statics, interpret)
+    return out

@@ -83,7 +83,10 @@ def fused_qkv_attention(
     }
     if scale is not None:
         attrs["scale"] = float(scale)
-    return helper.create_and_append(inputs, attrs)
+    out, _lse = helper.create_and_append(
+        inputs, attrs, out_slots=("Out", "Lse")
+    )
+    return out
 
 
 def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,

@@ -150,11 +150,15 @@ def gpt_lm_loss(input_ids, cfg, is_test=False, labels=None):
     final position predicts nothing and is dropped)."""
     b, s = input_ids.shape
     hidden = gpt_decoder(input_ids, cfg, is_test=is_test)
-    logits = layers.fc(
-        hidden, cfg.vocab_size, num_flatten_dims=2, bias_attr=False,
+    # slice the HIDDEN states, not the logits: slicing after the vocab
+    # projection copies a [B, S, V] tensor (~0.5 GB at S=2048/V=32k);
+    # slicing before it is a [B, S, H] copy and the head matmul computes
+    # only the s-1 predicted positions
+    pred_h = layers.slice(hidden, [1], [0], [s - 1])
+    pred = layers.fc(
+        pred_h, cfg.vocab_size, num_flatten_dims=2, bias_attr=False,
         param_attr=ParamAttr(name="lm_head_w", initializer=_init(cfg)),
     )
-    pred = layers.slice(logits, [1], [0], [s - 1])
     if labels is None:
         tgt = layers.slice(input_ids, [1], [1], [s])
     else:

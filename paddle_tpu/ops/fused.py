@@ -37,6 +37,11 @@ def _make_attention_grad_maker(grad_op_type, primal_slots):
         inputs["OutGrad"] = [g_out]
         if has_bias:
             inputs["KeyBias"] = op.inputs["KeyBias"]
+        if op.outputs.get("Lse") and _qkv_tiled_at_build(op, block):
+            # the forward saved (Out, Lse): hand both to the grad op so the
+            # tiled backward skips its forward re-run (flash_tiled_outs)
+            inputs["Out"] = op.outputs["Out"]
+            inputs["Lse"] = op.outputs["Lse"]
         outs = {}
         for slot in primal_slots + (("KeyBias",) if has_bias else ()):
             n = op.inputs[slot][0]
@@ -58,6 +63,26 @@ def _make_attention_grad_maker(grad_op_type, primal_slots):
         block.append_op(grad_op_type, inputs, outs, attrs)
 
     return maker
+
+
+def _qkv_tiled_at_build(op, block):
+    """Build-time mirror of fused_attention_qkv's tiled-path dispatch (the
+    AMP rewrite runs before backward, so the declared qkv dtype here is
+    the runtime dtype; jax backend is the same process)."""
+    from ..core.dtypes import to_numpy_dtype
+    from ..kernels.flash_attention import uses_tiled_path
+
+    v = block._find_var_recursive(op.inputs["QKV"][0])
+    if v is None or not v.shape or len(v.shape) != 3:
+        return False
+    H = int(op.attr("num_heads"))
+    S = int(v.shape[1])
+    D = int(v.shape[2]) // 3 // H
+    try:
+        dt = to_numpy_dtype(v.dtype)
+    except Exception:
+        return False
+    return uses_tiled_path(S, H, D, dt)
 
 
 def _attn_ctx(ctx, op):
@@ -161,7 +186,7 @@ def _fused_multihead_attention_grad(ctx, op, ins):
 @register_op(
     "fused_qkv_attention",
     inputs=["QKV", "KeyBias"],
-    outputs=["Out"],
+    outputs=["Out", "Lse"],
     grad_maker=_make_attention_grad_maker(
         "fused_qkv_attention_grad", ("QKV",)
     ),
@@ -170,7 +195,12 @@ def _fused_qkv_attention(ctx, op, ins):
     """Attention over the packed qkv projection [B, S, 3*H*D] -> [B, S,
     H*D] (attr num_heads). On TPU the Pallas kernel indexes the projection
     in place — no head-split transposes ever materialize (the 4-D op above
-    costs 8 layout copies of [B,S,H] per layer per step)."""
+    costs 8 layout copies of [B,S,H] per layer per step). The TILED path
+    (S beyond the whole-row cap) also emits the row logsumexp as `Lse` so
+    the dedicated grad op runs the backward without re-running the
+    forward kernel."""
+    import jax.numpy as jnp
+
     from ..kernels.flash_attention import fused_attention_qkv
 
     qkv = ins["QKV"][0]
@@ -179,7 +209,7 @@ def _fused_qkv_attention(ctx, op, ins):
     rng_key = None
     if rate > 0.0 and not is_test:
         rng_key = ctx.key_for(op.uid, op.type)
-    out = fused_attention_qkv(
+    out, lse = fused_attention_qkv(
         qkv,
         int(op.attr("num_heads")),
         key_bias=bias,
@@ -192,13 +222,23 @@ def _fused_qkv_attention(ctx, op, ins):
         causal=bool(op.attr("causal", False)),
         rng_key=rng_key,
         force_reference=gspmd_mode,
+        return_lse=True,
     )
-    return {"Out": [out]}
+    if lse is None and getattr(op, "block", None) is not None \
+            and _qkv_tiled_at_build(op, op.block):
+        # the grad maker predicted the tiled path and wired Lse as a grad
+        # input, but a runtime condition (e.g. gspmd fallback) routed
+        # elsewhere: emit a placeholder so the graph stays runnable; the
+        # grad emitter ignores it on non-tiled paths
+        lse = jnp.zeros(out.shape, jnp.float32)
+    if lse is None:
+        return {"Out": [out], "Lse": []}
+    return {"Out": [out], "Lse": [lse]}
 
 
 @register_op(
     "fused_qkv_attention_grad",
-    inputs=["QKV", "KeyBias", "OutGrad"],
+    inputs=["QKV", "KeyBias", "OutGrad", "Out", "Lse"],
     outputs=["QKVGrad", "KeyBiasGrad"],
     differentiable=False,
 )
@@ -214,6 +254,8 @@ def _fused_qkv_attention_grad(ctx, op, ins):
         rng_key = ctx.key_for(
             int(op.attr("__fwd_uid__", 0)), "fused_qkv_attention"
         )
+    saved_out = ins["Out"][0] if ins.get("Out") else None
+    saved_lse = ins["Lse"][0] if ins.get("Lse") else None
     dqkv, dbias = attention_grads_qkv(
         qkv, int(op.attr("num_heads")), bias, d_out, rng_key,
         scale=op.attr("scale", None),
@@ -224,6 +266,7 @@ def _fused_qkv_attention_grad(ctx, op, ins):
         ),
         causal=bool(op.attr("causal", False)),
         force_reference=gspmd_mode,
+        saved_out=saved_out, saved_lse=saved_lse,
     )
     outs = {}
     if op.outputs.get("QKVGrad"):

@@ -486,22 +486,38 @@ def _cross_entropy(ctx, op, ins):
     outputs=["Softmax", "Loss"],
 )
 def _softmax_with_cross_entropy(ctx, op, ins):
+    """Hard labels use the logsumexp-minus-picked form with fp32
+    accumulation: loss = lse(logits) - logits[label]. Unlike a
+    materialized log_softmax, nothing [N, V]-shaped in fp32 ever reaches
+    HBM — at a GPT LM head ([B*S, 32k] logits) the log_softmax
+    formulation under the old fp32 black-listing cost ~GBs of cast +
+    materialize traffic per step. The op is precision-robust with bf16
+    logits (max/sum reduce in fp32), so AMP no longer black-lists it.
+    The Softmax output is computed lazily from the same pieces; XLA DCEs
+    it when (as in every loss head) nothing consumes it."""
     logits, label = ins["Logits"][0], ins["Label"][0]
     axis = op.attr("axis", -1)
-    logp = jax.nn.log_softmax(logits, axis=axis)
     if op.attr("soft_label", False):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+        return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+    if label.ndim == logits.ndim:
+        lbl = label
     else:
-        if label.ndim == logits.ndim:
-            lbl = label
-        else:
-            lbl = label[..., None]
-        ignore = op.attr("ignore_index", -100)
-        valid = lbl != ignore
-        safe_lbl = jnp.where(valid, lbl, 0).astype(np.int32)
-        picked = jnp.take_along_axis(logp, safe_lbl, axis=axis)
-        loss = jnp.where(valid, -picked, 0.0)
-    return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+        lbl = label[..., None]
+    ignore = op.attr("ignore_index", -100)
+    valid = lbl != ignore
+    safe_lbl = jnp.where(valid, lbl, 0).astype(np.int32)
+    m = jnp.max(logits, axis=axis, keepdims=True).astype(jnp.float32)
+    sumexp = jnp.sum(
+        jnp.exp(logits.astype(jnp.float32) - m), axis=axis, keepdims=True,
+        dtype=jnp.float32,
+    )
+    lse = m + jnp.log(sumexp)
+    picked = jnp.take_along_axis(logits, safe_lbl, axis=axis)
+    loss = jnp.where(valid, lse - picked.astype(jnp.float32), 0.0)
+    softmax = jnp.exp(logits.astype(jnp.float32) - lse).astype(logits.dtype)
+    return {"Softmax": [softmax], "Loss": [loss]}
 
 
 @register_op("square_error_cost", inputs=["X", "Y"], outputs=["Out"])

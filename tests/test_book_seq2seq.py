@@ -81,7 +81,11 @@ def test_seq2seq_trains_and_beam_decodes():
     exe.run(fluid.default_startup_program())
     rng = np.random.RandomState(0)
     losses = []
-    for step in range(700):
+    # 900 steps (was 700): at 700 the copy task sat on a knife edge where
+    # float-rounding-level changes in the CE emitter (r4 lse-form, ~1e-6)
+    # flipped one of the ten decode trials; the extra steps make the
+    # decode margin robust to benign numeric drift
+    for step in range(900):
         s, d, l = _batch(rng, B)
         (lv,) = exe.run(
             feed={"src": s, "dec_in": d, "label": l}, fetch_list=[loss]
