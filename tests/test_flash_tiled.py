@@ -100,3 +100,33 @@ def test_adaptive_tile_sizes_fwd_bwd():
     scale = np.abs(np.asarray(gr)).max()
     np.testing.assert_allclose(np.asarray(g) / scale, np.asarray(gr) / scale,
                                atol=1e-4)
+
+
+def test_saved_lse_wired_into_grad_op(monkeypatch):
+    """r4: when the build-time predicate says the tiled kernel will run,
+    the grad maker wires the forward's saved (Out, Lse) into the
+    dedicated grad op so the backward skips its forward re-run. The
+    predicate is TPU-only, so force it here and assert graph structure."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.ops import fused as fused_ops
+
+    monkeypatch.setattr(fused_ops, "_qkv_tiled_at_build",
+                        lambda op, block: True)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [1, 2048, 64], "float32")
+        qkv = layers.fc(x, 3 * 8 * 64, num_flatten_dims=2)  # param -> grads
+        out = layers.fused_qkv_attention(qkv, 8, causal=True)
+        loss = layers.reduce_mean(out)
+        fluid.optimizer.SGD(0.1).minimize(loss, startup)
+    grad_ops = [op for op in main.global_block.ops
+                if op.type == "fused_qkv_attention_grad"]
+    assert grad_ops, "no dedicated grad op emitted"
+    g = grad_ops[0]
+    assert g.inputs.get("Out") and g.inputs.get("Lse"), g.inputs
+    fwd = [op for op in main.global_block.ops
+           if op.type == "fused_qkv_attention"][0]
+    assert g.inputs["Lse"] == fwd.outputs["Lse"]
+    assert g.inputs["Out"] == fwd.outputs["Out"]
