@@ -51,6 +51,58 @@ def _online_block(q, k, v, valid, m, l, acc, scale):
     return m_new, l_new, acc_new
 
 
+# keys processed per online-softmax block: bounds the materialized score
+# block to [S_local, _KV_CHUNK] regardless of shard size, so ring
+# attention scales to shards far beyond the [S_local, S_local] HBM cliff
+# (a 32k shard would otherwise stream multi-GB probability blocks per
+# ring step)
+_KV_CHUNK = 1024
+
+
+def _valid_mask(row0, col0, sq, sk):
+    rows = row0 + jnp.arange(sq)[:, None]
+    cols = col0 + jnp.arange(sk)[None, :]
+    return rows >= cols
+
+
+def _online_shard(qf, kf, vf, row0, col0, causal, m, l, acc, scale):
+    """Accumulate one full K/V shard into the running softmax state,
+    scanning _KV_CHUNK-sized key blocks (lax.scan) when the shard is
+    larger — the in-XLA analog of the Pallas KV tiling, and still
+    differentiable through the generic vjp path (scan transposes)."""
+    sq = qf.shape[2]
+    sk = kf.shape[2]
+    if sk <= _KV_CHUNK:
+        valid = _valid_mask(row0, col0, sq, sk) if causal else None
+        return _online_block(qf, kf, vf, valid, m, l, acc, scale)
+
+    def body(carry, i):
+        m_, l_, acc_ = carry
+        kc = lax.dynamic_slice_in_dim(kf, i * _KV_CHUNK, _KV_CHUNK, axis=2)
+        vc = lax.dynamic_slice_in_dim(vf, i * _KV_CHUNK, _KV_CHUNK, axis=2)
+        valid = (
+            _valid_mask(row0, col0 + i * _KV_CHUNK, sq, _KV_CHUNK)
+            if causal else None
+        )
+        return _online_block(qf, kc, vc, valid, m_, l_, acc_, scale), None
+
+    chunks = sk // _KV_CHUNK
+    (m, l, acc), _ = lax.scan(body, (m, l, acc), jnp.arange(chunks))
+    tail = sk - chunks * _KV_CHUNK
+    if tail:
+        # non-multiple shard: the remainder is ONE small block — never the
+        # full [sq, sk] score block (that would reopen the HBM cliff the
+        # chunking exists to close)
+        kc = kf[:, :, chunks * _KV_CHUNK:]
+        vc = vf[:, :, chunks * _KV_CHUNK:]
+        valid = (
+            _valid_mask(row0, col0 + chunks * _KV_CHUNK, sq, tail)
+            if causal else None
+        )
+        m, l, acc = _online_block(qf, kc, vc, valid, m, l, acc, scale)
+    return m, l, acc
+
+
 def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
     """q,k,v: LOCAL shards [B, H, S_local, D] inside shard_map.
 
@@ -71,16 +123,10 @@ def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
     kt, vt = k, v
     for t in range(n):
         src = (idx + t) % n  # which shard kt/vt currently holds
-        if causal:
-            # global positions: rows i*s_local + r, cols src*s_local + c
-            rows = idx * s_local + jnp.arange(s_local)[:, None]
-            cols = src * s_local + jnp.arange(s_local)[None, :]
-            valid = rows >= cols
-        else:
-            valid = None
-        m, l, acc = _online_block(
-            qf, kt.astype(jnp.float32), vt.astype(jnp.float32), valid, m, l,
-            acc, scale,
+        # global positions: rows i*s_local + r, cols src*s_local + c
+        m, l, acc = _online_shard(
+            qf, kt.astype(jnp.float32), vt.astype(jnp.float32),
+            idx * s_local, src * s_local, causal, m, l, acc, scale,
         )
         if t != n - 1:
             kt = lax.ppermute(kt, axis_name, perm)
