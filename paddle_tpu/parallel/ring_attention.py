@@ -76,6 +76,12 @@ def _online_shard(qf, kf, vf, row0, col0, causal, m, l, acc, scale):
         valid = _valid_mask(row0, col0, sq, sk) if causal else None
         return _online_block(qf, kf, vf, valid, m, l, acc, scale)
 
+    # jax.checkpoint: WITHOUT it the scan's backward saves each chunk's
+    # softmax residuals (p et al., [Sq, _KV_CHUNK] stacked over all
+    # chunks) — re-materializing the very [Sq, sk]-sized memory the
+    # chunking exists to avoid; rematerializing the chunk in the
+    # backward is the standard flash-attention trade
+    @jax.checkpoint
     def body(carry, i):
         m_, l_, acc_ = carry
         kc = lax.dynamic_slice_in_dim(kf, i * _KV_CHUNK, _KV_CHUNK, axis=2)
@@ -103,6 +109,18 @@ def _online_shard(qf, kf, vf, row0, col0, causal, m, l, acc, scale):
     return m, l, acc
 
 
+def _online_init(b, h, sq, d):
+    return (
+        jnp.full((b, h, sq, 1), _NEG, dtype=jnp.float32),
+        jnp.zeros((b, h, sq, 1), dtype=jnp.float32),
+        jnp.zeros((b, h, sq, d), dtype=jnp.float32),
+    )
+
+
+def _online_finalize(l, acc):
+    return acc / jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0
+
+
 def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
     """q,k,v: LOCAL shards [B, H, S_local, D] inside shard_map.
 
@@ -114,9 +132,7 @@ def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     idx = lax.axis_index(axis_name)
 
-    m = jnp.full((b, h, s_local, 1), _NEG, dtype=jnp.float32)
-    l = jnp.zeros((b, h, s_local, 1), dtype=jnp.float32)
-    acc = jnp.zeros((b, h, s_local, d), dtype=jnp.float32)
+    m, l, acc = _online_init(b, h, s_local, d)
     qf = q.astype(jnp.float32)
 
     perm = [(i, (i - 1) % n) for i in range(n)]  # send to left neighbor
@@ -132,8 +148,7 @@ def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
             kt = lax.ppermute(kt, axis_name, perm)
             vt = lax.ppermute(vt, axis_name, perm)
 
-    out = acc / jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0
-    return out.astype(q.dtype)
+    return _online_finalize(l, acc).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
@@ -158,17 +173,17 @@ def ulysses_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
         )
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    s_full = qh.shape[2]
+    bh, hh, s_full, _ = qh.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
-                   kh.astype(jnp.float32)) * scale
-    if causal:
-        rows = jnp.arange(s_full)[:, None]
-        cols = jnp.arange(s_full)[None, :]
-        s = jnp.where(rows >= cols, s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
-    return to_seq(out.astype(q.dtype))
+    # the head-sharded local attention spans the FULL sequence: stream it
+    # through the same chunked online softmax as the ring path — a dense
+    # [S, S] block at long context is exactly the cliff SP exists to avoid
+    m, l, acc = _online_init(bh, hh, s_full, d)
+    m, l, acc = _online_shard(
+        qh.astype(jnp.float32), kh.astype(jnp.float32),
+        vh.astype(jnp.float32), 0, 0, causal, m, l, acc, scale,
+    )
+    return to_seq(_online_finalize(l, acc).astype(q.dtype))
 
 
 # ---------------------------------------------------------------------------

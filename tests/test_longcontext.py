@@ -156,3 +156,7 @@ def test_ring_attention_kv_chunked_matches_dense(monkeypatch):
     # chunk=3 on shard length 4: one scan chunk + a tail block of 1
     monkeypatch.setattr(ra, "_KV_CHUNK", 3)
     _run_attention("ring_attention", True, sharded=True)
+    # ulysses streams its full-sequence local attention the same way
+    # (chunk=3 on the full S: scan chunks + tail)
+    for causal in (False, True):
+        _run_attention("ulysses_attention", causal, sharded=True)
