@@ -100,9 +100,16 @@ func toC(ts []Tensor, pin []*C.char) []C.PD_TensorC {
 		pin[i] = C.CString(t.Name)
 		ins[i].name = pin[i]
 		ins[i].dtype = C.PD_DataType(t.Dtype)
-		ins[i].shape = (*C.int64_t)(unsafe.Pointer(&t.Shape[0]))
+		// rank-0 tensors / empty buffers: pass nil, the C side tolerates
+		// a null pointer with rank 0 / byte_size 0 (indexing [0] on an
+		// empty Go slice would panic)
+		if len(t.Shape) > 0 {
+			ins[i].shape = (*C.int64_t)(unsafe.Pointer(&t.Shape[0]))
+		}
 		ins[i].rank = C.int(len(t.Shape))
-		ins[i].data = unsafe.Pointer(&t.Data[0])
+		if len(t.Data) > 0 {
+			ins[i].data = unsafe.Pointer(&t.Data[0])
+		}
 		ins[i].byte_size = C.size_t(len(t.Data))
 	}
 	return ins

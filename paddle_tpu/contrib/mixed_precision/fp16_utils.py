@@ -49,6 +49,11 @@ def _insert_cast(block, op_idx, op, name, dest_dtype, force=False):
 _GRAY_SKIP = {"while", "conditional_block", "cast", "print", "py_func",
               "assign", "share_data"}
 
+# input slots that carry TARGETS, not activations: never downcast them.
+# A soft-label fp32 Label is data — it does not ride the activation
+# stream the bandwidth rule targets, and bf16 quantizes it for no win.
+_LABEL_SLOTS = {"Label", "Target", "GTBox", "GTLabel", "GTScore"}
+
 
 def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
     """Insert casts per black/white lists into the (forward-only) program.
@@ -83,7 +88,13 @@ def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
             i += 1
             continue
         inserted = 0
+        skip = set()
+        if target == dest_dtype:
+            for slot in _LABEL_SLOTS & set(op.inputs):
+                skip.update(op.inputs[slot])
         for name in list(dict.fromkeys(op.input_names())):
+            if name in skip:
+                continue
             inserted += _insert_cast(block, i, op, name, target, force)
         if target == dest_dtype:
             # declared output dtypes follow the compute dtype so later

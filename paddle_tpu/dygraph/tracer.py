@@ -93,7 +93,13 @@ class Tracer:
         # occurrence — same per-sequence determinism, one compile
         seed_v = np.uint32(attrs.get("seed", 0) or seq)
 
-        key = self._cache_key(op_type, attrs, is_test, ins, diff_pos)
+        # explicit-seed RNG ops bake seed+uid into the trace
+        # (ops/_helpers.py op_key / ctx.key_for) and every occurrence needs
+        # a distinct stream — caching would either share one mask across
+        # occurrences (uid pinned) or compile per call (uid in the key,
+        # _op_seq never repeats). Rare ops; use the uncached path.
+        key = (None if attrs.get("seed", 0)
+               else self._cache_key(op_type, attrs, is_test, ins, diff_pos))
         entry = self._jit_cache.get(key) if key is not None else None
         if entry is None and key is not None:
             entry = self._build_jitted(op_type, op_def, attrs, is_test,
@@ -156,9 +162,8 @@ class Tracer:
     def _cache_key(op_type, attrs, is_test, ins, diff_pos):
         items = []
         for k, v in sorted(attrs.items()):
-            # "seed" stays IN the key: explicit-seed RNG ops bake the seed
-            # into the trace (ops/_helpers.py op_key reads it), so two
-            # seeds must not share a compile
+            # explicit-seed ops never reach here (trace_op routes them to
+            # the uncached path), so __uid__ can always be dropped
             if k in ("__uid__", "__loc__"):
                 continue
             if isinstance(v, list):
@@ -186,7 +191,10 @@ class Tracer:
 
     def _build_jitted(self, op_type, op_def, attrs, is_test, diff_pos):
         attrs_norm = dict(attrs)
-        attrs_norm["__uid__"] = 0  # one compile serves every occurrence
+        # one compile serves every occurrence: the RNG stream comes from
+        # the seed ARGUMENT (varied per call), not the uid; explicit-seed
+        # ops bypass this path entirely (see trace_op)
+        attrs_norm["__uid__"] = 0
         view = OpView(op_type, attrs_norm)
         spec_holder = [None]
 

@@ -38,6 +38,10 @@ import numpy as np
 
 from .errors import InvalidArgumentError, PreconditionNotMetError
 
+# the XLA compilation cache dir applied by any predictor in this process
+# (jax.config is process-global); conflicting dirs raise at construction
+_applied_optim_cache_dir = None
+
 
 class AnalysisConfig:
     def __init__(self, model_dir=None, params_file=None, model_file=None):
@@ -61,7 +65,14 @@ class AnalysisConfig:
     def set_optim_cache_dir(self, path):
         """Persist XLA compilations under `path` (reference
         SetOptimCacheDir): the first process pays the compile, later ones
-        load from disk."""
+        load from disk.
+
+        PROCESS-GLOBAL: the XLA compilation cache is a jax.config knob, so
+        every compile in the process (other predictors, training code)
+        shares the directory and the zeroed persistence thresholds once any
+        predictor with this knob is constructed. Two predictors configuring
+        DIFFERENT dirs is an error (raised at construction) — the cache
+        cannot be scoped per-predictor."""
         self._optim_cache_dir = str(path)
 
     def set_batch_buckets(self, sizes):
@@ -133,11 +144,21 @@ class Predictor:
         if config._optim_cache_dir:
             import jax
 
-            os.makedirs(config._optim_cache_dir, exist_ok=True)
-            jax.config.update("jax_compilation_cache_dir",
-                              config._optim_cache_dir)
+            global _applied_optim_cache_dir
+            new_dir = os.path.abspath(config._optim_cache_dir)
+            if (_applied_optim_cache_dir is not None
+                    and _applied_optim_cache_dir != new_dir):
+                raise PreconditionNotMetError(
+                    "set_optim_cache_dir is process-global (XLA compilation "
+                    f"cache): already configured to "
+                    f"{_applied_optim_cache_dir!r}, cannot switch to "
+                    f"{new_dir!r} in the same process"
+                )
+            os.makedirs(new_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", new_dir)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            _applied_optim_cache_dir = new_dir
         self._scope = Scope()
         self._exe = Executor()
         with scope_guard(self._scope):
@@ -250,6 +271,58 @@ class Predictor:
         }
         return padded, b
 
+    def _fetch_batch_leading(self, name):
+        """True iff the fetch's DECLARED shape has a dynamic (-1) leading
+        dim — the only case where bucket un-padding is verifiably safe.
+        Computed once per fetch name (the program is static after
+        construction)."""
+        cache = self.__dict__.setdefault("_batch_leading_cache", {})
+        if name not in cache:
+            var = self._program.global_block._find_var_recursive(name)
+            declared = getattr(var, "shape", None)
+            cache[name] = (
+                declared is not None and len(declared) > 0
+                and declared[0] in (-1, None),
+                declared,
+            )
+        return cache[name]
+
+    def _unpad_out(self, o, name, orig_b, bucket):
+        """Slice bucket padding off a fetch, but only when it is
+        VERIFIABLY the batch axis: the declared shape is batch-leading
+        (-1 first dim) AND the runtime leading dim equals the bucket. A
+        fetch whose leading dim merely coincides with the bucket size, or
+        one that reduces over the batch (pad rows leak into the
+        reduction), is a contract violation the old shape heuristic hid;
+        warn (once per fetch) instead of silently returning wrong data
+        (set_batch_buckets contract)."""
+        import warnings
+
+        batch_leading, declared = self._fetch_batch_leading(name)
+        if (batch_leading and getattr(o, "ndim", 0) > 0
+                and o.shape[0] == bucket):
+            return o[:orig_b]
+        warned = self.__dict__.setdefault("_bucket_warned", set())
+        if name in warned:
+            return o
+        warned.add(name)
+        if getattr(o, "ndim", 0) > 0 and o.shape[0] == bucket:
+            warnings.warn(
+                f"bucketed fetch {name!r} has leading dim == bucket size "
+                f"but its declared shape {declared} is not batch-leading; "
+                "returning it UN-sliced — restructure the fetch or disable "
+                "batch buckets (set_batch_buckets contract)",
+                RuntimeWarning, stacklevel=3,
+            )
+        elif not batch_leading and declared is not None:
+            warnings.warn(
+                f"bucketed fetch {name!r} (declared shape {declared}) is "
+                "not batch-leading; if it reduces over the batch the "
+                "zero-pad rows are included (set_batch_buckets contract)",
+                RuntimeWarning, stacklevel=3,
+            )
+        return o
+
     def run(self, inputs):
         """inputs: list of PaddleTensor/ndarray in feed order -> list of
         PaddleTensor (reference PaddlePredictor::Run)."""
@@ -259,20 +332,14 @@ class Predictor:
             self._program, feed=feed, fetch_list=self._fetch_vars,
             scope=self._scope,
         )
+        names = self.get_output_names()
         if orig_b is not None:
             bucket = next(iter(feed.values())).shape[0]
-            # un-pad only outputs that are visibly batch-leading (see the
-            # set_batch_buckets contract); anything else passes through
             outs = [
-                o[:orig_b]
-                if getattr(o, "ndim", 0) > 0 and o.shape[0] == bucket
-                else o
-                for o in outs
+                self._unpad_out(o, name, orig_b, bucket)
+                for o, name in zip(outs, names)
             ]
-        return [
-            PaddleTensor(o, name=n)
-            for o, n in zip(outs, self.get_output_names())
-        ]
+        return [PaddleTensor(o, name=n) for o, n in zip(outs, names)]
 
     def run_zero_copy(self, inputs):
         """Like run(), but returns (names, arrays) where `arrays` are

@@ -283,3 +283,30 @@ else:
     status = fleet.load_check_point(exe, "/ckpts", fs=fs)
     assert status.next() == 5
     np.testing.assert_allclose(np.asarray(scope.find_var("hw")), saved)
+
+
+def test_amp_gray_rule_leaves_soft_labels_fp32():
+    """ADVICE r4: the gray-op downcast must not quantize label slots —
+    a soft-label fp32 Label is data, not a master param on the activation
+    stream. The rewrite casts Logits to bf16 but leaves Label untouched."""
+    from paddle_tpu.contrib.mixed_precision import fp16_utils
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        img = fluid.data("img", [-1, 16], "float32")
+        soft = fluid.data("soft", [-1, 10], "float32")
+        pred = layers.fc(img, size=10)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(pred, soft, soft_label=True)
+        )
+    fp16_utils.rewrite_program(main)
+    for op in main.global_block.ops:
+        if op.type == "softmax_with_cross_entropy":
+            # Label input must still be the raw fp32 feed, not a cast
+            (lbl,) = op.inputs["Label"]
+            assert lbl == "soft", lbl
+            v = main.global_block._find_var_recursive(lbl)
+            assert str(v.dtype) == "float32"
+            break
+    else:
+        pytest.fail("softmax_with_cross_entropy not found")

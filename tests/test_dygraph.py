@@ -202,3 +202,38 @@ def test_eager_matches_static_linear():
         e_loss.numpy().reshape(-1),
         rtol=1e-5,
     )
+
+
+def test_explicit_seed_dropout_distinct_per_occurrence():
+    """ADVICE r4 (medium): the jit-cached tracer pinned __uid__=0, so two
+    explicit-seed dropouts in one step drew the IDENTICAL mask and diverged
+    from the uncached path. With an explicit seed the real uid must stay in
+    the trace (and in the cache key) so occurrences get distinct streams."""
+
+    def run_step(force_uncached=False):
+        with dygraph.guard():
+            from paddle_tpu.dygraph import tracer as tr_mod
+            from paddle_tpu.dygraph.tracer import trace_op_multi
+
+            tr = tr_mod._current()
+            if force_uncached:
+                tr._cache_key = lambda *a, **k: None
+            x = to_variable(np.ones((64, 64), "float32"))
+            attrs = {"dropout_prob": 0.5, "seed": 7,
+                     "dropout_implementation": "upscale_in_train"}
+            m1 = trace_op_multi("dropout", {"X": [x]}, dict(attrs))
+            m2 = trace_op_multi("dropout", {"X": [x]}, dict(attrs))
+            return (np.asarray(m1["Mask"][0].value),
+                    np.asarray(m2["Mask"][0].value))
+
+    a1, a2 = run_step()
+    # distinct masks for distinct occurrences even with a shared seed
+    assert not np.array_equal(a1, a2)
+    # deterministic across steps (explicit seed semantics preserved)
+    b1, b2 = run_step()
+    np.testing.assert_array_equal(a1, b1)
+    np.testing.assert_array_equal(a2, b2)
+    # cached path matches the uncached fallback stream exactly
+    u1, u2 = run_step(force_uncached=True)
+    np.testing.assert_array_equal(a1, u1)
+    np.testing.assert_array_equal(a2, u2)
