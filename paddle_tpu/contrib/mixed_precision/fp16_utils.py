@@ -47,12 +47,22 @@ def _insert_cast(block, op_idx, op, name, dest_dtype, force=False):
 # sub-blocks (casts would break capture analysis), cast has an explicit
 # out_dtype contract
 _GRAY_SKIP = {"while", "conditional_block", "cast", "print", "py_func",
-              "assign", "share_data"}
+              "assign", "share_data", "pipeline_block", "pipeline_uniform",
+              "pipeline_gate_loss", "recompute_segment"}
 
 # input slots that carry TARGETS, not activations: never downcast them.
 # A soft-label fp32 Label is data — it does not ride the activation
 # stream the bandwidth rule targets, and bf16 quantizes it for no win.
 _LABEL_SLOTS = {"Label", "Target", "GTBox", "GTLabel", "GTScore"}
+
+# per-(op, slot) gray-downcast exemptions: LN affine params stay fp32 like
+# black-listed layer_norm's (the fused kernel computes in fp32 internally,
+# and the bf16 cast of these [H] vectors is what trips the XLA partitioner
+# crash under gspmd-Auto sharding — see pipeline_uniform composition)
+_GRAY_SLOT_KEEP = {
+    ("fused_dropout_add_ln", "Scale"),
+    ("fused_dropout_add_ln", "LnBias"),
+}
 
 
 def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
@@ -64,9 +74,54 @@ def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
     master params, typically a bias) are cast down too. Without this,
     jnp's type promotion silently lifts every bias-add back to fp32 — the
     activation stream between matmuls then crosses custom-call fusion
-    barriers at twice the bytes (profiled on BERT-base, BASELINE.md r4)."""
+    barriers at twice the bytes (profiled on BERT-base, BASELINE.md r4).
+
+    Pipeline composition (reference meta-optimizer stacking,
+    optimizer.py:3556 + incubate/fleet/collective/__init__.py:384): when
+    the forward has already been sliced into pipeline stage sub-blocks,
+    the rewrite recurses into each stage block, then re-records the
+    pipeline boundary dtype from the rewritten boundary vars — stage
+    hand-offs ride ICI in bf16 (half the ppermute bytes), matching the
+    declared dtypes the next stage's cast checks rely on."""
     amp_lists = amp_lists or AutoMixedPrecisionLists()
-    block = program.global_block
+    _rewrite_block(program, program.global_block, amp_lists, dest_dtype)
+    for op in program.global_block.ops:
+        if op.type == "pipeline_block":
+            for bi in op.attr("stage_blocks"):
+                _rewrite_block(program, program.blocks[bi], amp_lists,
+                               dest_dtype)
+            # boundary activations may now be low-precision: sync the
+            # recorded dtype so the emitter's inter-stage cast matches
+            # declared dtypes
+            b_names = op.attr("boundary_names")
+            dts = set()
+            for n in b_names:
+                v = program.global_block._find_var_recursive(n)
+                if v is None:
+                    for bi in op.attr("stage_blocks"):
+                        v = program.blocks[bi]._find_var_recursive(n)
+                        if v is not None:
+                            break
+                if v is not None:
+                    dts.add(str(v.dtype))
+            if len(dts) == 1:
+                op.attrs["boundary_dtype"] = dts.pop()
+        elif op.type == "pipeline_uniform":
+            bi = op.attr("stage_block")
+            blk = program.blocks[bi]
+            _rewrite_block(program, blk, amp_lists, dest_dtype)
+            # boundary_dtype deliberately NOT lowered: a low-precision
+            # carry through ppermute/scan/psum composed with gspmd-Auto
+            # (mp) sharded weights trips an XLA partitioner check failure
+            # ("Invalid binary instruction opcode copy", reproduced
+            # minimally in jax 0.9 — bf16 carry crashes, f32 passes).
+            # Stage compute still runs bf16 (casts above); only the
+            # inter-stage hand-off pays f32 bytes.
+    program._bump()
+    return program
+
+
+def _rewrite_block(program, block, amp_lists, dest_dtype):
     i = 0
     while i < len(block.ops):
         op = block.ops[i]
@@ -90,8 +145,9 @@ def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
         inserted = 0
         skip = set()
         if target == dest_dtype:
-            for slot in _LABEL_SLOTS & set(op.inputs):
-                skip.update(op.inputs[slot])
+            for slot in op.inputs:
+                if slot in _LABEL_SLOTS or (op.type, slot) in _GRAY_SLOT_KEEP:
+                    skip.update(op.inputs[slot])
         for name in list(dict.fromkeys(op.input_names())):
             if name in skip:
                 continue
@@ -104,5 +160,4 @@ def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
                 if v is not None and is_float(v.dtype):
                     v.dtype = dest_dtype
         i += 1 + inserted
-    program._bump()
-    return program
+    return block

@@ -450,12 +450,17 @@ class Executor:
         mesh = program._mesh
         spmd_mode = getattr(program, "_spmd_mode", "shard_map")
         # under gspmd there is no axis binding: collectives degrade to
-        # identity and XLA derives cross-shard comms from shardings instead
-        mesh_axes = (
-            tuple(mesh.axis_names)
-            if (mesh is not None and spmd_mode == "shard_map")
-            else ()
-        )
+        # identity and XLA derives cross-shard comms from shardings instead.
+        # hybrid: only the program's manual axes are bound; the rest are
+        # gspmd-Auto (emitters must not issue collectives over them)
+        if mesh is None:
+            mesh_axes = ()
+        elif spmd_mode == "shard_map":
+            mesh_axes = tuple(mesh.axis_names)
+        elif spmd_mode == "hybrid":
+            mesh_axes = tuple(getattr(program, "_manual_axes", ()))
+        else:
+            mesh_axes = ()
 
         def traced(feeds, smut, sro, step_key):
             env = {}
@@ -522,6 +527,9 @@ class Executor:
             fn = wrap(
                 traced, program, mesh, state_ro, state_mut, write_back,
                 wrapped_fetches,
+                manual_axes=(
+                    mesh_axes if spmd_mode == "hybrid" else None
+                ),
             )
         else:
             fn = jax.jit(traced, donate_argnums=(1,))

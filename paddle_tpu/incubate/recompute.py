@@ -72,8 +72,32 @@ def apply_recompute(program, checkpoint_names):
     checkpoint_names: ordered var names marking segment boundaries. Ops up to
     the producer of checkpoint[0] form segment 1, ... The tail after the last
     checkpoint stays as-is (its activations feed backward immediately —
-    reference behavior)."""
-    block = program.global_block
+    reference behavior).
+
+    Composes with pipeline parallelism (reference RecomputeOptimizer under
+    PipelineOptimizer, optimizer.py:3858+3556): if the forward has been
+    sliced into pipeline stage sub-blocks, recompute recurses into each
+    stage block — checkpoints land inside the stage that produced them, and
+    the stage's boundary var + loss are protected segment outputs (the
+    pipeline scheduler reads them by name between stages)."""
+    did = _apply_recompute_block(program, program.global_block,
+                                 checkpoint_names)
+    for op in program.global_block.ops:
+        if op.type != "pipeline_block":
+            continue
+        protect = set(op.attr("boundary_names")) | {op.attr("loss_name")}
+        for bi in op.attr("stage_blocks"):
+            did |= _apply_recompute_block(
+                program, program.blocks[bi], checkpoint_names,
+                protected_reads=protect,
+            )
+    if did:
+        program._bump()
+    return program
+
+
+def _apply_recompute_block(program, block, checkpoint_names,
+                           protected_reads=()):
     ops = list(block.ops)
     # index just past the producer of each checkpoint
     bounds = []
@@ -86,7 +110,7 @@ def apply_recompute(program, checkpoint_names):
             bounds.append(pos)
     bounds = sorted(set(bounds))
     if not bounds:
-        return program
+        return False
 
     # reads occurring after a position (for segment output computation)
     segments = []
@@ -101,7 +125,7 @@ def apply_recompute(program, checkpoint_names):
     for start, end in segments:
         new_ops.extend(ops[cursor:start])
         span = ops[start:end]
-        later_reads = set()
+        later_reads = set(protected_reads)
         for op in ops[end:]:
             later_reads.update(op.input_names())
         in_names, out_names = _segment_io(span, block, later_reads)
@@ -127,8 +151,7 @@ def apply_recompute(program, checkpoint_names):
         cursor = end
     new_ops.extend(ops[cursor:])
     block.ops = new_ops
-    program._bump()
-    return program
+    return True
 
 
 class RecomputeOptimizer:

@@ -1,0 +1,283 @@
+"""Composed-parallelism BERT/ERNIE pretraining: dp × mp × pp + recompute +
+AMP + vocab-sharded embeddings in ONE program.
+
+This is the ERNIE-3.0-style "stack everything" configuration
+(BASELINE.json configs[4]). The reference reaches it by meta-optimizer
+stacking — RecomputeOptimizer (optimizer.py:3858) wrapped by the AMP
+decorator (contrib/mixed_precision/decorator.py:218) wrapped by
+PipelineOptimizer (optimizer.py:3556), wrapped by CollectiveOptimizer
+(incubate/fleet/collective/__init__.py:384) which adds the dp transpile —
+each strategy a separate NCCL/program rewrite that must be composed by
+hand.
+
+TPU-native composition is the same optimizer stack but ONE jitted SPMD
+program over a 3-axis mesh in "hybrid" shard_map mode
+(parallel/spmd.py):
+  * pp — manual axis: the GPipe scheduler (lax.scan + ppermute over ICI)
+    needs lax.axis_index and explicit neighbor sends;
+  * dp — manual axis: grad allreduce ops from GradAllReduce
+    (parallel/transpiler.py) ride lax.psum;
+  * mp — gspmd-Auto axis: Megatron column/row-parallel weights carry
+    sharding annotations (bert_tp_shardings) and the XLA SPMD partitioner
+    inserts the row-parallel reduce — no hand-written TP collectives;
+  * recompute — stage sub-blocks fold per-layer segments into
+    jax.checkpoint (incubate/recompute.py), so activations are
+    rematerialized in backward;
+  * AMP — bf16 cast rewrite recurses into the stage sub-blocks and the
+    pipeline boundary itself rides ICI in bf16
+    (contrib/mixed_precision/fp16_utils.py).
+
+The input word embedding and MLM output projection are vocab-sharded over
+mp (the "sharded table for the input layer"), so the largest tables never
+materialize replicated.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .bert import (BertConfig, bert_encoder, bert_encoder_layers,
+                   bert_mlm_head, bert_tp_shardings)
+
+
+def build_bert_3d(cfg, batch, seq_len, *, num_stages=2, microbatches=2,
+                  dp=1, use_amp=True, use_recompute=True, lr=1e-4,
+                  seed=1234, pipeline_mode="uniform"):
+    """Build the composed program. `batch` is the PER-DP-SHARD batch (each
+    dp group feeds its own slice); it must divide by `microbatches`.
+
+    pipeline_mode:
+      * "uniform" (default) — the stage-uniform pipeline
+        (parallel/pipeline_uniform.py): stacked per-stage weights sharded
+        over pp, branch-free body. The ONLY mode that composes with
+        gspmd-Auto tensor parallelism: the lax.switch dispatch of
+        "blocks" mode puts partitioner-inserted mp collectives inside
+        device-dependent branches, which deadlocks any mesh (see the
+        pipeline_uniform module docstring). Also the only mode where
+        params/optimizer state shard by stage (HBM /K).
+      * "blocks" — the reference-parity heterogeneous PipelineOptimizer
+        (device_guard-cut stages). Valid for pp×dp; do NOT combine with
+        mp shardings.
+
+    Returns (main, startup, loss). To run it sharded:
+
+        mesh = make_mesh({"dp": dp, "mp": mp, "pp": num_stages}, devices)
+        shard_program(main, mesh, bert_3d_shardings(cfg, num_stages),
+                      mode="hybrid", manual_axes=("dp", "pp"))
+
+    Meshless, the same program degrades to valid single-device numerics
+    (collectives are identity, the pipeline runs its sequential-microbatch
+    path) — which is what the equivalence tests compare against.
+    """
+    if pipeline_mode == "uniform":
+        return _build_uniform(
+            cfg, batch, seq_len, num_stages=num_stages,
+            microbatches=microbatches, dp=dp, use_amp=use_amp,
+            use_recompute=use_recompute, lr=lr, seed=seed,
+        )
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.mixed_precision import decorate
+    from paddle_tpu.incubate import RecomputeOptimizer
+    from paddle_tpu.optimizer import Adam
+    from paddle_tpu.parallel.transpiler import GradAllReduce
+
+    if batch % microbatches:
+        raise ValueError(
+            f"per-shard batch {batch} must divide by microbatches "
+            f"{microbatches}"
+        )
+    if cfg.num_layers < num_stages:
+        raise ValueError(
+            f"{cfg.num_layers} layers cannot fill {num_stages} stages"
+        )
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [batch, seq_len], "int64")
+        types = fluid.data("types", [batch, seq_len], "int64")
+        mask = fluid.data("mask", [batch, seq_len], "float32")
+        labels = fluid.data("labels", [batch, seq_len], "int64")
+
+        # layer stack split contiguously across stages; embeddings live on
+        # stage 0, the MLM head on the last stage (reference ERNIE
+        # device_guard placement)
+        per = [cfg.num_layers // num_stages] * num_stages
+        for i in range(cfg.num_layers % num_stages):
+            per[i] += 1
+        checkpoints = []
+        with fluid.device_guard("pipeline:0"):
+            h = bert_encoder(ids, types, mask, cfg, num_layers=per[0],
+                             checkpoints=checkpoints)
+            if num_stages == 1:
+                loss = bert_mlm_head(h, labels, cfg)
+        start = per[0]
+        for st in range(1, num_stages):
+            with fluid.device_guard(f"pipeline:{st}"):
+                h = bert_encoder_layers(
+                    h, mask, cfg, start=start, end=start + per[st],
+                    checkpoints=checkpoints,
+                )
+                start += per[st]
+                if st == num_stages - 1:
+                    loss = bert_mlm_head(h, labels, cfg)
+
+        inner = Adam(lr)
+        if use_recompute:
+            inner = RecomputeOptimizer(inner)
+            # per-encoder-layer boundaries; the LAST checkpoint of each
+            # stage is that stage's pipeline boundary (protected output)
+            inner._set_checkpoints(checkpoints)
+        if use_amp:
+            # bf16: same exponent range as fp32, static unit scale; the
+            # finiteness check still zeroes grads on a bad step
+            inner = decorate(inner, use_dynamic_loss_scaling=False,
+                             init_loss_scaling=1.0, dest_dtype="bfloat16")
+        if num_stages > 1:
+            from paddle_tpu.parallel import PipelineOptimizer
+
+            pipe = PipelineOptimizer(inner, num_microbatches=microbatches,
+                                     axis_name="pp")
+            _, params_grads = pipe.minimize(loss, startup)
+        else:
+            # no pipeline: dp×mp (+ recompute + AMP) only
+            _, params_grads = inner.minimize(loss, startup)
+
+        if dp > 1:
+            GradAllReduce(dp, axis_name="dp").transpile(main, params_grads)
+            blk = main.global_block
+            # fetched loss is the shard-local mean; average across dp
+            blk.append_op(
+                "scale", {"X": [loss.name]}, {"Out": [loss.name]},
+                {"scale": 1.0 / dp, "bias": 0.0},
+            )
+            blk.append_op(
+                "c_allreduce_sum", {"X": [loss.name]}, {"Out": [loss.name]},
+                {"axis_name": "dp"},
+            )
+    return main, startup, loss
+
+
+def _build_uniform(cfg, batch, seq_len, *, num_stages, microbatches, dp,
+                   use_amp, use_recompute, lr, seed):
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.mixed_precision import decorate
+    from paddle_tpu.optimizer import Adam
+    from paddle_tpu.parallel import (append_outside_grad_allreduce,
+                                     gate_loss, uniform_pipeline)
+    from paddle_tpu.parallel.transpiler import GradAllReduce
+
+    if batch % microbatches:
+        raise ValueError(
+            f"per-shard batch {batch} must divide by microbatches "
+            f"{microbatches}"
+        )
+    if cfg.num_layers % num_stages:
+        raise ValueError(
+            f"{cfg.num_layers} layers must divide evenly across "
+            f"{num_stages} uniform stages"
+        )
+    layers_per_stage = cfg.num_layers // num_stages
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [batch, seq_len], "int64")
+        types = fluid.data("types", [batch, seq_len], "int64")
+        mask = fluid.data("mask", [batch, seq_len], "float32")
+        labels = fluid.data("labels", [batch, seq_len], "int64")
+
+        # embeddings (vocab-shardable over mp) run unpipelined on every
+        # device; the uniform layer stack is the pipelined region
+        emb = bert_encoder(ids, types, mask, cfg, num_layers=0)
+
+        def stage(x_in):
+            return bert_encoder_layers(
+                x_in, mask, cfg, start=0, end=layers_per_stage
+            )
+
+        if num_stages > 1:
+            seq = uniform_pipeline(
+                emb, stage, num_stages, microbatches, mb_extern=[mask],
+                axis_name="pp", remat=use_recompute,
+            )
+        else:
+            seq = stage(emb)
+        raw_loss = bert_mlm_head(seq, labels, cfg)
+        loss = (
+            gate_loss(raw_loss, "pp") if num_stages > 1 else raw_loss
+        )
+
+        inner = Adam(lr)
+        if use_amp:
+            inner = decorate(inner, use_dynamic_loss_scaling=False,
+                             init_loss_scaling=1.0, dest_dtype="bfloat16")
+        _, params_grads = inner.minimize(loss, startup)
+        if num_stages > 1:
+            append_outside_grad_allreduce(main, params_grads, "pp")
+        if dp > 1:
+            GradAllReduce(dp, axis_name="dp").transpile(main, params_grads)
+            blk = main.global_block
+            blk.append_op(
+                "scale", {"X": [loss.name]}, {"Out": [loss.name]},
+                {"scale": 1.0 / dp, "bias": 0.0},
+            )
+            blk.append_op(
+                "c_allreduce_sum", {"X": [loss.name]}, {"Out": [loss.name]},
+                {"axis_name": "dp"},
+            )
+    return main, startup, loss
+
+
+def bert_3d_shardings(cfg, num_stages=None, mp_axis="mp", dp_axis="dp",
+                      pp_axis="pp"):
+    """Sharding annotations for the composed program.
+
+    num_stages set (uniform mode): encoder params are [K, ...] stacks named
+    `bert_l{j}_*@STACK` — spec = (pp,) + the layer's Megatron TP spec, so
+    one array is simultaneously stage-sharded (manual pp) and
+    tensor-sharded (auto mp). Embedding/MLM head keep their vocab-mp
+    shard; feeds shard over dp.
+
+    num_stages None ("blocks" mode): per-layer params with TP specs only
+    (every device holds all stages — the lax.switch design cannot shard by
+    stage).
+
+    Adam moments need no entries: same-shaped optimizer accumulators
+    inherit their parameter's spec automatically (spec_for's _accum_of
+    fallback, parallel/spmd.py) — the reference's sharded-optimizer
+    analogue; beta-pow accumulators are scalars and stay replicated."""
+    if num_stages is None:
+        sh = bert_tp_shardings(cfg, axis=mp_axis)
+    else:
+        layers_per_stage = cfg.num_layers // num_stages
+        import copy
+
+        tcfg = copy.copy(cfg)
+        tcfg.num_layers = layers_per_stage
+        tp = bert_tp_shardings(tcfg, axis=mp_axis)
+        sh = {}
+        for p, spec in tp.items():
+            if p.startswith("bert_l"):
+                sh[f"{p}@STACK"] = (pp_axis,) + tuple(spec)
+            else:
+                sh[p] = spec
+    for name in ("ids", "types", "mask", "labels"):
+        sh[name] = (dp_axis,)
+    return sh
+
+
+def example_feed_3d(cfg, batch, seq_len, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "ids": rng.randint(0, cfg.vocab_size, (batch, seq_len)).astype(
+            "int64"
+        ),
+        "types": rng.randint(
+            0, cfg.type_vocab_size, (batch, seq_len)
+        ).astype("int64"),
+        "mask": np.ones((batch, seq_len), "float32"),
+        "labels": rng.randint(0, cfg.vocab_size, (batch, seq_len)).astype(
+            "int64"
+        ),
+    }

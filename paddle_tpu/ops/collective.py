@@ -49,6 +49,24 @@ _register_allreduce(
 _register_allreduce("allreduce", lambda x, ax: lax.psum(x, ax))
 
 
+@register_op("mp_allreduce_sum", inputs=["X"], outputs=["Out"])
+def _mp_allreduce_sum(ctx, op, ins):
+    """DIFFERENTIABLE in-graph allreduce (reference
+    operators/collective/c_allreduce_op.h with use_model_parallel — the
+    forward-graph allreduce of tensor/sequence parallelism, unlike
+    c_allreduce_sum which the transpilers append post-backward). Under
+    shard_map psum transposes to psum, so each replica's unit cotangent
+    would arrive axis_size-fold; the correction keeps the forward value
+    while scaling the cotangent down (same trick as pipeline.py:196)."""
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": [x]}
+    n = ctx.axis_sizes[ax]
+    total = lax.psum(x, ax)
+    return {"Out": [total / n + lax.stop_gradient(total * (n - 1) / n)]}
+
+
 @register_op("c_broadcast", inputs=["X"], outputs=["Out"], differentiable=False)
 def _c_broadcast(ctx, op, ins):
     x = ins["X"][0]

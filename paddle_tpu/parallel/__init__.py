@@ -20,4 +20,9 @@ from .mesh import (  # noqa: F401
 from .spmd import device_put_sharded, shard_program, spec_for  # noqa: F401
 from .transpiler import GradAllReduce, LocalSGD  # noqa: F401
 from .pipeline import PipelineOptimizer  # noqa: F401  (registers pipeline_block)
+from .pipeline_uniform import (  # noqa: F401  (registers pipeline_uniform)
+    append_outside_grad_allreduce,
+    gate_loss,
+    uniform_pipeline,
+)
 from .sparse import shard_sparse_tables, sparse_table_names  # noqa: F401

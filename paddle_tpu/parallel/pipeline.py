@@ -336,14 +336,12 @@ class PipelineOptimizer:
                 loss, startup_program, parameter_list, no_grad_set
             )
             blk = program.global_block
-            # each grad is nonzero only on its stage's device: allreduce over
-            # the pp axis so every device applies identical updates
+            # each grad is nonzero only on its stage's device: allreduce
+            # over the pp axis so every device applies identical updates
+            # (inserted before AMP bookkeeping — see insert_grad_allreduce)
+            from .transpiler import insert_grad_allreduce
+
             for _, g in params_grads:
-                blk.append_op(
-                    "c_allreduce_sum",
-                    {"X": [g.name]},
-                    {"Out": [g.name]},
-                    {"axis_name": self._axis},
-                )
+                insert_grad_allreduce(blk, g, self._axis)
             ops = self._inner.apply_gradients(params_grads)
         return ops, params_grads

@@ -1,0 +1,362 @@
+"""Stage-uniform pipeline parallelism (GSPMD-pipelining style).
+
+The general `pipeline_block` (pipeline.py) dispatches per-device stages with
+lax.switch — faithful to the reference's heterogeneous SectionWorker
+sections (section_worker.cc:142), but SPMD-illegal to compose with
+gspmd-Auto tensor parallelism: the partitioner places mp collectives INSIDE
+the switch branches, devices take different branches by pp rank, and a
+subset of a global collective's participants deadlocks (reproduced on the
+8-device virtual mesh; the same program would hang a real pod).
+
+This module is the TPU-native composition answer, the design XLA's own
+pipelining work uses: make the pipeline body STAGE-UNIFORM so there is no
+branch at all.
+
+  * The user builds ONE stage's ops (a template sub-block). Its parameters
+    become [K, ...]-STACKED real parameters sharded over the pp axis —
+    under manual-pp shard_map each device's local shard IS its own stage's
+    weights. Weight selection is sharding, not control flow.
+  * Every device runs the identical stage computation per tick; mp
+    collectives (auto-axis, partitioner-inserted) therefore execute
+    uniformly on all devices — composition with tensor parallelism is
+    safe by construction.
+  * The GPipe schedule is the same lax.scan + lax.ppermute ring as
+    pipeline.py; stage inputs are injected at rank 0, final-stage outputs
+    accumulate into a [M, b, ...] buffer on rank K-1 and are replicated by
+    one psum so the (unpipelined) head runs on every device.
+  * Parameters AND optimizer state shard by stage: params/opt bytes per
+    device divide by K — the memory scaling the lax.switch design cannot
+    give (it replicates every stage's weights everywhere).
+  * Backward needs NO per-grad pp allreduce for stacked params: each
+    device's grad slice is exactly its stage's gradient. Only params
+    outside the pipeline (embeddings, head) need one — and `gate_loss`
+    arranges that every outside grad is a single-rank contribution, so a
+    plain psum is correct for all of them.
+
+Reference provenance: capability = PipelineOptimizer optimizer.py:3556 +
+SectionWorker section_worker.cc:142 (schedule), composed with
+RecomputeOptimizer optimizer.py:3858 (remat attr) and the AMP rewrite; the
+stacked-weight formulation itself is TPU-native (no reference analogue —
+NCCL pipelines never needed it because each rank ran a different program).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import unique_name
+from ..framework.registry import register_op, run_op
+
+
+def _uniform_infer(block, inputs, attrs):
+    x = block.var(inputs["X"][0])
+    return {"Out": [(tuple(x.shape), x.dtype)]}
+
+
+@register_op(
+    "pipeline_uniform",
+    inputs=["X", "MbExtern", "Stacked"],
+    outputs=["Out"],
+    infer_shape=_uniform_infer,
+)
+def _pipeline_uniform(ctx, op, ins):
+    prog = ctx.program
+    blk = prog.blocks[op.attr("stage_block")]
+    K = op.attr("num_stages")
+    M = op.attr("num_microbatches")
+    axis = op.attr("axis_name", "pp")
+    in_name = op.attr("in_name")
+    out_name = op.attr("out_name")
+    mb_names = op.attr("mb_extern_names")
+    tmpl_names = op.attr("template_names")
+    remat = op.attr("remat", False)
+    b_dtype = np.dtype(op.attr("boundary_dtype"))
+
+    x = ins["X"][0]
+    mb_extern = dict(zip(mb_names, ins.get("MbExtern", [])))
+    stacked = ins.get("Stacked", [])
+
+    if x.shape[0] % M:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by num_microbatches={M}"
+        )
+    bm = x.shape[0] // M
+    x_mb = x.reshape((M, bm) + x.shape[1:]).astype(b_dtype)
+    mb_views = {
+        nm: v.reshape((M, bm) + v.shape[1:]) for nm, v in mb_extern.items()
+    }
+    base_key = (
+        ctx.step_key if ctx.step_key is not None else jax.random.key(0)
+    )
+
+    def stage_fn(act_in, mb_idx, tick_key, params):
+        env = dict(zip(tmpl_names, params))
+        idx = jnp.clip(mb_idx, 0, M - 1)
+        for nm, v in mb_views.items():
+            env[nm] = lax.dynamic_index_in_dim(v, idx, 0, keepdims=False)
+        env[in_name] = act_in
+        sub_ctx = ctx.with_key(tick_key).with_batch_divisor(M)
+        for sub_op in blk.ops:
+            run_op(sub_ctx, sub_op, env)
+        return env[out_name].astype(b_dtype)
+
+    if remat:
+        # reference RecomputeOptimizer composition: the stage is one
+        # rematerialized segment — backward re-runs it from the boundary
+        stage_fn = jax.checkpoint(stage_fn)
+
+    if axis not in ctx.mesh_axes:
+        # single-device degrade: the K stages run sequentially per
+        # microbatch with the full [K, ...] stacks — identical numerics
+        # (same fold_in(base, m+k), k key schedule as tick t = m+k on
+        # stage k), no pipeline. Both loops are lax.scans so the stage
+        # traces ONCE, not M*K times (compile time flat in M and K).
+        def stage_step(carry, xs):
+            act, m = carry
+            k, params = xs
+            key = jax.random.fold_in(
+                jax.random.fold_in(base_key, m + k), k
+            )
+            return (stage_fn(act, m, key, list(params)), m), None
+
+        def mb_step(_, m):
+            act0 = lax.dynamic_index_in_dim(x_mb, m, 0, keepdims=False)
+            (act, _), _ = lax.scan(
+                stage_step, (act0, m),
+                (jnp.arange(K, dtype=jnp.int32), tuple(stacked)),
+            )
+            return None, act
+
+        _, outs = lax.scan(mb_step, None, jnp.arange(M, dtype=jnp.int32))
+        out = outs.reshape(x.shape).astype(b_dtype)
+        return {"Out": [out]}
+
+    K_mesh = ctx.axis_sizes[axis]
+    if K_mesh != K:
+        raise ValueError(
+            f"uniform pipeline has {K} stages but mesh axis {axis!r} has "
+            f"size {K_mesh}"
+        )
+    for s in stacked:
+        if s.shape[0] != 1:
+            raise ValueError(
+                "stacked param arrived unsharded inside the mesh body "
+                f"(leading dim {s.shape[0]}, expected 1): annotate it "
+                f"('{axis}', ...) and run in hybrid/shard_map mode"
+            )
+    local_params = [s[0] for s in stacked]  # this device's stage weights
+    stage_id = lax.axis_index(axis)
+    fwd_perm = [(i, (i + 1) % K) for i in range(K)]
+
+    def tick(carry, t):
+        send, outbuf = carry
+        recv = lax.ppermute(send, axis, fwd_perm)
+        mb_idx = t - stage_id
+        idx = jnp.clip(mb_idx, 0, M - 1)
+        first = lax.dynamic_index_in_dim(x_mb, idx, 0, keepdims=False)
+        act_in = jnp.where(stage_id == 0, first, recv)
+        # fold the stage id in too: uniform stages share op uids, so a
+        # tick-only key would draw the IDENTICAL dropout mask on every
+        # stage (the degrade path mirrors this as fold(base, m+k), k)
+        key = jax.random.fold_in(
+            jax.random.fold_in(base_key, t), stage_id
+        )
+        out = stage_fn(act_in, mb_idx, key, local_params)
+        valid = jnp.logical_and(mb_idx >= 0, mb_idx < M)
+        collect = jnp.logical_and(valid, stage_id == K - 1)
+        upd = lax.dynamic_update_index_in_dim(
+            outbuf, out.astype(outbuf.dtype), idx, 0
+        )
+        outbuf = jnp.where(collect, upd, outbuf)
+        return (out, outbuf), None
+
+    init = (
+        jnp.zeros((bm,) + x.shape[1:], b_dtype),
+        jnp.zeros((M, bm) + x.shape[1:], b_dtype),
+    )
+    (_, outbuf), _ = lax.scan(
+        tick, init, jnp.arange(M + K - 1, dtype=jnp.int32)
+    )
+    # outbuf is populated only on rank K-1; replicate it so the
+    # (unpipelined) head runs everywhere. Transpose of psum is psum under
+    # shard_map, but the incoming cotangent is nonzero on rank K-1 only
+    # (gate_loss), so the backward psum broadcasts — not scales — it.
+    out = lax.psum(outbuf, axis).reshape(x.shape).astype(b_dtype)
+    return {"Out": [out]}
+
+
+def _gate_infer(block, inputs, attrs):
+    v = block.var(inputs["X"][0])
+    return {"Out": [(tuple(v.shape), v.dtype)]}
+
+
+@register_op("pipeline_gate_loss", inputs=["X"], outputs=["Out"],
+             infer_shape=_gate_infer)
+def _pipeline_gate_loss(ctx, op, ins):
+    """Replicated loss whose COTANGENT originates on the last pipeline rank
+    only. Value: x (every rank computed the identical head loss from the
+    psum-replicated pipeline output). Backward: the where() kills every
+    rank's seed except rank K-1's, so all outside-the-pipeline gradients
+    (embeddings upstream, head downstream) become single-rank contributions
+    — one psum over pp per grad then yields the true gradient everywhere
+    (appended by the builder, see uniform_pipeline docstring)."""
+    x = ins["X"][0]
+    axis = op.attr("axis_name", "pp")
+    if axis not in ctx.mesh_axes:
+        return {"Out": [x]}
+    K = ctx.axis_sizes[axis]
+    r = lax.axis_index(axis)
+    gated = jnp.where(r == K - 1, x, jnp.zeros_like(x))
+    total = lax.psum(gated, axis)
+    # psum transposes to psum under shard_map: each rank's unit seed would
+    # arrive K-fold at the gate. Scale the COTANGENT by 1/K, not the value
+    # (same correction as pipeline.py:196).
+    return {"Out": [total / K + lax.stop_gradient(total * (K - 1) / K)]}
+
+
+def uniform_pipeline(x, stage_builder, num_stages, num_microbatches,
+                     mb_extern=(), axis_name="pp", remat=False,
+                     name="upipe"):
+    """Build a stage-uniform pipeline over `x` ([B, ...] activations).
+
+    stage_builder(x_var) -> out_var is called ONCE inside a fresh
+    sub-block; every parameter it creates becomes a TEMPLATE whose real,
+    trained parameter is a [num_stages, ...] stack sharded over
+    `axis_name`. out_var must have x's shape/dtype (uniformity).
+
+    mb_extern: batch-leading Variables every stage reads (e.g. the
+    attention mask) — sliced per microbatch like x.
+
+    Returns the [B, ...] final-stage output (replicated). The builder also
+    records the stack (and its Adam-moment) shardings on the program.
+
+    After `optimizer.minimize`, call `append_outside_grad_allreduce` so
+    non-stacked parameter grads are psum'd over pp — and wrap the loss in
+    `gate_loss` FIRST so those grads are single-rank contributions.
+    """
+    from ..framework.program import default_main_program, default_startup_program
+
+    main = default_main_program()
+    startup = default_startup_program()
+    gb = main.global_block
+
+    before = {p.name for p in gb.all_parameters()}
+    sub = main.create_block()
+    try:
+        x_in = sub.create_var(
+            name=unique_name.generate(f"{name}_in"), shape=x.shape,
+            dtype=x.dtype,
+        )
+        out_var = stage_builder(x_in)
+    finally:
+        main.rollback()
+    if tuple(out_var.shape) != tuple(x.shape):
+        raise ValueError(
+            f"uniform pipeline stage must preserve shape: in {x.shape}, "
+            f"out {out_var.shape}"
+        )
+    tmpl = [p for p in gb.all_parameters() if p.name not in before]
+
+    # real trained params: [K, ...] stacks; startup init is the template's
+    # init op re-shaped (independent init per stage slice)
+    K = int(num_stages)
+    stacked_names = []
+    sb = startup.global_block
+    for p in tmpl:
+        sname = f"{p.name}@STACK"
+        stacked_names.append(sname)
+        gb.create_parameter(
+            sname, (K,) + tuple(p.shape), p.dtype, trainable=True
+        )
+        init_ops = [o for o in sb.ops if p.name in o.output_names()]
+        if len(init_ops) != 1:
+            raise ValueError(
+                f"template param {p.name!r} has {len(init_ops)} startup "
+                "init ops; expected exactly 1"
+            )
+        io = init_ops[0]
+        attrs = dict(io.attrs)
+        if "shape" in attrs:
+            attrs["shape"] = [K] + list(attrs["shape"])
+        sb.create_parameter(sname, (K,) + tuple(p.shape), p.dtype)
+        sb.append_op(io.type, {k: list(v) for k, v in io.inputs.items()},
+                     {k: [sname] for k in io.outputs}, attrs)
+        # the template itself is never trained or materialized: drop its
+        # startup init and demote it to a plain shape/dtype declaration
+        sb.ops.remove(io)
+        sb.vars.pop(p.name, None)
+        p.trainable = False
+        p.persistable = False
+        # stacks shard over the pp axis — each device holds exactly its
+        # stage's slice (optimizer accumulators inherit this spec via
+        # spec_for's _accum_of fallback, whatever unique suffix they get)
+        main._sharding[sname] = (axis_name,)
+
+    out = gb.create_var(
+        name=unique_name.generate(f"{name}_out"), shape=x.shape,
+        dtype=x.dtype,
+    )
+    gb.append_op(
+        "pipeline_uniform",
+        {
+            "X": [x.name],
+            "MbExtern": [v.name for v in mb_extern],
+            "Stacked": list(stacked_names),
+        },
+        {"Out": [out.name]},
+        {
+            "stage_block": sub.idx,
+            "num_stages": K,
+            "num_microbatches": int(num_microbatches),
+            "axis_name": axis_name,
+            "in_name": x_in.name,
+            "out_name": out_var.name,
+            "mb_extern_names": [v.name for v in mb_extern],
+            "template_names": [p.name for p in tmpl],
+            "remat": bool(remat),
+            "boundary_dtype": str(x.dtype),
+        },
+    )
+    return out
+
+
+def gate_loss(loss, axis_name="pp"):
+    """Wrap the scalar loss so its cotangent originates on the last pp rank
+    only (see pipeline_gate_loss). Call before optimizer.minimize."""
+    blk = loss.block
+    out = blk.create_var(
+        name=unique_name.generate(f"{loss.name}@GATED"),
+        shape=tuple(loss.shape or (1,)), dtype=loss.dtype,
+    )
+    blk.append_op(
+        "pipeline_gate_loss", {"X": [loss.name]}, {"Out": [out.name]},
+        {"axis_name": axis_name},
+    )
+    return out
+
+
+def append_outside_grad_allreduce(program, params_grads, axis_name="pp"):
+    """psum non-stacked param grads over pp: with gate_loss in place each is
+    a single-rank contribution (embeddings live on rank 0's cotangent path,
+    head grads on rank K-1's), so a plain sum is the true gradient. Stacked
+    params need nothing — each device's slice IS its stage's grad. Inserted
+    before AMP bookkeeping ops (same rule as parallel/transpiler.py)."""
+    from .transpiler import insert_grad_allreduce
+
+    block = program.global_block
+    stacked = {
+        n
+        for op in block.ops
+        if op.type == "pipeline_uniform"
+        for n in op.inputs.get("Stacked", [])
+    }
+    for p, g in params_grads:
+        pname = p.name if hasattr(p, "name") else str(p)
+        if pname in stacked:
+            continue
+        insert_grad_allreduce(block, g, axis_name)
+    return program

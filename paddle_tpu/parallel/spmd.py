@@ -23,6 +23,22 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 def spec_for(program, name) -> P:
     s = program._sharding.get(name)
     if not s:
+        # same-shaped optimizer accumulators INHERIT their parameter's
+        # spec (optimizer.py tags them with _accum_of). A TP/stage-sharded
+        # weight must not drag a spec-less moment through its elementwise
+        # update: inside a manual shard_map body the param arrives sliced
+        # while the moment arrives full, and the update silently
+        # broadcasts. Accumulator names carry a unique_name suffix, so
+        # spec-by-name from the user cannot be relied on.
+        v = program.global_block._find_var_recursive(name)
+        parent = getattr(v, "_accum_of", None)
+        if parent is not None and parent != name:
+            pv = program.global_block._find_var_recursive(parent)
+            if (
+                pv is not None
+                and tuple(v.shape or ()) == tuple(pv.shape or ())
+            ):
+                return spec_for(program, parent)
         return P()
     return P(*s)
 
@@ -64,32 +80,70 @@ def stage_global(x, mesh, pspec, multiproc=None, local_is_full=False):
     return jax.device_put(x, sharding)
 
 
+def _project_spec(spec, manual):
+    """Drop non-manual axis names from a PartitionSpec (hybrid mode: the
+    shard_map body is manual over `manual` only; other mesh axes are Auto —
+    their sharding rides on the arrays' NamedShardings and XLA propagation,
+    exactly gspmd, while manual axes keep explicit collectives)."""
+    out = []
+    for e in spec:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            kept = tuple(a for a in e if a in manual)
+            out.append(kept if kept else None)
+        else:
+            out.append(e if e in manual else None)
+    return P(*out)
+
+
 def wrap_shard_map(
-    traced, program, mesh, state_ro, state_mut, write_back, fetch_names
+    traced, program, mesh, state_ro, state_mut, write_back, fetch_names,
+    manual_axes=None,
 ):
     """Wrap the executor's traced block for SPMD execution.
 
     traced(feeds, smut, sro, step_key) -> (tuple_of_fetches, new_state_dict)
     with static structure: new_state keys == write_back exactly.
+
+    manual_axes: None = fully manual (classic shard_map). A subset of mesh
+    axis names = HYBRID mode: the body is manual over those axes (explicit
+    collective ops, lax.axis_index — what the pipeline scheduler needs)
+    while the remaining axes are Auto — arrays stay global over them and
+    the XLA SPMD partitioner shards per annotation, which is how Megatron
+    tensor parallelism composes with the pipeline in ONE program. The
+    reference could not express this mix (every strategy was a separate
+    NCCL transpile); on TPU it is one jit.
     """
+    manual = (
+        frozenset(manual_axes) if manual_axes is not None
+        else frozenset(mesh.axis_names)
+    )
+    partial_manual = manual != frozenset(mesh.axis_names)
+
+    def body_spec(name):
+        s = spec_for(program, name)
+        return _project_spec(s, manual) if partial_manual else s
 
     def run(feeds, smut, sro, step_key):
         in_specs = (
-            {k: spec_for(program, k) for k in feeds},
-            {k: spec_for(program, k) for k in smut},
-            {k: spec_for(program, k) for k in sro},
+            {k: body_spec(k) for k in feeds},
+            {k: body_spec(k) for k in smut},
+            {k: body_spec(k) for k in sro},
             P(),
         )
         out_specs = (
-            tuple(spec_for(program, n) for n in fetch_names),
-            {n: spec_for(program, n) for n in write_back},
+            tuple(body_spec(n) for n in fetch_names),
+            {n: body_spec(n) for n in write_back},
         )
+        kw = {"axis_names": manual} if partial_manual else {}
         sm = jax.shard_map(
             traced,
             mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs,
             check_vma=False,
+            **kw,
         )
         return sm(feeds, smut, sro, step_key)
 
@@ -101,19 +155,25 @@ def wrap_shard_map(
             k: stage_global(v, mesh, spec_for(program, k), multiproc)
             for k, v in feeds.items()
         }
-        if multiproc:
-            # state must be global arrays too; each process's scope holds
-            # the FULL value (startup ran locally), so local_is_full slices
-            # out this process's part for cross-process-sharded state
+        if multiproc or partial_manual:
+            # multi-process: state must be global arrays; each process's
+            # scope holds the FULL value (startup ran locally), so
+            # local_is_full slices out this process's part.
+            # hybrid: the Auto axes' sharding lives ONLY on the arrays'
+            # committed NamedShardings (the body specs project them away),
+            # so state must be staged with its full spec or mp-annotated
+            # params silently stay replicated on every device
             smut = {
                 k: stage_global(
-                    v, mesh, spec_for(program, k), True, local_is_full=True
+                    v, mesh, spec_for(program, k), multiproc,
+                    local_is_full=True,
                 )
                 for k, v in smut.items()
             }
             sro = {
                 k: stage_global(
-                    v, mesh, spec_for(program, k), True, local_is_full=True
+                    v, mesh, spec_for(program, k), multiproc,
+                    local_is_full=True,
                 )
                 for k, v in sro.items()
             }
@@ -123,7 +183,8 @@ def wrap_shard_map(
 
 
 def wrap_gspmd(
-    traced, program, mesh, state_ro, state_mut, write_back, fetch_names
+    traced, program, mesh, state_ro, state_mut, write_back, fetch_names,
+    manual_axes=None,
 ):
     """GSPMD mode: no explicit collectives, no shard_map. Inputs are committed
     to the mesh per their annotations; jax.jit + the XLA SPMD partitioner
@@ -159,18 +220,32 @@ def device_put_sharded(x, mesh, pspec):
     return jax.device_put(x, NamedSharding(mesh, pspec))
 
 
-def shard_program(program, mesh, shardings=None, mode="shard_map"):
+def shard_program(program, mesh, shardings=None, mode="shard_map",
+                  manual_axes=None):
     """Attach a mesh + sharding annotations to a Program (SPMD mode switch).
 
     shardings: {var_name: tuple_of_axis_names_per_dim}. E.g. a data-parallel
     feed image of rank 4 -> {"image": ("dp", None, None, None)} (in practice
     only leading axes need naming: ("dp",) suffices as a prefix spec).
 
-    mode: "shard_map" (explicit collective ops, fleet/transpiled programs) or
-    "gspmd" (annotation-only, XLA-propagated — use for tensor parallelism).
+    mode: "shard_map" (explicit collective ops, fleet/transpiled programs),
+    "gspmd" (annotation-only, XLA-propagated — use for tensor parallelism),
+    or "hybrid" (manual_axes are shard_map-manual with explicit collectives,
+    every other mesh axis is gspmd-Auto — composes pipeline/dp collectives
+    with tensor-parallel annotation propagation in one program).
     """
     program._mesh = mesh
     program._spmd_mode = mode
+    if mode == "hybrid":
+        if not manual_axes:
+            raise ValueError("hybrid mode requires manual_axes")
+        unknown = set(manual_axes) - set(mesh.axis_names)
+        if unknown:
+            raise ValueError(
+                f"manual_axes {sorted(unknown)} not in mesh axes "
+                f"{mesh.axis_names}"
+            )
+        program._manual_axes = tuple(manual_axes)
     if shardings:
         program._sharding.update(
             {k: tuple(v) for k, v in shardings.items()}

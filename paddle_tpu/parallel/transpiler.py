@@ -37,6 +37,32 @@ def _insert_pos_after(block, names):
     return pos
 
 
+def insert_grad_allreduce(block, grad, axis_name, scale=None):
+    """Insert (optional scale +) c_allreduce_sum on a gradient, right after
+    its producer and BEFORE any AMP bookkeeping ops (_insert_pos_after):
+    FoundInfinite / loss-scale state must be computed from the globally
+    reduced gradients or they diverge across ranks. One definition shared
+    by the dp transpile, the pipeline optimizers, and leg builders."""
+    gname = grad.name if hasattr(grad, "name") else str(grad)
+    pos = _insert_pos_after(block, [gname])
+    if scale is not None:
+        block.append_op(
+            "scale",
+            inputs={"X": [gname]},
+            outputs={"Out": [gname]},
+            attrs={"scale": scale, "bias": 0.0},
+            index=pos,
+        )
+        pos += 1
+    block.append_op(
+        "c_allreduce_sum",
+        inputs={"X": [gname]},
+        outputs={"Out": [gname]},
+        attrs={"axis_name": axis_name},
+        index=pos,
+    )
+
+
 class GradAllReduce:
     """Insert per-gradient allreduce into a trained program (DP mode)."""
 
@@ -47,23 +73,10 @@ class GradAllReduce:
     def transpile(self, program, params_grads):
         block = program.global_block
         for _, g in params_grads:
-            gname = g.name if hasattr(g, "name") else str(g)
-            pos = _insert_pos_after(block, [gname])
-            # mean-reduce: scale by 1/nranks then psum — identical math to the
-            # reference's loss-grad scaling (transpiler/collective.py:190)
-            block.append_op(
-                "scale",
-                inputs={"X": [gname]},
-                outputs={"Out": [gname]},
-                attrs={"scale": 1.0 / self.nranks, "bias": 0.0},
-                index=pos,
-            )
-            block.append_op(
-                "c_allreduce_sum",
-                inputs={"X": [gname]},
-                outputs={"Out": [gname]},
-                attrs={"axis_name": self.axis_name},
-                index=pos + 1,
+            # mean-reduce: scale by 1/nranks then psum — identical math to
+            # the reference's loss-grad scaling (transpiler/collective.py:190)
+            insert_grad_allreduce(
+                block, g, self.axis_name, scale=1.0 / self.nranks
             )
         return program
 

@@ -1,0 +1,105 @@
+"""Measure per-device parameter/optimizer-state bytes across the composed
+parallelism stack (the BASELINE.md bytes/device table).
+
+Builds the SAME BERT pretrain step under each strategy stack on an
+8-device virtual CPU mesh and sums the actual per-device shard bytes of
+every persistable after one training step — measured, not estimated.
+Usage: JAX_PLATFORMS=cpu python tools/bytes_per_device_3d.py
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.framework.scope import Scope  # noqa: E402
+from paddle_tpu.models import BertConfig  # noqa: E402
+from paddle_tpu.models.bert_3d import (bert_3d_shardings, build_bert_3d,  # noqa: E402
+                                       example_feed_3d)
+from paddle_tpu.parallel import make_mesh, shard_program  # noqa: E402
+
+
+def bytes_per_device(scope):
+    per = {}
+    for name in scope.local_var_names():
+        v = scope.find_var(name)
+        if not hasattr(v, "addressable_shards"):
+            continue
+        for sh in v.addressable_shards:
+            per[sh.device] = per.get(sh.device, 0) + sh.data.nbytes
+    return per
+
+
+def run(cfg, b, s, dp, mp, pp, label):
+    main, startup, loss = build_bert_3d(
+        cfg, b // dp, s, num_stages=pp, microbatches=2, dp=dp,
+    )
+    axes = {}
+    if dp > 1:
+        axes["dp"] = dp
+    if mp > 1:
+        axes["mp"] = mp
+    if pp > 1:
+        axes["pp"] = pp
+    if not axes:
+        axes = {"dp": 1}
+    n = 1
+    for v in axes.values():
+        n *= v
+    mesh = make_mesh(axes, jax.devices()[:n])
+    sh = bert_3d_shardings(cfg, num_stages=pp if pp > 1 else None)
+    sh = {
+        k: tuple(a if (a is None or a in axes) else None for a in v)
+        for k, v in sh.items()
+    }
+    shard_program(main, mesh, sh, mode="hybrid",
+                  manual_axes=tuple(a for a in ("dp", "pp") if a in axes))
+    exe = fluid.Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    feed = example_feed_3d(cfg, b, s)
+    (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    assert np.isfinite(float(np.asarray(lv).reshape(-1)[0]))
+    per = bytes_per_device(scope)
+    mx = max(per.values())
+    print(f"| {label} | {n} | {mx / 1e6:.1f} MB |")
+    return mx
+
+
+def main():
+    cfg = BertConfig(
+        vocab_size=8192, hidden_size=512, num_layers=8, num_heads=8,
+        intermediate_size=2048, max_position=512,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    b, s = 16, 128
+    n_params = (
+        cfg.vocab_size * cfg.hidden_size * 2  # word emb + mlm head
+        + cfg.max_position * cfg.hidden_size
+        + cfg.num_layers * (
+            4 * cfg.hidden_size * cfg.hidden_size
+            + 2 * cfg.hidden_size * cfg.intermediate_size
+        )
+    )
+    print(f"model ~{n_params / 1e6:.1f}M params; fp32 param+2×Adam moments "
+          f"= {n_params * 12 / 1e6:.0f} MB unsharded")
+    print("| strategy | devices | max persistable bytes/device |")
+    print("|---|---|---|")
+    base = run(cfg, b, s, dp=8, mp=1, pp=1, label="dp8 (replicated params)")
+    m1 = run(cfg, b, s, dp=2, mp=4, pp=1, label="dp2 × mp4 (Megatron TP)")
+    m2 = run(cfg, b, s, dp=2, mp=2, pp=2,
+             label="dp2 × mp2 × pp2 (uniform pipeline, stacked stages)")
+    m3 = run(cfg, b, s, dp=1, mp=4, pp=2, label="mp4 × pp2")
+    print(f"shrink vs replicated: mp4 {base / m1:.2f}x, "
+          f"2x2x2 {base / m2:.2f}x, mp4xpp2 {base / m3:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
