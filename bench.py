@@ -541,6 +541,33 @@ def main():
             extras[name] = {"error": f"{type(e).__name__}: {e}"}
     primary["extra_metrics"] = extras
     print(json.dumps(primary))
+    # LAST line: compact all-legs summary. The driver records the TAIL of
+    # stdout; r4's full JSON was truncated mid-line and lost the headline
+    # legs entirely (VERDICT r4 weak #7). This line is small enough to
+    # always survive whole and parses to every leg.
+    def _leg_brief(m):
+        if "error" in m:
+            return {"error": m["error"][:120]}
+        out = {"value": m.get("value"), "unit": m.get("unit")}
+        mfu = m.get("mfu_vs_v5e_bf16_peak")
+        if mfu is not None:
+            out["mfu"] = mfu
+        if m.get("samples"):
+            out["samples"] = m["samples"]
+        return out
+
+    compact = {
+        "metric": primary["metric"],
+        "value": primary["value"],
+        "unit": primary["unit"],
+        "vs_baseline": primary.get("vs_baseline"),
+        "mfu": primary.get("mfu_vs_v5e_bf16_peak"),
+        "legs": {
+            "bert": _leg_brief(primary),
+            **{k: _leg_brief(v) for k, v in extras.items()},
+        },
+    }
+    print(json.dumps(compact))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,665 @@
+"""AST conversion of plain-Python control flow for @declarative.
+
+Reference: dygraph/dygraph_to_static/ — 19 transformer files
+(program_translator.py:252 ProgramTranslator, ifelse_transformer.py,
+loop_transformer.py, break_continue_transformer.py,
+logical_transformer.py) rewrite a dygraph function's source so
+`if tensor:` / `while tensor:` / `for`+`break` become cond/while ops.
+
+TPU-native version — ONE transformer pass + runtime dispatch helpers:
+
+  * `if`/`elif`/`else` -> branch closures + `_dy2st_if(cond, t, f)`;
+  * `while` -> cond/body closures over explicit loop vars +
+    `_dy2st_while`;
+  * `for x in range(...)` -> the equivalent while (increment hoisted
+    before the body so `continue` stays safe);
+  * `break`/`continue` -> boolean flags + guarded tails (the reference's
+    break_continue_transformer scheme), folded into the loop condition;
+  * `and`/`or`/`not` inside converted conditions -> `_dy2st_and/or/not`
+    (logical_transformer parity; evaluation is non-short-circuit on
+    tensors, like the reference's logical_and lowering).
+
+The helpers dispatch at RUNTIME on the condition's type, so one
+converted body serves every mode:
+  * python value     -> ordinary python control flow (closures called
+    directly; semantics unchanged);
+  * eager VarBase with a CONCRETE value (plain dygraph) -> python
+    control flow on bool(value);
+  * eager VarBase holding a TRACER (inside @declarative's jit) ->
+    lax.cond / lax.while_loop;
+  * static-graph Variable -> layers.cond / layers.While ops.
+
+Known limits (documented, reference shares most): a converted `if` must
+not contain `return`/`yield` (left as plain python — fine for python
+conds); loop-carried variables must be bound before the loop and keep
+one shape/dtype; tensor `while` under jit is forward-only
+(lax.while_loop has no transpose — use layers.While(max_iters=...) /
+StaticRNN for trainable loops, as the While docstring prescribes).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+__all__ = ["convert_function", "HELPERS"]
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers (injected into the transformed function's globals)
+# ---------------------------------------------------------------------------
+
+
+def _kind(x):
+    from ..framework.program import Variable
+    from .varbase import VarBase
+
+    if isinstance(x, Variable):
+        return "static"
+    v = x.value if isinstance(x, VarBase) else x
+    import jax
+
+    if isinstance(v, jax.core.Tracer):
+        return "tracer"
+    if isinstance(v, jax.Array):
+        return "eager"
+    return "py"
+
+
+def _as_scalar_bool(x):
+    import jax.numpy as jnp
+
+    return jnp.reshape(jnp.asarray(x), ()).astype(bool)
+
+
+def _unwrap(v):
+    from .varbase import VarBase
+
+    return v.value if isinstance(v, VarBase) else v
+
+
+def _wrap_like(val, proto):
+    from .varbase import VarBase
+
+    if isinstance(proto, VarBase):
+        return VarBase(val, stop_gradient=proto.stop_gradient)
+    return val
+
+
+def _dy2st_if(cond, true_fn, false_fn):
+    """Dispatch a converted `if`. true_fn/false_fn are closures returning
+    the tuple of names assigned in either branch."""
+    k = _kind(cond)
+    if k == "py":
+        return true_fn() if cond else false_fn()
+    if k == "eager":
+        return true_fn() if bool(_unwrap(cond)) else false_fn()
+    if k == "static":
+        from .. import layers
+
+        outs = layers.cond(cond, lambda: list(true_fn()),
+                           lambda: list(false_fn()))
+        return tuple(outs) if isinstance(outs, (list, tuple)) else (outs,)
+
+    # tracer: lax.cond with both branches traced functionally
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .varbase import VarBase
+
+    def run(fn):
+        def g(_):
+            return tuple(jnp.asarray(_unwrap(o)) for o in fn())
+
+        return g
+
+    try:
+        vals = lax.cond(_as_scalar_bool(_unwrap(cond)), run(true_fn),
+                        run(false_fn), None)
+    except TypeError as e:
+        raise TypeError(
+            "declarative if-conversion: the two branches must assign "
+            "matching shapes/dtypes to every variable written in either "
+            f"branch ({e})"
+        ) from e
+    return tuple(VarBase(v) for v in vals)
+
+
+def _dy2st_while(cond_fn, body_fn, init):
+    """Dispatch a converted `while`. cond_fn/body_fn take the loop vars as
+    parameters; body_fn returns their new values."""
+    probe = cond_fn(*init)
+    k = _kind(probe)
+    if k in ("py", "eager"):
+
+        def as_bool(c):
+            return bool(_unwrap(c))
+
+        vals = tuple(init)
+        c = probe
+        while as_bool(c):
+            vals = tuple(body_fn(*vals))
+            c = cond_fn(*vals)
+        return vals
+    if k == "static":
+        from .. import layers
+        from ..framework.program import Variable
+
+        def lift(v, i):
+            if isinstance(v, Variable):
+                return v
+            if isinstance(v, bool):
+                return layers.fill_constant([1], "bool", v)
+            if isinstance(v, int):
+                return layers.fill_constant([1], "int64", v)
+            if isinstance(v, float):
+                return layers.fill_constant([1], "float32", v)
+            raise TypeError(
+                "declarative while-conversion (static mode): loop "
+                f"variable #{i} is {type(v).__name__}; initialize loop "
+                "carries as tensors or python numbers"
+            )
+
+        init = tuple(lift(v, i) for i, v in enumerate(init))
+        probe = cond_fn(*init)
+        cond_var = layers.reshape(layers.cast(probe, "bool"), [1])
+        w = layers.While(cond_var)
+        with w.block():
+            outs = body_fn(*init)
+            for old, new in zip(init, outs):
+                layers.assign(new, old)
+            layers.assign(
+                layers.reshape(layers.cast(cond_fn(*init), "bool"), [1]),
+                cond_var,
+            )
+        return tuple(init)
+
+    # tracer: lax.while_loop over unwrapped values (forward-only)
+    import jax.numpy as jnp
+    from jax import lax
+
+    protos = tuple(init)
+    init_vals = tuple(jnp.asarray(_unwrap(v)) for v in init)
+
+    def cond_w(vals):
+        wrapped = tuple(_wrap_like(v, p) for v, p in zip(vals, protos))
+        return _as_scalar_bool(_unwrap(cond_fn(*wrapped)))
+
+    def body_w(vals):
+        wrapped = tuple(_wrap_like(v, p) for v, p in zip(vals, protos))
+        outs = body_fn(*wrapped)
+        return tuple(
+            jnp.asarray(_unwrap(o)).astype(iv.dtype).reshape(iv.shape)
+            for o, iv in zip(outs, init_vals)
+        )
+
+    vals = lax.while_loop(cond_w, body_w, init_vals)
+    return tuple(_wrap_like(v, p) for v, p in zip(vals, protos))
+
+
+def _logical(op, a, b=None):
+    ka = _kind(a)
+    kb = _kind(b) if b is not None else "py"
+    if ka == "py" and kb == "py":
+        if op == "and":
+            return a and b
+        if op == "or":
+            return a or b
+        return not a
+    if ka == "static" or kb == "static":
+        from .. import layers
+        from ..framework.program import Variable
+
+        def as_bool_var(x):
+            if not isinstance(x, Variable):
+                return layers.fill_constant([1], "bool", bool(x))
+            return layers.cast(x, "bool")
+
+        if op == "and":
+            return layers.logical_and(as_bool_var(a), as_bool_var(b))
+        if op == "or":
+            return layers.logical_or(as_bool_var(a), as_bool_var(b))
+        return layers.logical_not(as_bool_var(a))
+    # eager / tracer VarBase (or mixed with python bools)
+    import jax.numpy as jnp
+
+    from .varbase import VarBase
+
+    av = _as_scalar_bool(_unwrap(a))
+    if op == "not":
+        return VarBase(jnp.logical_not(av))
+    bv = _as_scalar_bool(_unwrap(b))
+    out = jnp.logical_and(av, bv) if op == "and" else jnp.logical_or(av, bv)
+    return VarBase(out)
+
+
+def _dy2st_and(a, b):
+    return _logical("and", a, b)
+
+
+def _dy2st_or(a, b):
+    return _logical("or", a, b)
+
+
+def _dy2st_not(a):
+    return _logical("not", a)
+
+
+HELPERS = {
+    "_dy2st_if": _dy2st_if,
+    "_dy2st_while": _dy2st_while,
+    "_dy2st_and": _dy2st_and,
+    "_dy2st_or": _dy2st_or,
+    "_dy2st_not": _dy2st_not,
+}
+
+
+# ---------------------------------------------------------------------------
+# AST analysis utilities
+# ---------------------------------------------------------------------------
+
+
+def _assigned_names(stmts):
+    """Names bound by a statement list (incl. nested, excl. inner defs)."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store,)):
+                if node.id not in names:
+                    names.append(node.id)
+
+        def visit_FunctionDef(self, node):
+            # a (generated or user) inner def is a local helper, not data
+            # flowing through cond/while; don't descend either
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+def _contains(stmts, types):
+    """Like ast.walk-search, but does NOT descend into nested function
+    definitions/lambdas: a Return inside a generated branch closure (or a
+    user inner def) must not block converting the enclosing construct."""
+    _SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+    def walk(node):
+        if isinstance(node, types):
+            return True
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPES):
+                continue
+            if walk(child):
+                return True
+        return False
+
+    return any(walk(s) for s in stmts)
+
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _tuple_load(names):
+    return ast.Tuple(elts=[_load(n) for n in names], ctx=ast.Load())
+
+
+def _call(fn_name, args):
+    return ast.Call(func=_load(fn_name), args=args, keywords=[])
+
+
+def _make_fn(name, argnames, body, defaults=None):
+    fd = ast.FunctionDef(
+        name=name,
+        args=ast.arguments(posonlyargs=[],
+                           args=[ast.arg(arg=a) for a in argnames],
+                           kwonlyargs=[], kw_defaults=[],
+                           defaults=list(defaults or [])),
+        body=body, decorator_list=[],
+    )
+    if hasattr(fd, "type_params"):  # py3.12+ field must exist for compile
+        fd.type_params = []
+    return fd
+
+
+def _read_before_write(stmts):
+    """Names read before being bound in a straight-line statement list —
+    exactly the set whose OUTER value a branch closure needs pre-bound.
+    Generated inner defs contribute their default-argument expressions
+    (evaluated at def site) but not their bodies."""
+    _SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    bound, rbw = set(), set()
+
+    def reads_of(node):
+        out = set()
+
+        def walk(n):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                out.add(n.id)
+            for c in ast.iter_child_nodes(n):
+                if isinstance(c, _SCOPES):
+                    if isinstance(c, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        for d in c.args.defaults:
+                            walk(d)
+                    continue
+                walk(c)
+
+        walk(node)
+        return out
+
+    def note_reads(names):
+        rbw.update(n for n in names if n not in bound)
+
+    for s in stmts:
+        if isinstance(s, ast.Assign):
+            note_reads(reads_of(s.value))
+            bound.update(_assigned_names([s]))
+        elif isinstance(s, ast.AugAssign):
+            note_reads(reads_of(s))
+            bound.update(_assigned_names([s]))
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in s.args.defaults:
+                note_reads(reads_of(d))
+            bound.add(s.name)
+        else:
+            # compound / other statements: conservative — everything they
+            # read counts, everything they bind becomes bound after
+            note_reads(reads_of(s))
+            bound.update(_assigned_names([s]))
+    return rbw
+
+
+def _assign_tuple(names, value):
+    if not names:
+        # still evaluate for side effects
+        return ast.Expr(value=value)
+    # always a tuple target — the helpers return a tuple even for one name
+    target = ast.Tuple(elts=[_store(n) for n in names], ctx=ast.Store())
+    return ast.Assign(targets=[target], value=value)
+
+
+class _CondLogic(ast.NodeTransformer):
+    """and/or/not inside a converted condition -> helper calls
+    (logical_transformer parity; non-short-circuit on tensors)."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        name = "_dy2st_and" if isinstance(node.op, ast.And) else "_dy2st_or"
+        out = node.values[0]
+        for v in node.values[1:]:
+            out = _call(name, [out, v])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _call("_dy2st_not", [node.operand])
+        return node
+
+
+def _convert_cond_expr(expr):
+    return ast.fix_missing_locations(_CondLogic().visit(expr))
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+_LOOP_BLOCKERS = (ast.Return, ast.Yield, ast.YieldFrom, ast.Global,
+                  ast.Nonlocal, ast.Try, ast.With)
+_IF_BLOCKERS = (ast.Return, ast.Yield, ast.YieldFrom, ast.Global,
+                ast.Nonlocal, ast.Break, ast.Continue)
+
+
+class _BreakContinueElim(ast.NodeTransformer):
+    """Replace break/continue with flag assignments and guard the
+    remaining statements of each block (break_continue_transformer.py
+    scheme). Does not descend into nested loops or function defs."""
+
+    def __init__(self, brk, cont):
+        self.brk = brk
+        self.cont = cont
+        self.used_brk = False
+        self.used_cont = False
+
+    def _process_block(self, stmts):
+        out = []
+        for i, s in enumerate(stmts):
+            # containment must be checked BEFORE visit: the transform is
+            # in-place and replaces Break/Continue with flag assignments
+            had = _contains([s], (ast.Break, ast.Continue))
+            out.append(self.visit(s))
+            rest = stmts[i + 1:]
+            if rest and had:
+                guard = _call("_dy2st_not",
+                              [_call("_dy2st_or",
+                                     [_load(self.brk), _load(self.cont)])])
+                out.append(ast.If(test=guard,
+                                  body=self._process_block(rest), orelse=[]))
+                break
+        return out
+
+    def visit_Break(self, node):
+        self.used_brk = True
+        return ast.Assign(targets=[_store(self.brk)],
+                          value=ast.Constant(value=True))
+
+    def visit_Continue(self, node):
+        self.used_cont = True
+        return ast.Assign(targets=[_store(self.cont)],
+                          value=ast.Constant(value=True))
+
+    def visit_While(self, node):  # nested loop owns its own break/continue
+        return node
+
+    def visit_For(self, node):
+        return node
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_If(self, node):
+        node.body = self._process_block(node.body)
+        node.orelse = self._process_block(node.orelse)
+        return node
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+
+    def _uid(self):
+        self.n += 1
+        return self.n
+
+    # -- if ---------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains([node], _IF_BLOCKERS):
+            return node
+        uid = self._uid()
+        outs = sorted(
+            set(_assigned_names(node.body)) | set(_assigned_names(node.orelse))
+        )
+        t_name, f_name = f"_dy2st_true_{uid}", f"_dy2st_false_{uid}"
+
+        def mk(fname, body):
+            body = list(body) or [ast.Pass()]
+            # a name both READ and ASSIGNED in the branch would shadow
+            # itself as an unbound local; pre-bind the current value as a
+            # default argument (ifelse_transformer passes them as inputs)
+            pre = sorted(set(_assigned_names(body)) & _read_before_write(body))
+            body.append(ast.Return(value=_tuple_load(outs)))
+            return _make_fn(fname, pre, body,
+                            defaults=[_load(n) for n in pre])
+
+        call = _call("_dy2st_if",
+                     [_convert_cond_expr(node.test), _load(t_name),
+                      _load(f_name)])
+        return [mk(t_name, node.body), mk(f_name, node.orelse),
+                _assign_tuple(outs, call)]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _contains([node], _LOOP_BLOCKERS):
+            return node
+        uid = self._uid()
+        pre = []
+        test = node.test
+        body = list(node.body)
+        if _contains(body, (ast.Break, ast.Continue)):
+            brk, cont = f"_dy2st_brk_{uid}", f"_dy2st_cont_{uid}"
+            elim = _BreakContinueElim(brk, cont)
+            body = elim._process_block(body)
+            # both flags are initialized whenever either appears: the
+            # guards reference them jointly (not (_brk or _cont))
+            pre.append(ast.Assign(targets=[_store(brk)],
+                                  value=ast.Constant(value=False)))
+            pre.append(ast.Assign(targets=[_store(cont)],
+                                  value=ast.Constant(value=False)))
+            if elim.used_brk:
+                test = _call("_dy2st_and",
+                             [test, _call("_dy2st_not", [_load(brk)])])
+            if elim.used_cont:
+                # reset each iteration
+                body.insert(0, ast.Assign(targets=[_store(cont)],
+                                          value=ast.Constant(value=False)))
+            # guards introduced nested Ifs: convert them too
+            body = [self.visit(s) for s in body]
+            body = [s for grp in body
+                    for s in (grp if isinstance(grp, list) else [grp])]
+        loop_vars = sorted(set(_assigned_names(body)))
+        c_name, b_name = f"_dy2st_cond_{uid}", f"_dy2st_body_{uid}"
+        cond_fn = _make_fn(
+            c_name, loop_vars,
+            [ast.Return(value=_convert_cond_expr(test))],
+        )
+        body_fn = _make_fn(
+            b_name, loop_vars,
+            body + [ast.Return(value=_tuple_load(loop_vars))],
+        )
+        call = _call("_dy2st_while",
+                     [_load(c_name), _load(b_name), _tuple_load(loop_vars)])
+        return pre + [cond_fn, body_fn, _assign_tuple(loop_vars, call)]
+
+    # -- for over range() -------------------------------------------------
+    def visit_For(self, node):
+        if not (
+            isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and not node.orelse
+            and isinstance(node.target, ast.Name)
+        ):
+            self.generic_visit(node)
+            return node
+        uid = self._uid()
+        r = node.iter.args
+        start = r[0] if len(r) >= 2 else ast.Constant(value=0)
+        stop = r[1] if len(r) >= 2 else r[0]
+        step = r[2] if len(r) >= 3 else ast.Constant(value=1)
+        i_name = f"_dy2st_i_{uid}"
+        # i = start + _i * step computed at the TOP, then _i advances
+        # immediately — `continue` guards never skip the increment
+        new_body = [
+            ast.Assign(
+                targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+                value=ast.BinOp(left=start, op=ast.Add(),
+                                right=ast.BinOp(left=_load(i_name),
+                                                op=ast.Mult(), right=step)),
+            ),
+            ast.Assign(targets=[_store(i_name)],
+                       value=ast.BinOp(left=_load(i_name), op=ast.Add(),
+                                       right=ast.Constant(value=1))),
+        ] + list(node.body)
+        # trip count (stop-start+step-1)//step — plain arithmetic so a
+        # TENSOR stop (for i in range(n_tensor)) stays a tensor and the
+        # while condition converts like any tensor condition
+        n_name = f"_dy2st_n_{uid}"
+        n_stmt = ast.Assign(
+            targets=[_store(n_name)],
+            value=ast.BinOp(
+                left=ast.BinOp(
+                    left=ast.BinOp(left=stop, op=ast.Sub(), right=start),
+                    op=ast.Add(),
+                    right=ast.BinOp(left=step, op=ast.Sub(),
+                                    right=ast.Constant(value=1)),
+                ),
+                op=ast.FloorDiv(), right=step,
+            ),
+        )
+        init = ast.Assign(targets=[_store(i_name)],
+                          value=ast.Constant(value=0))
+        # pre-bind the loop target (loop vars must exist before the loop)
+        tgt_init = ast.Assign(
+            targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+            value=start,
+        )
+        test = ast.Compare(left=_load(i_name), ops=[ast.Lt()],
+                           comparators=[_load(n_name)])
+        w = ast.While(test=test, body=new_body, orelse=[])
+        out = self.visit(w)
+        return [n_stmt, init, tgt_init] + (
+            out if isinstance(out, list) else [out]
+        )
+
+
+def convert_function(fn):
+    """Rewrite fn's plain-Python control flow; returns the converted
+    function or None when conversion is unavailable (no source, exotic
+    constructs — caller falls back to the original)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []
+    fdef.name = f"_dy2st_{fdef.name}"
+    new = ControlFlowTransformer().visit(fdef)
+    mod = ast.fix_missing_locations(ast.Module(body=[new], type_ignores=[]))
+    # LIVE globals: lookups not shadowed by the helpers fall through to the
+    # original function's module dict at CALL time (a snapshot would pin
+    # helper functions defined/rebound after decoration — a regression for
+    # previously-working @declarative code). Closure cells resolve lazily
+    # too, so a self-referential decorated function whose cell is still
+    # empty at decoration works once the cell fills.
+    cells = dict(zip(fn.__code__.co_freevars, fn.__closure__ or ()))
+
+    class _LiveGlobals(dict):
+        def __missing__(self, k):
+            if k in cells:
+                return cells[k].cell_contents  # ValueError -> NameError-ish
+            return fn.__globals__[k]
+
+    ns = _LiveGlobals(HELPERS)
+    try:
+        code = compile(mod, filename=f"<dy2st {fn.__qualname__}>",
+                       mode="exec")
+        exec(code, ns)
+    except Exception:
+        return None
+    out = ns[fdef.name]
+    out = functools.wraps(fn)(out)
+    out._dy2st_converted = True
+    return out

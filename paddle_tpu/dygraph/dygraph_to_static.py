@@ -87,9 +87,24 @@ def _collect_params(args):
 
 
 def declarative(fn=None):
-    """Decorator (reference @declarative / @paddle.jit.to_static)."""
+    """Decorator (reference @declarative / @paddle.jit.to_static).
+
+    Plain-Python control flow over tensors (if/while/for+break/continue)
+    is AST-converted up front (ast_transform.py — the reference's
+    dygraph_to_static transformer stack): the converted body dispatches
+    per condition type, so python conditions run unchanged, tensor
+    conditions lower to lax.cond/while_loop under the jit trace and to
+    layers.cond/While ops in static mode. When the source is unavailable
+    (REPL, C callables) the original function is used — the functional
+    subset still works, tensor-dependent python branching then raises
+    the hint below."""
     if fn is None:
         return declarative
+
+    from .ast_transform import convert_function
+
+    converted = convert_function(fn)
+    run_fn = converted if converted is not None else fn
 
     cache = {}
 
@@ -97,7 +112,9 @@ def declarative(fn=None):
     def wrapper(*args):
         tracer = _current_tracer()
         if tracer is None or not ProgramTranslator.get_instance().enabled:
-            return fn(*args)  # static mode: plain layer-building call
+            # static mode: layer-building call (converted so a python `if`
+            # over a static Variable builds cond/while ops)
+            return run_fn(*args)
 
         from .layers import Layer
 
@@ -142,7 +159,7 @@ def declarative(fn=None):
 
                 # no tape entries inside: grads are handled at the boundary
                 with no_grad_ctx():
-                    out = fn(*inner)
+                    out = run_fn(*inner)
                 struct["seq"] = isinstance(out, (list, tuple))
                 outs = out if struct["seq"] else [out]
                 return [o.value for o in outs]

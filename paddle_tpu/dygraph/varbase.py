@@ -100,10 +100,53 @@ class VarBase:
     def __truediv__(self, o):
         return self._binary(o, "elementwise_div")
 
+    def __floordiv__(self, o):
+        return self._binary(o, "elementwise_floordiv")
+
+    def __mod__(self, o):
+        return self._binary(o, "elementwise_mod")
+
     def __neg__(self):
         from .tracer import trace_op
 
         return trace_op("scale", {"X": [self]}, {"scale": -1.0, "bias": 0.0})
+
+    # comparisons build compare ops (fluid math_op_patch parity) — needed by
+    # @declarative-converted `while i < n:` style tensor conditions.
+    # __eq__ is elementwise like fluid's patched ==; identity hashing is
+    # kept explicitly (torch.Tensor makes the same trade). Non-coercible
+    # operands (None, strings, arbitrary objects) return NotImplemented so
+    # python's fallback equality holds — `vb == None` is False, not a raise
+    def _coercible(self, o):
+        import numbers
+
+        return isinstance(
+            o, (VarBase, numbers.Number, bool, np.ndarray, jnp.ndarray)
+        )
+
+    def __eq__(self, o):
+        if not self._coercible(o):
+            return NotImplemented
+        return self._binary(o, "equal")
+
+    def __ne__(self, o):
+        if not self._coercible(o):
+            return NotImplemented
+        return self._binary(o, "not_equal")
+
+    __hash__ = object.__hash__
+
+    def __lt__(self, o):
+        return self._binary(o, "less_than")
+
+    def __le__(self, o):
+        return self._binary(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._binary(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._binary(o, "greater_equal")
 
     def __matmul__(self, o):
         from .tracer import trace_op

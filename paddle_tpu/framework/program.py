@@ -67,6 +67,12 @@ class Variable:
             "elementwise_sub": layers.elementwise_sub,
             "elementwise_mul": layers.elementwise_mul,
             "elementwise_div": layers.elementwise_div,
+            "less_than": layers.less_than,
+            "less_equal": layers.less_equal,
+            "greater_than": layers.greater_than,
+            "greater_equal": layers.greater_equal,
+            "elementwise_floordiv": layers.elementwise_floordiv,
+            "elementwise_mod": layers.elementwise_mod,
         }[op_type]
         if not isinstance(other, Variable):
             other = layers.fill_constant([1], self.dtype, float(other))
@@ -91,6 +97,29 @@ class Variable:
 
     def __truediv__(self, other):
         return self._elementwise(other, "elementwise_div")
+
+    def __floordiv__(self, other):
+        return self._elementwise(other, "elementwise_floordiv")
+
+    def __rfloordiv__(self, other):
+        return self._elementwise(other, "elementwise_floordiv", reverse=True)
+
+    def __mod__(self, other):
+        return self._elementwise(other, "elementwise_mod")
+
+    # comparisons build compare ops (fluid math_op_patch parity) — used by
+    # @declarative-converted tensor conditions in static mode
+    def __lt__(self, other):
+        return self._elementwise(other, "less_than")
+
+    def __le__(self, other):
+        return self._elementwise(other, "less_equal")
+
+    def __gt__(self, other):
+        return self._elementwise(other, "greater_than")
+
+    def __ge__(self, other):
+        return self._elementwise(other, "greater_equal")
 
     def __repr__(self):
         return (

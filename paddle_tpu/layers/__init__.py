@@ -22,6 +22,7 @@ from .tensor import (  # noqa: F401  (generated attrs need explicit export)
     elementwise_min,
     elementwise_pow,
     elementwise_mod,
+    elementwise_floordiv,
     equal,
     not_equal,
     less_than,

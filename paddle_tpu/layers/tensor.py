@@ -310,6 +310,7 @@ def _make_binary(op_type):
 for _t in [
     "elementwise_add", "elementwise_sub", "elementwise_mul", "elementwise_div",
     "elementwise_max", "elementwise_min", "elementwise_pow", "elementwise_mod",
+    "elementwise_floordiv",
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal",
 ]:
