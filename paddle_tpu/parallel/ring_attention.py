@@ -5,32 +5,59 @@ this is greenfield, designed TPU-first:
 
 * Ring attention: the sequence axis is sharded over the "sp" mesh axis;
   each device keeps its Q shard resident and K/V shards rotate around the
-  ring via lax.ppermute (ICI neighbor exchange), overlapping the blockwise
-  attention compute of step i with the transfer of step i+1 (XLA's
-  latency-hiding scheduler pipelines the ppermute against the matmuls).
-  Softmax is computed online (running max/denominator), so no S×S matrix
-  ever materializes — O(S_local × S_block) memory.
+  ring via lax.ppermute (ICI neighbor exchange). The ring is a lax.scan
+  over ring steps — ONE ppermute pair in the compiled program regardless
+  of mesh size, so HLO size and compile time are flat from n=8 to a
+  n=256 pod slice (an unrolled Python loop grows both linearly). Softmax
+  is merged across shards in logsumexp form (the associative online-
+  softmax merge), so no S×S matrix ever materializes.
+
+* Backward is a hand-written ring pass (jax.custom_vjp), the standard
+  flash split given the saved global row-logsumexp: dq accumulates on the
+  resident q shard; dk/dv accumulators TRAVEL with the visiting K/V shard
+  and arrive home after the full rotation. Residual memory is O(S_local)
+  per device — the generic scan transpose would stack every visiting
+  shard (O(S) per device), exactly the memory SP exists to shed.
+
+* The per-shard block runs in VMEM via the Pallas kernels in
+  kernels/ring_block.py whenever the shapes tile (128%head_dim==0, packed
+  heads a multiple of 128 lanes; any shape in interpret mode), with a
+  chunked jnp online-softmax fallback otherwise (_KV_CHUNK-sized key
+  blocks — still O(S_local × chunk) memory).
+
+* Causal rings skip DEAD shards entirely (lax.cond on "is this visiting
+  shard wholly above the diagonal"): half the ring steps do no attention
+  math, mirroring the tiled kernel's dead-tile skip at tile granularity.
 
 * Ulysses: all_to_all reshard from sequence-sharded to head-sharded,
-  full local attention, all_to_all back. One pair of all_to_alls per layer
-  vs n_ring ppermutes; better when heads ≥ mesh axis size.
-
-Both are differentiable through the generic vjp path (ppermute/all_to_all
-transpose to their inverses under jax.vjp).
+  full local attention (same Pallas block, offsets 0), all_to_all back.
+  One pair of all_to_alls per layer vs n_ring ppermutes; better when
+  heads ≥ mesh axis size.
 """
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..kernels.ring_block import (
+    ring_supports,
+    shard_dkv,
+    shard_dq,
+    shard_fwd,
+)
 
 _NEG = -1e30  # finite stand-in for -inf: keeps exp() NaN-free on fully
 # masked blocks (a ring step where every key is causally ahead of this query
 # shard) — p is zeroed through `valid` instead of relying on exp(-inf)
+
+# test hook: force the chunked-jnp shard backend even where the Pallas
+# kernels support the shapes (tests/test_longcontext.py exercises both)
+_FORCE_JNP = False
 
 
 def _online_block(q, k, v, valid, m, l, acc, scale):
@@ -52,10 +79,8 @@ def _online_block(q, k, v, valid, m, l, acc, scale):
 
 
 # keys processed per online-softmax block: bounds the materialized score
-# block to [S_local, _KV_CHUNK] regardless of shard size, so ring
-# attention scales to shards far beyond the [S_local, S_local] HBM cliff
-# (a 32k shard would otherwise stream multi-GB probability blocks per
-# ring step)
+# block to [S_local, _KV_CHUNK] regardless of shard size, so the jnp
+# fallback scales to shards far beyond the [S_local, S_local] HBM cliff
 _KV_CHUNK = 1024
 
 
@@ -68,8 +93,7 @@ def _valid_mask(row0, col0, sq, sk):
 def _online_shard(qf, kf, vf, row0, col0, causal, m, l, acc, scale):
     """Accumulate one full K/V shard into the running softmax state,
     scanning _KV_CHUNK-sized key blocks (lax.scan) when the shard is
-    larger — the in-XLA analog of the Pallas KV tiling, and still
-    differentiable through the generic vjp path (scan transposes)."""
+    larger — the in-XLA analog of the Pallas KV tiling."""
     sq = qf.shape[2]
     sk = kf.shape[2]
     if sk <= _KV_CHUNK:
@@ -77,10 +101,10 @@ def _online_shard(qf, kf, vf, row0, col0, causal, m, l, acc, scale):
         return _online_block(qf, kf, vf, valid, m, l, acc, scale)
 
     # jax.checkpoint: WITHOUT it the scan's backward saves each chunk's
-    # softmax residuals (p et al., [Sq, _KV_CHUNK] stacked over all
-    # chunks) — re-materializing the very [Sq, sk]-sized memory the
-    # chunking exists to avoid; rematerializing the chunk in the
-    # backward is the standard flash-attention trade
+    # softmax residuals — re-materializing the very [Sq, sk]-sized memory
+    # the chunking exists to avoid. (The ring path no longer differentiates
+    # through this — custom_vjp below — but Ulysses' jnp fallback still
+    # does.)
     @jax.checkpoint
     def body(carry, i):
         m_, l_, acc_ = carry
@@ -97,8 +121,7 @@ def _online_shard(qf, kf, vf, row0, col0, causal, m, l, acc, scale):
     tail = sk - chunks * _KV_CHUNK
     if tail:
         # non-multiple shard: the remainder is ONE small block — never the
-        # full [sq, sk] score block (that would reopen the HBM cliff the
-        # chunking exists to close)
+        # full [sq, sk] score block
         kc = kf[:, :, chunks * _KV_CHUNK:]
         vc = vf[:, :, chunks * _KV_CHUNK:]
         valid = (
@@ -121,6 +144,273 @@ def _online_finalize(l, acc):
     return acc / jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0
 
 
+# ---------------------------------------------------------------------------
+# per-shard backends: (o_s, lse_s) forward / (dq, dk, dv) backward
+# ---------------------------------------------------------------------------
+
+
+def _pack(x):  # [B,H,S,D] -> [B,S,H*D] (flash lane layout)
+    b, h, s, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+def _unpack(x, h):  # [B,S,H*D] -> [B,H,S,D]
+    b, s, hd = x.shape
+    return x.reshape(b, s, h, hd // h).transpose(0, 2, 1, 3)
+
+
+def _shard_fwd_jnp(qf, kf, vf, row0, col0, causal, scale):
+    """Self-contained shard attention -> (o_s [B,H,Sq,D] f32,
+    lse_s [B,H,Sq,1] f32); fully-masked rows give o=0, lse=_NEG."""
+    b, h, sq, d = qf.shape
+    m, l, acc = _online_init(b, h, sq, d)
+    m, l, acc = _online_shard(qf, kf, vf, row0, col0, causal, m, l, acc,
+                              scale)
+    o_s = _online_finalize(l, acc)
+    lse_s = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG)
+    return o_s, lse_s
+
+
+def _bwd_block_jnp(qf, kc, vc, do, lse, delta, valid, scale):
+    """Flash backward for one [Sq, chunk] block given GLOBAL lse/delta."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kc) * scale
+    p = jnp.exp(s - lse)
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
+    dv_c = jnp.einsum("bhqk,bhqd->bhkd", p, do)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", do, vc)
+    ds = p * (dp - delta)
+    dq_c = jnp.einsum("bhqk,bhkd->bhqd", ds, kc) * scale
+    dk_c = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+    return dq_c, dk_c, dv_c
+
+
+def _shard_bwd_jnp(qf, kf, vf, do, lse, delta, row0, col0, causal, scale):
+    """(dq, dk, dv) f32 for one visiting shard, _KV_CHUNK-blocked."""
+    sq, sk = qf.shape[2], kf.shape[2]
+    if sk <= _KV_CHUNK:
+        valid = _valid_mask(row0, col0, sq, sk) if causal else None
+        return _bwd_block_jnp(qf, kf, vf, do, lse, delta, valid, scale)
+
+    chunks = sk // _KV_CHUNK
+
+    def body(dq_acc, i):
+        kc = lax.dynamic_slice_in_dim(kf, i * _KV_CHUNK, _KV_CHUNK, axis=2)
+        vc = lax.dynamic_slice_in_dim(vf, i * _KV_CHUNK, _KV_CHUNK, axis=2)
+        valid = (
+            _valid_mask(row0, col0 + i * _KV_CHUNK, sq, _KV_CHUNK)
+            if causal else None
+        )
+        dq_c, dk_c, dv_c = _bwd_block_jnp(qf, kc, vc, do, lse, delta,
+                                          valid, scale)
+        return dq_acc + dq_c, (dk_c, dv_c)
+
+    dq, (dks, dvs) = lax.scan(body, jnp.zeros_like(qf), jnp.arange(chunks))
+
+    def _restitch(parts):  # [chunks,B,H,CH,D] -> [B,H,chunks*CH,D]
+        c, b, h, ch, d = parts.shape
+        return parts.transpose(1, 2, 0, 3, 4).reshape(b, h, c * ch, d)
+
+    dk, dv = _restitch(dks), _restitch(dvs)
+    tail = sk - chunks * _KV_CHUNK
+    if tail:
+        kc = kf[:, :, chunks * _KV_CHUNK:]
+        vc = vf[:, :, chunks * _KV_CHUNK:]
+        valid = (
+            _valid_mask(row0, col0 + chunks * _KV_CHUNK, sq, tail)
+            if causal else None
+        )
+        dq_t, dk_t, dv_t = _bwd_block_jnp(qf, kc, vc, do, lse, delta,
+                                          valid, scale)
+        dq = dq + dq_t
+        dk = jnp.concatenate([dk, dk_t], axis=2)
+        dv = jnp.concatenate([dv, dv_t], axis=2)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# the ring: scan-rolled forward + custom_vjp ring backward
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_core(q, k, v, axis_name, n, causal, scale, backend, interpret):
+    out, _lse = _ring_fwd_impl(q, k, v, axis_name, n, causal, scale,
+                               backend, interpret)
+    return out
+
+
+def _ring_perm(n):
+    return [(i, (i - 1) % n) for i in range(n)]  # send to left neighbor
+
+
+def _ring_fwd_impl(q, k, v, axis_name, n, causal, scale, backend, interpret):
+    """Step 0 (the resident shard — always live under causal) is hoisted
+    out of the scan so the ring does exactly n-1 rotations: a scan body of
+    rotate-then-compute never pays a final dead transfer, and the HLO still
+    contains ONE ppermute pair regardless of n."""
+    idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    perm = _ring_perm(n)
+
+    if backend == "pallas":
+        qp = _pack(q)
+        kt0, vt0 = _pack(k), _pack(v)
+
+        def sfwd(kt, vt, src):
+            offs = jnp.stack([idx * s_local, src * s_local]).astype(jnp.int32)
+            return shard_fwd(qp, kt, vt, offs, h, d, causal, scale,
+                             interpret)
+
+        dead = lambda _: (jnp.zeros(qp.shape, jnp.float32),
+                          jnp.full(qp.shape, _NEG, jnp.float32))
+        finish = lambda o: _unpack(o, h).astype(q.dtype)
+    else:
+        qf = q.astype(jnp.float32)
+        kt0, vt0 = k, v
+
+        def sfwd(kt, vt, src):
+            return _shard_fwd_jnp(
+                qf, kt.astype(jnp.float32), vt.astype(jnp.float32),
+                idx * s_local, src * s_local, causal, scale,
+            )
+
+        dead = lambda _: (jnp.zeros(q.shape, jnp.float32),
+                          jnp.full((b, h, s_local, 1), _NEG, jnp.float32))
+        finish = lambda o: o.astype(q.dtype)
+
+    o, l = sfwd(kt0, vt0, idx)  # step 0: diagonal shard
+
+    def body(carry, t):
+        kt, vt, o, l = carry
+        kt = lax.ppermute(kt, axis_name, perm)
+        vt = lax.ppermute(vt, axis_name, perm)
+        src = (idx + t) % n
+        if causal:
+            # a shard wholly above the diagonal contributes nothing: skip
+            # its kernel at runtime (half the ring on average)
+            os_, ls_ = lax.cond(src <= idx, lambda _: sfwd(kt, vt, src),
+                                dead, None)
+        else:
+            os_, ls_ = sfwd(kt, vt, src)
+        l_new = jnp.logaddexp(l, ls_)
+        o = o * jnp.exp(l - l_new) + os_ * jnp.exp(ls_ - l_new)
+        return (kt, vt, o, l_new), None
+
+    if n > 1:
+        (_, _, o, l), _ = lax.scan(body, (kt0, vt0, o, l), jnp.arange(1, n))
+    # lse layout: packed [B,Sq,H*D] (pallas) / [B,H,Sq,1] (jnp)
+    return finish(o), l
+
+
+def _slim_lse(lse, h, d, backend):
+    """Residual diet: the packed lse is column-replicated D times — keep
+    one column per head across the fwd->bwd interval ([B,Sq,H] instead of
+    [B,Sq,H*D]; at long context that residual is activation-sized)."""
+    if backend == "pallas":
+        b, s, hd = lse.shape
+        return lse.reshape(b, s, h, d)[..., 0]
+    return lse  # jnp layout is already [B,H,Sq,1]
+
+
+def _fatten_lse(lse, d, backend):
+    if backend == "pallas":
+        return jnp.repeat(lse, d, axis=-1)
+    return lse
+
+
+def _ring_core_fwd(q, k, v, axis_name, n, causal, scale, backend, interpret):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, n, causal, scale,
+                              backend, interpret)
+    h, d = q.shape[1], q.shape[3]
+    return out, (q, k, v, out, _slim_lse(lse, h, d, backend))
+
+
+def _ring_core_bwd(axis_name, n, causal, scale, backend, interpret, res, g):
+    """Ring backward: dq accumulates on the resident q shard; dk/dv
+    accumulators TRAVEL with the visiting k/v shard. Step 0 is hoisted
+    (n-1 in-scan rotations), so one final hop outside the scan brings each
+    accumulator home fully summed: n transfers total — the minimum for a
+    backward that must return remote-shard gradients."""
+    q, k, v, out, lse_slim = res
+    idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    perm = _ring_perm(n)
+    lse = _fatten_lse(lse_slim, d, backend)
+
+    if backend == "pallas":
+        qp = _pack(q)
+        gp = _pack(g)
+        op = _pack(out)
+        kt0, vt0 = _pack(k), _pack(v)
+        delta = jnp.sum(
+            gp.astype(jnp.float32).reshape(b, s_local, h, d)
+            * op.astype(jnp.float32).reshape(b, s_local, h, d),
+            axis=-1,
+        )  # [B,Sq,H]
+        delta = jnp.repeat(delta, d, axis=-1)  # column-replicated [B,Sq,H*D]
+        zeros_q = jnp.zeros(qp.shape, jnp.float32)
+        zeros_kv = jnp.zeros(kt0.shape, jnp.float32)
+
+        def sbwd(kt, vt, src):
+            offs = jnp.stack([idx * s_local, src * s_local]).astype(jnp.int32)
+            dq_c = shard_dq(qp, kt, vt, gp, lse, delta, offs, h, d,
+                            causal, scale, interpret)
+            dk_c, dv_c = shard_dkv(qp, kt, vt, gp, lse, delta, offs,
+                                   h, d, causal, scale, interpret)
+            return dq_c, dk_c, dv_c
+
+        def finish(x, like):
+            return _unpack(x, h).astype(like.dtype)
+    else:
+        qf = q.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        of = out.astype(jnp.float32)
+        kt0, vt0 = k, v
+        delta = jnp.sum(gf * of, axis=-1, keepdims=True)  # [B,H,Sq,1]
+        zeros_q = jnp.zeros(q.shape, jnp.float32)
+        zeros_kv = jnp.zeros(k.shape, jnp.float32)
+
+        def sbwd(kt, vt, src):
+            return _shard_bwd_jnp(
+                qf, kt.astype(jnp.float32), vt.astype(jnp.float32), gf,
+                lse, delta, idx * s_local, src * s_local, causal, scale,
+            )
+
+        def finish(x, like):
+            return x.astype(like.dtype)
+
+    dq, dk0, dv0 = sbwd(kt0, vt0, idx)  # step 0: diagonal shard
+
+    def body(carry, t):
+        kt, vt, dkt, dvt, dq = carry
+        kt, dkt = (lax.ppermute(x, axis_name, perm) for x in (kt, dkt))
+        vt, dvt = (lax.ppermute(x, axis_name, perm) for x in (vt, dvt))
+        src = (idx + t) % n
+        if causal:
+            dq_c, dk_c, dv_c = lax.cond(
+                src <= idx, lambda _: sbwd(kt, vt, src),
+                lambda _: (zeros_q, zeros_kv, zeros_kv), None,
+            )
+        else:
+            dq_c, dk_c, dv_c = sbwd(kt, vt, src)
+        return (kt, vt, dkt + dk_c, dvt + dv_c, dq + dq_c), None
+
+    if n > 1:
+        (_, _, dkt, dvt, dq), _ = lax.scan(
+            body, (kt0, vt0, dk0, dv0, dq), jnp.arange(1, n)
+        )
+        # accumulators sit one hop from home after n-1 rotations
+        dkt = lax.ppermute(dkt, axis_name, perm)
+        dvt = lax.ppermute(dvt, axis_name, perm)
+    else:
+        dkt, dvt = dk0, dv0
+    return finish(dq, q), finish(dkt, k), finish(dvt, v)
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
 def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
     """q,k,v: LOCAL shards [B, H, S_local, D] inside shard_map.
 
@@ -129,26 +419,68 @@ def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
     """
     n = int(axis_size)
     b, h, s_local, d = q.shape
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    idx = lax.axis_index(axis_name)
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+    interpret = jax.default_backend() == "cpu"
+    use_pallas = (
+        not _FORCE_JNP
+        and ring_supports(s_local, s_local, h, d, q.dtype, interpret)
+    )
+    backend = "pallas" if use_pallas else "jnp"
+    return _ring_core(q, k, v, axis_name, n, causal, scale, backend,
+                      interpret)
 
-    m, l, acc = _online_init(b, h, s_local, d)
-    qf = q.astype(jnp.float32)
 
-    perm = [(i, (i - 1) % n) for i in range(n)]  # send to left neighbor
-    kt, vt = k, v
-    for t in range(n):
-        src = (idx + t) % n  # which shard kt/vt currently holds
-        # global positions: rows i*s_local + r, cols src*s_local + c
-        m, l, acc = _online_shard(
-            qf, kt.astype(jnp.float32), vt.astype(jnp.float32),
-            idx * s_local, src * s_local, causal, m, l, acc, scale,
-        )
-        if t != n - 1:
-            kt = lax.ppermute(kt, axis_name, perm)
-            vt = lax.ppermute(vt, axis_name, perm)
+# ---------------------------------------------------------------------------
+# Ulysses: all_to_all head resharding + full local attention
+# ---------------------------------------------------------------------------
 
-    return _online_finalize(l, acc).astype(q.dtype)
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _local_flash(q, k, v, causal, scale, interpret):
+    """Full (unsharded-sequence) attention through the ring-block Pallas
+    kernels, offsets 0 — the Ulysses local step and any future dense use."""
+    out, _lse = _local_flash_fwd(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _local_flash_fwd(q, k, v, causal, scale, interpret):
+    h, d = q.shape[1], q.shape[3]
+    offs = jnp.zeros(2, jnp.int32)
+    o, lse = shard_fwd(_pack(q), _pack(k), _pack(v), offs, h, d, causal,
+                       scale, interpret)
+    return _unpack(o, h).astype(q.dtype), lse
+
+
+def _local_flash_vjp_fwd(q, k, v, causal, scale, interpret):
+    out, lse = _local_flash_fwd(q, k, v, causal, scale, interpret)
+    h, d = q.shape[1], q.shape[3]
+    return out, (q, k, v, out, _slim_lse(lse, h, d, "pallas"))
+
+
+def _local_flash_vjp_bwd(causal, scale, interpret, res, g):
+    q, k, v, out, lse_slim = res
+    b, h, s, d = q.shape
+    lse = _fatten_lse(lse_slim, d, "pallas")
+    qp, kp, vp, gp, op = (_pack(x) for x in (q, k, v, g, out))
+    delta = jnp.sum(
+        gp.astype(jnp.float32).reshape(b, s, h, d)
+        * op.astype(jnp.float32).reshape(b, s, h, d),
+        axis=-1,
+    )
+    delta = jnp.repeat(delta, d, axis=-1)
+    offs = jnp.zeros(2, jnp.int32)
+    dq = shard_dq(qp, kp, vp, gp, lse, delta, offs, h, d, causal, scale,
+                  interpret)
+    dk, dv = shard_dkv(qp, kp, vp, gp, lse, delta, offs, h, d, causal,
+                       scale, interpret)
+    return (
+        _unpack(dq, h).astype(q.dtype),
+        _unpack(dk, h).astype(k.dtype),
+        _unpack(dv, h).astype(v.dtype),
+    )
+
+
+_local_flash.defvjp(_local_flash_vjp_fwd, _local_flash_vjp_bwd)
 
 
 def ulysses_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
@@ -174,10 +506,14 @@ def ulysses_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
     bh, hh, s_full, _ = qh.shape
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    # the head-sharded local attention spans the FULL sequence: stream it
-    # through the same chunked online softmax as the ring path — a dense
-    # [S, S] block at long context is exactly the cliff SP exists to avoid
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+    interpret = jax.default_backend() == "cpu"
+    if (not _FORCE_JNP
+            and ring_supports(s_full, s_full, hh, d, qh.dtype, interpret)):
+        return to_seq(_local_flash(qh, kh, vh, causal, scale, interpret))
+    # jnp fallback: stream the full sequence through the same chunked
+    # online softmax as the ring path — a dense [S, S] block at long
+    # context is exactly the cliff SP exists to avoid
     m, l, acc = _online_init(bh, hh, s_full, d)
     m, l, acc = _online_shard(
         qh.astype(jnp.float32), kh.astype(jnp.float32),
