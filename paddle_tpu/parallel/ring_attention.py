@@ -319,6 +319,30 @@ def _fatten_lse(lse, d, backend):
     return lse
 
 
+def _pallas_bwd_prep(q, k, v, out, g, lse_slim, causal, scale, interpret):
+    """Shared pallas backward plumbing (ring and Ulysses): pack operands,
+    rebuild the lane-replicated lse, compute fp32 delta=rowsum(do*out),
+    and return a per-shard (kt, vt, offs) -> (dq, dk, dv) closure."""
+    b, h, s, d = q.shape
+    lse = _fatten_lse(lse_slim, d, "pallas")
+    qp, gp, op = _pack(q), _pack(g), _pack(out)
+    delta = jnp.sum(
+        gp.astype(jnp.float32).reshape(b, s, h, d)
+        * op.astype(jnp.float32).reshape(b, s, h, d),
+        axis=-1,
+    )  # [B,Sq,H]
+    delta = jnp.repeat(delta, d, axis=-1)  # column-replicated [B,Sq,H*D]
+
+    def sbwd(kt, vt, offs):
+        dq_c = shard_dq(qp, kt, vt, gp, lse, delta, offs, h, d, causal,
+                        scale, interpret)
+        dk_c, dv_c = shard_dkv(qp, kt, vt, gp, lse, delta, offs, h, d,
+                               causal, scale, interpret)
+        return dq_c, dk_c, dv_c
+
+    return qp, sbwd
+
+
 def _ring_core_fwd(q, k, v, axis_name, n, causal, scale, backend, interpret):
     out, lse = _ring_fwd_impl(q, k, v, axis_name, n, causal, scale,
                               backend, interpret)
@@ -336,33 +360,22 @@ def _ring_core_bwd(axis_name, n, causal, scale, backend, interpret, res, g):
     idx = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     perm = _ring_perm(n)
-    lse = _fatten_lse(lse_slim, d, backend)
 
     if backend == "pallas":
-        qp = _pack(q)
-        gp = _pack(g)
-        op = _pack(out)
+        qp, shard_bwd = _pallas_bwd_prep(q, k, v, out, g, lse_slim,
+                                         causal, scale, interpret)
         kt0, vt0 = _pack(k), _pack(v)
-        delta = jnp.sum(
-            gp.astype(jnp.float32).reshape(b, s_local, h, d)
-            * op.astype(jnp.float32).reshape(b, s_local, h, d),
-            axis=-1,
-        )  # [B,Sq,H]
-        delta = jnp.repeat(delta, d, axis=-1)  # column-replicated [B,Sq,H*D]
         zeros_q = jnp.zeros(qp.shape, jnp.float32)
         zeros_kv = jnp.zeros(kt0.shape, jnp.float32)
 
         def sbwd(kt, vt, src):
             offs = jnp.stack([idx * s_local, src * s_local]).astype(jnp.int32)
-            dq_c = shard_dq(qp, kt, vt, gp, lse, delta, offs, h, d,
-                            causal, scale, interpret)
-            dk_c, dv_c = shard_dkv(qp, kt, vt, gp, lse, delta, offs,
-                                   h, d, causal, scale, interpret)
-            return dq_c, dk_c, dv_c
+            return shard_bwd(kt, vt, offs)
 
         def finish(x, like):
             return _unpack(x, h).astype(like.dtype)
     else:
+        lse = lse_slim  # jnp layout needs no fattening
         qf = q.astype(jnp.float32)
         gf = g.astype(jnp.float32)
         of = out.astype(jnp.float32)
@@ -420,9 +433,12 @@ def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
     n = int(axis_size)
     b, h, s_local, d = q.shape
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+    # interpret-mode Pallas is a CPU-test affordance; on other non-TPU
+    # backends (gpu) the chunked-jnp path is the compiled fallback
     interpret = jax.default_backend() == "cpu"
     use_pallas = (
         not _FORCE_JNP
+        and (interpret or jax.default_backend() == "tpu")
         and ring_supports(s_local, s_local, h, d, q.dtype, interpret)
     )
     backend = "pallas" if use_pallas else "jnp"
@@ -459,20 +475,10 @@ def _local_flash_vjp_fwd(q, k, v, causal, scale, interpret):
 
 def _local_flash_vjp_bwd(causal, scale, interpret, res, g):
     q, k, v, out, lse_slim = res
-    b, h, s, d = q.shape
-    lse = _fatten_lse(lse_slim, d, "pallas")
-    qp, kp, vp, gp, op = (_pack(x) for x in (q, k, v, g, out))
-    delta = jnp.sum(
-        gp.astype(jnp.float32).reshape(b, s, h, d)
-        * op.astype(jnp.float32).reshape(b, s, h, d),
-        axis=-1,
-    )
-    delta = jnp.repeat(delta, d, axis=-1)
-    offs = jnp.zeros(2, jnp.int32)
-    dq = shard_dq(qp, kp, vp, gp, lse, delta, offs, h, d, causal, scale,
-                  interpret)
-    dk, dv = shard_dkv(qp, kp, vp, gp, lse, delta, offs, h, d, causal,
-                       scale, interpret)
+    h = q.shape[1]
+    _, shard_bwd = _pallas_bwd_prep(q, k, v, out, g, lse_slim, causal,
+                                    scale, interpret)
+    dq, dk, dv = shard_bwd(_pack(k), _pack(v), jnp.zeros(2, jnp.int32))
     return (
         _unpack(dq, h).astype(q.dtype),
         _unpack(dk, h).astype(k.dtype),
@@ -509,6 +515,7 @@ def ulysses_attention(q, k, v, axis_name, axis_size, causal=False, scale=None):
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
     interpret = jax.default_backend() == "cpu"
     if (not _FORCE_JNP
+            and (interpret or jax.default_backend() == "tpu")
             and ring_supports(s_full, s_full, hh, d, qh.dtype, interpret)):
         return to_seq(_local_flash(qh, kh, vh, causal, scale, interpret))
     # jnp fallback: stream the full sequence through the same chunked

@@ -218,6 +218,10 @@ def test_ring_attention_kv_chunked_matches_dense(monkeypatch):
     # chunk=3 on shard length 4: one scan chunk + a tail block of 1
     monkeypatch.setattr(ra, "_KV_CHUNK", 3)
     _run_attention("ring_attention", True, sharded=True)
+    # the BACKWARD tail branch too (s_local=4 with chunk=3: restitched
+    # scan chunks + concatenated tail grads) — against dense autodiff
+    for causal in (False, True):
+        test_ring_backward_grads_match_dense_autodiff("jnp", causal)
     # ulysses streams its full-sequence local attention the same way
     # (chunk=3 on the full S: scan chunks + tail)
     for causal in (False, True):
