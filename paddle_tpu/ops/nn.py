@@ -590,6 +590,15 @@ def _nearest_interp(ctx, op, ins):
     n, c, h, w = x.shape
     oh = op.attr("out_h", 0) or int(h * op.attr("scale", 1.0))
     ow = op.attr("out_w", 0) or int(w * op.attr("scale", 1.0))
+    if oh % h == 0 and ow % w == 0 and not op.attr("align_corners", False):
+        # integer upscale (the FPN-neck x2 case): broadcast+reshape repeat.
+        # jax.image.resize's nearest gather transposes to a scatter-add on
+        # TPU; the broadcast's transpose is a block reduce-sum — no scatter
+        fh, fw = oh // h, ow // w
+        out = jnp.broadcast_to(
+            x[:, :, :, None, :, None], (n, c, h, fh, w, fw)
+        ).reshape(n, c, oh, ow)
+        return {"Out": [out]}
     return {
         "Out": [
             jax.image.resize(x, (n, c, oh, ow), method="nearest")
