@@ -101,28 +101,258 @@ def quant_aware(program, weight_bits=8, activation_bits=8):
     return program
 
 
+# ops whose float outputs get a moving-average scale observer during
+# training (reference quantization_pass.py _out_scale_op_list subset that
+# exists here)
+OUT_SCALE_OPS = (
+    "conv2d", "depthwise_conv2d", "mul", "matmul", "relu", "leaky_relu",
+    "relu6", "sigmoid", "tanh", "swish", "softmax", "batch_norm",
+    "elementwise_add", "elementwise_mul", "pool2d", "concat",
+    "reshape2", "transpose2", "dropout",
+)
+
+
+class OutScaleForTrainingPass:
+    """Attach a `moving_average_abs_max_scale` observer to every float
+    output of the target ops so output ranges are recorded DURING training
+    (reference quantization_pass.py OutScaleForTrainingPass: inference
+    engines consume these as out_threshold). The observer op's
+    accum/state live as persistable scope vars updated in the same jitted
+    step (mutates aliasing); `scales()` reads them back."""
+
+    def __init__(self, moving_rate=0.9, op_types=OUT_SCALE_OPS):
+        self.moving_rate = float(moving_rate)
+        self.op_types = tuple(op_types)
+
+    @staticmethod
+    def _state_names(var_name):
+        return (f"{var_name}@out_scale.accum", f"{var_name}@out_scale.state")
+
+    def apply(self, program, startup_program):
+        blk = program.global_block
+        sblk = startup_program.global_block
+        observed = set()
+        i = 0
+        n_observers = 0
+        while i < len(blk.ops):
+            op = blk.ops[i]
+            if op.type not in self.op_types:
+                i += 1
+                continue
+            for names in op.outputs.values():
+                for name in names:
+                    v = blk._find_var_recursive(name)
+                    if (v is None or name in observed
+                            or v.dtype not in ("float32", "bfloat16")):
+                        continue
+                    observed.add(name)
+                    accum_n, state_n = self._state_names(name)
+                    for prog_blk in (blk, sblk):
+                        for sn in (accum_n, state_n):
+                            prog_blk.create_var(
+                                name=sn, shape=(1,), dtype="float32",
+                                persistable=True,
+                            )
+                    for sn in (accum_n, state_n):
+                        sblk.append_op(
+                            "fill_constant", {}, {"Out": [sn]},
+                            {"shape": [1], "dtype": "float32", "value": 0.0},
+                        )
+                    out_n = unique_name.generate(name + "@out_scale.out")
+                    scale_n = unique_name.generate(name + "@out_scale.scale")
+                    blk.create_var(name=out_n, shape=v.shape, dtype=v.dtype)
+                    blk.create_var(name=scale_n, shape=(1,), dtype="float32")
+                    blk.append_op(
+                        "moving_average_abs_max_scale",
+                        {"X": [name], "InAccum": [accum_n],
+                         "InState": [state_n]},
+                        {"Out": [out_n], "OutScale": [scale_n],
+                         "OutAccum": [accum_n], "OutState": [state_n]},
+                        {"moving_rate": self.moving_rate},
+                        index=i + 1,
+                    )
+                    i += 1
+                    n_observers += 1
+            i += 1
+        program._bump()
+        return n_observers
+
+    def scales(self, program, scope):
+        """{var_name: recorded moving-average abs-max} after training."""
+        import numpy as np
+
+        out = {}
+        for op in program.global_block.ops:
+            if op.type != "moving_average_abs_max_scale":
+                continue
+            name = op.inputs["X"][0]
+            accum = scope.find_var(op.inputs["InAccum"][0])
+            state = scope.find_var(op.inputs["InState"][0])
+            if accum is None or state is None:
+                continue
+            s = float(np.asarray(accum).reshape(-1)[0])
+            c = float(np.asarray(state).reshape(-1)[0])
+            out[name] = s / max(c, 1e-9)
+        return out
+
+
+def _merge_hists(hist_max_pairs, bins=2048):
+    """Merge per-batch (histogram over [0, batch_max], batch_max) pairs
+    onto one [0, global_max] grid, spreading each source bin's count over
+    the destination bins it covers proportionally. Keeps calibration
+    memory at O(bins) per var instead of retaining every activation."""
+    import numpy as np
+
+    max_val = max((m for _, m in hist_max_pairs), default=0.0)
+    merged = np.zeros(bins, np.float64)
+    if max_val <= 0.0:
+        return merged, 0.0
+    for hist, m in hist_max_pairs:
+        if m <= 0.0:
+            continue
+        scale = m / max_val  # source grid occupies the first `scale` part
+        src_edges = np.linspace(0.0, scale * bins, bins + 1)
+        for j, cnt in enumerate(hist):
+            if not cnt:
+                continue
+            lo, hi = src_edges[j], src_edges[j + 1]
+            d0, d1 = int(lo), min(int(np.ceil(hi)), bins)
+            span = hi - lo
+            for d in range(d0, d1):
+                overlap = min(hi, d + 1) - max(lo, d)
+                if overlap > 0:
+                    merged[d] += cnt * overlap / span
+    return merged, max_val
+
+
+def _kl_threshold(hist, bin_width, quant_bins=255):
+    """TensorRT-style KL calibration threshold (reference
+    post_training_quantization.py _get_kl_scaling_factor semantics):
+    given the |x| histogram, pick the clip point i in the top 30% of bins
+    minimizing KL(P_clipped || Q_quantized), where P folds the outlier
+    mass into its last bin and Q redistributes i bins merged to
+    `quant_bins` levels back over P's nonzero support."""
+    import numpy as np
+
+    hist = np.asarray(hist, np.float64)
+    bins = hist.shape[0]
+    total = hist.sum()
+    if total <= 0.0:
+        return 0.0
+    start = int((bins - 1) * 0.7)
+    best_kl, best_i = None, 0
+    for i in range(start, bins):
+        if hist[i - 1] == 0:
+            continue
+        p = hist[:i].astype(np.float64)
+        p[i - 1] += hist[i:].sum()  # clip: outliers fold into last bin
+        # quantize: merge i bins into quant_bins levels, then expand each
+        # level's mass uniformly over its nonzero source bins
+        merged = i // quant_bins
+        q = np.zeros(i, np.float64)
+        src = hist[:i].astype(np.float64)
+        for b in range(quant_bins):
+            j0 = b * merged
+            j1 = i if b == quant_bins - 1 else (b + 1) * merged
+            seg = src[j0:j1]
+            nz = seg > 0
+            if nz.any():
+                view = q[j0:j1]
+                view[nz] = seg.sum() / nz.sum()
+        nz = (p > 0) & (q > 0)
+        if not nz.any():
+            continue
+        kl = float(np.sum(
+            p[nz] / total * np.log((p[nz] / total) / (q[nz] / q.sum()))
+        ))
+        if best_kl is None or kl < best_kl:
+            best_kl, best_i = kl, i
+    if best_i == 0:
+        best_i = start
+    return (best_i + 0.5) * bin_width
+
+
 class PostTrainingQuantization:
-    """Collect abs-max activation scales over calibration batches
-    (reference post_training_quantization.py) and return {var: scale}."""
+    """Collect activation scales over calibration batches (reference
+    post_training_quantization.py). algo:
+
+    * "abs_max"  — running max of |x| (the r4 behavior)
+    * "avg"      — mean of per-batch abs-max
+    * "min_max"  — (min, max) pairs per var
+    * "hist"     — percentile of the pooled |x| distribution
+                   (hist_percent, default 0.99999)
+    * "KL"       — TensorRT-style KL-divergence clip point over the
+                   pooled |x| histogram; candidates span the top 30% of
+                   bins (reference semantics), so the clip floor is
+                   0.7*abs_max — use "hist" for aggressive outlier clips
+    """
 
     def __init__(self, executor, program, feed_names, fetch_vars,
-                 scope=None):
+                 scope=None, algo="abs_max", hist_percent=0.99999):
+        if algo not in ("abs_max", "avg", "min_max", "hist", "KL"):
+            raise ValueError(f"unsupported PTQ algo {algo!r}")
         self._exe = executor
         self._program = program
         self._feed_names = feed_names
         self._fetch = fetch_vars
         self._scope = scope
+        self._algo = algo
+        self._hist_percent = float(hist_percent)
+
+    _BINS = 2048
 
     def quantize(self, calibration_feeds, var_names):
         import numpy as np
 
         var_names = list(var_names)  # a generator must survive re-iteration
-        scales = {n: 0.0 for n in var_names}
+        # hist/KL keep O(bins) per (var, batch) — per-batch histograms
+        # merged at the end — never the raw activations (a conv feature
+        # map over 100 calibration batches would be GBs)
+        hists = {n: [] for n in var_names}
+        batch_max = {n: [] for n in var_names}
+        mins = {n: np.inf for n in var_names}
+        maxs = {n: -np.inf for n in var_names}
+        n_batches = 0
         for feed in calibration_feeds:
+            n_batches += 1
             outs = self._exe.run(
                 self._program, feed=feed, fetch_list=var_names,
                 scope=self._scope,
             )
             for n, v in zip(var_names, outs):
-                scales[n] = max(scales[n], float(np.abs(np.asarray(v)).max()))
-        return scales
+                a = np.asarray(v)
+                amax = float(np.abs(a).max())
+                if self._algo in ("hist", "KL"):
+                    h, _ = np.histogram(
+                        np.abs(a).ravel(), bins=self._BINS,
+                        range=(0.0, max(amax, 1e-30)),
+                    )
+                    hists[n].append((h, amax))
+                batch_max[n].append(amax)
+                mins[n] = min(mins[n], float(a.min()))
+                maxs[n] = max(maxs[n], float(a.max()))
+        if n_batches == 0:
+            raise ValueError(
+                "PostTrainingQuantization.quantize: calibration_feeds is "
+                "empty (exhausted generator or empty calibration set?)"
+            )
+        if self._algo == "abs_max":
+            return {n: max(batch_max[n]) for n in var_names}
+        if self._algo == "avg":
+            return {n: float(np.mean(batch_max[n])) for n in var_names}
+        if self._algo == "min_max":
+            return {n: (mins[n], maxs[n]) for n in var_names}
+        out = {}
+        for n in var_names:
+            hist, max_val = _merge_hists(hists[n], self._BINS)
+            bin_width = max_val / self._BINS
+            if self._algo == "hist":
+                if hist.sum() <= 0:
+                    out[n] = 0.0
+                    continue
+                cdf = np.cumsum(hist) / hist.sum()
+                idx = int(np.searchsorted(cdf, self._hist_percent))
+                out[n] = (min(idx, self._BINS - 1) + 1) * bin_width
+            else:
+                out[n] = _kl_threshold(hist, bin_width)
+        return out

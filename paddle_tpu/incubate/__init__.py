@@ -2,3 +2,4 @@
 incubate/: fleet lives at paddle_tpu.fleet; recompute here)."""
 
 from .recompute import RecomputeOptimizer, apply_recompute  # noqa: F401
+from . import data_generator  # noqa: F401

@@ -1,0 +1,163 @@
+"""User-facing MultiSlot sample writers (reference
+python/paddle/fluid/incubate/data_generator/__init__.py): users subclass
+DataGenerator, implement generate_sample (one input line -> one or more
+[(slot_name, [feasign, ...]), ...] samples), and the generator emits the
+MultiSlot text lines that the native parser consumes
+(native/multislot.cpp via dataset/factory.py):
+
+    <ids_num> <id1> <id2> ...  per slot, space-joined across slots
+
+The reference writes to stdout for its Hadoop-pipe trainers
+(run_from_stdin); here write_to_file is the primary path (local file ->
+InMemoryDataset/QueueDataset -> Executor.train_from_dataset), with the
+stdin/stdout protocol kept for pipe-command parity.
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    """Base: subclass and override generate_sample (and optionally
+    generate_batch + set_batch)."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        if not isinstance(batch_size, int) or batch_size < 1:
+            raise ValueError(f"batch_size must be a positive int, got "
+                             f"{batch_size!r}")
+        self.batch_size_ = batch_size
+
+    # -- user hooks ----------------------------------------------------
+    def generate_sample(self, line):
+        """Return a callable yielding [(name, [feasign, ...]), ...] for
+        one raw input line (None when generating from memory)."""
+        raise NotImplementedError(
+            "generate_sample must be implemented by the subclass"
+        )
+
+    def generate_batch(self, samples):
+        """Optional batch-level hook (identity by default)."""
+
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    # -- drivers -------------------------------------------------------
+    def _iter_outputs(self, lines):
+        batch = []
+        for line in lines:
+            it = self.generate_sample(line)
+            for sample in it():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    for out in self.generate_batch(batch)():
+                        yield self._gen_str(out)
+                    batch = []
+        if batch:
+            for out in self.generate_batch(batch)():
+                yield self._gen_str(out)
+
+    def run_from_memory(self, out=None):
+        """Emit samples produced by generate_sample(None) (debug path)."""
+        out = out or sys.stdout
+        for s in self._iter_outputs([None]):
+            out.write(s)
+
+    def run_from_stdin(self, stdin=None, out=None):
+        """Reference pipe protocol: one raw line in, MultiSlot lines out."""
+        stdin = stdin or sys.stdin
+        out = out or sys.stdout
+        for s in self._iter_outputs(stdin):
+            out.write(s)
+
+    def write_to_file(self, lines, path):
+        """Process `lines` and write MultiSlot text to `path`; returns the
+        number of samples written. The file feeds
+        dataset.DatasetFactory().create_dataset(...).set_filelist."""
+        n = 0
+        with open(path, "w") as f:
+            for s in self._iter_outputs(lines):
+                f.write(s)
+                n += 1
+        return n
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator"
+        )
+
+    # shared validation for both writers
+    def _check_sample(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "generate_sample output must be a list/tuple of "
+                "(name, [feasign, ...]) pairs, got " + repr(type(line))
+            )
+        for item in line:
+            name, elements = item
+            if not isinstance(name, str):
+                raise ValueError(f"slot name must be str, got {type(name)}")
+            if not isinstance(elements, (list, tuple)) or not elements:
+                raise ValueError(
+                    f"slot {name!r}: elements must be a non-empty list "
+                    "(pad in generate_sample, the feed is dense)"
+                )
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Feasigns are ints (slot type uint64) or floats (slot type float);
+    a float anywhere in a slot promotes that slot to float — the
+    reference's proto_info rule. Slot order and membership must be
+    identical across samples."""
+
+    def _gen_str(self, line):
+        self._check_sample(line)
+        if self._proto_info is None:
+            self._proto_info = [(name, "uint64") for name, _ in line]
+        elif len(line) != len(self._proto_info):
+            raise ValueError(
+                "the complete field set of two given lines are inconsistent"
+            )
+        parts = []
+        for idx, (name, elements) in enumerate(line):
+            if self._proto_info[idx][0] != name:
+                raise ValueError(
+                    f"slot order changed: expected "
+                    f"{self._proto_info[idx][0]!r}, got {name!r}"
+                )
+            parts.append(str(len(elements)))
+            for e in elements:
+                if isinstance(e, float):
+                    self._proto_info[idx] = (name, "float")
+                elif isinstance(e, bool) or not isinstance(e, int):
+                    # bool IS an int subclass but str(True) would write a
+                    # non-numeric token the parser rejects much later
+                    raise ValueError(
+                        f"slot {name!r}: feasign type {type(e)} not int/float"
+                    )
+                parts.append(str(e))
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Feasigns are pre-formatted strings (fast path, no type tracking)."""
+
+    def _gen_str(self, line):
+        self._check_sample(line)
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"

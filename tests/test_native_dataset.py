@@ -191,3 +191,79 @@ def test_dataset_rejects_width_mismatch(tmp_path):
     ds.set_filelist([str(f)])
     with pytest.raises(ValueError, match="declares 3"):
         list(ds.batches())
+
+
+def test_data_generator_roundtrip_train(tmp_path):
+    """r5 (VERDICT #9): the user-facing MultiSlot writer
+    (incubate/data_generator.py, reference incubate/data_generator)
+    round-trips through the native parser into train_from_dataset."""
+    from paddle_tpu.incubate.data_generator import (
+        MultiSlotDataGenerator,
+        MultiSlotStringDataGenerator,
+    )
+
+    rng = np.random.RandomState(3)
+
+    class CTRData(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                ids = [int(v) for v in rng.randint(0, 100, 3)]
+                yield [("ids", ids), ("label", [float(ids[0] % 2)])]
+
+            return local_iter
+
+    gen = CTRData()
+    path = tmp_path / "gen-part-0.txt"
+    # 64 raw "lines" -> 64 samples
+    n = gen.write_to_file(range(64), str(path))
+    assert n == 64
+    assert gen._proto_info == [("ids", "uint64"), ("label", "float")]
+
+    # the written text parses through the NATIVE parser byte-for-byte
+    v, o = native.parse_multislot(path.read_text(), 2)
+    assert len(o) == 64 * 2 + 1
+    assert np.all(np.diff(o) >= 1)
+
+    ids = fluid.data("ids", [-1, 3], "int64")
+    label = fluid.data("label", [-1, 1], "float32")
+    emb = layers.embedding(ids, size=[100, 8])
+    logit = layers.fc(layers.reshape(emb, [-1, 24]), 1)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label)
+    )
+    fluid.optimizer.Adam(0.02).minimize(loss)
+    dataset = fluid.DatasetFactory().create_dataset("QueueDataset")
+    dataset.set_batch_size(32)
+    dataset.set_use_var([ids, label])
+    dataset.set_filelist([str(path)])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    first = last = None
+    for _ in range(20):
+        exe.train_from_dataset(
+            fluid.default_main_program(), dataset, fetch_list=[loss]
+        )
+        (lv,) = exe.run(
+            feed=next(iter(dataset.batches())), fetch_list=[loss]
+        )
+        lv = float(np.asarray(lv).reshape(-1)[0])
+        first = first if first is not None else lv
+        last = lv
+    assert last < first * 0.9, (first, last)
+
+    # string variant + stdin/stdout pipe protocol parity
+    import io
+
+    class SData(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                toks = line.split()
+                yield [("w", toks[:-1]), ("y", [toks[-1]])]
+
+            return local_iter
+
+    out = io.StringIO()
+    SData().run_from_stdin(stdin=["1 2 3 0\n", "4 5 6 1\n"], out=out)
+    assert out.getvalue() == "3 1 2 3 1 0\n3 4 5 6 1 1\n"
+    v2, o2 = native.parse_multislot(out.getvalue(), 2)
+    np.testing.assert_allclose(v2, [1, 2, 3, 0, 4, 5, 6, 1])

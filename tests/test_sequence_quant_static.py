@@ -146,6 +146,79 @@ def test_post_training_quantization_scales():
     assert scales[h.name] == pytest.approx(6.0)
 
 
+def test_ptq_algo_family_semantics():
+    """r5 (VERDICT #7): KL picks a clip point far below abs-max when the
+    distribution has a few huge outliers; hist takes the requested
+    percentile; avg means the per-batch maxima; min_max records both ends."""
+    from paddle_tpu.contrib.slim.quantization import PostTrainingQuantization
+
+    x = fluid.data("x", [1000])
+    h = layers.scale(x, scale=1.0)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    body = rng.uniform(-1.0, 1.0, 1000).astype(np.float32)
+    body[:3] = [100.0, -80.0, 90.0]  # outliers
+    feeds = [{"x": body}, {"x": (body * 0.5).astype(np.float32)}]
+
+    def ptq(algo, **kw):
+        p = PostTrainingQuantization(
+            exe, fluid.default_main_program(), ["x"], [h], algo=algo, **kw
+        )
+        return p.quantize(feeds, [h.name])[h.name]
+
+    assert ptq("abs_max") == pytest.approx(100.0)
+    assert ptq("avg") == pytest.approx((100.0 + 50.0) / 2)
+    lo, hi = ptq("min_max")
+    assert lo == pytest.approx(-80.0) and hi == pytest.approx(100.0)
+    # 99th percentile of the pooled |x| sits inside the uniform body
+    assert 0.5 < ptq("hist", hist_percent=0.99) < 2.0
+    # KL clips below abs-max but only within the reference's search band
+    # (candidate clip points span the top 30% of histogram bins, so the
+    # reachable floor is 0.7*max — post_training_quantization.py:560)
+    kl = ptq("KL")
+    assert 69.0 < kl < 100.0, kl
+
+
+def test_out_scale_for_training_pass():
+    """r5 (VERDICT #7): observers record output ranges DURING training
+    (reference OutScaleForTrainingPass); scales() returns the moving
+    average of per-step abs-max for every observed float output."""
+    from paddle_tpu.contrib.slim.quantization import OutScaleForTrainingPass
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 3])
+        y = fluid.data("y", [4, 1])
+        h = layers.fc(x, size=8, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        passo = OutScaleForTrainingPass(moving_rate=0.9)
+        n = passo.apply(main, startup)
+        assert n >= 2  # at least the two fc (mul) outputs + relu
+        fluid.optimizer.SGD(0.1).minimize(loss, startup)
+    scope = fluid.framework.scope.Scope()
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    xv = rng.randn(4, 3).astype(np.float32)
+    yv = rng.randn(4, 1).astype(np.float32)
+    for _ in range(5):
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss],
+                scope=scope)
+    scales = passo.scales(main, scope)
+    assert len(scales) == n
+    relu_scales = [v for k, v in scales.items()]
+    assert all(np.isfinite(v) and v >= 0.0 for v in relu_scales)
+    assert any(v > 0.0 for v in relu_scales)
+    # the observer is a passthrough: training still converges with it
+    lvals = [float(np.asarray(exe.run(main, feed={"x": xv, "y": yv},
+                                      fetch_list=[loss], scope=scope)[0]
+                              ).reshape(-1)[0]) for _ in range(30)]
+    assert lvals[-1] < lvals[0]
+
+
 # -- dygraph_to_static ------------------------------------------------------
 
 
