@@ -128,6 +128,12 @@ class OutScaleForTrainingPass:
     def _state_names(var_name):
         return (f"{var_name}@out_scale.accum", f"{var_name}@out_scale.state")
 
+    # the op's real activation output; auxiliary outputs (batch_norm
+    # running stats, dropout Mask, reshape2 XShape) are NOT activations —
+    # exporting ranges for them would hand inference engines wrong clip
+    # points (reference pass observes the single activation output too)
+    _PRIMARY_SLOTS = ("Out", "Y", "Output")
+
     def apply(self, program, startup_program):
         blk = program.global_block
         sblk = startup_program.global_block
@@ -139,7 +145,10 @@ class OutScaleForTrainingPass:
             if op.type not in self.op_types:
                 i += 1
                 continue
-            for names in op.outputs.values():
+            slot = next(
+                (s for s in self._PRIMARY_SLOTS if op.outputs.get(s)), None
+            )
+            for names in ([op.outputs[slot]] if slot else []):
                 for name in names:
                     v = blk._find_var_recursive(name)
                     if (v is None or name in observed
@@ -196,33 +205,21 @@ class OutScaleForTrainingPass:
         return out
 
 
-def _merge_hists(hist_max_pairs, bins=2048):
-    """Merge per-batch (histogram over [0, batch_max], batch_max) pairs
-    onto one [0, global_max] grid, spreading each source bin's count over
-    the destination bins it covers proportionally. Keeps calibration
-    memory at O(bins) per var instead of retaining every activation."""
+def _rescale_hist(hist, old_max, new_max, bins):
+    """Re-grid a [0, old_max] histogram onto [0, new_max] (new_max >
+    old_max), spreading each source bin proportionally over the <=2
+    destination bins it covers — the reference's combine_histogram
+    rescale, vectorized. O(bins) memory and time."""
     import numpy as np
 
-    max_val = max((m for _, m in hist_max_pairs), default=0.0)
-    merged = np.zeros(bins, np.float64)
-    if max_val <= 0.0:
-        return merged, 0.0
-    for hist, m in hist_max_pairs:
-        if m <= 0.0:
-            continue
-        scale = m / max_val  # source grid occupies the first `scale` part
-        src_edges = np.linspace(0.0, scale * bins, bins + 1)
-        for j, cnt in enumerate(hist):
-            if not cnt:
-                continue
-            lo, hi = src_edges[j], src_edges[j + 1]
-            d0, d1 = int(lo), min(int(np.ceil(hi)), bins)
-            span = hi - lo
-            for d in range(d0, d1):
-                overlap = min(hi, d + 1) - max(lo, d)
-                if overlap > 0:
-                    merged[d] += cnt * overlap / span
-    return merged, max_val
+    f = old_max / new_max  # < 1: each source bin spans at most 2 dst bins
+    lo = np.arange(bins, dtype=np.float64) * f
+    d0 = np.minimum(lo.astype(np.int64), bins - 1)
+    w1 = np.clip(lo + f - (d0 + 1), 0.0, None) / f
+    out = np.zeros(bins, np.float64)
+    np.add.at(out, d0, hist * (1.0 - w1))
+    np.add.at(out, np.minimum(d0 + 1, bins - 1), hist * w1)
+    return out
 
 
 def _kl_threshold(hist, bin_width, quant_bins=255):
@@ -305,10 +302,11 @@ class PostTrainingQuantization:
         import numpy as np
 
         var_names = list(var_names)  # a generator must survive re-iteration
-        # hist/KL keep O(bins) per (var, batch) — per-batch histograms
-        # merged at the end — never the raw activations (a conv feature
-        # map over 100 calibration batches would be GBs)
-        hists = {n: [] for n in var_names}
+        # hist/KL keep ONE running histogram per var (rescaled in place
+        # when a batch extends the range) — never the raw activations (a
+        # conv feature map over 100 calibration batches would be GBs)
+        hists = {n: np.zeros(self._BINS, np.float64) for n in var_names}
+        hist_max = {n: 0.0 for n in var_names}
         batch_max = {n: [] for n in var_names}
         mins = {n: np.inf for n in var_names}
         maxs = {n: -np.inf for n in var_names}
@@ -323,11 +321,17 @@ class PostTrainingQuantization:
                 a = np.asarray(v)
                 amax = float(np.abs(a).max())
                 if self._algo in ("hist", "KL"):
+                    if amax > hist_max[n]:
+                        if hist_max[n] > 0.0:
+                            hists[n] = _rescale_hist(
+                                hists[n], hist_max[n], amax, self._BINS
+                            )
+                        hist_max[n] = amax
                     h, _ = np.histogram(
                         np.abs(a).ravel(), bins=self._BINS,
-                        range=(0.0, max(amax, 1e-30)),
+                        range=(0.0, max(hist_max[n], 1e-30)),
                     )
-                    hists[n].append((h, amax))
+                    hists[n] += h
                 batch_max[n].append(amax)
                 mins[n] = min(mins[n], float(a.min()))
                 maxs[n] = max(maxs[n], float(a.max()))
@@ -344,8 +348,8 @@ class PostTrainingQuantization:
             return {n: (mins[n], maxs[n]) for n in var_names}
         out = {}
         for n in var_names:
-            hist, max_val = _merge_hists(hists[n], self._BINS)
-            bin_width = max_val / self._BINS
+            hist, max_val = hists[n], hist_max[n]
+            bin_width = max_val / self._BINS if max_val > 0 else 0.0
             if self._algo == "hist":
                 if hist.sum() <= 0:
                     out[n] = 0.0

@@ -21,13 +21,14 @@ _DEPTH_CFG = {
 }
 
 
-def _conv_bn(x, num_filters, filter_size, stride=1, act=None, is_test=False):
+def _conv_bn(x, num_filters, filter_size, stride=1, act=None,
+             is_test=False, padding=None):
     conv = layers.conv2d(
         x,
         num_filters=num_filters,
         filter_size=filter_size,
         stride=stride,
-        padding=(filter_size - 1) // 2,
+        padding=(filter_size - 1) // 2 if padding is None else padding,
         bias_attr=False,
     )
     return layers.batch_norm(conv, act=act, is_test=is_test)
@@ -54,14 +55,32 @@ def _bottleneck_block(x, num_filters, stride, is_test):
     return layers.relu(y + short)
 
 
-def resnet(image, class_num=1000, depth=50, is_test=False):
-    """Build ResNet; returns logits. image: NCHW float var."""
+def resnet(image, class_num=1000, depth=50, is_test=False,
+           space_to_depth_stem=False):
+    """Build ResNet; returns logits. image: NCHW float var.
+
+    space_to_depth_stem: the standard TPU stem transform (MLPerf ResNet):
+    the 7x7/s2 conv on 3 channels starves the MXU (contraction dim 3,
+    stride-2 input walks); space-to-depth(2) turns the input into
+    [N, 12, H/2, W/2] and an equivalent-function-class 4x4/s1 conv reads
+    it densely. Trained from scratch (the 4x4x12 kernel subsumes the
+    7x7x3 one at even alignments), so accuracy parity holds; checkpoints
+    are NOT weight-compatible with the plain stem."""
     if depth not in _DEPTH_CFG:
         raise ValueError(f"unsupported depth {depth}; pick {sorted(_DEPTH_CFG)}")
     block_kind, counts = _DEPTH_CFG[depth]
     block = _basic_block if block_kind == "basic" else _bottleneck_block
 
-    x = _conv_bn(image, 64, 7, stride=2, act="relu", is_test=is_test)
+    if space_to_depth_stem:
+        x = layers.space_to_depth(image, blocksize=2)
+        # SAME for the even 4-wide kernel needs asymmetric total pad 3
+        # (symmetric (4-1)//2 would shrink the map to 111x111 and starve
+        # the border pixels of full kernel support)
+        x = layers.pad2d(x, paddings=[1, 2, 1, 2])
+        x = _conv_bn(x, 64, 4, stride=1, act="relu", is_test=is_test,
+                     padding=0)
+    else:
+        x = _conv_bn(image, 64, 7, stride=2, act="relu", is_test=is_test)
     x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1,
                       pool_type="max")
     num_filters = [64, 128, 256, 512]
@@ -73,9 +92,11 @@ def resnet(image, class_num=1000, depth=50, is_test=False):
     return layers.fc(x, size=class_num)
 
 
-def resnet_train_net(image, label, depth=50, class_num=1000):
+def resnet_train_net(image, label, depth=50, class_num=1000,
+                     space_to_depth_stem=False):
     """logits -> (avg softmax-CE loss, top-1 accuracy)."""
-    logits = resnet(image, class_num=class_num, depth=depth)
+    logits = resnet(image, class_num=class_num, depth=depth,
+                    space_to_depth_stem=space_to_depth_stem)
     loss = layers.softmax_with_cross_entropy(logits, label)
     avg_loss = layers.reduce_mean(loss)
     acc = layers.accuracy(layers.softmax(logits), label)

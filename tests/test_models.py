@@ -37,6 +37,27 @@ def test_resnet18_trains():
     assert np.isfinite(vals).all()
 
 
+def test_resnet_space_to_depth_stem_trains():
+    """r5: the TPU stem variant (s2d(2) + 4x4/s1 conv) trains; kept as an
+    option even though it measured neutral on v5e (BASELINE.md negative
+    result) — other TPU generations may differ."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        img = fluid.data("image", [4, 3, 32, 32], "float32")
+        label = fluid.data("label", [4, 1], "int64")
+        loss, acc = resnet_train_net(img, label, depth=18, class_num=10,
+                                     space_to_depth_stem=True)
+        SGD(0.01).minimize(loss, startup)
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 10, (4, 1)).astype("int64")
+    vals = _run_steps(main, startup, loss,
+                      lambda i: {"image": x, "label": y}, n=4)
+    assert vals[-1] < vals[0]
+    assert np.isfinite(vals).all()
+
+
 def test_bert_tiny_trains():
     cfg = BertConfig.tiny()
     b, s = 2, 16
