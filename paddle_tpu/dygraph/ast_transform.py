@@ -625,7 +625,12 @@ class ControlFlowTransformer(ast.NodeTransformer):
 def convert_function(fn):
     """Rewrite fn's plain-Python control flow; returns the converted
     function or None when conversion is unavailable (no source, exotic
-    constructs — caller falls back to the original)."""
+    constructs — caller falls back to the original). Bound methods
+    convert through their underlying function and re-bind, so
+    `declarative(layer.forward)` keeps its `self`."""
+    bound_self = fn.__self__ if inspect.ismethod(fn) else None
+    if bound_self is not None:
+        fn = fn.__func__
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)
@@ -662,4 +667,8 @@ def convert_function(fn):
     out = ns[fdef.name]
     out = functools.wraps(fn)(out)
     out._dy2st_converted = True
+    if bound_self is not None:
+        import types
+
+        out = types.MethodType(out, bound_self)
     return out

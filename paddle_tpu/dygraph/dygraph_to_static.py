@@ -105,6 +105,11 @@ def declarative(fn=None):
 
     converted = convert_function(fn)
     run_fn = converted if converted is not None else fn
+    # declarative(layer.forward): the Layer is the method's __self__, not
+    # an argument — its parameters must still become traced inputs or
+    # they bake into the jit as constants (no grads, stale weights after
+    # an optimizer step)
+    bound_owner = getattr(fn, "__self__", None)
 
     cache = {}
 
@@ -119,6 +124,9 @@ def declarative(fn=None):
         from .layers import Layer
 
         params = _collect_params(args)
+        if isinstance(bound_owner, Layer):
+            for name, p in bound_owner.named_parameters():
+                params[f"self:{name}"] = p
         var_args = [a for a in args if isinstance(a, VarBase)]
         var_pos = [i for i, a in enumerate(args) if isinstance(a, VarBase)]
         # static (non-tensor) args are captured for the trace closure — but

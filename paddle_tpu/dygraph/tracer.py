@@ -13,8 +13,9 @@ Reference parity: imperative/tracer.cc:45 (TraceOp), basic_engine.cc:159
   tracer paid a fresh jax.vjp trace + op-by-op eager dispatch per op,
   22x the static executor on small shapes, tools/bench_dygraph.py): the
   fused forward+vjp of each op is jax.jit-compiled once per (op_type,
-  attrs, input avals) — the vjp closure is a jax.tree_util.Partial, a
-  pytree, so it crosses the jit boundary as residual outputs. backward()
+  attrs, input avals) — the vjp closure is a PYTREE (tree_util.Partial
+  on older jax, jax._src.api.VJP on 0.9+; detected structurally, never
+  by type), so it crosses the jit boundary as residual outputs. backward()
   applies tape closures through one shared jitted apply. This is the
   compiled analog of the reference's generated pybind fast paths
   (op_function_generator.cc) plus its dygraph kernel cache.
@@ -212,8 +213,9 @@ class Tracer:
                 return flat
 
             flat, vjp_fn = jax.vjp(fwd, diff_vals)
-            # vjp_fn is a jax.tree_util.Partial — a pytree, so it crosses
-            # the jit boundary (residuals as outputs, structure static)
+            # vjp_fn is a pytree (whatever type this jax returns), so
+            # it crosses the jit boundary (residuals as outputs,
+            # structure static) — see _apply_vjp's structural detection
             return flat, vjp_fn
 
         def fwd_only(raw, seed_v):
@@ -269,14 +271,32 @@ class Tracer:
         self._tape.clear()
 
 
-# one shared jitted apply for tape closures: a vjp Partial is a pytree
-# argument, so jax.jit caches per (closure structure, cotangent avals) —
-# the backward sweep dispatches compiled code per tape entry
+# one shared jitted apply for tape closures: jax.vjp's closure is a
+# PYTREE (tree_util.Partial historically; jax._src.api.VJP since 0.9 —
+# residual arrays as leaves, stable treedef across calls), so jax.jit
+# caches per (closure structure, cotangent avals) and the backward sweep
+# dispatches compiled code per tape entry. Detection is by pytree-ness,
+# not type name: an isinstance(Partial) gate silently routed EVERY
+# backward through the eager per-primitive fallback on jax 0.9 (measured
+# 87% of the tiny-block step).
 _apply_vjp_jit = jax.jit(lambda f, cts: f(cts))
+_jittable_closure_types: dict = {}
+
+
+def _is_pytree_closure(fn):
+    t = type(fn)
+    ok = _jittable_closure_types.get(t)
+    if ok is None:
+        # a registered pytree flattens to a non-leaf treedef; a plain
+        # python closure is a single opaque leaf
+        td = jax.tree_util.tree_structure(fn)
+        ok = td != jax.tree_util.tree_structure(0)
+        _jittable_closure_types[t] = ok
+    return ok
 
 
 def _apply_vjp(vjp_fn, cts):
-    if isinstance(vjp_fn, jax.tree_util.Partial):
+    if _is_pytree_closure(vjp_fn):
         return _apply_vjp_jit(vjp_fn, cts)
     return vjp_fn(cts)  # plain python closure (uncached fallback path)
 

@@ -167,6 +167,18 @@ class VarBase:
     def __len__(self):
         return int(self._value.shape[0])
 
+    def __bool__(self):
+        # eager `if tensor_cond:` must read the VALUE (reference eager
+        # semantics); default object truthiness silently took the True
+        # branch for any tensor. Multi-element raises like numpy.
+        if int(np.prod(self._value.shape)) != 1:
+            raise ValueError(
+                "The truth value of a multi-element VarBase is ambiguous; "
+                "use reductions (any/all) or @declarative for traced "
+                "control flow"
+            )
+        return bool(np.asarray(self._value).reshape(()))
+
     def __repr__(self):
         return f"VarBase(name={self.name}, shape={self.shape}, dtype={self.dtype})\n{self._value}"
 
