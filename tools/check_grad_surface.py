@@ -116,7 +116,7 @@ EXEMPT = {
     # composites of swept cells
     "lstmp": "lstm scan (swept) + projection matmul (swept)",
     "attention_lstm": "lstm_unit cell (swept) + softmax attention "
-    "(softmax/matmul swept); output parity tested in test_rnn_detection",
+    "(softmax/matmul swept); output checked in tests/test_op_surface_r3.py",
     "inplace_abn": "batch_norm (swept) + in-place activation alias",
     "sync_batch_norm": "batch_norm math (swept) with psum'd batch stats; "
     "cross-device stats covered by dist tests",
@@ -127,7 +127,7 @@ EXEMPT = {
     "deformable_psroi_pooling": "deformable_conv bilinear sampling "
     "(swept) + psroi_pool pooling (swept)",
     "var_conv_2d": "ragged conv: conv2d kernel math (swept) under "
-    "length masks; output parity tested in test_rnn_detection",
+    "length masks; output checked in tests/test_detection_ext.py",
     "polygon_box_transform": "coordinate relabeling of offsets "
     "(scale/add algebra); inference-only op in the reference detection "
     "heads",
@@ -135,12 +135,12 @@ EXEMPT = {
     "is non-differentiable, the passthrough is",
     "roi_perspective_transform": "perspective resampling: kink-dense "
     "bilinear borders; inference-only in reference pipelines "
-    "(output parity tested in test_roi_ops)",
+    "(output checked in tests/test_detection_ext.py)",
     "filter_by_instag": "tag-match row selection: data-dependent gather "
     "(gather swept); selection itself non-differentiable",
     # stochastic forward: numeric differencing would re-sample
     "nce": "stochastic negative sampling: loss surface is sample-"
-    "dependent; deterministic-seed output parity tested in test_ops",
+    "dependent; output checked in tests/test_op_surface_r3.py",
     "sample_logits": "stochastic sampled-softmax helper (same reason)",
     "pyramid_hash": "hashed n-gram embedding: hash indexing is integer; "
     "table grads = lookup_table grads (swept)",
