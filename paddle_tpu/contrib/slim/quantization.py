@@ -21,6 +21,54 @@ QUANTIZABLE_OPS = ("mul", "matmul", "conv2d", "depthwise_conv2d")
 _WEIGHT_SLOTS = {"Y", "Filter"}
 
 
+def _weight_quant_axis(op_type, var):
+    # output-channel axis: 0 for conv filters [out,in,kh,kw], last for
+    # matmul weights [in,out] (reference QuantizationTransformPass)
+    return 0 if "conv" in op_type else len(var.shape or (1,)) - 1
+
+
+def _rewrite_quantizable_inputs(program, quantizable_ops, insert):
+    """Shared program walker for QAT transpile and PTQ apply: for every
+    float input of every quantizable op, call
+    insert(blk, index, op, name, var, is_weight) -> (quantized_name or
+    None, ops_inserted); rewires the op input to the quantized name and
+    reuses it for later consumers. Returns the quant-dequant count."""
+    blk = program.global_block
+    quantized = {}  # original name -> quantized name (reuse per block)
+    i = 0
+    n_inserted = 0
+    while i < len(blk.ops):
+        op = blk.ops[i]
+        if op.type not in quantizable_ops:
+            i += 1
+            continue
+        for slot, names in list(op.inputs.items()):
+            new_names = []
+            for n in names:
+                v = blk._find_var_recursive(n)
+                if v is None or v.dtype not in ("float32", "bfloat16"):
+                    new_names.append(n)
+                    continue
+                if n in quantized:
+                    new_names.append(quantized[n])
+                    continue
+                is_weight = slot in _WEIGHT_SLOTS or getattr(
+                    v, "persistable", False
+                )
+                qname, added = insert(blk, i, op, n, v, is_weight)
+                if qname is None:
+                    new_names.append(n)
+                    continue
+                i += added
+                n_inserted += 1
+                quantized[n] = qname
+                new_names.append(qname)
+            op.inputs[slot] = new_names
+        i += 1
+    program._bump()
+    return n_inserted
+
+
 class QuantizationTranspiler:
     def __init__(self, weight_bits=8, activation_bits=8,
                  quantizable_ops=QUANTIZABLE_OPS):
@@ -32,65 +80,34 @@ class QuantizationTranspiler:
         """Insert fake quant-dequant before every quantizable op's float
         inputs. Weights get channel-wise scales (reference
         QuantizationTransformPass behavior); activations per-tensor."""
-        blk = program.global_block
-        quantized = {}  # original name -> quantized name (reuse per block)
-        i = 0
-        n_inserted = 0
-        while i < len(blk.ops):
-            op = blk.ops[i]
-            if op.type not in self.quantizable_ops:
-                i += 1
-                continue
-            for slot, names in list(op.inputs.items()):
-                new_names = []
-                for n in names:
-                    v = blk._find_var_recursive(n)
-                    if v is None or v.dtype not in ("float32", "bfloat16"):
-                        new_names.append(n)
-                        continue
-                    if n in quantized:
-                        new_names.append(quantized[n])
-                        continue
-                    is_weight = slot in _WEIGHT_SLOTS or getattr(
-                        v, "persistable", False
-                    )
-                    qname = unique_name.generate(n + ".quantized")
-                    blk.create_var(
-                        name=qname, shape=v.shape, dtype=v.dtype,
-                    )
-                    sname = unique_name.generate(n + ".quant_scale")
-                    blk.create_var(name=sname, shape=(1,), dtype="float32")
-                    if is_weight:
-                        # output-channel axis: 0 for conv filters
-                        # [out,in,kh,kw], last for matmul weights [in,out]
-                        # (reference QuantizationTransformPass convention)
-                        axis = 0 if "conv" in op.type else len(
-                            v.shape or (1,)
-                        ) - 1
-                        blk.append_op(
-                            "fake_channel_wise_quantize_dequantize_abs_max",
-                            {"X": [n]},
-                            {"Out": [qname], "OutScale": [sname]},
-                            {"bit_length": self.weight_bits,
-                             "quant_axis": axis},
-                            index=i,
-                        )
-                    else:
-                        blk.append_op(
-                            "fake_quantize_dequantize_abs_max",
-                            {"X": [n]},
-                            {"Out": [qname], "OutScale": [sname]},
-                            {"bit_length": self.activation_bits},
-                            index=i,
-                        )
-                    i += 1
-                    n_inserted += 1
-                    quantized[n] = qname
-                    new_names.append(qname)
-                op.inputs[slot] = new_names
-            i += 1
-        program._bump()
-        return n_inserted
+
+        def insert(blk, i, op, n, v, is_weight):
+            qname = unique_name.generate(n + ".quantized")
+            blk.create_var(name=qname, shape=v.shape, dtype=v.dtype)
+            sname = unique_name.generate(n + ".quant_scale")
+            blk.create_var(name=sname, shape=(1,), dtype="float32")
+            if is_weight:
+                blk.append_op(
+                    "fake_channel_wise_quantize_dequantize_abs_max",
+                    {"X": [n]},
+                    {"Out": [qname], "OutScale": [sname]},
+                    {"bit_length": self.weight_bits,
+                     "quant_axis": _weight_quant_axis(op.type, v)},
+                    index=i,
+                )
+            else:
+                blk.append_op(
+                    "fake_quantize_dequantize_abs_max",
+                    {"X": [n]},
+                    {"Out": [qname], "OutScale": [sname]},
+                    {"bit_length": self.activation_bits},
+                    index=i,
+                )
+            return qname, 1
+
+        return _rewrite_quantizable_inputs(
+            program, self.quantizable_ops, insert
+        )
 
 
 def quant_aware(program, weight_bits=8, activation_bits=8):
@@ -360,3 +377,75 @@ class PostTrainingQuantization:
             else:
                 out[n] = _kl_threshold(hist, bin_width)
         return out
+
+    def apply(self, program, scales, quantizable_ops=QUANTIZABLE_OPS,
+              activation_bits=8, weight_bits=8):
+        """Bake calibrated activation scales into an INFERENCE program
+        (reference save_quantized_model flow: QuantizationTransformPass in
+        test mode + scale load + freeze): every quantizable op's float
+        activation input with a calibrated scale routes through a
+        FIXED-scale quant-dequant (the moving-average qdq op in is_test
+        mode consumes InScale verbatim); weights quantize channel-wise by
+        abs-max at apply time (they are constants at inference, so
+        data-derived == calibrated). Returns the number of quant-dequant
+        ops inserted; pair with io.save_inference_model to export."""
+        def norm_scale(s):
+            # min_max returns (min, max); scalar algos return a float
+            if isinstance(s, (tuple, list)):
+                s = max(abs(s[0]), abs(s[1]))
+            return float(s)
+
+        blk = program.global_block
+        # ONE shared zero var feeds every qdq op's unused accum/state
+        # (read-only in is_test mode)
+        zero_n = unique_name.generate("ptq_zero")
+        blk.create_var(name=zero_n, shape=(1,), dtype="float32")
+        blk.append_op(
+            "fill_constant", {}, {"Out": [zero_n]},
+            {"shape": [1], "dtype": "float32", "value": 0.0}, index=0,
+        )
+
+        def insert(blk, i, op, n, v, is_weight):
+            if not is_weight:
+                sval = norm_scale(scales[n]) if n in scales else 0.0
+                if sval <= 0.0:
+                    # uncalibrated or degenerate (all-zero activation):
+                    # a 0 InScale would divide to NaN at inference — skip
+                    return None, 0
+            qname = unique_name.generate(n + ".ptq_quantized")
+            blk.create_var(name=qname, shape=v.shape, dtype=v.dtype)
+            oscale = unique_name.generate(n + ".ptq_scale_out")
+            blk.create_var(name=oscale, shape=(1,), dtype="float32")
+            if is_weight:
+                blk.append_op(
+                    "fake_channel_wise_quantize_dequantize_abs_max",
+                    {"X": [n]},
+                    {"Out": [qname], "OutScale": [oscale]},
+                    {"bit_length": weight_bits,
+                     "quant_axis": _weight_quant_axis(op.type, v)},
+                    index=i,
+                )
+                return qname, 1
+            sn = unique_name.generate(n + ".ptq_in_scale")
+            acc_out = unique_name.generate(n + ".ptq_acc_out")
+            st_out = unique_name.generate(n + ".ptq_st_out")
+            for aux_n in (sn, acc_out, st_out):
+                blk.create_var(name=aux_n, shape=(1,), dtype="float32")
+            blk.append_op(
+                "fill_constant", {}, {"Out": [sn]},
+                {"shape": [1], "dtype": "float32", "value": sval},
+                index=i,
+            )
+            blk.append_op(
+                "fake_quantize_dequantize_moving_average_abs_max",
+                {"X": [n], "InScale": [sn], "InAccum": [zero_n],
+                 "InState": [zero_n]},
+                {"Out": [qname], "OutScale": [oscale],
+                 "OutAccum": [acc_out], "OutState": [st_out]},
+                {"bit_length": activation_bits, "is_test": True},
+                index=i + 1,
+            )
+            return qname, 2
+
+        return _rewrite_quantizable_inputs(program, quantizable_ops,
+                                           insert)
