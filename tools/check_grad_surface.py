@@ -114,14 +114,10 @@ EXEMPT = {
     "split_selected_rows": "selected-rows row-split: gather of identity "
     "(gather swept)",
     # composites of swept cells
-    "lstmp": "lstm scan (swept) + projection matmul (swept)",
     "attention_lstm": "lstm_unit cell (swept) + softmax attention "
     "(softmax/matmul swept); output checked in tests/test_op_surface_r3.py",
-    "inplace_abn": "batch_norm (swept) + in-place activation alias",
     "sync_batch_norm": "batch_norm math (swept) with psum'd batch stats; "
     "cross-device stats covered by dist tests",
-    "hierarchical_sigmoid": "path-gathered sigmoid CE: composition of "
-    "gather (swept) + sigmoid_cross_entropy_with_logits (swept)",
     "box_decoder_and_assign": "box_coder decode (swept) + argmax "
     "assignment (non-differentiable selection)",
     "deformable_psroi_pooling": "deformable_conv bilinear sampling "
