@@ -12,6 +12,24 @@ python -m pytest tests/ -q
 echo "== bench smoke =="
 python bench.py
 
+echo "== observability smoke =="
+python - <<'EOF'
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.data("x", [4, 4])
+    y = layers.scale(x, scale=2.0)
+exe = fluid.Executor()
+exe.run(startup)
+exe.run(main, feed={"x": np.ones((4, 4), "float32")}, fetch_list=[y])
+observability.dump("/tmp/paddle_tpu_obs_snapshot.json")
+EOF
+python tools/stats_report.py /tmp/paddle_tpu_obs_snapshot.json \
+    --require executor.
+
 echo "== driver entry points =="
 python __graft_entry__.py
 

@@ -47,7 +47,8 @@ from . import dygraph  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from . import dataloader  # noqa: F401
 from . import profiler  # noqa: F401
-from . import monitor  # noqa: F401  (runtime stat counters)
+from . import observability  # noqa: F401  (metrics/histograms/spans/exporters)
+from . import monitor  # noqa: F401  (back-compat facade over observability)
 from . import debugger  # noqa: F401  (draw_block_graphviz)
 from . import install_check  # noqa: F401  (run_check)
 from .flags import get_flags, set_flags  # noqa: F401

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -118,6 +119,11 @@ class _WorkerPool:
             raise out
         return out
 
+    def depth(self):
+        """Ready batches currently buffered (observability queue gauge)."""
+        with self._done_lock:
+            return len(self._done)
+
     def close(self):
         self._shutdown = True
         for _ in self._threads:
@@ -167,6 +173,7 @@ class _SingleProcessIter(_DataLoaderIterBase):
         return self
 
     def __next__(self):
+        t0 = time.perf_counter()
         if self._ahead is not None:
             out = self._ahead
             self._ahead = None
@@ -176,9 +183,13 @@ class _SingleProcessIter(_DataLoaderIterBase):
             self._ahead = self._stage(next(self._it))  # stage one ahead
         except StopIteration:
             self._ahead = None
-        from .. import monitor
+        from .. import observability as _obs
 
-        monitor.add("dataloader.batches")  # once per DELIVERED batch
+        # wait = time the consumer blocked in this __next__: producing the
+        # current batch PLUS the synchronous fetch/collate of the look-ahead
+        # (only its device staging is async dispatch)
+        _obs.observe("dataloader.batch_wait", time.perf_counter() - t0)
+        _obs.add("dataloader.batches")  # once per DELIVERED batch
         return out
 
 
@@ -212,7 +223,14 @@ class _MultiWorkerIter(_DataLoaderIterBase):
     def _pull(self):
         if self._next_out >= self._n:
             return None
+        from .. import observability as _obs
+
+        t0 = time.perf_counter()
         out = self._pool.get(self._next_out)
+        _obs.observe("dataloader.batch_wait", time.perf_counter() - t0)
+        # depth of the ready-batch slot AFTER the pop: 0 means the consumer
+        # is outrunning the workers (input-pipeline stall territory)
+        _obs.set_gauge("dataloader.queue_depth", self._pool.depth())
         self._next_out += 1
         if self._next_submit < self._n:
             self._pool.submit(self._next_submit, self._batches[self._next_submit])
@@ -230,6 +248,9 @@ class _MultiWorkerIter(_DataLoaderIterBase):
         if out is None:
             self._pool.close()
             raise StopIteration
+        from .. import observability as _obs
+
+        _obs.add("dataloader.batches")  # once per DELIVERED batch
         return out
 
     def __del__(self):

@@ -288,6 +288,11 @@ class CollectiveOptimizer:
             dp = mesh.shape.get(DATA_AXIS, 1)
             if dp > 1:
                 GradAllReduce(dp).transpile(main, params_grads)
+                from .. import observability as _obs
+
+                _obs.add("collective.grad_allreduce_tensors",
+                         len(params_grads))
+                _obs.set_gauge("collective.dp_degree", dp)
             ops = inner.apply_gradients(params_grads)
             if dp > 1:
                 # fetched metrics (loss) are shard-local means; average them

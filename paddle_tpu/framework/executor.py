@@ -106,24 +106,44 @@ class Executor:
         return_numpy=True,
         use_program_cache=True,
     ):
+        from .. import observability as _obs
+
+        _obs.add("executor.run_steps")
+        with _obs.timed("executor.step_latency"), _obs.span("executor.step"):
+            return self._run_body(
+                program, feed, fetch_list, scope, return_numpy,
+                use_program_cache,
+            )
+
+    def _run_body(
+        self, program, feed, fetch_list, scope, return_numpy,
+        use_program_cache,
+    ):
+        from .. import observability as _obs
+
         # the shared prologue keys the cache on the Program OBJECT
         # (identity hash, strong ref) so a freed Program's recycled id
         # cannot produce a stale hit; _prepared is the single source of
         # the key derivation for run/flops/AOT serialize+load
         (program, scope, block, feed_arrays, _feed_sig, fetch_names,
          key) = self._prepared(program, feed, fetch_list, scope)
-        from .. import monitor
-
-        monitor.add("executor.run_steps")
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
-            monitor.add("executor.compile_count")
-            compiled = self._compile(program, block, set(feed_arrays), fetch_names, scope)
+            if use_program_cache:
+                _obs.add("executor.cache_misses")
+            _obs.add("executor.compile_count")
+            with _obs.timed("executor.compile_time"), \
+                    _obs.span("executor.compile"):
+                compiled = self._compile(
+                    program, block, set(feed_arrays), fetch_names, scope
+                )
             if use_program_cache:
                 self._cache[key] = compiled
                 while len(self._cache) > self.CACHE_CAPACITY:
                     self._cache.popitem(last=False)
+                    _obs.add("executor.cache_evictions")
         else:
+            _obs.add("executor.cache_hits")
             self._cache.move_to_end(key)
 
         state_ro = {n: self._from_scope(scope, n, block) for n in compiled.state_ro}

@@ -473,6 +473,9 @@ def fused_attention_qkv(
     head-split transpose/copy around the kernel. With return_lse=True the
     TILED path returns (out, lse) so a later backward can skip the
     forward re-run; every other path returns (out, None)."""
+    from .. import observability as _obs
+
+    _obs.add("kernels.fused_attention_qkv")
     B, S, three_hd = qkv.shape
     D = three_hd // 3 // num_heads
     if scale is None:
@@ -783,6 +786,9 @@ def fused_attention(
     in q, k, v, key_bias. `rng_key` (a jax PRNG key) feeds dropout; required
     when dropout_rate > 0 and not is_test.
     """
+    from .. import observability as _obs
+
+    _obs.add("kernels.fused_attention")
     B, H, S, D = q.shape
     if scale is None:
         scale = 1.0 / float(np.sqrt(D))

@@ -515,5 +515,8 @@ flash_tiled_outs.defvjp(_flash_tiled_outs_fwd, _flash_tiled_outs_bwd)
 def flash_tiled(qkv, bias, seed, H, D, statics, interpret):
     """out-only wrapper: ONE vjp pair of record (flash_tiled_outs); the
     discarded lse costs nothing extra — the kernel always computes it."""
+    from .. import observability as _obs
+
+    _obs.add("kernels.flash_tiled")
     out, _ = flash_tiled_outs(qkv, bias, seed, H, D, statics, interpret)
     return out

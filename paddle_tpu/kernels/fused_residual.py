@@ -221,6 +221,9 @@ def fused_dropout_add_ln_bwd(x2d, y2d, g, seed, d_out, rate, is_test,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
 def fused_dropout_add_ln(x2d, y2d, g, c, seed, statics, interpret):
+    from .. import observability as _obs
+
+    _obs.add("kernels.fused_dropout_add_ln")
     st = dict(statics)
     return fused_dropout_add_ln_fwd(
         x2d, y2d, g, c, seed, st["rate"], st["is_test"], st["upscale"],

@@ -94,6 +94,9 @@ def _vec_spec(n):
 
 def layer_norm_fwd(x2d, scale, bias, eps, interpret=False):
     """(y, mean, var) over rows of x2d [R, N]; scale/bias [N] or None."""
+    from .. import observability as _obs
+
+    _obs.add("kernels.layer_norm")
     R, N = x2d.shape
     if scale is None:
         scale = jnp.ones((N,), jnp.float32)

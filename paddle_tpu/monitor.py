@@ -1,46 +1,52 @@
-"""Runtime stat counters (VERDICT r3 missing item 3 "runtime
-observability utilities"; the reference grew an equivalent StatRegistry /
-STAT_ADD layer in platform/monitor.h in later releases — absent from this
-v1.8 vintage, so the API here is the minimal registry that layer
-provides: named monotonic counters + gauges, snapshot/reset).
+"""Runtime stat counters — compatible facade over `paddle_tpu.observability`.
 
-Wired-in producers: the Executor bumps `executor.run_steps` and
-`executor.compile_count`; the dataloader bumps `dataloader.batches`.
-Anything else can `monitor.add("my.counter", n)`.
+The seed's minimal StatRegistry API (named monotonic counters + gauges,
+snapshot/reset; reference platform/monitor.h STAT_ADD) is preserved
+verbatim for existing callers; the real registry now lives in
+`observability/` and also provides histograms, `timed()` wall-clock
+timers, host spans with Chrome-trace export, and Prometheus/JSON
+exporters — import `paddle_tpu.observability` for those (the most-used
+ones are re-exported here).
+
+Wired-in producers (see README.md §Observability for the full name
+catalog): the Executor bumps `executor.run_steps`, `executor.compile_count`
+and the cache hit/miss/eviction counters and records the
+`executor.step_latency` / `executor.compile_time` histograms; the
+dataloader bumps `dataloader.batches` and records `dataloader.batch_wait`;
+collectives count ops and payload bytes under `collective.*`. Anything
+else can `monitor.add("my.counter", n)`.
 """
 
 from __future__ import annotations
 
-import threading
-
-_lock = threading.Lock()
-_int_stats: dict[str, int] = {}
-_float_stats: dict[str, float] = {}
+from . import observability as _obs
+from .observability import (  # noqa: F401  (convenience re-exports)
+    dump,
+    observe,
+    prometheus_text,
+    snapshot,
+    span,
+    timed,
+)
 
 
 def add(name: str, value: int = 1) -> None:
     """STAT_ADD: bump the integer counter `name` by value."""
-    with _lock:
-        _int_stats[name] = _int_stats.get(name, 0) + int(value)
+    _obs.add(name, value)
 
 
 def set_float(name: str, value: float) -> None:
     """Gauge write (STAT_RESET/float stat)."""
-    with _lock:
-        _float_stats[name] = float(value)
+    _obs.set_gauge(name, value)
 
 
 def get_int_stats() -> dict[str, int]:
-    with _lock:
-        return dict(_int_stats)
+    return _obs.get_counters()
 
 
 def get_float_stats() -> dict[str, float]:
-    with _lock:
-        return dict(_float_stats)
+    return _obs.get_gauges()
 
 
 def reset() -> None:
-    with _lock:
-        _int_stats.clear()
-        _float_stats.clear()
+    _obs.reset()

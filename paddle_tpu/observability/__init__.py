@@ -1,0 +1,56 @@
+"""Runtime observability: counters/gauges/histograms, host spans, exporters.
+
+Grown from the seed's `monitor.py` two-counter registry (kept as a
+compatible facade) into the telemetry layer a TPU training stack needs to
+diagnose "fast as the hardware allows": the executor records a
+step-latency histogram, per-program compile time and executable-cache
+hits/misses/evictions; the dataloader records batch wait time and queue
+depth; the collective/SPMD/pipeline layers record op counts and payload
+bytes by kind; Pallas kernel entry points record invocation counts.
+
+Reading it out:
+  * ``snapshot()`` / ``dump(path)`` — structured JSON (pretty-print with
+    ``tools/stats_report.py``);
+  * ``prometheus_text()`` — text exposition for scraping;
+  * ``chrome_trace()`` / ``tools.timeline.Timeline(dir,
+    include_host_spans=True)`` — host spans as Chrome-trace JSON, alone or
+    merged with a jax.profiler device capture.
+
+Kill-switch: ``PADDLE_TPU_MONITOR=0`` in the environment makes every hook
+a no-op (``set_enabled`` flips it at runtime; ``set_enabled(None)``
+re-reads the env). Per-op timing tables and traffic counters here are the
+raw features learned TPU cost models consume (PAPERS.md: "A Learned
+Performance Model for TPUs", "Operator Fusion in XLA").
+
+Canonical metric names are documented in README.md §Observability.
+"""
+
+from __future__ import annotations
+
+from . import export, metrics, spans  # noqa: F401
+from .export import dump, prometheus_text, snapshot  # noqa: F401
+from .metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    add,
+    enabled,
+    get_counters,
+    get_gauges,
+    get_histograms,
+    observe,
+    set_enabled,
+    set_gauge,
+    timed,
+)
+from .spans import (  # noqa: F401
+    chrome_trace,
+    get_spans,
+    save_chrome_trace,
+    span,
+    span_count,
+)
+
+
+def reset() -> None:
+    """Clear every counter/gauge/histogram and the span buffer."""
+    metrics.reset()
+    spans.reset()

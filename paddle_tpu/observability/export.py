@@ -1,0 +1,64 @@
+"""Exporters: structured JSON snapshot and Prometheus text exposition.
+
+`snapshot()` is the one authoritative read: every counter, gauge and
+histogram plus the span-buffer depth, in plain JSON types so `dump(path)`
+is loadable by anything (tools/stats_report.py pretty-prints it).
+`prometheus_text()` renders the same state in the text exposition format
+(metric names sanitized to [a-zA-Z0-9_:], histogram buckets cumulative
+with the canonical _bucket/_sum/_count triple) for scraping.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from . import metrics, spans
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    name = _NAME_RE.sub("_", name)
+    return name if not name[:1].isdigit() else "_" + name
+
+
+def snapshot() -> dict:
+    """Structured view of every metric: {"counters", "gauges",
+    "histograms", "span_count"}."""
+    return {
+        "counters": metrics.get_counters(),
+        "gauges": metrics.get_gauges(),
+        "histograms": metrics.get_histograms(),
+        "span_count": spans.span_count(),
+    }
+
+
+def dump(path: str, pretty: bool = True) -> str:
+    """Write the JSON snapshot to `path`; returns the path."""
+    with open(path, "w") as f:
+        json.dump(snapshot(), f, indent=2 if pretty else None, sort_keys=True)
+    return path
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition of the current registry state."""
+    out = []
+    snap = snapshot()
+    for name, value in sorted(snap["counters"].items()):
+        pn = _prom_name(name)
+        out.append(f"# TYPE {pn} counter")
+        out.append(f"{pn} {value}")
+    for name, value in sorted(snap["gauges"].items()):
+        pn = _prom_name(name)
+        out.append(f"# TYPE {pn} gauge")
+        out.append(f"{pn} {value}")
+    for name, h in sorted(snap["histograms"].items()):
+        pn = _prom_name(name)
+        out.append(f"# TYPE {pn} histogram")
+        for le, cum in h["buckets"]:
+            le_s = le if isinstance(le, str) else repr(float(le))
+            out.append(f'{pn}_bucket{{le="{le_s}"}} {cum}')
+        out.append(f"{pn}_sum {h['sum']}")
+        out.append(f"{pn}_count {h['count']}")
+    return "\n".join(out) + "\n"

@@ -1,0 +1,129 @@
+"""Host spans: named wall-clock regions in a bounded ring buffer.
+
+`span("name")` is the app-level sibling of profiler.RecordEvent: where
+RecordEvent only annotates an *active* jax.profiler capture, spans record
+always (unless the monitor kill-switch is off) into a deque capped at
+PADDLE_TPU_SPAN_BUFFER entries (default 4096) — old spans fall off, a
+long-running trainer never grows memory.
+
+Export goes through tools/timeline._ChromeTraceFormatter, so host spans
+are ordinary Chrome-trace "X" events: load them alone (`chrome_trace()`)
+or merged with a jax.profiler device capture
+(`tools.timeline.Timeline(dir, include_host_spans=True)`) in one
+Perfetto-loadable JSON.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import os
+import threading
+import time
+
+from . import metrics
+
+try:
+    # clamp: deque(maxlen=negative) raises; malformed env must not break
+    # `import paddle_tpu`
+    _MAX_SPANS = max(0, int(os.environ.get("PADDLE_TPU_SPAN_BUFFER", "4096")))
+except ValueError:
+    _MAX_SPANS = 4096
+_lock = threading.Lock()
+_spans: collections.deque = collections.deque(maxlen=_MAX_SPANS)
+
+
+class _Span:
+    """Context manager AND decorator recording one ring-buffer span."""
+
+    __slots__ = ("name", "category", "args", "_wall_us", "_t0")
+
+    def __init__(self, name, category="host", args=None):
+        self.name = name
+        self.category = category
+        self.args = args or {}
+        self._t0 = None
+
+    def __enter__(self):
+        if metrics.enabled():
+            self._wall_us = time.time_ns() / 1e3
+            self._t0 = time.perf_counter_ns()
+        else:
+            self._t0 = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None:
+            dur_us = (time.perf_counter_ns() - self._t0) / 1e3
+            rec = {
+                "name": self.name,
+                "cat": self.category,
+                "ts": self._wall_us,
+                "dur": dur_us,
+                "tid": threading.get_ident(),
+                "args": self.args,
+            }
+            with _lock:
+                _spans.append(rec)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _Span(self.name, self.category, self.args):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def span(name: str, category: str = "host", **args) -> _Span:
+    """``with span("executor.step", step=i): ...`` or ``@span("f")``."""
+    return _Span(name, category, args)
+
+
+def get_spans() -> list[dict]:
+    with _lock:
+        return list(_spans)
+
+
+def span_count() -> int:
+    with _lock:
+        return len(_spans)
+
+
+def reset() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def emit_into(fmt, pid: int = 0) -> None:
+    """Write the buffered spans into a _ChromeTraceFormatter as process
+    `pid`, one trace tid per host thread."""
+    recs = get_spans()
+    fmt.emit_pid("paddle_tpu host spans", pid)
+    tids: dict[int, int] = {}
+    for rec in recs:
+        tid = tids.setdefault(rec["tid"], len(tids))
+    for native_tid, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+        fmt.emit_tid(f"thread-{native_tid}", pid, tid)
+    for rec in recs:
+        fmt.emit_region(
+            rec["ts"], rec["dur"], pid, tids[rec["tid"]], rec["cat"],
+            rec["name"], rec["args"],
+        )
+
+
+def chrome_trace(pretty: bool = False) -> str:
+    """Buffered spans alone as Chrome-trace JSON ("M" metadata + "X"
+    duration events; chrome://tracing / Perfetto loadable)."""
+    from ..tools.timeline import _ChromeTraceFormatter
+
+    fmt = _ChromeTraceFormatter()
+    emit_into(fmt, pid=0)
+    return fmt.format_to_string(pretty)
+
+
+def save_chrome_trace(path: str, pretty: bool = False) -> str:
+    with open(path, "w") as f:
+        f.write(chrome_trace(pretty))
+    return path

@@ -30,11 +30,31 @@ def _axis(ctx, op):
     return name if name in ctx.mesh_axes else None
 
 
+def _record(kind, x, ax):
+    """Count the collective and its per-shard payload bytes by kind.
+
+    Emitters run at TRACE time, so these counters advance once per program
+    compile (per collective op in the block), not once per device step —
+    the right granularity for "how much ICI traffic does one step issue",
+    since the compiled step replays the same collectives every run."""
+    if ax is None:
+        return
+    from .. import observability as _obs
+
+    _obs.add(f"collective.{kind}")
+    try:
+        nbytes = int(x.size) * x.dtype.itemsize
+    except (AttributeError, TypeError):
+        return
+    _obs.add(f"collective.{kind}.bytes", nbytes)
+
+
 def _register_allreduce(op_type, reducer):
     @register_op(op_type, inputs=["X"], outputs=["Out"], differentiable=False)
     def emit(ctx, op, ins):
         x = ins["X"][0]
         ax = _axis(ctx, op)
+        _record(op_type, x, ax)
         return {"Out": [x if ax is None else reducer(x, ax)]}
 
     return emit
@@ -60,6 +80,7 @@ def _mp_allreduce_sum(ctx, op, ins):
     while scaling the cotangent down (same trick as pipeline.py:196)."""
     x = ins["X"][0]
     ax = _axis(ctx, op)
+    _record("mp_allreduce_sum", x, ax)
     if ax is None:
         return {"Out": [x]}
     n = ctx.axis_sizes[ax]
@@ -71,6 +92,7 @@ def _mp_allreduce_sum(ctx, op, ins):
 def _c_broadcast(ctx, op, ins):
     x = ins["X"][0]
     ax = _axis(ctx, op)
+    _record("c_broadcast", x, ax)
     if ax is None:
         return {"Out": [x]}
     root = op.attr("root", 0)
@@ -83,6 +105,7 @@ def _c_broadcast(ctx, op, ins):
 def _c_allgather(ctx, op, ins):
     x = ins["X"][0]
     ax = _axis(ctx, op)
+    _record("c_allgather", x, ax)
     if ax is None:
         return {"Out": [x]}
     out = lax.all_gather(x, ax)  # [nranks, ...]
@@ -95,6 +118,7 @@ def _c_allgather(ctx, op, ins):
 def _c_reducescatter(ctx, op, ins):
     x = ins["X"][0]
     ax = _axis(ctx, op)
+    _record("c_reducescatter", x, ax)
     if ax is None:
         return {"Out": [x]}
     return {"Out": [lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)]}
@@ -104,6 +128,7 @@ def _c_reducescatter(ctx, op, ins):
 def _alltoall(ctx, op, ins):
     x = ins["X"][0]
     ax = _axis(ctx, op)
+    _record("alltoall", x, ax)
     if ax is None:
         return {"Out": [x]}
     n = lax.axis_size(ax)
@@ -118,6 +143,7 @@ def _alltoall(ctx, op, ins):
 def _collective_permute(ctx, op, ins):
     x = ins["X"][0]
     ax = _axis(ctx, op)
+    _record("collective_permute", x, ax)
     if ax is None:
         return {"Out": [x]}
     n = lax.axis_size(ax)
@@ -154,6 +180,7 @@ def _c_comm_init_all(ctx, op, ins):
 def _barrier(ctx, op, ins):
     x = ins["X"][0] if ins.get("X") and ins["X"][0] is not None else jnp.zeros([1])
     ax = _axis(ctx, op)
+    _record("barrier", None, ax)  # zero-payload sync: count the op, no bytes
     if ax is None:
         return {"Out": [x]}
     return {"Out": [x + 0 * lax.psum(jnp.zeros([1], x.dtype), ax)]}

@@ -82,6 +82,9 @@ def _pipeline_block(ctx, op, ins):
     feeds = dict(zip(feed_names, ins.get("Feeds", [])))
     extern = dict(zip(extern_names, ins.get("Extern", [])))
 
+    from .. import observability as _obs
+
+    _obs.add("collective.pipeline_microbatches", M)
     if axis not in ctx.mesh_axes:
         # single-device degrade: run the stages sequentially per microbatch
         # (identical numerics, no pipeline) — reference nranks==1 behavior
@@ -134,6 +137,15 @@ def _pipeline_block(ctx, op, ins):
         )
     b_shape = (full[0] // M,) + full[1:]
     b_dtype = np.dtype(op.attr("boundary_dtype"))
+
+    # per-step ICI boundary traffic: one ppermute of the microbatch
+    # activation per schedule tick (trace-time count, like ops/collective.py)
+    ticks = M + K - 1
+    _obs.add("collective.pipeline_ppermute", ticks)
+    _obs.add(
+        "collective.pipeline_ppermute.bytes",
+        ticks * int(np.prod(b_shape)) * b_dtype.itemsize,
+    )
 
     def make_stage_fn(k):
         blk = prog.blocks[stage_blocks[k]]

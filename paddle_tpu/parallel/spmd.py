@@ -20,6 +20,28 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+def _shard_map(f, mesh, in_specs, out_specs, check_vma=False,
+               axis_names=None):
+    """Version-compat shard_map: new-style jax.shard_map (check_vma /
+    axis_names) when present, else jax.experimental.shard_map.shard_map
+    (check_rep, and `auto` = the COMPLEMENT of axis_names)."""
+    if hasattr(jax, "shard_map"):
+        kw = {"axis_names": axis_names} if axis_names is not None else {}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kw,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kw = {}
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma, **kw,
+    )
+
+
 def spec_for(program, name) -> P:
     s = program._sharding.get(name)
     if not s:
@@ -136,21 +158,24 @@ def wrap_shard_map(
             tuple(body_spec(n) for n in fetch_names),
             {n: body_spec(n) for n in write_back},
         )
-        kw = {"axis_names": manual} if partial_manual else {}
-        sm = jax.shard_map(
+        sm = _shard_map(
             traced,
             mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs,
             check_vma=False,
-            **kw,
+            axis_names=manual if partial_manual else None,
         )
         return sm(feeds, smut, sro, step_key)
 
     jitted = jax.jit(run, donate_argnums=(1,))
     multiproc = _spans_processes(mesh)
+    from .. import observability as _obs
+
+    _obs.set_gauge("collective.mesh_devices", mesh.size)
 
     def fn(feeds, smut, sro, step_key):
+        _obs.add("collective.shard_map_dispatches")
         feeds = {
             k: stage_global(v, mesh, spec_for(program, k), multiproc)
             for k, v in feeds.items()
@@ -196,6 +221,9 @@ def wrap_gspmd(
 
     jitted = jax.jit(traced, donate_argnums=(1,))
     multiproc = _spans_processes(mesh)
+    from .. import observability as _obs
+
+    _obs.set_gauge("collective.mesh_devices", mesh.size)
 
     def put(k, v):
         # multi-process gspmd convention: every process holds the FULL
@@ -207,6 +235,7 @@ def wrap_gspmd(
         )
 
     def fn(feeds, smut, sro, step_key):
+        _obs.add("collective.gspmd_dispatches")
         feeds = {k: put(k, v) for k, v in feeds.items()}
         smut = {k: put(k, v) for k, v in smut.items()}
         sro = {k: put(k, v) for k, v in sro.items()}

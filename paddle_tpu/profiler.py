@@ -37,8 +37,10 @@ def stop_profiler(sorted_key=None, profile_path=None):
     global _active_dir
     import jax
 
-    jax.profiler.stop_trace()
+    # clear _active_dir BEFORE stop_trace: if the runtime raises mid-stop,
+    # a later start_profiler must not see a phantom active session
     out_dir, _active_dir = _active_dir, None
+    jax.profiler.stop_trace()
     table = summary(out_dir)
     if table:
         print(_format_table(table))
@@ -80,6 +82,15 @@ def cuda_profiler(*args, **kwargs):  # pragma: no cover - API parity shim
     )
 
 
+def _op_kind(name):
+    """Base op kind of an xplane event name: the leading identifier chars —
+    digits included, so `fusion.2`, `all-reduce.1` and names *starting* with
+    a digit all aggregate by base kind (XLA's `.<id>` instance suffix stops
+    at the dot); anything unmatched falls back to 24-char truncation."""
+    m = re.match(r"%?([a-zA-Z0-9\-_]+)", name)
+    return m.group(1) if m else name[:24]
+
+
 def summary(trace_dir):
     """Aggregate device-op time from the xplane capture: returns
     [(op_kind, total_ms, count)] sorted by time (the reference's
@@ -91,14 +102,14 @@ def summary(trace_dir):
     )
     if not files:
         return []
-    pd = ProfileData.from_serialized_xspace(open(files[-1], "rb").read())
+    with open(files[-1], "rb") as f:
+        pd = ProfileData.from_serialized_xspace(f.read())
 
     def collect(planes_lines):
         agg = {}
         for plane, line in planes_lines:
             for ev in line.events:
-                m = re.match(r"%?([a-zA-Z\-_]+)", ev.name)
-                kind = m.group(1) if m else ev.name[:24]
+                kind = _op_kind(ev.name)
                 t, c = agg.get(kind, (0, 0))
                 agg[kind] = (t + ev.duration_ns, c + 1)
         return agg

@@ -45,8 +45,12 @@ class _ChromeTraceFormatter:
 
 
 class Timeline:
-    def __init__(self, trace_dir):
+    def __init__(self, trace_dir, include_host_spans=False):
         self.trace_dir = trace_dir
+        # merge the observability span ring buffer as an extra process, so
+        # app-level spans (executor.step, fleet.minimize, user span()s) land
+        # in ONE Perfetto-loadable JSON next to the device trace
+        self.include_host_spans = include_host_spans
 
     def generate_chrome_trace(self):
         from jax.profiler import ProfileData
@@ -61,9 +65,12 @@ class Timeline:
             raise FileNotFoundError(
                 f"no xplane capture under {self.trace_dir}"
             )
-        pd = ProfileData.from_serialized_xspace(open(files[-1], "rb").read())
+        with open(files[-1], "rb") as f:
+            pd = ProfileData.from_serialized_xspace(f.read())
         fmt = _ChromeTraceFormatter()
+        n_planes = 0
         for pid, plane in enumerate(pd.planes):
+            n_planes = pid + 1
             fmt.emit_pid(plane.name, pid)
             for tid, line in enumerate(plane.lines):
                 fmt.emit_tid(line.name, pid, tid)
@@ -76,6 +83,10 @@ class Timeline:
                         "op",
                         ev.name[:120],
                     )
+        if self.include_host_spans:
+            from ..observability import spans as _spans
+
+            _spans.emit_into(fmt, pid=n_planes)
         return fmt.format_to_string()
 
     def save(self, path):

@@ -23,6 +23,12 @@ import copy  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (tier-1 verify runs -m 'not slow')"
+    )
+
+
 @pytest.fixture(autouse=True)
 def _isolate_global_state():
     """Snapshot/restore every piece of process-global framework state so a
@@ -33,8 +39,15 @@ def _isolate_global_state():
     from paddle_tpu.framework import program as _prog
     from paddle_tpu.framework import scope as _scope
     from paddle_tpu.framework import unique_name as _un
+    from paddle_tpu.observability import metrics as _met
+    from paddle_tpu.observability import spans as _spans
     from paddle_tpu.parallel import mesh as _mesh
 
+    saved_metrics = copy.deepcopy(
+        (_met._counters, _met._gauges, _met._histograms)
+    )
+    saved_enabled = _met._enabled
+    saved_spans = list(_spans._spans)
     saved_flags = copy.deepcopy(_flags._FLAGS)
     saved_mesh = _mesh._current_mesh
     saved_scope = _scope._current_scope
@@ -45,6 +58,14 @@ def _isolate_global_state():
     try:
         yield
     finally:
+        for store, saved in zip(
+            (_met._counters, _met._gauges, _met._histograms), saved_metrics
+        ):
+            store.clear()
+            store.update(saved)
+        _met._enabled = saved_enabled
+        _spans._spans.clear()
+        _spans._spans.extend(saved_spans)
         _flags._FLAGS.clear()
         _flags._FLAGS.update(saved_flags)
         _mesh._current_mesh = saved_mesh

@@ -1,0 +1,430 @@
+"""Observability subsystem: histogram/timer primitives, span -> Chrome-trace
+round-trip, exporters, the PADDLE_TPU_MONITOR=0 kill-switch, and the
+instrumented executor / dataloader / collective hot paths.
+
+Reference role: platform/monitor.h StatRegistry + tools/timeline.py, grown
+into the histogram/span/export layer (ISSUE 1)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability as obs
+from paddle_tpu.framework import unique_name
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    obs.reset()
+    obs.set_enabled(True)
+    yield
+    obs.reset()
+    obs.set_enabled(None)  # back to the environment's setting
+
+
+@pytest.fixture
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+# -- primitives --------------------------------------------------------------
+
+
+def test_histogram_bucket_edges():
+    """Edges are inclusive (value <= le) and snapshot buckets cumulative."""
+    for v in (0.5, 1.0, 1.5, 4.0, 9.0):
+        obs.observe("h.edges", v, buckets=(1.0, 2.0, 4.0))
+    h = obs.snapshot()["histograms"]["h.edges"]
+    assert h["count"] == 5
+    assert h["sum"] == pytest.approx(16.0)
+    assert h["min"] == 0.5 and h["max"] == 9.0
+    assert h["buckets"] == [[1.0, 2], [2.0, 3], [4.0, 4], ["+Inf", 5]]
+
+
+def test_timed_context_and_decorator():
+    with obs.timed("t.ctx"):
+        pass
+
+    @obs.timed("t.fn")
+    def f(a, b):
+        return a + b
+
+    assert f(2, 3) == 5
+    assert f(4, 5) == 9
+    hists = obs.snapshot()["histograms"]
+    assert hists["t.ctx"]["count"] == 1
+    assert hists["t.fn"]["count"] == 2
+    assert hists["t.fn"]["sum"] >= 0.0
+
+
+def test_timed_records_on_exception():
+    with pytest.raises(ValueError):
+        with obs.timed("t.err"):
+            raise ValueError("boom")
+    assert obs.snapshot()["histograms"]["t.err"]["count"] == 1
+
+
+def test_monitor_facade_back_compat():
+    from paddle_tpu import monitor
+
+    monitor.add("compat.counter", 2)
+    monitor.add("compat.counter")
+    monitor.set_float("compat.gauge", 1.5)
+    assert monitor.get_int_stats()["compat.counter"] == 3
+    assert monitor.get_float_stats()["compat.gauge"] == 1.5
+    monitor.reset()
+    assert monitor.get_int_stats() == {}
+
+
+def test_thread_safety_concurrent_add_observe_snapshot():
+    """Exact totals under 8 writer threads racing snapshot readers."""
+    n_threads, n_iter = 8, 500
+    stop = threading.Event()
+
+    def writer():
+        for _ in range(n_iter):
+            obs.add("ts.counter")
+            obs.observe("ts.hist", 1.0, buckets=(0.5, 2.0))
+
+    def reader():
+        while not stop.is_set():
+            obs.snapshot()
+
+    threads = [threading.Thread(target=writer) for _ in range(n_threads)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    r.join()
+    snap = obs.snapshot()
+    assert snap["counters"]["ts.counter"] == n_threads * n_iter
+    h = snap["histograms"]["ts.hist"]
+    assert h["count"] == n_threads * n_iter
+    assert h["buckets"][-1][1] == h["count"]
+
+
+def test_thread_safety_concurrent_reset_does_not_corrupt():
+    """add/reset races must never raise or leave negative/garbage state."""
+    def writer():
+        for _ in range(300):
+            obs.add("tr.counter")
+            obs.observe("tr.hist", 0.1)
+
+    def resetter():
+        for _ in range(50):
+            obs.reset()
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    threads.append(threading.Thread(target=resetter))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = obs.snapshot()
+    assert 0 <= snap["counters"].get("tr.counter", 0) <= 1200
+    h = snap["histograms"].get("tr.hist")
+    if h is not None:
+        assert h["buckets"][-1][1] == h["count"]
+
+
+# -- spans -------------------------------------------------------------------
+
+
+def test_span_chrome_trace_round_trip():
+    with obs.span("outer", step=1):
+        with obs.span("inner"):
+            pass
+    data = json.loads(obs.chrome_trace())
+    events = data["traceEvents"]
+    metas = [e for e in events if e["ph"] == "M"]
+    regions = [e for e in events if e["ph"] == "X"]
+    assert metas and regions
+    names = {e["name"] for e in regions}
+    assert {"outer", "inner"} <= names
+    outer = next(e for e in regions if e["name"] == "outer")
+    inner = next(e for e in regions if e["name"] == "inner")
+    assert outer["args"] == {"step": 1}
+    assert outer["dur"] >= inner["dur"]
+    assert {"ts", "dur", "pid", "tid", "cat"} <= set(outer)
+
+
+def test_span_decorator_and_ring_buffer_bound():
+    @obs.span("decorated")
+    def f():
+        return 7
+
+    assert f() == 7
+    assert any(s["name"] == "decorated" for s in obs.get_spans())
+    from paddle_tpu.observability import spans as spans_mod
+
+    assert spans_mod._spans.maxlen is not None  # bounded ring, never grows
+
+
+def test_save_chrome_trace(tmp_path):
+    with obs.span("persisted"):
+        pass
+    path = obs.save_chrome_trace(str(tmp_path / "trace.json"))
+    data = json.loads(open(path).read())
+    assert any(e["name"] == "persisted" for e in data["traceEvents"])
+
+
+# -- exporters ---------------------------------------------------------------
+
+
+def test_prometheus_text_exposition():
+    obs.add("prom.counter", 3)
+    obs.set_gauge("prom.gauge", 2.5)
+    obs.observe("prom.lat", 0.3, buckets=(0.25, 1.0))
+    text = obs.prometheus_text()
+    assert "# TYPE prom_counter counter" in text
+    assert "prom_counter 3" in text
+    assert "# TYPE prom_gauge gauge" in text
+    assert 'prom_lat_bucket{le="1.0"} 1' in text
+    assert 'prom_lat_bucket{le="+Inf"} 1' in text
+    assert "prom_lat_count 1" in text
+
+
+def test_dump_and_stats_report_cli(tmp_path):
+    obs.add("cli.counter")
+    obs.observe("cli.hist", 0.5)
+    path = obs.dump(str(tmp_path / "snap.json"))
+    snap = json.loads(open(path).read())
+    assert snap["counters"]["cli.counter"] == 1
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "stats_report.py"),
+         path, "--require", "cli."],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "cli.counter" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "stats_report.py"),
+         path, "--require", "absent."],
+        capture_output=True, text=True,
+    )
+    assert r2.returncode == 2
+
+
+# -- kill-switch -------------------------------------------------------------
+
+
+def test_kill_switch_in_process():
+    obs.set_enabled(False)
+    obs.add("dead.counter")
+    obs.set_gauge("dead.gauge", 1.0)
+    obs.observe("dead.hist", 1.0)
+    with obs.timed("dead.timer"):
+        pass
+    with obs.span("dead.span"):
+        pass
+    snap = obs.snapshot()
+    assert snap["counters"] == {}
+    assert snap["gauges"] == {}
+    assert snap["histograms"] == {}
+    assert snap["span_count"] == 0
+
+
+@pytest.mark.slow
+def test_kill_switch_env_subprocess():
+    """PADDLE_TPU_MONITOR=0 at process start: every hook is a no-op even
+    across an instrumented executor run."""
+    script = (
+        "import numpy as np\n"
+        "import paddle_tpu as fluid\n"
+        "from paddle_tpu import layers, observability as obs\n"
+        "main, startup = fluid.Program(), fluid.Program()\n"
+        "with fluid.program_guard(main, startup):\n"
+        "    x = fluid.data('x', [2, 2])\n"
+        "    y = layers.scale(x, scale=2.0)\n"
+        "exe = fluid.Executor()\n"
+        "exe.run(startup)\n"
+        "exe.run(main, feed={'x': np.zeros((2, 2), 'float32')},"
+        " fetch_list=[y])\n"
+        "import json; print(json.dumps(obs.snapshot()))\n"
+    )
+    env = dict(os.environ, PADDLE_TPU_MONITOR="0", JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    snap = json.loads(r.stdout.strip().splitlines()[-1])
+    assert snap["counters"] == {} and snap["histograms"] == {}
+
+
+# -- instrumented hot paths --------------------------------------------------
+
+
+def test_executor_step_and_cache_metrics(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [2, 2])
+    y = layers.scale(x, scale=3.0)
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    for _ in range(3):
+        exe.run(main, feed={"x": np.zeros((2, 2), "float32")},
+                fetch_list=[y], scope=scope)
+    snap = obs.snapshot()
+    c = snap["counters"]
+    assert c["executor.run_steps"] == 4
+    assert c["executor.compile_count"] == 2  # startup + one main step
+    assert c["executor.cache_misses"] == 2
+    assert c["executor.cache_hits"] == 2  # steps 2 and 3
+    assert snap["histograms"]["executor.step_latency"]["count"] == 4
+    assert snap["histograms"]["executor.compile_time"]["count"] == 2
+    # hit rate derivable from ONE snapshot (ISSUE satellite)
+    assert c["executor.cache_hits"] + c["executor.cache_misses"] \
+        == c["executor.run_steps"]
+    # step spans landed in the ring buffer
+    names = [s["name"] for s in obs.get_spans()]
+    assert names.count("executor.step") == 4
+    assert names.count("executor.compile") == 2
+
+
+def test_executor_cache_eviction_counter(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [2, 2])
+    y = layers.scale(x, scale=2.0)
+    exe = fluid.Executor()
+    exe.CACHE_CAPACITY = 1
+    exe.run(startup, scope=scope)
+    exe.run(main, feed={"x": np.zeros((2, 2), "float32")},
+            fetch_list=[y], scope=scope)
+    # startup's executable was evicted to make room for the main step
+    assert obs.snapshot()["counters"]["executor.cache_evictions"] >= 1
+
+
+def test_dataloader_metrics():
+    from paddle_tpu.dataloader import Dataset
+
+    class _Sq(Dataset):
+        def __getitem__(self, i):
+            return np.asarray([i], dtype=np.float32)
+
+        def __len__(self):
+            return 12
+
+    n = sum(1 for _ in fluid.DataLoader(
+        _Sq(), batch_size=3, use_buffer_reader=False))
+    assert n == 4
+    snap = obs.snapshot()
+    assert snap["counters"]["dataloader.batches"] == 4
+    assert snap["histograms"]["dataloader.batch_wait"]["count"] == 4
+
+    obs.reset()
+    n = sum(1 for _ in fluid.DataLoader(
+        _Sq(), batch_size=3, num_workers=2, use_buffer_reader=False))
+    assert n == 4
+    snap = obs.snapshot()
+    assert snap["counters"]["dataloader.batches"] == 4
+    assert snap["histograms"]["dataloader.batch_wait"]["count"] == 4
+    assert "dataloader.queue_depth" in snap["gauges"]
+
+
+def test_collective_counters_on_mesh(fresh_programs):
+    from paddle_tpu.parallel import make_mesh, shard_program
+
+    main, startup, scope = fresh_programs
+    fluid.data("x", [8, 4], "float32")
+    blk = main.global_block
+    blk.create_var(name="out", shape=(8, 4), dtype="float32")
+    blk.append_op(
+        "c_allreduce_sum",
+        inputs={"X": ["x"]},
+        outputs={"Out": ["out"]},
+        attrs={"axis_name": "dp"},
+    )
+    mesh = make_mesh({"dp": 8})
+    shard_program(main, mesh, {"x": ("dp",), "out": ("dp",)})
+    exe = fluid.Executor()
+    data = np.arange(32, dtype="float32").reshape(8, 4)
+    exe.run(main, feed={"x": data}, fetch_list=["out"], scope=scope)
+    c = obs.snapshot()["counters"]
+    assert c["collective.c_allreduce_sum"] >= 1
+    # per-shard payload: [1, 4] float32 = 16 bytes per traced emission
+    assert c["collective.c_allreduce_sum.bytes"] >= 16
+    assert c["collective.shard_map_dispatches"] >= 1
+    assert obs.snapshot()["gauges"]["collective.mesh_devices"] == 8
+
+
+def test_one_step_train_snapshot_end_to_end(fresh_programs, tmp_path):
+    """Acceptance: one fleet training step + a dataloader pull, then
+    dump() -> snapshot holds an executor.* histogram, a dataloader.*
+    metric, and a collective.* counter."""
+    from paddle_tpu.dataloader import Dataset
+    from paddle_tpu.fleet.collective import DistributedStrategy, fleet
+
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [8, 4])
+    y = layers.fc(x, 1)
+    loss = layers.reduce_mean(y)
+    fleet.init()
+    strategy = DistributedStrategy()
+    strategy.mesh_axes = {"dp": 8}
+    opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.1), strategy)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+
+    class _Ds(Dataset):
+        def __getitem__(self, i):
+            return np.ones((4,), dtype=np.float32)
+
+        def __len__(self):
+            return 8
+
+    for batch in fluid.DataLoader(_Ds(), batch_size=8,
+                                  use_buffer_reader=False):
+        exe.run(main, feed={"x": np.stack(batch)}, fetch_list=[loss],
+                scope=scope)
+    snap = json.loads(open(obs.dump(str(tmp_path / "snap.json"))).read())
+    assert any(k.startswith("executor.") for k in snap["histograms"])
+    assert any(k.startswith("dataloader.") for k in snap["counters"])
+    assert any(k.startswith("collective.") for k in snap["counters"])
+    assert snap["counters"]["collective.grad_allreduce_tensors"] >= 1
+    assert snap["gauges"]["collective.dp_degree"] == 8
+
+
+# -- profiler satellites -----------------------------------------------------
+
+
+def test_profiler_op_kind_digits_and_ids():
+    from paddle_tpu.profiler import _op_kind
+
+    assert _op_kind("fusion.2") == "fusion"
+    assert _op_kind("all-reduce.1") == "all-reduce"
+    assert _op_kind("%convolution.37") == "convolution"
+    # names starting with a digit must not fall into 24-char truncation
+    assert _op_kind("2d_transpose.4") == "2d_transpose"
+    assert _op_kind("log1p.3") == "log1p"
+
+
+def test_stop_profiler_resets_active_dir_on_error(monkeypatch):
+    import jax
+
+    import paddle_tpu.profiler as prof
+
+    monkeypatch.setattr(prof, "_active_dir", "/tmp/phantom_prof")
+
+    def boom():
+        raise RuntimeError("runtime stop failure")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", boom)
+    with pytest.raises(RuntimeError, match="runtime stop failure"):
+        prof.stop_profiler()
+    assert prof._active_dir is None  # no phantom active session left behind

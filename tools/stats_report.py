@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Pretty-print a paddle_tpu observability snapshot.
+
+Usage:
+    python tools/stats_report.py SNAPSHOT.json [--require PREFIX ...]
+
+SNAPSHOT.json is the file written by `paddle_tpu.observability.dump(path)`
+(counters / gauges / histograms / span_count). `--require PREFIX` (repeatable)
+exits nonzero unless at least one metric name starts with PREFIX — the CI
+guard that instrumentation did not silently go dead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_BARS = " ▁▂▃▄▅▆▇█"
+
+
+def _sparkline(hist):
+    """Non-cumulative bucket counts as a unicode mini-bar chart."""
+    cum = [c for _, c in hist["buckets"]]
+    per = [c - p for c, p in zip(cum, [0] + cum[:-1])]
+    peak = max(per) if per and max(per) > 0 else 1
+    return "".join(_BARS[round(c / peak * (len(_BARS) - 1))] for c in per)
+
+
+def render(snap):
+    lines = []
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+    lines.append("==== paddle_tpu observability snapshot ====")
+    if counters:
+        lines.append(f"-- counters ({len(counters)}) --")
+        width = max(len(n) for n in counters)
+        for name in sorted(counters):
+            lines.append(f"  {name:<{width}}  {counters[name]:>14}")
+    if gauges:
+        lines.append(f"-- gauges ({len(gauges)}) --")
+        width = max(len(n) for n in gauges)
+        for name in sorted(gauges):
+            lines.append(f"  {name:<{width}}  {gauges[name]:>14.6g}")
+    if hists:
+        lines.append(f"-- histograms ({len(hists)}) --")
+        for name in sorted(hists):
+            h = hists[name]
+            n = h["count"]
+            mean = h["sum"] / n if n else 0.0
+            lines.append(
+                f"  {name}: count={n} sum={h['sum']:.6g} mean={mean:.6g} "
+                f"min={h['min']} max={h['max']}  |{_sparkline(h)}|"
+            )
+    lines.append(f"span buffer: {snap.get('span_count', 0)} spans")
+    if not (counters or gauges or hists):
+        lines.append("(snapshot is empty — PADDLE_TPU_MONITOR=0, or nothing "
+                     "instrumented ran)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("snapshot", help="JSON file from observability.dump()")
+    ap.add_argument(
+        "--require", action="append", default=[], metavar="PREFIX",
+        help="fail unless some metric name starts with PREFIX (repeatable)",
+    )
+    args = ap.parse_args(argv)
+    with open(args.snapshot) as f:
+        snap = json.load(f)
+    print(render(snap))
+    names = (
+        list(snap.get("counters", {}))
+        + list(snap.get("gauges", {}))
+        + list(snap.get("histograms", {}))
+    )
+    missing = [
+        p for p in args.require if not any(n.startswith(p) for n in names)
+    ]
+    if missing:
+        print(f"MISSING required metric prefixes: {missing}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
