@@ -30,6 +30,69 @@ EOF
 python tools/stats_report.py /tmp/paddle_tpu_obs_snapshot.json \
     --require executor.
 
+echo "== resilience chaos smoke (injected IO + dataloader faults) =="
+PADDLE_TPU_FAULT_INJECT="io.save:io:1.0:0:1,dataloader.fetch:io:1.0:0:2" \
+python - <<'EOF'
+import shutil
+
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability
+from paddle_tpu.dataloader.dataset import Dataset
+from paddle_tpu.fleet import collective as fc
+from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+
+shutil.rmtree("/tmp/paddle_tpu_chaos_ckpt", ignore_errors=True)
+rng = np.random.RandomState(0)
+W = rng.randn(4, 1).astype(np.float32)
+
+
+class DS(Dataset):
+    def __getitem__(self, i):
+        x = rng.randn(4).astype(np.float32)
+        return x, x @ W + 0.01 * rng.randn(1).astype(np.float32)
+
+    def __len__(self):
+        return 64
+
+
+x = fluid.data("x", [-1, 4])
+y = fluid.data("y", [-1, 1])
+pred = layers.fc(x, 1)
+loss = layers.mean(layers.square_error_cost(pred, y))
+fluid.optimizer.SGD(0.05).minimize(loss)
+exe = fluid.Executor()
+exe.run(fluid.default_startup_program())
+
+fleet = fc.Fleet()
+fleet.init(UserDefinedRoleMaker())
+loader = fluid.DataLoader(
+    DS(), feed_list=[x, y], batch_size=8, num_workers=2,
+    use_buffer_reader=False,
+)
+losses = []
+for epoch in range(3):
+    for feed in loader:
+        (lv,) = exe.run(feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    # first epoch's save trips the injected io.save fault; the retry heals it
+    fleet.save_check_point(exe, "/tmp/paddle_tpu_chaos_ckpt",
+                           fc.TrainStatus(epoch))
+
+status = fleet.load_check_point(exe, "/tmp/paddle_tpu_chaos_ckpt")
+assert status.next() == 3, status._epoch_no
+c = observability.snapshot()["counters"]
+retries = c.get("resilience.retries", 0)
+faults = c.get("resilience.faults_injected", 0)
+assert faults >= 3, f"chaos faults never fired: {faults}"
+assert retries > 0, f"injected faults were not retried: {c}"
+first, last = np.mean(losses[:4]), np.mean(losses[-4:])
+assert last < first, f"chaos run failed to converge: {first} -> {last}"
+print(f"chaos smoke OK: loss {first:.4f} -> {last:.4f}, "
+      f"faults={faults} retries={retries} "
+      f"giveups={c.get('resilience.giveups', 0)}")
+EOF
+
 echo "== driver entry points =="
 python __graft_entry__.py
 

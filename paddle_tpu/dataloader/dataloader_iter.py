@@ -19,6 +19,7 @@ TPU-native re-design:
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -67,7 +68,16 @@ _END = _EndOfEpoch()
 class _WorkerPool:
     """Thread workers pulling batch-index lists from a task queue, pushing
     collated batches to an output slot keyed by batch index so ordering is
-    preserved regardless of worker completion order."""
+    preserved regardless of worker completion order.
+
+    Hang-proofing contract (this pool feeds a step loop that must never
+    wedge silently): ``get()`` raises RuntimeError instead of blocking
+    forever once the pool is closed or every worker thread has died; a
+    worker thread that DIES (BaseException past the fetch guard — e.g.
+    SystemExit, interpreter teardown) with a batch in flight gets that
+    batch resubmitted once to the surviving workers before any error
+    surfaces. Ordinary fetch exceptions still flow to the consumer through
+    the output slot, attributed to their batch."""
 
     def __init__(self, fetch, num_workers, capacity, worker_init_fn=None):
         self._fetch = fetch
@@ -76,6 +86,8 @@ class _WorkerPool:
         self._done_lock = threading.Condition()
         self._capacity = capacity
         self._shutdown = False
+        self._inflight = {}  # worker_id -> (batch_id, indices)
+        self._resubmitted = set()  # batch_ids given their one second chance
         self._threads = [
             threading.Thread(target=self._work, args=(i,), daemon=True)
             for i in range(num_workers)
@@ -95,24 +107,77 @@ class _WorkerPool:
             if item is None:
                 return
             batch_id, indices = item
+            with self._done_lock:
+                self._inflight[worker_id] = item
             try:
                 out = self._fetch(indices)
-            except BaseException as e:  # surfaced on the consumer side
+            except Exception as e:  # surfaced on the consumer side
                 out = e
+            # BaseException (SystemExit, KeyboardInterrupt) kills the
+            # worker; get() notices the dead thread and resubmits _inflight
             with self._done_lock:
                 while (
                     len(self._done) >= self._capacity and not self._shutdown
                 ):
                     self._done_lock.wait(0.1)
+                self._inflight.pop(worker_id, None)
                 if self._shutdown:
                     return
                 self._done[batch_id] = out
                 self._done_lock.notify_all()
 
-    def get(self, batch_id):
+    def _reap_dead_workers(self, batch_id):
+        """Called under the lock. Resubmit (once) the in-flight batch of any
+        dead worker; raise when the awaited batch can no longer arrive."""
+        dead = [
+            i for i, t in enumerate(self._threads)
+            if not t.is_alive() and i in self._inflight
+        ]
+        for i in dead:
+            bid, indices = self._inflight.pop(i)
+            if bid in self._done:
+                continue
+            if bid not in self._resubmitted:
+                self._resubmitted.add(bid)
+                from .. import observability as _obs
+
+                _obs.add("resilience.worker_resubmits")
+                self._tasks.put((bid, indices))
+            else:
+                self._done[bid] = RuntimeError(
+                    f"dataloader worker died twice fetching batch {bid}"
+                )
+                self._done_lock.notify_all()
+        if batch_id in self._done:
+            # the awaited batch's result (or its attributed died-twice
+            # error) just landed — deliver that, not a generic failure
+            return
+        if not any(t.is_alive() for t in self._threads):
+            raise RuntimeError(
+                "all dataloader workers are dead; cannot produce batch "
+                f"{batch_id} (check worker_init_fn / dataset __getitem__)"
+            )
+
+    def get(self, batch_id, timeout=None):
+        """Next ready batch; raises RuntimeError on a closed pool or when
+        every worker died, ExecutionTimeoutError past `timeout` seconds."""
+        deadline = None if not timeout else time.monotonic() + timeout
         with self._done_lock:
             while batch_id not in self._done:
-                self._done_lock.wait()
+                if self._shutdown:
+                    raise RuntimeError(
+                        "dataloader worker pool is closed (get() after "
+                        "close() would hang forever)"
+                    )
+                self._reap_dead_workers(batch_id)
+                if deadline is not None and time.monotonic() >= deadline:
+                    from ..errors import ExecutionTimeoutError
+
+                    raise ExecutionTimeoutError(
+                        f"dataloader batch {batch_id} not produced within "
+                        f"{timeout}s"
+                    )
+                self._done_lock.wait(0.1)
             out = self._done.pop(batch_id)
             self._done_lock.notify_all()
         if isinstance(out, BaseException):
@@ -205,12 +270,30 @@ class _MultiWorkerIter(_DataLoaderIterBase):
                 "random access to parallelize; reference splits streams per "
                 "worker instead — use several datasets + ChainDataset)"
             )
+        from ..resilience import fault_point, retry
+
+        def _fetch(idxs):
+            fault_point("dataloader.fetch")
+            return self._collate([ds[i] for i in idxs])
+
+        # transient fetch failures (flaky remote storage, injected chaos
+        # faults) retry in the worker before the consumer ever sees them
+        try:
+            attempts = int(
+                os.environ.get("PADDLE_TPU_DATALOADER_RETRIES", "3")
+            )
+        except ValueError:  # malformed env must not break training startup
+            attempts = 3
         self._pool = _WorkerPool(
-            fetch=lambda idxs: self._collate([ds[i] for i in idxs]),
+            fetch=retry(
+                max_attempts=max(1, attempts), base_delay=0.01, max_delay=0.5,
+                name="dataloader.fetch",
+            )(_fetch),
             num_workers=loader.num_workers,
             capacity=max(2, loader.prefetch_factor * loader.num_workers),
             worker_init_fn=loader.worker_init_fn,
         )
+        self._timeout = getattr(loader, "timeout", 0) or None
         self._batches = list(iter(loader.batch_sampler))
         self._n = len(self._batches)
         self._next_submit = 0
@@ -226,7 +309,7 @@ class _MultiWorkerIter(_DataLoaderIterBase):
         from .. import observability as _obs
 
         t0 = time.perf_counter()
-        out = self._pool.get(self._next_out)
+        out = self._pool.get(self._next_out, timeout=self._timeout)
         _obs.observe("dataloader.batch_wait", time.perf_counter() - t0)
         # depth of the ready-batch slot AFTER the pop: 0 means the consumer
         # is outrunning the workers (input-pipeline stall territory)

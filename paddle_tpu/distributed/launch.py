@@ -40,6 +40,15 @@ def parse_args(argv=None):
     p.add_argument("--simulate_cpu", action="store_true",
                    help="force children onto the CPU platform with gloo "
                         "collectives (localhost cluster simulation)")
+    p.add_argument("--elastic", action="store_true",
+                   help="restart dead children with bounded exponential "
+                        "backoff instead of aborting the pod (rank 0 dying "
+                        "still aborts: it owns the coordination service)")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="per-rank restart budget under --elastic")
+    p.add_argument("--restart_backoff", type=float, default=0.5,
+                   help="base seconds for the restart backoff "
+                        "(doubles per restart of that rank, capped at 10s)")
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -84,51 +93,106 @@ def _terminate_pod(procs, grace=10.0):
             out.close()
 
 
+def spawn_trainer(args, endpoints, rank, attempt=0):
+    """Start (or restart) the trainer process for `rank`. Restarts append
+    to the same per-rank log file so the crash that triggered the restart
+    stays readable."""
+    env = dict(os.environ)
+    env.update(
+        PADDLE_TRAINER_ID=str(rank),
+        PADDLE_TRAINERS_NUM=str(len(endpoints)),
+        PADDLE_TRAINER_ENDPOINTS=",".join(endpoints),
+        PADDLE_CURRENT_ENDPOINT=endpoints[rank],
+        PADDLE_COORDINATOR=endpoints[0],
+        PADDLE_RESTART_ATTEMPT=str(attempt),
+    )
+    if args.simulate_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, args.training_script] + args.training_script_args
+    # fresh spawn truncates; a restart appends so the crash that triggered
+    # it stays readable in the same per-rank log
+    out = (
+        open(
+            os.path.join(args.log_dir, f"worker_{rank}.log"),
+            "w" if attempt == 0 else "a",
+        )
+        if args.log_dir
+        else None
+    )
+    proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
+    proc._paddle_log = out
+    proc._paddle_rank = rank
+    return proc
+
+
 def start_local_trainers(args, endpoints, local_ranks):
-    procs = []
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
-    for rank in local_ranks:
-        env = dict(os.environ)
-        env.update(
-            PADDLE_TRAINER_ID=str(rank),
-            PADDLE_TRAINERS_NUM=str(len(endpoints)),
-            PADDLE_TRAINER_ENDPOINTS=",".join(endpoints),
-            PADDLE_CURRENT_ENDPOINT=endpoints[rank],
-            PADDLE_COORDINATOR=endpoints[0],
-        )
-        if args.simulate_cpu:
-            env["JAX_PLATFORMS"] = "cpu"
-            env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-        cmd = [sys.executable, args.training_script] + args.training_script_args
-        out = (
-            open(os.path.join(args.log_dir, f"worker_{rank}.log"), "w")
-            if args.log_dir
-            else None
-        )
-        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
-        proc._paddle_log = out
-        procs.append(proc)
-    return procs
+    return [spawn_trainer(args, endpoints, rank) for rank in local_ranks]
 
 
-def watch_local_trainers(procs):
-    """Supervise: if any child fails, terminate the pod and propagate
-    (reference utils.py watch_local_trainers / launch.py:219-226)."""
+def watch_local_trainers(procs, args=None, endpoints=None):
+    """Supervise the pod (reference utils.py watch_local_trainers /
+    launch.py:219-226). Default policy: any child failure aborts the pod.
+    Under ``--elastic``: a failed non-rank-0 child is restarted with
+    bounded exponential backoff up to ``--max_restarts`` times per rank;
+    rank 0 dying always aborts immediately (it hosts the JAX coordination
+    service, so its death already doomed every peer)."""
+    elastic = bool(args and getattr(args, "elastic", False))
+    max_restarts = getattr(args, "max_restarts", 3) if args else 3
+    backoff_base = getattr(args, "restart_backoff", 0.5) if args else 0.5
+    restarts = {}  # rank -> count
+    pending = {}  # procs index -> monotonic time of the scheduled restart
     try:
         while True:
             alive = False
-            for p in procs:
+            now = time.monotonic()
+            for i, p in enumerate(procs):
                 rc = p.poll()
                 if rc is None:
                     alive = True
-                elif rc != 0:
+                    continue
+                if rc == 0:
+                    continue  # clean exit: done, never restarted
+                if i in pending:
+                    # backoff in progress: restart when its deadline
+                    # arrives; never sleep inline — the scan must keep
+                    # monitoring every other child (rank 0's death aborts
+                    # immediately even mid-backoff)
+                    alive = True
+                    if now >= pending[i]:
+                        del pending[i]
+                        rank = getattr(p, "_paddle_rank", i)
+                        log = getattr(p, "_paddle_log", None)
+                        if log is not None:
+                            log.close()
+                        procs[i] = spawn_trainer(
+                            args, endpoints, rank, restarts[rank]
+                        )
+                    continue
+                rank = getattr(p, "_paddle_rank", i)
+                n = restarts.get(rank, 0)
+                if not elastic or rank == 0 or n >= max_restarts:
                     _terminate_pod(procs)
                     raise RuntimeError(
-                        f"trainer (pid {p.pid}) exited with code {rc}; "
-                        "pod aborted"
+                        f"trainer rank {rank} (pid {p.pid}) exited with "
+                        f"code {rc}"
+                        + (f" after {n} restart(s)" if elastic and n else "")
+                        + "; pod aborted"
                     )
+                restarts[rank] = n + 1
+                from ..resilience import backoff_delay
+
+                delay = backoff_delay(n + 1, backoff_base, 10.0)
+                print(
+                    f"[launch --elastic] rank {rank} died (rc={rc}); "
+                    f"restart {n + 1}/{max_restarts} in {delay:.1f}s",
+                    file=sys.stderr,
+                )
+                pending[i] = now + delay
+                alive = True
             if not alive:
                 _terminate_pod(procs)  # reaps + closes log handles
                 return 0
@@ -142,7 +206,7 @@ def launch(argv=None):
     args = parse_args(argv)
     endpoints, local_ranks = build_cluster(args)
     procs = start_local_trainers(args, endpoints, local_ranks)
-    return watch_local_trainers(procs)
+    return watch_local_trainers(procs, args, endpoints)
 
 
 if __name__ == "__main__":

@@ -120,6 +120,19 @@ class ExternalError(EnforceNotMet, OSError):
     code = ErrorCode.EXTERNAL
 
 
+class CheckpointCorruptionError(EnforceNotMet, OSError):
+    """A checkpoint failed integrity verification (torn write, CRC/shape/
+    dtype mismatch vs its manifest, undecodable container). Raised by
+    io.py load paths BEFORE any scope mutation — never silently-wrong
+    weights. An OSError so generic IO handlers still catch it, but
+    explicitly non-retryable: re-reading corrupt bytes cannot help, the
+    caller must fall back to an older checkpoint (Fleet.load_check_point
+    does so automatically)."""
+
+    code = ErrorCode.EXTERNAL
+    retryable = False
+
+
 def enforce(condition, error):
     """PADDLE_ENFORCE (enforce.h:282): raise `error` (an EnforceNotMet
     instance) unless `condition`."""

@@ -53,15 +53,19 @@ _CHECKPOINT_PREFIX = "__paddle_checkpoint__"
 _TRAIN_STATUS_FILE = "train_status.json"
 
 
-def _checkpoint_numbers(fs, path):
+def _dir_numbers(dirs):
     nos = []
-    for d in fs.list_dirs(path):
+    for d in dirs:
         if d.startswith(_CHECKPOINT_PREFIX):
             try:
                 nos.append(int(d[len(_CHECKPOINT_PREFIX):]))
             except ValueError:
-                continue
+                continue  # e.g. a stale "<prefix>N.tmp" from a crashed save
     return sorted(nos)
+
+
+def _checkpoint_numbers(fs, path):
+    return _dir_numbers(fs.list_dirs(path))
 
 
 class Fleet:
@@ -156,12 +160,14 @@ class Fleet:
         """Save persistables + TrainStatus into a new numbered checkpoint
         dir and rotate old ones. The payload is written locally and
         published through the FS backend (upload + atomic mv), so remote
-        backends only implement the FS contract. First worker only;
-        returns the checkpoint number."""
+        backends only implement the FS contract; write + publish are
+        retried with backoff (transient FS faults heal, the final state is
+        idempotent). First worker only; returns the checkpoint number."""
         import tempfile
 
         from .fs_wrapper import LocalFS
         from .. import io as _io
+        from ..resilience import retry
 
         fs = fs or LocalFS()
         if not self.is_first_worker():
@@ -169,12 +175,26 @@ class Fleet:
         import shutil
 
         fs.mkdir(path)
-        nos = _checkpoint_numbers(fs, path)
+        dirs = fs.list_dirs(path)
+        # a *.tmp dir is a crashed prior save's half-published payload:
+        # sweep it here, the only writer (list once, reuse for numbering)
+        for d in dirs:
+            if d.startswith(_CHECKPOINT_PREFIX) and d.endswith(".tmp"):
+                fs.delete(os.path.join(path, d))
+        nos = _dir_numbers(dirs)
         no = (nos[-1] + 1) if nos else 0
         ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
         tmp = ckpt + ".tmp"
         local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
-        try:
+
+        def _write_and_publish():
+            # a prior attempt's mv may have landed even though it REPORTED
+            # failure (remote rename applied, response lost); mv onto an
+            # existing dir would nest tmp inside the live checkpoint, so
+            # treat an existing ckpt as "already published"
+            if fs.is_exist(ckpt):
+                fs.delete(tmp)
+                return
             _io.save_persistables(executor, local, main_program)
             with open(os.path.join(local, _TRAIN_STATUS_FILE), "w") as f:
                 json.dump({"epoch_no": train_status._epoch_no}, f)
@@ -183,10 +203,16 @@ class Fleet:
             # atomic publish: a crash mid-save leaves only a .tmp dir
             # behind, never a half-written numbered checkpoint
             fs.mv(tmp, ckpt)
+
+        try:
+            retry(
+                max_attempts=4, base_delay=0.05, max_delay=2.0,
+                name="checkpoint.save",
+            ).call(_write_and_publish)
         finally:
             shutil.rmtree(local, ignore_errors=True)
         if not remain_all_checkpoint:
-            for old in _checkpoint_numbers(fs, path)[:-max_checkpoint_num]:
+            for old in (nos + [no])[:-max_checkpoint_num]:
                 fs.delete(os.path.join(path, f"{_CHECKPOINT_PREFIX}{old}"))
         return no
 
@@ -196,9 +222,16 @@ class Fleet:
     ):
         """Load the newest (or requested) checkpoint via the FS backend;
         returns its TrainStatus. Missing dir -> TrainStatus(-1) (cold
-        start, reference behavior)."""
+        start, reference behavior).
+
+        When the newest checkpoint fails integrity verification
+        (CheckpointCorruptionError from io.py's manifest/CRC check), falls
+        back to the next-newest until one loads — never silently-wrong
+        weights, and a torn latest save costs one rotation step, not the
+        run. An explicitly requested checkpoint_no never falls back."""
         import tempfile
 
+        from ..errors import CheckpointCorruptionError
         from .fs_wrapper import LocalFS
         from .. import io as _io
 
@@ -208,19 +241,33 @@ class Fleet:
             return TrainStatus(-1)
         import shutil
 
-        no = checkpoint_no if checkpoint_no is not None else nos[-1]
-        ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
-        local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
-        try:
-            fs.download(ckpt, local)
-            _io.load_persistables(executor, local, main_program)
-            status_file = os.path.join(local, _TRAIN_STATUS_FILE)
-            if os.path.exists(status_file):
-                with open(status_file) as f:
-                    return TrainStatus(json.load(f).get("epoch_no", -1))
-            return TrainStatus(-1)
-        finally:
-            shutil.rmtree(local, ignore_errors=True)
+        candidates = (
+            [checkpoint_no] if checkpoint_no is not None else list(reversed(nos))
+        )
+        last_err = None
+        for i, no in enumerate(candidates):
+            ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
+            local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
+            try:
+                fs.download(ckpt, local)
+                _io.load_persistables(executor, local, main_program)
+                if i > 0:
+                    from .. import observability as _obs
+
+                    _obs.add("resilience.checkpoint_fallbacks")
+                status_file = os.path.join(local, _TRAIN_STATUS_FILE)
+                if os.path.exists(status_file):
+                    with open(status_file) as f:
+                        return TrainStatus(json.load(f).get("epoch_no", -1))
+                return TrainStatus(-1)
+            except CheckpointCorruptionError as e:
+                from .. import observability as _obs
+
+                _obs.add("resilience.checkpoint_corrupt")
+                last_err = e
+            finally:
+                shutil.rmtree(local, ignore_errors=True)
+        raise last_err
 
 
 

@@ -3,12 +3,19 @@ incubate/fleet/collective/fs_wrapper.py: FS / LocalFS / BDFS).
 
 LocalFS covers single-host and NFS-mounted checkpoint dirs; a HadoopFS-style
 backend plugs in by implementing the same five methods (the reference
-shelled out to `hadoop fs`, framework/io/fs.cc)."""
+shelled out to `hadoop fs`, framework/io/fs.cc).
+
+Mutating entry points carry resilience fault seams (``fs.upload`` /
+``fs.download`` / ``fs.mv`` / ``fs.delete`` for LocalFS, ``fs.hadoop`` for
+every HadoopFS shell-out) so checkpoint publish/fetch paths are
+chaos-testable; callers (Fleet.save_check_point) retry around them."""
 
 from __future__ import annotations
 
 import os
 import shutil
+
+from ..resilience.faults import fault_point
 
 
 class FS:
@@ -52,18 +59,22 @@ class LocalFS(FS):
         os.makedirs(path, exist_ok=True)
 
     def delete(self, path):
+        fault_point("fs.delete")
         if os.path.isdir(path):
             shutil.rmtree(path)
         elif os.path.exists(path):
             os.remove(path)
 
     def mv(self, src, dst):
+        fault_point("fs.mv")
         shutil.move(src, dst)
 
     def upload(self, local_path, remote_path):
+        fault_point("fs.upload")
         shutil.copytree(local_path, remote_path, dirs_exist_ok=True)
 
     def download(self, remote_path, local_path):
+        fault_point("fs.download")
         shutil.copytree(remote_path, local_path, dirs_exist_ok=True)
 
 
@@ -82,6 +93,7 @@ class HadoopFS(FS):
     def _run(self, *args, check=True):
         import subprocess
 
+        fault_point("fs.hadoop")
         cmd = [self._bin, "fs"] + self._cfg + list(args)
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if check and proc.returncode != 0:

@@ -508,10 +508,14 @@ class Executor:
                     # (reference framework/op_call_stack.cc); add_note keeps
                     # the original exception intact — many jax error classes
                     # cannot be reconstructed from a single message string
-                    e.add_note(
+                    note = (
                         f"[while tracing op #{i} {op.type!r} created at "
                         f"{op.attr('__loc__', '<unknown>')}]"
                     )
+                    if hasattr(e, "add_note"):  # PEP 678, python >= 3.11
+                        e.add_note(note)
+                    elif e.args and isinstance(e.args[0], str):
+                        e.args = (e.args[0] + "\n" + note,) + e.args[1:]
                     raise
                 if check_nan:
                     bad = jnp.zeros((), bool)

@@ -7,17 +7,37 @@ one .npz per directory (save_params/persistables) or a single pickled
 payload (save/load), fetched through a single host sync. The reference runs
 generated save/load *ops* through the Executor; here persistence is pure
 host-side IO — there is nothing device-specific about a checkpoint.
+
+Durability contract (the reference's fault-tolerant save/load_check_point
+discipline, generalized to every writer here):
+
+* every file lands via write-to-temp + flush + fsync + ``os.replace`` (and
+  a best-effort directory fsync), so a crash mid-save leaves either the old
+  complete file or a stray ``*.tmp.*`` — never a torn checkpoint under the
+  real name;
+* each payload gets a sibling ``manifest.json`` recording per-array CRC32 +
+  shape + dtype; load paths verify BEFORE mutating the scope and raise
+  :class:`~paddle_tpu.errors.CheckpointCorruptionError` on any mismatch or
+  undecodable container (pre-manifest checkpoints still load, container
+  errors are still typed);
+* ``fault_point("io.save")`` / ``fault_point("io.load")`` seams let the
+  resilience fault registry chaos-test every caller.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
+import tempfile
+import zlib
 
 import numpy as np
 
+from .errors import CheckpointCorruptionError
 from .framework.program import Parameter, Program, default_main_program
 from .framework.scope import global_scope
+from .resilience.faults import fault_point
 
 __all__ = [
     "save_params",
@@ -30,6 +50,131 @@ __all__ = [
     "load_inference_model",
     "prune",
 ]
+
+MANIFEST_NAME = "manifest.json"
+
+
+# -- durable write/verify helpers -------------------------------------------
+def _fsync_dir(path):
+    """Best-effort directory fsync so the rename itself is durable (POSIX;
+    silently skipped where directories cannot be opened)."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path, write_fn):
+    """Run `write_fn(file_obj)` against a temp file in `path`'s directory,
+    fsync it, and publish with os.replace — the torn-write guarantee."""
+    dirname = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(
+        dir=dirname, prefix=os.path.basename(path) + ".tmp."
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(dirname)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _array_entry(arr):
+    a = np.asarray(arr)
+    return {
+        "crc32": zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF,
+        "shape": list(a.shape),
+        "dtype": str(a.dtype),
+    }
+
+
+def _write_manifest(path, payload_file, arrays):
+    manifest = {
+        "format": 1,
+        "file": os.path.basename(payload_file),
+        "arrays": {name: _array_entry(a) for name, a in arrays.items()},
+    }
+    _atomic_write(
+        path, lambda f: f.write(json.dumps(manifest, indent=1).encode())
+    )
+
+
+def _read_manifest(path):
+    """Manifest dict, or None when absent (pre-durability checkpoint)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as f:
+            return json.loads(f.read().decode())
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptionError(
+            f"unreadable checkpoint manifest {path!r}: {e}"
+        ) from e
+
+
+def _verify_arrays(arrays, manifest, origin):
+    if manifest is None:
+        return
+    want = manifest.get("arrays", {})
+    missing = sorted(set(want) - set(arrays))
+    if missing:
+        raise CheckpointCorruptionError(
+            f"checkpoint {origin!r} is missing arrays {missing} listed in "
+            "its manifest"
+        )
+    for name, entry in want.items():
+        got = _array_entry(arrays[name])
+        for field in ("shape", "dtype", "crc32"):
+            if got[field] != entry[field]:
+                raise CheckpointCorruptionError(
+                    f"checkpoint {origin!r} array {name!r}: {field} mismatch "
+                    f"(manifest {entry[field]!r}, file {got[field]!r})"
+                )
+
+
+def _load_npz_verified(path, manifest_path=None):
+    """Read every array of an .npz into host memory and verify it against
+    the sibling manifest; all corruption surfaces as the typed error and
+    nothing is returned partially."""
+    manifest = _read_manifest(
+        manifest_path
+        if manifest_path is not None
+        else os.path.join(os.path.dirname(path), MANIFEST_NAME)
+    )
+    if manifest is not None and manifest.get("file") != os.path.basename(path):
+        # the dir-level manifest describes a different payload (e.g.
+        # save_params + save_persistables into one dir under two
+        # filenames); it cannot vouch for this one
+        manifest = None
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            arrays = {name: data[name] for name in data.files}
+    except FileNotFoundError:
+        if manifest is not None:
+            raise CheckpointCorruptionError(
+                f"checkpoint payload {path!r} is missing but its manifest "
+                "exists (torn publish)"
+            ) from None
+        raise
+    except Exception as e:  # zipfile.BadZipFile, zlib.error, OSError, ...
+        raise CheckpointCorruptionError(
+            f"undecodable checkpoint payload {path!r}: {e}"
+        ) from e
+    _verify_arrays(arrays, manifest, path)
+    return arrays
 
 
 def _collect(program, scope, predicate):
@@ -60,11 +205,14 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 
 
 def _save_vars(dirname, main_program, predicate, filename):
+    fault_point("io.save")
     program = main_program or default_main_program()
     scope = global_scope()
     arrays = _collect(program, scope, predicate)
     os.makedirs(dirname, exist_ok=True)
-    np.savez(os.path.join(dirname, filename or "__params__.npz"), **arrays)
+    path = os.path.join(dirname, filename or "__params__.npz")
+    _atomic_write(path, lambda f: np.savez(f, **arrays))
+    _write_manifest(os.path.join(dirname, MANIFEST_NAME), path, arrays)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):
@@ -78,16 +226,21 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 def _load_vars(dirname, main_program, filename):
     import jax.numpy as jnp
 
+    fault_point("io.load")
     scope = global_scope()
     path = os.path.join(dirname, filename or "__params__.npz")
-    with np.load(path, allow_pickle=False) as data:
-        for name in data.files:
-            scope.set_var(name, jnp.asarray(data[name]))
+    # verify the WHOLE payload before the first scope write: a corrupt
+    # checkpoint must never leave the scope half-overwritten
+    arrays = _load_npz_verified(path)
+    for name, arr in arrays.items():
+        scope.set_var(name, jnp.asarray(arr))
 
 
 def save(program, model_path):
     """fluid.save parity (io.py:1598): one combined file with params +
-    optimizer state (all persistables), plus the serialized program."""
+    optimizer state (all persistables), plus the serialized program.
+    All three files (.pdparams/.pdmodel/.manifest.json) publish atomically."""
+    fault_point("io.save")
     scope = global_scope()
     payload = {
         "params": _collect(program, scope, _is_parameter),
@@ -96,19 +249,51 @@ def save(program, model_path):
         ),
     }
     os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
-    with open(model_path + ".pdparams", "wb") as f:
-        pickle.dump(payload, f, protocol=4)
-    with open(model_path + ".pdmodel", "wb") as f:
-        pickle.dump(program, f, protocol=4)
+    _atomic_write(
+        model_path + ".pdparams", lambda f: pickle.dump(payload, f, protocol=4)
+    )
+    _atomic_write(
+        model_path + ".pdmodel", lambda f: pickle.dump(program, f, protocol=4)
+    )
+    _write_manifest(
+        model_path + ".manifest.json",
+        model_path + ".pdparams",
+        {
+            **{f"params/{k}": v for k, v in payload["params"].items()},
+            **{f"opt/{k}": v for k, v in payload["opt"].items()},
+        },
+    )
 
 
 def load(program, model_path, var_list=None):
-    """fluid.load parity (io.py:1662)."""
+    """fluid.load parity (io.py:1662). Verifies the payload against its
+    manifest (when present) before any scope mutation."""
     import jax.numpy as jnp
 
+    fault_point("io.load")
     scope = global_scope()
-    with open(model_path + ".pdparams", "rb") as f:
-        payload = pickle.load(f)
+    path = model_path + ".pdparams"
+    manifest = _read_manifest(model_path + ".manifest.json")
+    try:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+    except FileNotFoundError:
+        if manifest is not None:
+            raise CheckpointCorruptionError(
+                f"checkpoint payload {path!r} is missing but its manifest "
+                "exists (torn publish)"
+            ) from None
+        raise
+    except Exception as e:  # truncated/garbled pickle: EOFError, Unpickling..
+        raise CheckpointCorruptionError(
+            f"undecodable checkpoint payload {path!r}: {e}"
+        ) from e
+    flat = {
+        f"{group}/{name}": arr
+        for group in ("params", "opt")
+        for name, arr in payload.get(group, {}).items()
+    }
+    _verify_arrays(flat, manifest, path)
     wanted = {v.name for v in var_list} if var_list else None
     for group in ("params", "opt"):
         for name, arr in payload.get(group, {}).items():
@@ -171,19 +356,22 @@ def save_inference_model(
         for v in target_vars
     ]
     pruned = prune(test_prog, targets, feeds=feeded_var_names)
+    fault_point("io.save")
     os.makedirs(dirname, exist_ok=True)
     meta = {
         "program": pruned,
         "feed_names": list(feeded_var_names),
         "fetch_names": [t.name for t in targets],
     }
-    with open(os.path.join(dirname, model_filename or "__model__"), "wb") as f:
-        pickle.dump(meta, f, protocol=4)
+    _atomic_write(
+        os.path.join(dirname, model_filename or "__model__"),
+        lambda f: pickle.dump(meta, f, protocol=4),
+    )
     scope = global_scope()
     arrays = _collect(pruned, scope, _is_persistable)
-    np.savez(
-        os.path.join(dirname, params_filename or "__params__.npz"), **arrays
-    )
+    params_path = os.path.join(dirname, params_filename or "__params__.npz")
+    _atomic_write(params_path, lambda f: np.savez(f, **arrays))
+    _write_manifest(os.path.join(dirname, MANIFEST_NAME), params_path, arrays)
     return [t.name for t in targets]
 
 
@@ -193,11 +381,20 @@ def load_inference_model(dirname, executor=None, model_filename=None,
     scope (reference io.py:1303)."""
     import jax.numpy as jnp
 
-    with open(os.path.join(dirname, model_filename or "__model__"), "rb") as f:
-        meta = pickle.load(f)
+    fault_point("io.load")
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    try:
+        with open(model_path, "rb") as f:
+            meta = pickle.load(f)
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptionError(
+            f"undecodable inference model {model_path!r}: {e}"
+        ) from e
     scope = global_scope()
     path = os.path.join(dirname, params_filename or "__params__.npz")
-    with np.load(path, allow_pickle=False) as data:
-        for name in data.files:
-            scope.set_var(name, jnp.asarray(data[name]))
+    arrays = _load_npz_verified(path)
+    for name, arr in arrays.items():
+        scope.set_var(name, jnp.asarray(arr))
     return meta["program"], meta["feed_names"], meta["fetch_names"]

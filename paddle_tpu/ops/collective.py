@@ -40,7 +40,12 @@ def _record(kind, x, ax):
     if ax is None:
         return
     from .. import observability as _obs
+    from ..resilience.faults import fault_point
 
+    # chaos seam: an armed "collective.dispatch" fault aborts the trace,
+    # modeling a peer dropping out mid-compile (EQuARX-style collective
+    # layer failures); surfaced to the Executor as a typed error
+    fault_point("collective.dispatch")
     _obs.add(f"collective.{kind}")
     try:
         nbytes = int(x.size) * x.dtype.itemsize

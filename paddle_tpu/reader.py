@@ -69,6 +69,9 @@ class DataLoader:
         self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = 2
         self.worker_init_fn = worker_init_fn
+        # 0/None = wait forever for a batch (still hang-proof: a closed
+        # pool or all-dead workers raise instead of blocking)
+        self.timeout = float(timeout or 0)
 
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler

@@ -1,0 +1,33 @@
+"""Resilience subsystem: retry/backoff, deterministic fault injection,
+durable-checkpoint verification, hang-proof pipelines.
+
+The production seams live where the failures live — `io.py` (atomic,
+CRC-manifested checkpoints), `fleet/collective.py` (retried publish +
+newest-valid fallback), `dataloader/dataloader_iter.py` (retried fetch,
+dead-worker resubmission, shutdown-safe get), `distributed/launch.py`
+(--elastic child restarts) — and this package provides the two primitives
+they share:
+
+* :func:`retry` — exponential backoff with full jitter, per-attempt
+  timeout, overall deadline, a retryable-exception classifier, and
+  ``resilience.retries`` / ``resilience.giveups`` counters;
+* :mod:`faults` — the ``PADDLE_TPU_FAULT_INJECT`` registry whose
+  :func:`fault_point` seams make every one of those paths chaos-testable
+  deterministically.
+
+README §Resilience documents the fault-site catalog, env syntax, metric
+names, and the checkpoint durability guarantees.
+"""
+
+from __future__ import annotations
+
+from . import faults, retry as _retry_mod  # noqa: F401
+from .faults import (  # noqa: F401
+    FAULT_ENV_VAR,
+    FaultSpec,
+    clear,
+    fault_point,
+    inject,
+    reload_env,
+)
+from .retry import backoff_delay, default_retryable, retry  # noqa: F401

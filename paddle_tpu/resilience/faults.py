@@ -1,0 +1,195 @@
+"""Deterministic fault injection (chaos seams for the resilience layer).
+
+A *site* is a named seam in production code (``fault_point("io.save")``)
+that is free when nothing is armed. Arming a site — programmatically via
+``inject()`` or from the ``PADDLE_TPU_FAULT_INJECT`` environment variable —
+makes the seam raise a typed, usually-retryable exception with a
+deterministic, seeded pattern, so chaos runs are reproducible and CI can
+assert exact behavior (the reference stack tested fault tolerance the
+ad-hoc way: kill -9 in shell scripts; a seeded in-process registry lets the
+same scenarios run inside pytest).
+
+Env syntax (comma/semicolon-separated specs)::
+
+    PADDLE_TPU_FAULT_INJECT="site:kind[:prob[:seed[:max_fires]]][,spec...]"
+    # e.g. "io.save:io:1.0:0:1,dataloader.fetch:unavailable:0.5:42"
+
+``kind`` selects the exception: ``io`` (ExternalError, an OSError),
+``unavailable`` (UnavailableError), ``timeout`` (ExecutionTimeoutError) —
+all retryable — and ``corrupt`` (CheckpointCorruptionError, NOT retryable).
+``prob`` in [0,1] is drawn from a per-spec ``random.Random(seed)``; the
+optional ``max_fires`` caps total fires (prob=1 + max_fires=1 = "fail
+exactly once, then heal" — the deterministic shape chaos CI wants).
+
+Wired seams: ``io.save`` / ``io.load`` (io.py), ``fs.upload`` /
+``fs.download`` / ``fs.mv`` / ``fs.delete`` (LocalFS), ``fs.hadoop``
+(HadoopFS shell-outs), ``dataloader.fetch`` (worker batch fetch),
+``collective.dispatch`` (trace-time collective emission). The catalog is
+documented in README §Resilience.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+__all__ = [
+    "FAULT_ENV_VAR",
+    "FaultSpec",
+    "clear",
+    "fault_point",
+    "inject",
+    "parse_spec",
+    "reload_env",
+    "specs",
+]
+
+FAULT_ENV_VAR = "PADDLE_TPU_FAULT_INJECT"
+
+_KINDS = ("io", "unavailable", "timeout", "corrupt")
+
+
+def _make_error(kind, site):
+    from .. import errors
+
+    msg = f"injected {kind!r} fault at site {site!r}"
+    if kind == "io":
+        return errors.ExternalError(msg)
+    if kind == "unavailable":
+        return errors.UnavailableError(msg)
+    if kind == "timeout":
+        return errors.ExecutionTimeoutError(msg)
+    if kind == "corrupt":
+        return errors.CheckpointCorruptionError(msg)
+    raise ValueError(f"unknown fault kind {kind!r} (one of {_KINDS})")
+
+
+class FaultSpec:
+    """One armed site: seeded RNG + fire bookkeeping."""
+
+    __slots__ = ("site", "kind", "prob", "seed", "max_fires", "fires", "_rng")
+
+    def __init__(self, site, kind="io", prob=1.0, seed=0, max_fires=None):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} (one of {_KINDS})")
+        self.site = site
+        self.kind = kind
+        self.prob = float(prob)
+        self.seed = int(seed)
+        self.max_fires = None if max_fires is None else int(max_fires)
+        self.fires = 0
+        self._rng = random.Random(self.seed)
+
+    def should_fire(self):
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        # always draw, even at prob 0/1: the consumed-draw count (and so
+        # the fire pattern) then depends only on call count + seed
+        hit = self._rng.random() < self.prob
+        if hit:
+            self.fires += 1
+        return hit
+
+    def __repr__(self):
+        return (
+            f"FaultSpec({self.site}:{self.kind}:{self.prob}:{self.seed}"
+            + (f":{self.max_fires}" if self.max_fires is not None else "")
+            + f" fires={self.fires})"
+        )
+
+
+_lock = threading.Lock()
+_registry: dict[str, FaultSpec] = {}
+_env_loaded = False
+
+
+def parse_spec(text):
+    """``site:kind[:prob[:seed[:max_fires]]]`` -> FaultSpec."""
+    parts = text.strip().split(":")
+    if len(parts) < 2 or not parts[0]:
+        raise ValueError(
+            f"bad fault spec {text!r}: want site:kind[:prob[:seed[:max_fires]]]"
+        )
+    site, kind = parts[0], parts[1]
+    prob = float(parts[2]) if len(parts) > 2 and parts[2] else 1.0
+    seed = int(parts[3]) if len(parts) > 3 and parts[3] else 0
+    max_fires = int(parts[4]) if len(parts) > 4 and parts[4] else None
+    return FaultSpec(site, kind, prob, seed, max_fires)
+
+
+def inject(site, kind="io", prob=1.0, seed=0, max_fires=None):
+    """Arm `site` programmatically; replaces any prior spec for the site
+    (including one from the env — and a LATER lazy env load never clobbers
+    a programmatic arm, so the env is drained eagerly here first)."""
+    _ensure_env_loaded()
+    spec = FaultSpec(site, kind, prob, seed, max_fires)
+    with _lock:
+        _registry[spec.site] = spec
+    return spec
+
+
+def clear(site=None):
+    """Disarm one site, or every site (also forgets the env config)."""
+    global _env_loaded
+    _ensure_env_loaded()  # so a later lazy env load cannot re-arm the site
+    with _lock:
+        if site is None:
+            _registry.clear()
+        else:
+            _registry.pop(site, None)
+
+
+def reload_env(value=None):
+    """(Re)parse ``PADDLE_TPU_FAULT_INJECT`` (or `value`) into the registry."""
+    global _env_loaded
+    text = os.environ.get(FAULT_ENV_VAR, "") if value is None else value
+    specs_ = []
+    for chunk in text.replace(";", ",").split(","):
+        if chunk.strip():
+            specs_.append(parse_spec(chunk))
+    with _lock:
+        for spec in specs_:
+            _registry[spec.site] = spec
+        _env_loaded = True
+    return specs_
+
+
+def _ensure_env_loaded():
+    """First-use env load, check-and-apply under ONE lock hold: concurrent
+    first callers (e.g. two dataloader workers) must not each re-parse the
+    env — the second parse would replace armed specs and reset their fires
+    counters, breaking max_fires determinism."""
+    global _env_loaded
+    with _lock:
+        if _env_loaded:
+            return
+        text = os.environ.get(FAULT_ENV_VAR, "")
+        for chunk in text.replace(";", ",").split(","):
+            if chunk.strip():
+                spec = parse_spec(chunk)
+                _registry[spec.site] = spec
+        _env_loaded = True
+
+
+def specs():
+    """Snapshot of armed sites (site -> FaultSpec)."""
+    with _lock:
+        return dict(_registry)
+
+
+def fault_point(site):
+    """The seam: no-op unless `site` is armed and its draw fires."""
+    if not _env_loaded:
+        _ensure_env_loaded()
+    if not _registry:  # benign unlocked read: the common all-clear fast path
+        return
+    with _lock:
+        spec = _registry.get(site)
+        fire = spec.should_fire() if spec is not None else False
+    if fire:
+        from .. import observability as _obs
+
+        _obs.add("resilience.faults_injected")
+        _obs.add(f"resilience.faults_injected.{site}")
+        raise _make_error(spec.kind, site)

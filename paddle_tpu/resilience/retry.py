@@ -1,0 +1,270 @@
+"""Retry with exponential backoff, full jitter, timeouts, and a deadline.
+
+Long-running multi-host training loops (PAPERS.md: arxiv 2004.13336's
+weight-update sharding, EQuARX's collective layer) assume the host side
+retries transient failures instead of dying; this is that discipline as a
+library. Backoff follows the "full jitter" scheme (delay drawn uniformly
+from [0, min(max_delay, base*2^attempt)]) so a pod of workers retrying the
+same dead FS does not thunder back in lockstep.
+
+Three call shapes share one policy object::
+
+    @retry(max_attempts=5, name="checkpoint.publish")
+    def publish(): ...
+
+    retry(deadline=30.0).call(fs.upload, local, remote)
+
+    for attempt in retry(max_attempts=4):
+        with attempt:            # retryable exceptions inside the body are
+            flaky_io()           # swallowed + slept on until attempts/
+                                 # deadline run out, then re-raised
+
+What counts as retryable is the `retry_on` classifier: an exception tuple
+or a ``callable(exc) -> bool``. The default treats OSError /
+ConnectionError / TimeoutError and the taxonomy's UnavailableError /
+ExecutionTimeoutError / ResourceExhaustedError as transient, and honors an
+explicit ``exc.retryable`` attribute either way (so
+CheckpointCorruptionError — an OSError — stays fatal).
+
+Counters through the PR-1 observability registry: ``resilience.retries``,
+``resilience.giveups`` (plus ``.<name>``-suffixed variants when the policy
+is named). ``clock``/``sleep``/``rng`` are injectable for deterministic
+tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import threading
+import time
+
+__all__ = ["backoff_delay", "default_retryable", "retry"]
+
+
+def default_retryable(exc):
+    """Transient-failure classifier; `exc.retryable` overrides when set."""
+    flag = getattr(exc, "retryable", None)
+    if flag is not None:
+        return bool(flag)
+    from .. import errors
+
+    return isinstance(
+        exc,
+        (
+            ConnectionError,
+            TimeoutError,
+            OSError,
+            errors.UnavailableError,
+            errors.ExecutionTimeoutError,
+            errors.ResourceExhaustedError,
+        ),
+    )
+
+
+def backoff_delay(attempt, base_delay=0.1, max_delay=30.0, rng=None):
+    """Delay before retry number `attempt` (1-based): full jitter over an
+    exponentially growing cap. rng=None -> no jitter (the deterministic
+    upper envelope, what the launcher's restart loop uses)."""
+    cap = min(float(max_delay), float(base_delay) * (2.0 ** (attempt - 1)))
+    return rng.uniform(0.0, cap) if rng is not None else cap
+
+
+class _Attempt:
+    """One try in the `for attempt in retry(...)` shape: a context manager
+    that reports success/failure back to the policy."""
+
+    __slots__ = ("_policy", "number")
+
+    def __init__(self, policy, number):
+        self._policy = policy
+        self.number = number
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is None:
+            self._policy._succeeded = True
+            return False
+        if isinstance(exc, BaseException) and not isinstance(exc, Exception):
+            return False  # KeyboardInterrupt etc.: never swallowed
+        return self._policy._absorb(exc)  # True -> swallowed, will retry
+
+
+class _RetryPolicy:
+    def __init__(
+        self,
+        max_attempts=3,
+        base_delay=0.1,
+        max_delay=30.0,
+        deadline=None,
+        attempt_timeout=None,
+        retry_on=default_retryable,
+        name=None,
+        sleep=time.sleep,
+        clock=time.monotonic,
+        rng=None,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.deadline = None if deadline is None else float(deadline)
+        self.attempt_timeout = (
+            None if attempt_timeout is None else float(attempt_timeout)
+        )
+        self.retry_on = retry_on
+        self.name = name
+        self._sleep = sleep
+        self._clock = clock
+        self._rng = rng if rng is not None else random.Random()
+        # iterator-shape state
+        self._attempt_no = 0
+        self._succeeded = False
+        self._t0 = None
+
+    # -- classification ----------------------------------------------------
+    def _is_retryable(self, exc):
+        if callable(self.retry_on) and not isinstance(self.retry_on, type):
+            return bool(self.retry_on(exc))
+        return isinstance(exc, self.retry_on)
+
+    def _count(self, what):
+        from .. import observability as _obs
+
+        _obs.add(f"resilience.{what}")
+        if self.name:
+            _obs.add(f"resilience.{what}.{self.name}")
+
+    # -- core decision -----------------------------------------------------
+    def _decide(self, exc, attempt_no, t0):
+        """Seconds to back off before retrying, or None to give up (the
+        giveup is counted here; the retry is counted by the caller once it
+        actually commits — a runaway-attempt fence can still veto it).
+        Pure of policy-instance state so concurrent `call`s (e.g. one
+        decorated fetch shared by every dataloader worker thread) don't
+        race."""
+        if not self._is_retryable(exc):
+            # a first-attempt non-retryable failure is an ordinary error,
+            # not an abandoned retry budget — don't pollute the giveups
+            # metric operators alert on
+            if attempt_no > 1:
+                self._count("giveups")
+            return None
+        if attempt_no >= self.max_attempts:
+            self._count("giveups")
+            return None
+        delay = backoff_delay(
+            attempt_no, self.base_delay, self.max_delay, self._rng
+        )
+        if (
+            self.deadline is not None
+            and (self._clock() - t0) + delay > self.deadline
+        ):
+            self._count("giveups")
+            return None
+        return delay
+
+    def _absorb(self, exc):
+        delay = self._decide(exc, self._attempt_no, self._t0)
+        if delay is None:
+            return False
+        self._count("retries")
+        if delay > 0:
+            self._sleep(delay)
+        return True
+
+    # -- iterator shape ----------------------------------------------------
+    def __iter__(self):
+        self._attempt_no = 0
+        self._succeeded = False
+        self._t0 = self._clock()
+        return self
+
+    def __next__(self):
+        if self._succeeded:
+            raise StopIteration
+        if self._attempt_no >= self.max_attempts:
+            # only reachable when _absorb declined to swallow — the body's
+            # exception already propagated, so this is a plain stop
+            raise StopIteration
+        self._attempt_no += 1
+        return _Attempt(self, self._attempt_no)
+
+    # -- callable shapes ---------------------------------------------------
+    def _run_attempt(self, fn, args, kwargs, runaway):
+        if self.attempt_timeout is None:
+            return fn(*args, **kwargs)
+        box = {}
+
+        def target():
+            try:
+                box["value"] = fn(*args, **kwargs)
+            except BaseException as e:  # re-raised on the caller thread
+                box["error"] = e
+
+        t = threading.Thread(
+            target=target, daemon=True,
+            name=f"retry-attempt-{self.name or 'anon'}",
+        )
+        t.start()
+        t.join(self.attempt_timeout)
+        if t.is_alive():
+            from .. import errors
+
+            # the runaway thread is abandoned (daemon): Python cannot kill
+            # it, but the caller gets control back — the hang-proofing half
+            # of the contract. call() refuses to start the next attempt
+            # while this one is still running (no concurrent duplicates of
+            # a possibly non-reentrant fn).
+            runaway.append(t)
+            raise errors.ExecutionTimeoutError(
+                f"attempt exceeded {self.attempt_timeout}s"
+                + (f" in {self.name!r}" if self.name else "")
+            )
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    def call(self, fn, *args, **kwargs):
+        attempt_no, t0 = 0, self._clock()
+        runaway = []  # timed-out attempt threads still running fn
+        while True:
+            attempt_no += 1
+            try:
+                return self._run_attempt(fn, args, kwargs, runaway)
+            except Exception as exc:
+                delay = self._decide(exc, attempt_no, t0)
+                if delay is None:
+                    raise
+                if runaway:
+                    # spend the backoff waiting for abandoned attempts; if
+                    # any is STILL alive, give up rather than run two
+                    # copies of fn concurrently (torn-write hazard)
+                    deadline = self._clock() + max(delay, 0.0)
+                    for t in runaway:
+                        t.join(max(0.0, deadline - self._clock()))
+                    if any(t.is_alive() for t in runaway):
+                        self._count("giveups")
+                        raise
+                    runaway.clear()
+                    self._count("retries")
+                else:
+                    self._count("retries")
+                    if delay > 0:
+                        self._sleep(delay)
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+
+        wrapper.retry_policy = self
+        return wrapper
+
+
+def retry(**kwargs):
+    """Build a retry policy; see module docstring for the three shapes."""
+    return _RetryPolicy(**kwargs)

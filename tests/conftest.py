@@ -42,6 +42,7 @@ def _isolate_global_state():
     from paddle_tpu.observability import metrics as _met
     from paddle_tpu.observability import spans as _spans
     from paddle_tpu.parallel import mesh as _mesh
+    from paddle_tpu.resilience import faults as _faults
 
     saved_metrics = copy.deepcopy(
         (_met._counters, _met._gauges, _met._histograms)
@@ -55,6 +56,7 @@ def _isolate_global_state():
     saved_startup = _prog._startup_program
     saved_device = _prog._current_device
     saved_gen = _un._generator
+    saved_faults = (dict(_faults._registry), _faults._env_loaded)
     try:
         yield
     finally:
@@ -74,3 +76,6 @@ def _isolate_global_state():
         _prog._startup_program = saved_startup
         _prog._current_device = saved_device
         _un._generator = saved_gen
+        _faults._registry.clear()
+        _faults._registry.update(saved_faults[0])
+        _faults._env_loaded = saved_faults[1]
