@@ -93,6 +93,113 @@ print(f"chaos smoke OK: loss {first:.4f} -> {last:.4f}, "
       f"giveups={c.get('resilience.giveups', 0)}")
 EOF
 
+echo "== health-guard chaos smoke: nonfinite skip =="
+python - <<'EOF'
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability
+from paddle_tpu.resilience import TrainGuard, faults
+
+rng = np.random.RandomState(0)
+W = rng.randn(4, 1).astype(np.float32)
+x = fluid.data("x", [-1, 4])
+y = fluid.data("y", [-1, 1])
+pred = layers.fc(x, 1)
+loss = layers.mean(layers.square_error_cost(pred, y))
+fluid.optimizer.SGD(0.05).minimize(loss)
+exe = fluid.Executor()
+exe.run(fluid.default_startup_program())
+
+# every 4th step arrives NaN-poisoned; the guard must skip each one with
+# ZERO weight updates and the run must still converge
+from paddle_tpu.framework.scope import global_scope
+
+def params():
+    return {
+        v.name: np.asarray(global_scope().find_var(v.name)).copy()
+        for v in fluid.default_main_program().list_vars()
+        if v.persistable and global_scope().find_var(v.name) is not None
+    }
+
+losses, skipped = [], 0
+with TrainGuard(exe) as g:
+    for step in range(24):
+        if step % 4 == 3:
+            faults.inject("guard.step", "nonfinite", 1.0, 0, 1)
+            before = params()
+        xa = rng.randn(8, 4).astype(np.float32)
+        out = g.step(feed={"x": xa, "y": xa @ W}, fetch_list=[loss])
+        if step % 4 == 3:
+            assert out is None, "poisoned step was not skipped"
+            after = params()
+            for name, val in before.items():
+                np.testing.assert_array_equal(val, after[name])
+            skipped += 1
+        else:
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+c = observability.snapshot()["counters"]
+assert skipped == 6 and c.get("resilience.bad_steps", 0) == 6, c
+first, last = np.mean(losses[:4]), np.mean(losses[-4:])
+assert last < first, f"guarded run failed to converge: {first} -> {last}"
+print(f"nonfinite chaos OK: loss {first:.4f} -> {last:.4f}, "
+      f"bad_steps={c['resilience.bad_steps']} (all skipped, zero updates)")
+EOF
+
+echo "== health-guard chaos smoke: hung rank killed + restarted =="
+# the workers are launched by script path, so the repo root must be
+# importable from their sys.path
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+HANG_DIR=$(mktemp -d)
+python -m paddle_tpu.distributed.launch \
+    --nproc_per_node 2 --simulate_cpu --elastic \
+    --max_restarts 2 --restart_backoff 0.1 \
+    --heartbeat_dir "$HANG_DIR/hb" --heartbeat_timeout 20 \
+    tests/dist_hang_worker.py "$HANG_DIR" 2> "$HANG_DIR/launch.log" \
+    || { cat "$HANG_DIR/launch.log"; exit 1; }
+grep -q "hung" "$HANG_DIR/launch.log"
+grep -q "restart 1/2" "$HANG_DIR/launch.log"
+python - "$HANG_DIR" <<'EOF'
+import json, sys
+r1 = json.load(open(sys.argv[1] + "/hang_losses_1.json"))
+assert r1["attempt"] == 1, "rank 1 result not written by its restart"
+assert r1["losses"][-1] < r1["losses"][0], "restarted rank did not converge"
+print(f"hang chaos OK: rank 1 killed+restarted, "
+      f"loss {r1['losses'][0]:.4f} -> {r1['losses'][-1]:.4f}")
+EOF
+rm -rf "$HANG_DIR"
+
+echo "== health-guard chaos smoke: SIGTERM preemption drain =="
+PRE_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu python tests/dist_preempt_worker.py "$PRE_DIR" \
+    > "$PRE_DIR/worker.log" 2>&1 &
+WPID=$!
+for _ in $(seq 600); do
+    [ -f "$PRE_DIR/ready" ] && break
+    kill -0 "$WPID" 2>/dev/null || { cat "$PRE_DIR/worker.log"; exit 1; }
+    sleep 0.2
+done
+[ -f "$PRE_DIR/ready" ] || { echo "worker never ready"; exit 1; }
+kill -TERM "$WPID"
+rc=0; wait "$WPID" || rc=$?
+[ "$rc" -eq 75 ] || {
+    echo "expected PREEMPTION_EXIT_CODE 75, got $rc"
+    cat "$PRE_DIR/worker.log"; exit 1
+}
+python - "$PRE_DIR" <<'EOF'
+import sys
+import paddle_tpu as fluid
+from paddle_tpu.fleet import collective as fc
+from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+
+fleet = fc.Fleet()
+fleet.init(UserDefinedRoleMaker())
+# load verifies the CRC manifest before any scope mutation
+status = fleet.load_check_point(fluid.Executor(), sys.argv[1] + "/ckpts")
+assert status == fc.TrainStatus(0), status
+print("preemption chaos OK: exit code 75 + final checkpoint verified")
+EOF
+rm -rf "$PRE_DIR"
+
 echo "== driver entry points =="
 python __graft_entry__.py
 

@@ -65,6 +65,46 @@ class OptimizerWithMixedPrecision:
     def get_loss_scaling(self):
         return self._loss_scaling
 
+    def note_step(self, good, scope=None):
+        """Host-side dynamic-loss-scale feedback for good/bad steps
+        detected OUTSIDE the compiled block (TrainGuard's fused finite
+        check on fetches). Runs the same automaton as the in-graph
+        `update_loss_scaling` op against the persistable state vars:
+        a bad step zeroes good_steps and decays the scale after
+        `decr_every_n_nan_or_inf` consecutive bad ones; a good step
+        grows it after `incr_every_n_steps`. No-op before `minimize`
+        built the state or when the vars are not in `scope` yet."""
+        import numpy as np
+
+        from ...framework.scope import global_scope
+
+        if self._loss_scaling is None:
+            return None
+        scope = scope or global_scope()
+        names = (
+            self._loss_scaling.name,
+            self._good_steps.name,
+            self._bad_steps.name,
+        )
+        vals = [scope.find_var(n) for n in names]
+        if any(v is None for v in vals):
+            return None
+        scale = float(np.asarray(vals[0]).reshape(-1)[0])
+        good_n = int(np.asarray(vals[1]).reshape(-1)[0])
+        bad_n = int(np.asarray(vals[2]).reshape(-1)[0])
+        if good:
+            good_n, bad_n = good_n + 1, 0
+            if self._use_dynamic and good_n >= self._incr_every:
+                scale, good_n = scale * self._incr_ratio, 0
+        else:
+            good_n, bad_n = 0, bad_n + 1
+            if self._use_dynamic and bad_n >= self._decr_every:
+                scale, bad_n = scale * self._decr_ratio, 0
+        scope.set_var(names[0], np.asarray([scale], dtype=np.float32))
+        scope.set_var(names[1], np.asarray([good_n], dtype=np.int32))
+        scope.set_var(names[2], np.asarray([bad_n], dtype=np.int32))
+        return scale
+
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         main = loss.block.program

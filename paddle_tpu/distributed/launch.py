@@ -21,10 +21,16 @@ from __future__ import annotations
 
 import argparse
 import os
+import random
 import signal
 import subprocess
 import sys
 import time
+
+# full-jitter source for restart backoff: same-tick deaths draw
+# independent delays instead of thundering back in lockstep (seedable in
+# tests for determinism)
+_restart_rng = random.Random()
 
 
 def parse_args(argv=None):
@@ -48,7 +54,16 @@ def parse_args(argv=None):
                    help="per-rank restart budget under --elastic")
     p.add_argument("--restart_backoff", type=float, default=0.5,
                    help="base seconds for the restart backoff "
-                        "(doubles per restart of that rank, capped at 10s)")
+                        "(doubles per restart of that rank, capped at 10s, "
+                        "full jitter so same-tick deaths respawn staggered)")
+    p.add_argument("--heartbeat_dir", type=str, default=None,
+                   help="directory of per-rank hb_rank{K} liveness files; "
+                        "exported to children as PADDLE_HEARTBEAT_DIR so "
+                        "TrainGuard/Heartbeat auto-beat once per step")
+    p.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                   help="seconds without a heartbeat before a child is "
+                        "declared HUNG and SIGTERM→SIGKILLed (then routed "
+                        "through the --elastic restart path); 0 disables")
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -110,6 +125,10 @@ def spawn_trainer(args, endpoints, rank, attempt=0):
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
         env.pop("PALLAS_AXON_POOL_IPS", None)
+    if getattr(args, "heartbeat_dir", None):
+        env["PADDLE_HEARTBEAT_DIR"] = args.heartbeat_dir
+        if getattr(args, "heartbeat_timeout", 0):
+            env["PADDLE_HEARTBEAT_TIMEOUT"] = str(args.heartbeat_timeout)
     cmd = [sys.executable, args.training_script] + args.training_script_args
     # fresh spawn truncates; a restart appends so the crash that triggered
     # it stays readable in the same per-rank log
@@ -124,47 +143,121 @@ def spawn_trainer(args, endpoints, rank, attempt=0):
     proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
     proc._paddle_log = out
     proc._paddle_rank = rank
+    # wall clock: heartbeat staleness compares against beat files written
+    # by another process, and a fresh spawn must reset the stall baseline
+    # even when a pre-kill beat file is still lying around
+    proc._paddle_spawned = time.time()
     return proc
 
 
 def start_local_trainers(args, endpoints, local_ranks):
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
+    if getattr(args, "heartbeat_dir", None):
+        os.makedirs(args.heartbeat_dir, exist_ok=True)
     return [spawn_trainer(args, endpoints, rank) for rank in local_ranks]
+
+
+def _beat_staleness(args, proc, now_wall):
+    """Seconds since `proc`'s rank last proved liveness: its newest beat
+    file if one postdates the spawn, else the spawn itself (a rank hung
+    BEFORE its first beat — e.g. a stuck init collective — must still
+    trip the watchdog; size --heartbeat_timeout above worst-case
+    compile+warmup)."""
+    from ..resilience.health import heartbeat_path, read_beat
+
+    ref = getattr(proc, "_paddle_spawned", now_wall)
+    beat = read_beat(
+        heartbeat_path(args.heartbeat_dir, getattr(proc, "_paddle_rank", 0))
+    )
+    if beat is not None:
+        try:
+            ref = max(ref, float(beat.get("time", ref)))
+        except (TypeError, ValueError):
+            pass
+    return now_wall - ref
+
+
+def _kill_hung(proc, grace=5.0):
+    """SIGTERM a hung child, escalating to SIGKILL after `grace` without
+    blocking the supervision scan (a rank stuck in a native collective
+    routinely ignores SIGTERM forever)."""
+    if getattr(proc, "_paddle_kill_at", None) is None:
+        proc._paddle_hung = True
+        proc._paddle_kill_at = time.monotonic() + grace
+        proc.send_signal(signal.SIGTERM)
+    elif time.monotonic() >= proc._paddle_kill_at:
+        proc.kill()
 
 
 def watch_local_trainers(procs, args=None, endpoints=None):
     """Supervise the pod (reference utils.py watch_local_trainers /
     launch.py:219-226). Default policy: any child failure aborts the pod.
     Under ``--elastic``: a failed non-rank-0 child is restarted with
-    bounded exponential backoff up to ``--max_restarts`` times per rank;
-    rank 0 dying always aborts immediately (it hosts the JAX coordination
-    service, so its death already doomed every peer)."""
+    bounded, full-jittered exponential backoff up to ``--max_restarts``
+    times per rank — each dead rank gets its own independent deadline, so
+    two ranks dying in the same poll tick neither share a slot nor
+    respawn in lockstep. Rank 0 dying always aborts immediately (it hosts
+    the JAX coordination service, so its death already doomed every peer).
+
+    Liveness: with ``--heartbeat_dir``/``--heartbeat_timeout`` a child
+    whose newest beat (or spawn, if it never beat) is older than the
+    timeout is declared HUNG, SIGTERM→SIGKILLed (``resilience.hangs``),
+    and its eventual death is handled exactly like a crash — i.e. routed
+    through the elastic restart path.
+
+    Preemption: a child exiting with the distinguished
+    ``PREEMPTION_EXIT_CODE`` (it drained after SIGTERM and wrote a final
+    checkpoint) is a CLEAN exit — no pod abort, no restart-budget burn —
+    unless the launcher itself killed it as hung."""
+    from ..resilience.health import PREEMPTION_EXIT_CODE
+
     elastic = bool(args and getattr(args, "elastic", False))
     max_restarts = getattr(args, "max_restarts", 3) if args else 3
     backoff_base = getattr(args, "restart_backoff", 0.5) if args else 0.5
+    hb_timeout = float(getattr(args, "heartbeat_timeout", 0) or 0) if args else 0
+    hb_dir = getattr(args, "heartbeat_dir", None) if args else None
+    watch_beats = bool(hb_dir and hb_timeout > 0)
     restarts = {}  # rank -> count
-    pending = {}  # procs index -> monotonic time of the scheduled restart
+    pending = {}  # procs index -> {"deadline": monotonic, "rank": rank}
     try:
         while True:
             alive = False
             now = time.monotonic()
+            now_wall = time.time() if watch_beats else 0.0
             for i, p in enumerate(procs):
                 rc = p.poll()
                 if rc is None:
                     alive = True
+                    if watch_beats and _beat_staleness(
+                        args, p, now_wall
+                    ) > hb_timeout:
+                        if getattr(p, "_paddle_kill_at", None) is None:
+                            rank = getattr(p, "_paddle_rank", i)
+                            print(
+                                f"[launch] rank {rank} (pid {p.pid}) hung: "
+                                f"no heartbeat in {hb_timeout}s; killing",
+                                file=sys.stderr,
+                            )
+                            from .. import observability as _obs
+
+                            _obs.add("resilience.hangs")
+                            _obs.add("resilience.hangs.launcher")
+                        _kill_hung(p)
                     continue
-                if rc == 0:
-                    continue  # clean exit: done, never restarted
+                hung = getattr(p, "_paddle_hung", False)
+                if rc == 0 or (rc == PREEMPTION_EXIT_CODE and not hung):
+                    continue  # clean exit (incl. graceful preemption drain)
                 if i in pending:
                     # backoff in progress: restart when its deadline
                     # arrives; never sleep inline — the scan must keep
                     # monitoring every other child (rank 0's death aborts
                     # immediately even mid-backoff)
                     alive = True
-                    if now >= pending[i]:
+                    entry = pending[i]
+                    if now >= entry["deadline"]:
                         del pending[i]
-                        rank = getattr(p, "_paddle_rank", i)
+                        rank = entry["rank"]
                         log = getattr(p, "_paddle_log", None)
                         if log is not None:
                             log.close()
@@ -177,21 +270,25 @@ def watch_local_trainers(procs, args=None, endpoints=None):
                 if not elastic or rank == 0 or n >= max_restarts:
                     _terminate_pod(procs)
                     raise RuntimeError(
-                        f"trainer rank {rank} (pid {p.pid}) exited with "
-                        f"code {rc}"
+                        f"trainer rank {rank} (pid {p.pid}) "
+                        + ("hung (heartbeat stale) and was killed, exit "
+                           if hung else "exited with ")
+                        + f"code {rc}"
                         + (f" after {n} restart(s)" if elastic and n else "")
                         + "; pod aborted"
                     )
                 restarts[rank] = n + 1
                 from ..resilience import backoff_delay
 
-                delay = backoff_delay(n + 1, backoff_base, 10.0)
+                delay = backoff_delay(n + 1, backoff_base, 10.0,
+                                      rng=_restart_rng)
                 print(
-                    f"[launch --elastic] rank {rank} died (rc={rc}); "
-                    f"restart {n + 1}/{max_restarts} in {delay:.1f}s",
+                    f"[launch --elastic] rank {rank} "
+                    + ("hung (killed)" if hung else f"died (rc={rc})")
+                    + f"; restart {n + 1}/{max_restarts} in {delay:.1f}s",
                     file=sys.stderr,
                 )
-                pending[i] = now + delay
+                pending[i] = {"deadline": now + delay, "rank": rank}
                 alive = True
             if not alive:
                 _terminate_pod(procs)  # reaps + closes log handles

@@ -133,6 +133,34 @@ class CheckpointCorruptionError(EnforceNotMet, OSError):
     retryable = False
 
 
+class NonFiniteError(PreconditionNotMetError):
+    """A NaN/Inf reached a numeric health check: the executor's
+    FLAGS_check_nan_inf per-op scan (which names the offending op via
+    `op=`/`outputs=`) and TrainGuard's always-on fused fetch check both
+    raise this. A PreconditionNotMetError subclass so pre-existing
+    handlers keep working; non-retryable — re-running the same step on
+    the same state reproduces the same NaN."""
+
+    code = ErrorCode.PRECONDITION_NOT_MET
+    retryable = False
+
+    def __init__(self, message, op=None, loc=None, outputs=None):
+        self.outputs = list(outputs) if outputs else []
+        if self.outputs:
+            message = f"{message}; outputs: {self.outputs}"
+        super().__init__(message, op=op, loc=loc)
+
+
+class TrainingDivergedError(EnforceNotMet, RuntimeError):
+    """TrainGuard exhausted its recovery policy: K consecutive non-finite
+    steps and no (remaining) checkpoint to roll back to. The run cannot
+    make progress by retrying — a human (or an outer scheduler with a
+    different initialization/LR) must intervene."""
+
+    code = ErrorCode.FATAL
+    retryable = False
+
+
 def enforce(condition, error):
     """PADDLE_ENFORCE (enforce.h:282): raise `error` (an EnforceNotMet
     instance) unless `condition`."""

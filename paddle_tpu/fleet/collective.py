@@ -216,6 +216,16 @@ class Fleet:
                 fs.delete(os.path.join(path, f"{_CHECKPOINT_PREFIX}{old}"))
         return no
 
+    def has_check_point(self, path, fs=None):
+        """Whether at least one numbered checkpoint exists under `path` —
+        distinguishes 'load would be a cold start' from 'load would
+        restore real weights' (TrainGuard's rollback gate; a loaded
+        checkpoint can legitimately carry TrainStatus(-1))."""
+        from .fs_wrapper import LocalFS
+
+        fs = fs or LocalFS()
+        return bool(fs.is_exist(path) and _checkpoint_numbers(fs, path))
+
     def load_check_point(
         self, executor, path, trainer_id=None, main_program=None, fs=None,
         checkpoint_no=None,
@@ -282,6 +292,14 @@ class TrainStatus:
 
     def __eq__(self, other):
         return isinstance(other, TrainStatus) and self._epoch_no == other._epoch_no
+
+    def __ne__(self, other):
+        # the reference defined __eq__ only, so `status != other` fell back
+        # to identity on py2-style consumers; keep the pair consistent
+        return not self.__eq__(other)
+
+    def __repr__(self):
+        return f"TrainStatus(epoch_no={self._epoch_no})"
 
 
 class CollectiveOptimizer:

@@ -197,14 +197,14 @@ class Executor:
             if bad.any():
                 idx = int(np.argmax(bad))
                 op = compiled.nan_ops[idx]
-                from ..errors import PreconditionNotMetError
+                from ..errors import NonFiniteError
 
-                raise PreconditionNotMetError(
+                raise NonFiniteError(
                     f"NaN/Inf detected in outputs of op #{idx} "
-                    f"{op.type!r}; outputs: "
-                    f"{op.output_names()} — FLAGS_check_nan_inf mode "
+                    f"{op.type!r} — FLAGS_check_nan_inf mode "
                     "(reference details/nan_inf_utils_detail.cc)",
                     op=op,
+                    outputs=op.output_names(),
                 )
         if return_numpy:
             return [np.asarray(f) for f in fetches]

@@ -12,22 +12,39 @@ they share:
   timeout, overall deadline, a retryable-exception classifier, and
   ``resilience.retries`` / ``resilience.giveups`` counters;
 * :mod:`faults` — the ``PADDLE_TPU_FAULT_INJECT`` registry whose
-  :func:`fault_point` seams make every one of those paths chaos-testable
-  deterministically.
+  :func:`fault_point` / :func:`corrupt_point` seams make every one of
+  those paths chaos-testable deterministically;
+* :mod:`health` — per-rank :class:`Heartbeat` liveness files +
+  :class:`StepWatchdog` stall monitor, and the preemption exit-code
+  contract (:data:`PREEMPTION_EXIT_CODE`) the launcher honors;
+* :mod:`guard` — :class:`TrainGuard`, the step-loop wrapper tying it all
+  together: always-on fused finite checks with bad-step skip, AMP
+  loss-scale feedback, checkpoint rollback after K consecutive bad
+  steps, and SIGTERM drain-to-checkpoint.
 
-README §Resilience documents the fault-site catalog, env syntax, metric
-names, and the checkpoint durability guarantees.
+README §Resilience and §Training health guard document the fault-site
+catalog, env syntax, metric names, and the recovery policy knobs.
 """
 
 from __future__ import annotations
 
-from . import faults, retry as _retry_mod  # noqa: F401
+from . import faults, guard as _guard_mod, health  # noqa: F401
+from . import retry as _retry_mod  # noqa: F401
 from .faults import (  # noqa: F401
     FAULT_ENV_VAR,
     FaultSpec,
     clear,
+    corrupt_point,
     fault_point,
     inject,
     reload_env,
+)
+from .guard import TrainGuard  # noqa: F401
+from .health import (  # noqa: F401
+    PREEMPTION_EXIT_CODE,
+    Heartbeat,
+    StepWatchdog,
+    heartbeat_path,
+    read_beat,
 )
 from .retry import backoff_delay, default_retryable, retry  # noqa: F401

@@ -17,6 +17,11 @@ Env syntax (comma/semicolon-separated specs)::
 ``kind`` selects the exception: ``io`` (ExternalError, an OSError),
 ``unavailable`` (UnavailableError), ``timeout`` (ExecutionTimeoutError) —
 all retryable — and ``corrupt`` (CheckpointCorruptionError, NOT retryable).
+Two kinds misbehave instead of raising: ``hang`` sleeps at the seam for
+``PADDLE_TPU_FAULT_HANG_SECONDS`` (default 3600 — "stuck", from a
+watchdog's point of view), and ``nonfinite`` poisons the value passing
+through a :func:`corrupt_point` seam with NaNs (at a plain
+:func:`fault_point` it degrades to raising NonFiniteError).
 ``prob`` in [0,1] is drawn from a per-spec ``random.Random(seed)``; the
 optional ``max_fires`` caps total fires (prob=1 + max_fires=1 = "fail
 exactly once, then heal" — the deterministic shape chaos CI wants).
@@ -24,8 +29,12 @@ exactly once, then heal" — the deterministic shape chaos CI wants).
 Wired seams: ``io.save`` / ``io.load`` (io.py), ``fs.upload`` /
 ``fs.download`` / ``fs.mv`` / ``fs.delete`` (LocalFS), ``fs.hadoop``
 (HadoopFS shell-outs), ``dataloader.fetch`` (worker batch fetch),
-``collective.dispatch`` (trace-time collective emission). The catalog is
-documented in README §Resilience.
+``collective.dispatch`` (trace-time collective emission),
+``guard.step`` (TrainGuard pre-step: corrupt_point over the feed, so
+``nonfinite`` fabricates a divergence and ``hang`` a stuck step),
+``health.beat`` (Heartbeat.beat: ``hang`` makes the beat never land, what
+a stalled rank looks like to the launcher). The catalog is documented in
+README §Resilience.
 """
 
 from __future__ import annotations
@@ -33,11 +42,14 @@ from __future__ import annotations
 import os
 import random
 import threading
+import time
 
 __all__ = [
     "FAULT_ENV_VAR",
+    "HANG_SECONDS_ENV",
     "FaultSpec",
     "clear",
+    "corrupt_point",
     "fault_point",
     "inject",
     "parse_spec",
@@ -46,8 +58,9 @@ __all__ = [
 ]
 
 FAULT_ENV_VAR = "PADDLE_TPU_FAULT_INJECT"
+HANG_SECONDS_ENV = "PADDLE_TPU_FAULT_HANG_SECONDS"
 
-_KINDS = ("io", "unavailable", "timeout", "corrupt")
+_KINDS = ("io", "unavailable", "timeout", "corrupt", "hang", "nonfinite")
 
 
 def _make_error(kind, site):
@@ -62,7 +75,34 @@ def _make_error(kind, site):
         return errors.ExecutionTimeoutError(msg)
     if kind == "corrupt":
         return errors.CheckpointCorruptionError(msg)
+    if kind == "nonfinite":
+        return errors.NonFiniteError(msg)
     raise ValueError(f"unknown fault kind {kind!r} (one of {_KINDS})")
+
+
+def _hang_seconds():
+    try:
+        return float(os.environ.get(HANG_SECONDS_ENV, "3600"))
+    except ValueError:
+        return 3600.0
+
+
+def _poison(value):
+    """NaN-fill every inexact array inside `value` (dict/list/tuple walked
+    recursively; non-float leaves pass through untouched)."""
+    import numpy as np
+
+    if isinstance(value, dict):
+        return {k: _poison(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return type(value)(_poison(v) for v in value)
+    try:
+        arr = np.asarray(value)
+    except Exception:
+        return value
+    if not np.issubdtype(arr.dtype, np.inexact):
+        return value
+    return np.full_like(arr, np.nan)
 
 
 class FaultSpec:
@@ -178,18 +218,49 @@ def specs():
         return dict(_registry)
 
 
-def fault_point(site):
-    """The seam: no-op unless `site` is armed and its draw fires."""
+def _draw(site):
+    """Shared seam core: None when `site` is free or its draw missed, else
+    the armed kind that fired (the fire is counted here)."""
     if not _env_loaded:
         _ensure_env_loaded()
     if not _registry:  # benign unlocked read: the common all-clear fast path
-        return
+        return None
     with _lock:
         spec = _registry.get(site)
         fire = spec.should_fire() if spec is not None else False
-    if fire:
-        from .. import observability as _obs
+    if not fire:
+        return None
+    from .. import observability as _obs
 
-        _obs.add("resilience.faults_injected")
-        _obs.add(f"resilience.faults_injected.{site}")
-        raise _make_error(spec.kind, site)
+    _obs.add("resilience.faults_injected")
+    _obs.add(f"resilience.faults_injected.{site}")
+    return spec.kind
+
+
+def fault_point(site):
+    """The raise-style seam: no-op unless `site` is armed and its draw
+    fires. A fired ``hang`` sleeps instead of raising; ``nonfinite`` at a
+    raise-only seam degrades to raising NonFiniteError."""
+    kind = _draw(site)
+    if kind is None:
+        return
+    if kind == "hang":
+        time.sleep(_hang_seconds())
+        return
+    raise _make_error(kind, site)
+
+
+def corrupt_point(site, value):
+    """The value-corrupting seam: returns `value` (possibly poisoned).
+    A fired ``nonfinite`` NaN-fills every float array inside `value`;
+    ``hang`` sleeps then passes `value` through; raising kinds raise as at
+    :func:`fault_point`."""
+    kind = _draw(site)
+    if kind is None:
+        return value
+    if kind == "hang":
+        time.sleep(_hang_seconds())
+        return value
+    if kind == "nonfinite":
+        return _poison(value)
+    raise _make_error(kind, site)

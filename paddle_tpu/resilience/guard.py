@@ -1,0 +1,348 @@
+"""TrainGuard: the step-loop health guard (detect → skip → rollback → drain).
+
+PR 2 made storage and workers crash-safe; this closes the loop for the
+three ways the *training loop itself* dies on long TPU jobs:
+
+* **numeric blow-up** — every step the guard checks the fetched values
+  (loss, grad norms, whatever the user fetches) with ONE fused on-device
+  ``jnp.isfinite`` reduction. That is the cheap always-on path; the
+  per-op ``FLAGS_check_nan_inf`` executor mode stays available for
+  debugging *which* op went bad. A bad step is **skipped**: the guard
+  restores the pre-step parameter/optimizer state it snapshotted (device
+  copies of the program's persistables; jax arrays are immutable so this
+  is one device-to-device copy per step), bumps ``resilience.bad_steps``,
+  and feeds the AMP dynamic-loss-scale automaton
+  (``OptimizerWithMixedPrecision.note_step``) so fp16 users get scale
+  decay for free. After `max_bad_steps` CONSECUTIVE bad steps — the same
+  state keeps reproducing the NaN, so skipping cannot help — it rolls
+  back by reloading the newest valid checkpoint through
+  ``Fleet.load_check_point`` (PR-2 corrupt-fallback included) and raises
+  :class:`errors.TrainingDivergedError` once the rollback budget is gone.
+
+* **hung step** — the guard touches its :class:`health.Heartbeat` once
+  per completed step; the launcher's ``--heartbeat_timeout`` watcher (or
+  an in-process :class:`health.StepWatchdog` via `watchdog_timeout=`)
+  notices when the beats stop.
+
+* **preemption** — ``__enter__`` installs a SIGTERM handler that only
+  sets a drain flag; the loop finishes its current step, then the guard
+  writes a final ``Fleet.save_check_point`` and exits with
+  :data:`health.PREEMPTION_EXIT_CODE`, which the launcher (and its
+  ``--elastic`` restart accounting) treats as a clean exit.
+
+Usage::
+
+    with TrainGuard(exe, program=main, fleet=fleet,
+                    checkpoint_dir="ckpts") as g:
+        for epoch in range(epochs):
+            for feed in loader:
+                out = g.step(feed=feed, fetch_list=[loss])
+                if out is None:
+                    continue            # non-finite step was skipped
+            g.train_status = TrainStatus(epoch)
+
+Chaos seam: ``guard.step`` (``nonfinite`` poisons the feed, ``hang``
+sleeps pre-step); metrics: ``resilience.bad_steps``, ``.rollbacks``,
+``.preemptions``, ``guard.steps``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+from .health import (
+    HEARTBEAT_DIR_ENV,
+    HEARTBEAT_TIMEOUT_ENV,
+    PREEMPTION_EXIT_CODE,
+    Heartbeat,
+    StepWatchdog,
+)
+
+__all__ = ["TrainGuard"]
+
+
+def _device_copy(value):
+    """A genuinely separate buffer: executor donation may invalidate the
+    scope's old arrays on device backends, so a reference is not a
+    snapshot. Stays on device for jax arrays (device-to-device copy)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if isinstance(value, np.ndarray):
+        return value.copy()
+    try:
+        return jnp.array(value, copy=True)
+    except Exception:
+        return value
+
+
+class TrainGuard:
+    """Wrap a training step loop with numeric-health skip/rollback,
+    heartbeat liveness, and preemption-graceful shutdown. See the module
+    docstring for the policy; constructor knobs:
+
+    executor, program:  what to run (program=None → default main program).
+    fleet, checkpoint_dir, fs:  enable rollback (load_check_point) and the
+        final preemption checkpoint (save_check_point).
+    max_bad_steps:  consecutive non-finite steps before a rollback (or
+        TrainingDivergedError when rollback is unavailable). Default 3.
+    max_rollbacks:  rollback budget; the next rollback request past it
+        raises TrainingDivergedError. Default 2.
+    amp:  an OptimizerWithMixedPrecision to feed good/bad steps into.
+    snapshot:  pre-step persistable snapshot enabling bad-step skip
+        (default True; set False to trade skip-exactness for zero copy
+        overhead — AMP's zeroed grads still no-op the update for fp16).
+    heartbeat:  a health.Heartbeat, or None to auto-create when the
+        launcher exported PADDLE_HEARTBEAT_DIR (else no beats).
+    watchdog_timeout:  seconds to arm an in-process StepWatchdog
+        (None → PADDLE_HEARTBEAT_TIMEOUT env when launched with a
+        heartbeat dir, else off).
+    exit_on_preempt:  raise SystemExit(PREEMPTION_EXIT_CODE) after the
+        drain checkpoint (default True); False just sets `.preempted`.
+    """
+
+    def __init__(
+        self,
+        executor,
+        program=None,
+        scope=None,
+        fleet=None,
+        checkpoint_dir=None,
+        fs=None,
+        max_bad_steps=3,
+        max_rollbacks=2,
+        amp=None,
+        snapshot=True,
+        heartbeat=None,
+        watchdog_timeout=None,
+        exit_on_preempt=True,
+        train_status=None,
+    ):
+        self.executor = executor
+        self.program = program
+        self.scope = scope
+        self.fleet = fleet
+        self.checkpoint_dir = checkpoint_dir
+        self.fs = fs
+        self.max_bad_steps = int(max_bad_steps)
+        self.max_rollbacks = int(max_rollbacks)
+        self.amp = amp
+        self.snapshot = snapshot
+        self.exit_on_preempt = exit_on_preempt
+        self.train_status = train_status
+
+        self.steps = 0
+        self.bad_steps = 0
+        self.bad_streak = 0
+        self.rollbacks = 0
+        self.preempted = False
+        self.draining = False
+
+        if heartbeat is None and os.environ.get(HEARTBEAT_DIR_ENV):
+            heartbeat = Heartbeat()
+        self.heartbeat = heartbeat
+        if watchdog_timeout is None and heartbeat is not None:
+            env = os.environ.get(HEARTBEAT_TIMEOUT_ENV)
+            watchdog_timeout = float(env) if env else None
+        self._watchdog_timeout = watchdog_timeout
+        self.watchdog = None
+        self._old_sigterm = None
+        self._finalized = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self):
+        # SIGTERM → drain flag only: signal-safe, and the current step (a
+        # device computation mid-flight) finishes instead of being torn
+        if threading.current_thread() is threading.main_thread():
+            self._old_sigterm = signal.getsignal(signal.SIGTERM)
+            signal.signal(signal.SIGTERM, self._on_sigterm)
+        if self._watchdog_timeout:
+            self.watchdog = StepWatchdog(
+                self._watchdog_timeout, name="guard"
+            ).start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
+        if self._old_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._old_sigterm)
+            self._old_sigterm = None
+        # drain requested right at loop end (no further step() call):
+        # still honor the preemption contract on the way out
+        if exc_type is None and self.draining and not self._finalized:
+            self._finalize_preemption()
+        return False
+
+    def _on_sigterm(self, signum, frame):
+        self.draining = True
+
+    # -- the guarded step --------------------------------------------------
+    def step(self, feed=None, fetch_list=None, program=None,
+             return_numpy=True, **run_kw):
+        """Run one guarded training step. Returns the fetches, or None when
+        the step was skipped (non-finite) or the loop is draining."""
+        if self.draining:
+            return self._finalize_preemption()
+        from .. import observability as _obs
+        from . import faults
+
+        program = program if program is not None else self.program
+        # chaos seam: "nonfinite" poisons the feed (a corrupted batch is
+        # how real blow-ups arrive), "hang" sticks the step pre-beat
+        feed = faults.corrupt_point("guard.step", feed)
+
+        saved = self._snapshot(program) if self.snapshot else None
+        fetches = self.executor.run(
+            program, feed=feed, fetch_list=fetch_list,
+            scope=self.scope, return_numpy=False, **run_kw,
+        )
+        good = self._all_finite(fetches)
+        self.steps += 1
+        _obs.add("guard.steps")
+
+        if good:
+            # no amp.note_step here: the in-graph update_loss_scaling op
+            # already counted this good step — feeding it again would
+            # double the scale-growth rate
+            self.bad_streak = 0
+            out = self._to_numpy(fetches) if return_numpy else list(fetches)
+        else:
+            self._skip_bad_step(saved)
+            out = None
+        self._beat()
+        if self.draining:
+            return self._finalize_preemption()
+        return out
+
+    def _skip_bad_step(self, saved):
+        from .. import observability as _obs
+        from ..errors import TrainingDivergedError
+
+        self.bad_steps += 1
+        self.bad_streak += 1
+        _obs.add("resilience.bad_steps")
+        if saved is not None:
+            scope = self._scope()
+            for name, value in saved.items():
+                scope.set_var(name, value)
+            # AFTER the restore (which reverted the in-graph automaton's
+            # own decay), so exactly ONE decay survives the skip; with
+            # snapshot=False the in-graph update_loss_scaling op already
+            # decayed — feeding it again would double-decay
+            if self.amp is not None:
+                self.amp.note_step(False, scope=self.scope)
+        if self.bad_streak < self.max_bad_steps:
+            return
+        # the same state keeps producing NaNs: skipping cannot help — roll
+        # back to the newest valid checkpoint, if the budget allows.
+        # has_check_point gates the load: load_check_point returns
+        # TrainStatus(-1) BOTH for "nothing on disk" (cold start, scope
+        # untouched) and for a real checkpoint whose status predates the
+        # first epoch — only the former means rollback is impossible.
+        if (
+            self.fleet is not None and self.checkpoint_dir is not None
+            and self.rollbacks < self.max_rollbacks
+            and self.fleet.has_check_point(self.checkpoint_dir, fs=self.fs)
+        ):
+            self.train_status = self.fleet.load_check_point(
+                self.executor, self.checkpoint_dir,
+                main_program=self.program, fs=self.fs,
+            )
+            self.rollbacks += 1
+            self.bad_streak = 0
+            _obs.add("resilience.rollbacks")
+            return
+        if self.fleet is None or self.checkpoint_dir is None:
+            why = "no fleet/checkpoint_dir configured for rollback"
+        elif self.rollbacks >= self.max_rollbacks:
+            why = f"rollback budget {self.max_rollbacks} exhausted"
+        else:
+            why = "no checkpoint available to roll back to"
+        raise TrainingDivergedError(
+            f"{self.bad_streak} consecutive non-finite steps and no "
+            f"recovery left ({why}); total bad steps: {self.bad_steps}"
+        )
+
+    # -- preemption drain --------------------------------------------------
+    def _finalize_preemption(self):
+        """Final checkpoint + distinguished exit, once."""
+        if self._finalized:
+            if self.exit_on_preempt:
+                raise SystemExit(PREEMPTION_EXIT_CODE)
+            return None
+        self._finalized = True
+        self.preempted = True
+        from .. import observability as _obs
+
+        _obs.add("resilience.preemptions")
+        if self.fleet is not None and self.checkpoint_dir is not None:
+            from ..fleet.collective import TrainStatus
+
+            status = (
+                self.train_status if self.train_status is not None
+                else TrainStatus(-1)
+            )
+            self.fleet.save_check_point(
+                self.executor, self.checkpoint_dir, status,
+                main_program=self.program, fs=self.fs,
+            )
+        if self.exit_on_preempt:
+            raise SystemExit(PREEMPTION_EXIT_CODE)
+        return None
+
+    # -- helpers -----------------------------------------------------------
+    def _scope(self):
+        from ..framework.scope import global_scope
+
+        return self.scope if self.scope is not None else global_scope()
+
+    def _resolved_program(self, program=None):
+        from ..framework.program import default_main_program
+
+        program = program if program is not None else self.program
+        program = program if program is not None else default_main_program()
+        return getattr(program, "program", program)
+
+    def _snapshot(self, program):
+        """Pre-step copies of every scope-resident persistable of the
+        program — restoring them IS the skip."""
+        program = self._resolved_program(program)
+        scope = self._scope()
+        saved = {}
+        for var in program.list_vars():
+            if not getattr(var, "persistable", False):
+                continue
+            value = scope.find_var(var.name)
+            if value is not None:
+                saved[var.name] = _device_copy(value)
+        return saved
+
+    @staticmethod
+    def _all_finite(fetches):
+        """ONE fused on-device reduction over every inexact fetch."""
+        import jax.numpy as jnp
+
+        flags = [
+            jnp.all(jnp.isfinite(f))
+            for f in fetches
+            if jnp.issubdtype(jnp.asarray(f).dtype, jnp.inexact)
+        ]
+        if not flags:
+            return True
+        return bool(jnp.stack(flags).all())
+
+    @staticmethod
+    def _to_numpy(fetches):
+        import numpy as np
+
+        return [np.asarray(f) for f in fetches]
+
+    def _beat(self):
+        if self.heartbeat is not None:
+            self.heartbeat.beat()
+        if self.watchdog is not None:
+            self.watchdog.touch()

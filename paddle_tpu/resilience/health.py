@@ -1,0 +1,235 @@
+"""Heartbeat liveness + step watchdog (the health half of the guard layer).
+
+A hung step is the failure mode PR 2 could not see: the elastic launcher
+only notices children that *exit*, while a rank stuck in a collective or a
+starved input pipeline blocks forever with a perfectly healthy process
+table. The fix is a liveness contract:
+
+* each trainer owns a :class:`Heartbeat` and touches it once per step —
+  a single small file ``{dir}/hb_rank{K}`` holding a monotonic step
+  counter plus a wall-clock timestamp, published atomically with the PR-2
+  temp+``os.replace`` idiom so the launcher never reads a torn beat;
+* the launcher (``--heartbeat_dir/--heartbeat_timeout``) reads the beats
+  from its supervision loop and treats a stale one like a dead child:
+  SIGTERM→SIGKILL the hung rank and route it through the ``--elastic``
+  restart path (``resilience.hangs`` counters);
+* in-process, a :class:`StepWatchdog` monitor thread invokes a callback
+  when no beat/touch lands within its timeout — the cheap way for a
+  single-process loop to self-report a stall it cannot unblock.
+
+The preemption half of the contract lives here too:
+:data:`PREEMPTION_EXIT_CODE` is the distinguished exit code a drained
+trainer exits with after a SIGTERM (guard.py); the launcher treats it as a
+clean exit — no pod abort, no restart-budget burn.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+__all__ = [
+    "HEARTBEAT_DIR_ENV",
+    "HEARTBEAT_TIMEOUT_ENV",
+    "PREEMPTION_EXIT_CODE",
+    "Heartbeat",
+    "StepWatchdog",
+    "heartbeat_path",
+    "read_beat",
+]
+
+# Exit code a preempted (SIGTERM-drained) trainer exits with after writing
+# its final checkpoint. 75 is EX_TEMPFAIL ("temporary failure, retry
+# later") — exactly the semantics of a preemption — and collides with no
+# Python/pytest/signal convention (negative codes and 128+N mean "killed
+# by signal N" to the launcher's Popen).
+PREEMPTION_EXIT_CODE = 75
+
+# Env plumbing: the launcher exports these so a TrainGuard/Heartbeat in
+# the child auto-configures without flag threading.
+HEARTBEAT_DIR_ENV = "PADDLE_HEARTBEAT_DIR"
+HEARTBEAT_TIMEOUT_ENV = "PADDLE_HEARTBEAT_TIMEOUT"
+
+
+def heartbeat_path(directory, rank):
+    """The beat file for `rank` — the {dir}/hb_rank{K} naming contract
+    shared by Heartbeat (writer) and the launcher (reader)."""
+    return os.path.join(directory, f"hb_rank{int(rank)}")
+
+
+def read_beat(path):
+    """Parse one beat file -> dict(rank, step, time), or None when the file
+    is missing or torn (a beat mid-publish is indistinguishable from no
+    beat; the next poll sees the full one)."""
+    try:
+        with open(path) as f:
+            beat = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return beat if isinstance(beat, dict) else None
+
+
+class Heartbeat:
+    """Per-rank liveness file a trainer touches once per step.
+
+    ``beat()`` bumps a monotonic step counter and atomically publishes
+    ``{"rank": K, "step": N, "time": wall}`` to ``{dir}/hb_rank{K}``
+    (temp file + ``os.replace`` in the same directory — a reader never
+    sees a torn write). Wall-clock time is deliberate: launcher and
+    trainer are different processes and the launcher compares the beat
+    against its own clock.
+
+    `directory`/`rank` default from the launcher's env
+    (PADDLE_HEARTBEAT_DIR / PADDLE_TRAINER_ID), so library code can do
+    ``Heartbeat()`` inside any launched trainer.
+    """
+
+    def __init__(self, directory=None, rank=None, _time=time.time):
+        if directory is None:
+            directory = os.environ.get(HEARTBEAT_DIR_ENV)
+        if directory is None:
+            raise ValueError(
+                "Heartbeat needs a directory (arg or "
+                f"{HEARTBEAT_DIR_ENV} env)"
+            )
+        if rank is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.directory = directory
+        self.rank = int(rank)
+        self.step = 0
+        self._time = _time
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def path(self):
+        return heartbeat_path(self.directory, self.rank)
+
+    def beat(self, step=None):
+        """Publish one liveness beat (and return its payload). `step`
+        overrides the monotonic counter (e.g. to resume after a restart
+        from a checkpointed step number)."""
+        from .faults import fault_point
+
+        # the chaos seam: an armed "hang" sleeps HERE, i.e. the beat never
+        # lands — exactly what a stuck collective looks like to a watcher
+        fault_point("health.beat")
+        self.step = self.step + 1 if step is None else int(step)
+        payload = {"rank": self.rank, "step": self.step, "time": self._time()}
+        fd, tmp = tempfile.mkstemp(
+            dir=self.directory, prefix=f"hb_rank{self.rank}.tmp."
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        from .. import observability as _obs
+
+        _obs.add("resilience.heartbeats")
+        return payload
+
+
+class StepWatchdog:
+    """Monitor thread that fires when no ``touch()`` lands within `timeout`.
+
+    The thread cannot raise into the training thread (Python offers no
+    safe cross-thread raise), so stalls are delivered through `on_stall`:
+    ``on_stall(stalled_seconds)`` — default logs to stderr. Every stall
+    bumps ``resilience.hangs`` (plus ``resilience.hangs.<name>``); the
+    watchdog fires ONCE per stall and re-arms on the next touch, so a
+    30-minute hang is one event, not one per poll.
+
+    Usable as a context manager::
+
+        with StepWatchdog(timeout=60, on_stall=dump_stacks) as wd:
+            for batch in loader:
+                train_step(batch)
+                wd.touch()
+    """
+
+    def __init__(self, timeout, on_stall=None, name=None,
+                 poll_interval=None, clock=time.monotonic):
+        if timeout <= 0:
+            raise ValueError("StepWatchdog timeout must be > 0")
+        self.timeout = float(timeout)
+        self.on_stall = on_stall
+        self.name = name
+        self.stalls = 0
+        self._poll = (
+            float(poll_interval) if poll_interval is not None
+            else max(0.01, min(self.timeout / 4.0, 1.0))
+        )
+        self._clock = clock
+        self._last = clock()
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread = None
+        self._lock = threading.Lock()
+
+    def touch(self):
+        """Record liveness; also re-arms the watchdog after a stall."""
+        with self._lock:
+            self._last = self._clock()
+            self._fired = False
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self.touch()  # the clock starts at start(), not __init__
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"step-watchdog-{self.name or 'anon'}",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self._poll * 4 + 1.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+    def _run(self):
+        while not self._stop.wait(self._poll):
+            with self._lock:
+                stalled = self._clock() - self._last
+                fire = stalled > self.timeout and not self._fired
+                if fire:
+                    self._fired = True
+            if not fire:
+                continue
+            self.stalls += 1
+            from .. import observability as _obs
+
+            _obs.add("resilience.hangs")
+            if self.name:
+                _obs.add(f"resilience.hangs.{self.name}")
+            if self.on_stall is not None:
+                try:
+                    self.on_stall(stalled)
+                except Exception:
+                    pass  # a broken callback must not kill the monitor
+            else:
+                import sys
+
+                print(
+                    f"[StepWatchdog{f' {self.name}' if self.name else ''}] "
+                    f"no step in {stalled:.1f}s (timeout {self.timeout}s)",
+                    file=sys.stderr,
+                )

@@ -1,0 +1,56 @@
+"""Launcher hang-chaos worker: each rank trains a tiny regression
+independently (no cross-rank collectives, so a killed peer cannot wedge
+the others). On its FIRST attempt, rank 1 arms a ``hang`` fault at the
+``guard.step`` seam after a few healthy steps — the beats stop, the
+launcher's ``--heartbeat_timeout`` watcher kills it, and the ``--elastic``
+path restarts it; the restart (PADDLE_RESTART_ATTEMPT=1) runs clean.
+
+Writes ``hang_losses_{rank}.json`` into argv[1] on successful completion.
+Used by tests/test_health_guard.py (slow) and the ci.sh chaos smoke.
+"""
+
+import json
+import os
+import sys
+
+# bound the injected hang: long enough to be "stuck" for any sane
+# --heartbeat_timeout, short enough that a broken watchdog fails the test
+# instead of wedging CI
+os.environ.setdefault("PADDLE_TPU_FAULT_HANG_SECONDS", "120")
+
+import numpy as np
+
+
+def main(out_dir):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.resilience import TrainGuard, faults
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    attempt = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
+    rng = np.random.RandomState(7 + rank)
+    W = rng.randn(4, 1).astype(np.float32)
+
+    x = fluid.data("x", [-1, 4])
+    y = fluid.data("y", [-1, 1])
+    pred = layers.fc(x, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    # heartbeat auto-configured from the launcher's PADDLE_HEARTBEAT_DIR
+    with TrainGuard(exe) as g:
+        for step in range(20):
+            if rank == 1 and attempt == 0 and step == 3:
+                faults.inject("guard.step", "hang", 1.0, 0, 1)
+            xa = rng.randn(8, 4).astype(np.float32)
+            out = g.step(feed={"x": xa, "y": xa @ W}, fetch_list=[loss])
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    with open(os.path.join(out_dir, f"hang_losses_{rank}.json"), "w") as f:
+        json.dump({"attempt": attempt, "losses": losses}, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,58 @@
+"""Preemption-drain worker: trains with a TrainGuard wired to a Fleet
+checkpoint dir, touches a ``ready`` marker once the loop is underway, and
+then keeps stepping until a SIGTERM arrives. The guard drains — finishes
+the in-flight step, writes a final ``save_check_point`` (CRC manifest and
+all), and exits with the distinguished PREEMPTION_EXIT_CODE (75).
+
+argv[1] = work dir (checkpoints land in {dir}/ckpts, marker at
+{dir}/ready). Used by tests/test_health_guard.py and the ci.sh chaos
+smoke.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main(work_dir):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.fleet import collective as fc
+    from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+    from paddle_tpu.resilience import TrainGuard
+
+    rng = np.random.RandomState(11)
+    W = rng.randn(4, 1).astype(np.float32)
+
+    x = fluid.data("x", [-1, 4])
+    y = fluid.data("y", [-1, 1])
+    pred = layers.fc(x, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    fleet = fc.Fleet()
+    fleet.init(UserDefinedRoleMaker())
+    ckpt_dir = os.path.join(work_dir, "ckpts")
+    marker = os.path.join(work_dir, "ready")
+
+    with TrainGuard(
+        exe, fleet=fleet, checkpoint_dir=ckpt_dir,
+        train_status=fc.TrainStatus(0),
+    ) as g:
+        for step in range(100000):
+            xa = rng.randn(8, 4).astype(np.float32)
+            g.step(feed={"x": xa, "y": xa @ W}, fetch_list=[loss])
+            if step == 0:
+                open(marker, "w").close()
+            time.sleep(0.05)  # leave a window for the SIGTERM to land
+    # unreachable under preemption: g.step raises SystemExit(75) after the
+    # final checkpoint; reaching here means the test never sent SIGTERM
+    sys.exit(9)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

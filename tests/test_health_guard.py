@@ -1,0 +1,603 @@
+"""Training health guard: heartbeat liveness, step watchdog, TrainGuard
+numeric-anomaly skip/rollback, preemption drain, and the launcher's
+hung-rank + preemption exit-code contracts.
+
+In-process pieces (watchdog, guard policy, AMP feedback) run against real
+programs on the CPU mesh; the launcher contracts run against fake procs
+(same-tick death bookkeeping) and real subprocesses (exit codes, and — as
+slow tests — the full hang-kill-restart and SIGTERM-drain loops that the
+ci.sh chaos smoke also exercises).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import errors, layers, observability
+from paddle_tpu.fleet import collective as fc
+from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+from paddle_tpu.framework import unique_name
+from paddle_tpu.framework.scope import Scope
+from paddle_tpu.resilience import (
+    PREEMPTION_EXIT_CODE,
+    Heartbeat,
+    StepWatchdog,
+    TrainGuard,
+    faults,
+    heartbeat_path,
+    read_beat,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    faults.clear()
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield
+    faults.clear()
+
+
+def _counter(name):
+    return observability.snapshot()["counters"].get(name, 0)
+
+
+# -- heartbeat ---------------------------------------------------------------
+def test_heartbeat_writes_monotonic_beats(tmp_path):
+    hb = Heartbeat(str(tmp_path), rank=3)
+    p1 = hb.beat()
+    p2 = hb.beat()
+    assert (p1["step"], p2["step"]) == (1, 2)
+    on_disk = read_beat(heartbeat_path(str(tmp_path), 3))
+    assert on_disk["rank"] == 3 and on_disk["step"] == 2
+    assert on_disk["time"] == pytest.approx(time.time(), abs=30)
+    hb.beat(step=41)  # resume-from-checkpoint override
+    assert read_beat(hb.path)["step"] == 41
+
+
+def test_heartbeat_env_autoconfig(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    hb = Heartbeat()
+    hb.beat()
+    assert read_beat(heartbeat_path(str(tmp_path), 2))["step"] == 1
+
+
+def test_read_beat_tolerates_missing_and_torn(tmp_path):
+    assert read_beat(str(tmp_path / "nope")) is None
+    torn = tmp_path / "hb_rank0"
+    torn.write_text('{"rank": 0, "st')  # torn mid-publish
+    assert read_beat(str(torn)) is None
+
+
+# -- watchdog ----------------------------------------------------------------
+def test_watchdog_fires_on_stall_and_stays_quiet_when_beating():
+    stalls = []
+    wd = StepWatchdog(timeout=0.5, poll_interval=0.02,
+                      on_stall=stalls.append, name="t")
+    with wd:
+        for _ in range(15):  # slow-but-beating loop: never a stall
+            time.sleep(0.03)
+            wd.touch()
+        assert stalls == []
+        time.sleep(1.2)  # stalled: fires exactly once until re-armed
+        assert len(stalls) == 1 and stalls[0] > 0.5
+        wd.touch()
+        time.sleep(1.2)
+        assert len(stalls) == 2
+    assert wd.stalls == 2
+    assert _counter("resilience.hangs") >= 2
+    assert _counter("resilience.hangs.t") >= 2
+
+
+# -- fault kinds -------------------------------------------------------------
+def test_hang_fault_sleeps_at_seam(monkeypatch):
+    monkeypatch.setenv(faults.HANG_SECONDS_ENV, "0.3")
+    faults.inject("some.site", "hang", 1.0, 0, 1)
+    t0 = time.monotonic()
+    faults.fault_point("some.site")  # sleeps, does not raise
+    assert time.monotonic() - t0 >= 0.25
+    faults.fault_point("some.site")  # max_fires=1: healed
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_nonfinite_corrupt_point_poisons_floats_only():
+    faults.inject("guard.step", "nonfinite", 1.0, 0, 1)
+    feed = {"x": np.ones((2, 2), np.float32), "i": np.arange(3)}
+    out = faults.corrupt_point("guard.step", feed)
+    assert np.isnan(out["x"]).all()
+    np.testing.assert_array_equal(out["i"], np.arange(3))  # ints untouched
+    clean = {"x": np.ones(2, np.float32)}
+    assert faults.corrupt_point("guard.step", clean) is clean  # healed
+
+
+def test_nonfinite_at_raise_seam_degrades_to_typed_error():
+    faults.inject("io.save", "nonfinite", 1.0)
+    with pytest.raises(errors.NonFiniteError):
+        faults.fault_point("io.save")
+
+
+def test_parse_spec_accepts_new_kinds():
+    assert faults.parse_spec("a.b:hang:1.0:7").kind == "hang"
+    assert faults.parse_spec("a.b:nonfinite").kind == "nonfinite"
+
+
+# -- executor check_nan_inf typing -------------------------------------------
+def test_check_nan_inf_raises_typed_nonfinite_error():
+    x = fluid.data("x", [2, 2])
+    y = layers.log(x)
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(errors.NonFiniteError) as ei:
+        exe.run(feed={"x": np.full((2, 2), -1.0, np.float32)},
+                fetch_list=[y])
+    assert ei.value.op_type == "log"
+    assert y.name in ei.value.outputs
+    assert "log" in str(ei.value) and y.name in str(ei.value)
+    # still catchable as the pre-taxonomy type
+    assert isinstance(ei.value, errors.PreconditionNotMetError)
+
+
+# -- TrainGuard --------------------------------------------------------------
+def _regression(lr=0.05, amp=None):
+    rng = np.random.RandomState(3)
+    W = rng.randn(4, 1).astype(np.float32)
+    x = fluid.data("x", [-1, 4])
+    y = fluid.data("y", [-1, 1])
+    pred = layers.fc(x, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt = fluid.optimizer.SGD(lr)
+    if amp is not None:
+        opt = amp(opt)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    def feed(step, b=8):
+        r = np.random.RandomState(100 + step)
+        xa = r.randn(b, 4).astype(np.float32)
+        return {"x": xa, "y": xa @ W}
+
+    return exe, loss, feed, opt
+
+
+def _params(scope=None):
+    from paddle_tpu.framework.scope import global_scope
+
+    scope = scope or global_scope()
+    prog = fluid.default_main_program()
+    return {
+        v.name: np.asarray(scope.find_var(v.name)).copy()
+        for v in prog.list_vars()
+        if getattr(v, "persistable", False)
+        and scope.find_var(v.name) is not None
+    }
+
+
+def test_guard_skips_nonfinite_step_and_converges():
+    exe, loss, feed, _ = _regression()
+    with TrainGuard(exe) as g:
+        losses = []
+        for step in range(12):
+            if step == 4:
+                before = _params()
+                faults.inject("guard.step", "nonfinite", 1.0, 0, 1)
+            out = g.step(feed=feed(step), fetch_list=[loss])
+            if step == 4:
+                # the poisoned step was skipped: no fetches, ZERO weight
+                # updates (restored state is bit-identical)
+                assert out is None
+                after = _params()
+                for name, val in before.items():
+                    np.testing.assert_array_equal(val, after[name])
+            else:
+                assert out is not None
+                losses.append(float(out[0].reshape(-1)[0]))
+    assert g.bad_steps == 1 and g.steps == 12
+    assert _counter("resilience.bad_steps") == 1
+    assert losses[-1] < losses[0]  # still converged around the skip
+
+
+def test_guard_returns_device_arrays_when_asked():
+    exe, loss, feed, _ = _regression()
+    with TrainGuard(exe) as g:
+        out = g.step(feed=feed(0), fetch_list=[loss], return_numpy=False)
+    assert not isinstance(out[0], np.ndarray)
+
+
+def test_guard_feeds_amp_loss_scale_decay():
+    from paddle_tpu.contrib.mixed_precision import decorate
+
+    amp_box = {}
+
+    def amp(opt):
+        amp_box["opt"] = decorate(
+            opt, init_loss_scaling=1024.0, decr_every_n_nan_or_inf=1,
+            decr_ratio=0.5,
+        )
+        return amp_box["opt"]
+
+    exe, loss, feed, _ = _regression(amp=amp)
+    amp_opt = amp_box["opt"]
+    scale_name = amp_opt.get_loss_scaling().name
+    from paddle_tpu.framework.scope import global_scope
+
+    with TrainGuard(exe, amp=amp_opt) as g:
+        g.step(feed=feed(0), fetch_list=[loss])
+        assert float(
+            np.asarray(global_scope().find_var(scale_name)).reshape(-1)[0]
+        ) == 1024.0
+        faults.inject("guard.step", "nonfinite", 1.0, 0, 1)
+        assert g.step(feed=feed(1), fetch_list=[loss]) is None
+        # skip restored the pre-step state, then note_step decayed it
+        assert float(
+            np.asarray(global_scope().find_var(scale_name)).reshape(-1)[0]
+        ) == 512.0
+
+
+def test_guard_rolls_back_then_raises_diverged(tmp_path):
+    exe, loss, feed, _ = _regression()
+    fleet = fc.Fleet()
+    fleet.init(UserDefinedRoleMaker())
+    ckpt = str(tmp_path / "ckpts")
+    with TrainGuard(
+        exe, fleet=fleet, checkpoint_dir=ckpt,
+        max_bad_steps=2, max_rollbacks=1,
+    ) as g:
+        for step in range(3):
+            g.step(feed=feed(step), fetch_list=[loss])
+        fleet.save_check_point(exe, ckpt, fc.TrainStatus(0))
+        good = _params()
+        faults.inject("guard.step", "nonfinite", 1.0)  # every step bad now
+        assert g.step(feed=feed(3), fetch_list=[loss]) is None
+        assert g.rollbacks == 0
+        assert g.step(feed=feed(4), fetch_list=[loss]) is None  # K=2 -> roll
+        assert g.rollbacks == 1
+        assert _counter("resilience.rollbacks") == 1
+        after = _params()
+        for name, val in good.items():
+            np.testing.assert_array_equal(val, after[name])
+        assert g.train_status == fc.TrainStatus(0)
+        g.step(feed=feed(5), fetch_list=[loss])
+        with pytest.raises(errors.TrainingDivergedError, match="budget"):
+            g.step(feed=feed(6), fetch_list=[loss])
+    assert g.bad_steps == 4
+
+
+def test_guard_rolls_back_to_pre_epoch_checkpoint(tmp_path):
+    """A preemption-drain checkpoint saved before the first epoch finishes
+    carries TrainStatus(-1) — it must still count as a valid rollback
+    target, not as 'nothing to load'."""
+    exe, loss, feed, _ = _regression()
+    fleet = fc.Fleet()
+    fleet.init(UserDefinedRoleMaker())
+    ckpt = str(tmp_path / "ckpts")
+    assert not fleet.has_check_point(ckpt)
+    with TrainGuard(
+        exe, fleet=fleet, checkpoint_dir=ckpt, max_bad_steps=2,
+    ) as g:
+        g.step(feed=feed(0), fetch_list=[loss])
+        fleet.save_check_point(exe, ckpt, fc.TrainStatus(-1))
+        assert fleet.has_check_point(ckpt)
+        good = _params()
+        faults.inject("guard.step", "nonfinite", 1.0)
+        g.step(feed=feed(1), fetch_list=[loss])
+        g.step(feed=feed(2), fetch_list=[loss])  # K=2 -> rollback, no raise
+        assert g.rollbacks == 1
+        after = _params()
+        for name, val in good.items():
+            np.testing.assert_array_equal(val, after[name])
+
+
+def test_guard_diverges_without_rollback_config():
+    exe, loss, feed, _ = _regression()
+    faults.inject("guard.step", "nonfinite", 1.0)
+    with TrainGuard(exe, max_bad_steps=2) as g:
+        assert g.step(feed=feed(0), fetch_list=[loss]) is None
+        with pytest.raises(errors.TrainingDivergedError, match="no fleet"):
+            g.step(feed=feed(1), fetch_list=[loss])
+
+
+def test_guard_beats_heartbeat_each_step(tmp_path):
+    exe, loss, feed, _ = _regression()
+    hb = Heartbeat(str(tmp_path), rank=0)
+    with TrainGuard(exe, heartbeat=hb) as g:
+        for step in range(3):
+            g.step(feed=feed(step), fetch_list=[loss])
+    assert read_beat(hb.path)["step"] == 3
+    assert _counter("resilience.heartbeats") == 3
+
+
+def test_guard_sigterm_drains_to_final_checkpoint(tmp_path):
+    exe, loss, feed, _ = _regression()
+    fleet = fc.Fleet()
+    fleet.init(UserDefinedRoleMaker())
+    ckpt = str(tmp_path / "ckpts")
+    with TrainGuard(
+        exe, fleet=fleet, checkpoint_dir=ckpt, exit_on_preempt=False,
+        train_status=fc.TrainStatus(7),
+    ) as g:
+        assert signal.getsignal(signal.SIGTERM) == g._on_sigterm
+        g.step(feed=feed(0), fetch_list=[loss])
+        signal.raise_signal(signal.SIGTERM)  # delivered in-process
+        assert g.draining
+        assert g.step(feed=feed(1), fetch_list=[loss]) is None  # drained
+    assert g.preempted
+    assert _counter("resilience.preemptions") == 1
+    # the final checkpoint is valid (CRC-verified on load) and carries the
+    # drain-time train status
+    status = fleet.load_check_point(exe, ckpt)
+    assert status == fc.TrainStatus(7)
+    # handler restored on exit
+    assert signal.getsignal(signal.SIGTERM) != g._on_sigterm
+
+
+def test_guard_sigterm_exit_code_is_distinguished():
+    exe, loss, feed, _ = _regression()
+    with pytest.raises(SystemExit) as ei:
+        with TrainGuard(exe) as g:
+            g.step(feed=feed(0), fetch_list=[loss])
+            signal.raise_signal(signal.SIGTERM)
+            g.step(feed=feed(1), fetch_list=[loss])
+    assert ei.value.code == PREEMPTION_EXIT_CODE
+
+
+def test_guard_drain_at_loop_end_still_finalizes():
+    """SIGTERM landing after the last step: __exit__ honors the contract."""
+    exe, loss, feed, _ = _regression()
+    with pytest.raises(SystemExit) as ei:
+        with TrainGuard(exe) as g:
+            g.step(feed=feed(0), fetch_list=[loss])
+            signal.raise_signal(signal.SIGTERM)
+    assert ei.value.code == PREEMPTION_EXIT_CODE
+    assert g.preempted
+
+
+# -- AMP note_step unit ------------------------------------------------------
+def test_amp_note_step_automaton():
+    from paddle_tpu.contrib.mixed_precision import decorate
+    from paddle_tpu.framework.scope import global_scope
+
+    rng = np.random.RandomState(0)
+    x = fluid.data("x", [4, 4])
+    loss = layers.mean(layers.fc(x, 1))
+    opt = decorate(
+        fluid.optimizer.SGD(0.1), init_loss_scaling=8.0,
+        incr_every_n_steps=2, decr_every_n_nan_or_inf=2,
+        incr_ratio=2.0, decr_ratio=0.5,
+    )
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    def scale():
+        return float(np.asarray(
+            global_scope().find_var(opt.get_loss_scaling().name)
+        ).reshape(-1)[0])
+
+    opt.note_step(False)
+    assert scale() == 8.0  # 1 bad < decr_every
+    opt.note_step(False)
+    assert scale() == 4.0  # 2 consecutive bad -> decay
+    opt.note_step(True)
+    opt.note_step(False)  # good resets the bad streak
+    opt.note_step(False)
+    assert scale() == 2.0
+    opt.note_step(True)
+    opt.note_step(True)  # 2 consecutive good -> grow
+    assert scale() == 4.0
+
+
+def test_amp_note_step_noop_before_minimize():
+    from paddle_tpu.contrib.mixed_precision import decorate
+
+    opt = decorate(fluid.optimizer.SGD(0.1))
+    assert opt.note_step(False) is None  # state not built yet: no crash
+
+
+# -- TrainStatus -------------------------------------------------------------
+def test_train_status_ne_consistent_with_eq():
+    a, b, c = fc.TrainStatus(1), fc.TrainStatus(1), fc.TrainStatus(2)
+    assert a == b and not (a != b)
+    assert a != c and not (a == c)
+    assert a != object() and not (a == object())
+    assert "epoch_no=1" in repr(a)
+
+
+# -- launcher: same-tick deaths + interleaved restarts -----------------------
+class _FakeProc:
+    """poll() plays back a script of return codes (None = alive)."""
+
+    _pid = 1000
+
+    def __init__(self, rank, script):
+        _FakeProc._pid += 1
+        self.pid = _FakeProc._pid
+        self._paddle_rank = rank
+        self._paddle_log = None
+        self._paddle_spawned = time.time()
+        self._script = list(script)
+        self._rc = None
+
+    def poll(self):
+        if self._rc is None and self._script:
+            self._rc = self._script.pop(0)
+        return self._rc
+
+    def wait(self, timeout=None):
+        return self.poll()
+
+    def send_signal(self, sig):
+        pass
+
+    def kill(self):
+        self._rc = -9
+
+
+def test_watch_two_ranks_dying_same_tick_get_independent_restarts(capsys):
+    from paddle_tpu.distributed import launch
+
+    spawned = []
+
+    def fake_spawn(args, endpoints, rank, attempt=0):
+        spawned.append((rank, attempt))
+        return _FakeProc(rank, [0])  # restarted children exit clean
+
+    args = launch.parse_args([
+        "--elastic", "--max_restarts", "2", "--restart_backoff", "0.01",
+        "x.py",
+    ])
+    procs = [
+        _FakeProc(0, [None, None, None, None, 0]),
+        _FakeProc(1, [1]),   # dies on the first tick...
+        _FakeProc(2, [7]),   # ...same tick as rank 2
+    ]
+    old_spawn = launch.spawn_trainer
+    launch.spawn_trainer = fake_spawn
+    try:
+        rc = launch.watch_local_trainers(procs, args, ["e0", "e1", "e2"])
+    finally:
+        launch.spawn_trainer = old_spawn
+    assert rc == 0
+    # both ranks were scheduled + respawned with their own attempt counter
+    assert sorted(spawned) == [(1, 1), (2, 1)]
+    err = capsys.readouterr().err
+    assert "rank 1 died (rc=1); restart 1/2" in err
+    assert "rank 2 died (rc=7); restart 1/2" in err
+
+
+def test_watch_interleaved_restarts_survive_bookkeeping(capsys):
+    from paddle_tpu.distributed import launch
+
+    spawned = []
+
+    def fake_spawn(args, endpoints, rank, attempt=0):
+        spawned.append((rank, attempt))
+        if rank == 1 and attempt == 1:
+            return _FakeProc(rank, [3])  # rank 1's first restart dies too
+        return _FakeProc(rank, [None, 0])
+
+    args = launch.parse_args([
+        "--elastic", "--max_restarts", "2", "--restart_backoff", "0.01",
+        "x.py",
+    ])
+    procs = [
+        _FakeProc(0, [None] * 12 + [0]),
+        _FakeProc(1, [1]),
+        _FakeProc(2, [2]),
+    ]
+    old_spawn = launch.spawn_trainer
+    launch.spawn_trainer = fake_spawn
+    try:
+        rc = launch.watch_local_trainers(procs, args, ["e0", "e1", "e2"])
+    finally:
+        launch.spawn_trainer = old_spawn
+    assert rc == 0
+    # rank 1 restarted twice (second restart after the first's death
+    # interleaved with rank 2's pending restart), rank 2 once
+    assert sorted(spawned) == [(1, 1), (1, 2), (2, 1)]
+    assert "restart 2/2" in capsys.readouterr().err
+
+
+def test_watch_aborts_when_hung_rank_exits_preemption_code():
+    """rc==PREEMPTION_EXIT_CODE is clean ONLY when the launcher did not
+    have to kill the child as hung."""
+    from paddle_tpu.distributed import launch
+
+    p = _FakeProc(1, [PREEMPTION_EXIT_CODE])
+    p._paddle_hung = True
+    args = launch.parse_args(["x.py"])
+    with pytest.raises(RuntimeError, match="hung"):
+        launch.watch_local_trainers(
+            [_FakeProc(0, [None, 0]), p], args, ["e0", "e1"]
+        )
+
+
+def test_launcher_treats_preemption_exit_as_clean(tmp_path):
+    """A child exiting PREEMPTION_EXIT_CODE does not abort the pod and
+    burns no restart budget (subprocess-level contract; the child script
+    is jax-free so this is fast)."""
+    script = tmp_path / "preempted.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.exit(%d if os.environ['PADDLE_TRAINER_ID'] == '1' else 0)\n"
+        % PREEMPTION_EXIT_CODE
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node", "2", str(script),
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "restart" not in proc.stderr and "aborted" not in proc.stderr
+
+
+# -- end-to-end chaos (also run by ci.sh) ------------------------------------
+@pytest.mark.slow
+def test_launcher_kills_and_restarts_hung_rank(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node", "2", "--simulate_cpu", "--elastic",
+            "--max_restarts", "2", "--restart_backoff", "0.1",
+            "--heartbeat_dir", str(tmp_path / "hb"),
+            "--heartbeat_timeout", "20",
+            os.path.join(HERE, "dist_hang_worker.py"), str(tmp_path),
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=540,
+    )
+    assert proc.returncode == 0, f"stdout:{proc.stdout}\nstderr:{proc.stderr}"
+    assert "hung" in proc.stderr and "restart 1/2" in proc.stderr
+    r1 = json.load(open(tmp_path / "hang_losses_1.json"))
+    assert r1["attempt"] == 1  # the file was written by the restart
+    assert r1["losses"][-1] < r1["losses"][0]
+    r0 = json.load(open(tmp_path / "hang_losses_0.json"))
+    assert r0["attempt"] == 0  # rank 0 was never disturbed
+
+
+@pytest.mark.slow
+def test_sigterm_produces_final_checkpoint_and_exit_code(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            os.path.join(HERE, "dist_preempt_worker.py"), str(tmp_path),
+        ],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    marker = tmp_path / "ready"
+    deadline = time.monotonic() + 120
+    while not marker.exists():
+        assert proc.poll() is None, proc.communicate()[1]
+        assert time.monotonic() < deadline, "worker never became ready"
+        time.sleep(0.1)
+    proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=120)
+    assert proc.returncode == PREEMPTION_EXIT_CODE, f"{out}\n{err}"
+    # the drain checkpoint verifies (CRC manifest) and loads
+    fleet = fc.Fleet()
+    fleet.init(UserDefinedRoleMaker())
+    status = fleet.load_check_point(
+        fluid.Executor(), str(tmp_path / "ckpts")
+    )
+    assert status == fc.TrainStatus(0)
