@@ -200,6 +200,15 @@ print("preemption chaos OK: exit code 75 + final checkpoint verified")
 EOF
 rm -rf "$PRE_DIR"
 
+echo "== exact-resume chaos stage: 2-rank SIGKILL mid-epoch + elastic resume =="
+# trains a 2-rank pod twice — control (uninterrupted) and kill (rank 1
+# SIGKILLs itself mid-epoch, --elastic restarts it, the restart resumes
+# from its newest COMPLETE checkpoint) — and asserts final weights and
+# consumed-example logs are BITWISE identical, no example skipped or
+# consumed twice, the resume counters fired, and a v1 (epoch-only)
+# checkpoint still loads
+python tools/resume_audit.py
+
 echo "== driver entry points =="
 python __graft_entry__.py
 

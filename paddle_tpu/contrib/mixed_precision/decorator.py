@@ -65,6 +65,63 @@ class OptimizerWithMixedPrecision:
     def get_loss_scaling(self):
         return self._loss_scaling
 
+    def _state_vals(self, scope=None):
+        from ...framework.scope import global_scope
+
+        if self._loss_scaling is None:
+            return None, None
+        scope = scope or global_scope()
+        names = (
+            self._loss_scaling.name,
+            self._good_steps.name,
+            self._bad_steps.name,
+        )
+        vals = [scope.find_var(n) for n in names]
+        if any(v is None for v in vals):
+            return None, None
+        return names, vals
+
+    def state_dict(self, scope=None):
+        """Dynamic loss-scale automaton state (scale + good/bad step
+        counters) as plain floats/ints, for TrainStatus v2 capture. Empty
+        dict before `minimize` built the state vars (nothing to save)."""
+        import numpy as np
+
+        names, vals = self._state_vals(scope)
+        if names is None:
+            return {}
+        return {
+            "loss_scaling": float(np.asarray(vals[0]).reshape(-1)[0]),
+            "good_steps": int(np.asarray(vals[1]).reshape(-1)[0]),
+            "bad_steps": int(np.asarray(vals[2]).reshape(-1)[0]),
+        }
+
+    def load_state_dict(self, state, scope=None):
+        """Restore :meth:`state_dict` into the scope vars. No-op when the
+        state vars are not built/resident yet or `state` is empty, so a
+        v1 (epoch-only) checkpoint restores cleanly with defaults."""
+        import numpy as np
+
+        if not state:
+            return
+        names, _ = self._state_vals(scope)
+        if names is None:
+            return
+        from ...framework.scope import global_scope
+
+        scope = scope or global_scope()
+        scope.set_var(
+            names[0],
+            np.asarray([state.get("loss_scaling", self._init_loss_scaling)],
+                       dtype=np.float32),
+        )
+        scope.set_var(
+            names[1], np.asarray([state.get("good_steps", 0)], dtype=np.int32)
+        )
+        scope.set_var(
+            names[2], np.asarray([state.get("bad_steps", 0)], dtype=np.int32)
+        )
+
     def note_step(self, good, scope=None):
         """Host-side dynamic-loss-scale feedback for good/bad steps
         detected OUTSIDE the compiled block (TrainGuard's fused finite
@@ -78,17 +135,10 @@ class OptimizerWithMixedPrecision:
 
         from ...framework.scope import global_scope
 
-        if self._loss_scaling is None:
+        names, vals = self._state_vals(scope)
+        if names is None:
             return None
         scope = scope or global_scope()
-        names = (
-            self._loss_scaling.name,
-            self._good_steps.name,
-            self._bad_steps.name,
-        )
-        vals = [scope.find_var(n) for n in names]
-        if any(v is None for v in vals):
-            return None
         scale = float(np.asarray(vals[0]).reshape(-1)[0])
         good_n = int(np.asarray(vals[1]).reshape(-1)[0])
         bad_n = int(np.asarray(vals[2]).reshape(-1)[0])

@@ -5,6 +5,19 @@ samplers yield single indices. DistributedBatchSampler shards batches across
 data-parallel ranks (the reference kept this in incubate; here it is the
 front door for multi-host input pipelines — each host feeds its own shard,
 matching the per-process feed model of jax.distributed).
+
+Exact-resume cursor: BatchSampler and DistributedBatchSampler carry a
+``state_dict()/load_state_dict()`` cursor — the epoch plus the number of
+batches already consumed — and the next ``__iter__`` after a
+``load_state_dict`` fast-skips to it (index arithmetic only; no sample is
+fetched for the skipped prefix). ``advance()`` is called by the DataLoader
+once per batch it DELIVERS to the training loop, so a checkpoint taken
+after step K resumes at batch K+1: nothing replayed, nothing skipped.
+RandomSampler is deterministically seeded per instance (an explicit
+per-epoch ``np.random.RandomState``, never global numpy state), so the
+skipped prefix is bitwise the prefix the dead run already consumed — and
+ranks that fork with different global numpy state still shuffle
+identically.
 """
 
 from __future__ import annotations
@@ -32,24 +45,81 @@ class SequenceSampler(Sampler):
 
 
 class RandomSampler(Sampler):
+    """Shuffled indices from an explicit, capturable RNG.
+
+    ``generator`` may be an int seed, an ``np.random.RandomState`` (legacy:
+    caller-managed, not exactly resumable), or None — which now draws ONE
+    per-instance seed from OS entropy instead of consuming global numpy
+    state on every epoch. Seeded instances reshuffle per epoch via
+    ``set_epoch`` (the enclosing BatchSampler drives it) yet are fully
+    deterministic given (seed, epoch) — the property exact resume needs."""
+
     def __init__(self, data_source, replacement=False, num_samples=None,
                  generator=None):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
         self.generator = generator  # np.random.RandomState or seed int
+        self._epoch = 0
+        # standalone unseeded instances auto-reshuffle each __iter__ (the
+        # old OS-entropy behavior, now deterministic given the instance
+        # seed); an external set_epoch/load_state_dict pins the epoch for
+        # that iteration instead (the BatchSampler / exact-resume path)
+        self._epoch_pinned = False
+        self._drawn = False
+        if generator is None:
+            import random as _random
+
+            self._seed = _random.SystemRandom().getrandbits(31)
+        elif isinstance(generator, (int, np.integer)):
+            self._seed = int(generator)
+        else:
+            self._seed = None  # explicit RandomState: stateful, caller-owned
 
     @property
     def num_samples(self):
         return self._num_samples or len(self.data_source)
 
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+        self._epoch_pinned = True
+
+    def state_dict(self):
+        return {"seed": self._seed, "epoch": self._epoch}
+
+    def load_state_dict(self, state):
+        if not state:
+            return
+        if "seed" in state and state["seed"] is None:
+            # the cursor was captured from a caller-managed RandomState:
+            # its stream position is not capturable, so the skipped prefix
+            # cannot be proven to match — refuse, don't diverge
+            from ..errors import ResumeMismatchError
+
+            raise ResumeMismatchError(
+                "sampler cursor was saved from a RandomSampler driven by a "
+                "caller-managed np.random.RandomState; that stream is not "
+                "capturable — seed the sampler (int or None generator) for "
+                "exact resume"
+            )
+        if state.get("seed") is not None:
+            self._seed = int(state["seed"])
+        self._epoch = int(state.get("epoch", 0))
+        self._epoch_pinned = True
+
     def _rng(self):
-        g = self.generator
-        if isinstance(g, np.random.RandomState):
-            return g
-        return np.random.RandomState(g)  # None -> OS entropy
+        if self._seed is None:
+            return self.generator  # legacy RandomState passthrough
+        # fresh per-epoch stream: replaying an epoch replays its permutation
+        return np.random.RandomState((self._seed + 1_000_003 * self._epoch)
+                                     % (2 ** 32))
 
     def __iter__(self):
+        if (self.generator is None and not self._epoch_pinned
+                and self._drawn):
+            self._epoch += 1  # standalone unseeded: reshuffle per epoch
+        self._epoch_pinned = False
+        self._drawn = True
         n = len(self.data_source)
         rng = self._rng()
         if self.replacement:
@@ -77,15 +147,106 @@ class BatchSampler(Sampler):
             raise ValueError("batch_size must be positive")
         self.batch_size = int(batch_size)
         self.drop_last = bool(drop_last)
+        self._epoch = 0
+        self._consumed = 0  # batches DELIVERED this epoch (DataLoader-driven)
+        self._resume_skip = None  # armed by load_state_dict, one-shot
+        self._iterated = False
+
+    # -- exact-resume cursor ----------------------------------------------
+    def state_dict(self):
+        """Cursor = (epoch, batches consumed). Consumption is advanced by
+        the DataLoader on DELIVERY, so prefetched-but-undelivered batches
+        are (correctly) not counted — they re-fetch on resume."""
+        st = {
+            "version": 1,
+            "epoch": self._epoch,
+            "batches_consumed": self._consumed,
+            "batch_size": self.batch_size,
+            "num_samples": self._source_len(),
+        }
+        sub = getattr(self.sampler, "state_dict", None)
+        if callable(sub):
+            st["sampler"] = sub()
+        return st
+
+    def _source_len(self):
+        try:
+            return len(self.sampler)
+        except TypeError:
+            return None
+
+    def _check_cursor_compat(self, state):
+        """A cursor counts BATCHES over a specific permutation: skipping N
+        batches of a different batch_size — or of a shuffle over a
+        dataset whose size changed — lands on a different example prefix
+        than the dead run consumed. Refuse, don't diverge."""
+        from ..errors import ResumeMismatchError
+
+        saved = state.get("batch_size")
+        if saved is not None and int(saved) != self.batch_size:
+            raise ResumeMismatchError(
+                f"sampler cursor was saved with batch_size={saved} but "
+                f"this sampler has batch_size={self.batch_size}; "
+                "fast-skipping would land on a different example prefix "
+                "than the dead run consumed"
+            )
+        saved_n, n = state.get("num_samples"), self._source_len()
+        if saved_n is not None and n is not None and int(saved_n) != n:
+            raise ResumeMismatchError(
+                f"sampler cursor was saved over {saved_n} samples but the "
+                f"dataset now has {n}; the shuffle permutation (and so the "
+                "consumed prefix) would differ — re-shard/restart the "
+                "epoch instead of fast-skipping"
+            )
+
+    def load_state_dict(self, state):
+        """Arm the next ``__iter__`` to replay `state`'s epoch and skip its
+        consumed prefix (index arithmetic only — no data is fetched)."""
+        if not state:
+            return
+        self._check_cursor_compat(state)
+        self._epoch = int(state.get("epoch", 0))
+        self._consumed = int(state.get("batches_consumed", 0))
+        self._resume_skip = self._consumed
+        sub = state.get("sampler")
+        if sub and hasattr(self.sampler, "load_state_dict"):
+            self.sampler.load_state_dict(sub)
+
+    def advance(self, n=1):
+        self._consumed += n
+
+    def _begin_epoch(self, bump_epoch=True):
+        """Start-of-iteration bookkeeping shared with the distributed
+        subclass: consume a one-shot resume skip, else open a fresh epoch
+        (with `bump_epoch`, advancing the epoch so seeded samplers
+        reshuffle — the distributed subclass passes False: its epoch is
+        user-driven via set_epoch). Returns the number of leading batches
+        to skip."""
+        if self._resume_skip is not None:
+            skip, self._resume_skip = self._resume_skip, None
+        else:
+            if bump_epoch and self._iterated:
+                self._epoch += 1
+            skip = 0
+        self._iterated = True
+        self._consumed = skip
+        set_epoch = getattr(self.sampler, "set_epoch", None)
+        if callable(set_epoch):
+            set_epoch(self._epoch)
+        return skip
 
     def __iter__(self):
+        skip = self._begin_epoch()
+        emitted = 0
         batch = []
         for idx in self.sampler:
             batch.append(idx)
             if len(batch) == self.batch_size:
-                yield batch
+                if emitted >= skip:
+                    yield batch
+                emitted += 1
                 batch = []
-        if batch and not self.drop_last:
+        if batch and not self.drop_last and emitted >= skip:
             yield batch
 
     def __len__(self):
@@ -97,7 +258,12 @@ class BatchSampler(Sampler):
 
 class DistributedBatchSampler(BatchSampler):
     """Each rank sees a disjoint 1/nranks slice of every epoch
-    (reference incubate distributed batch sampler semantics)."""
+    (reference incubate distributed batch sampler semantics).
+
+    The epoch is user-driven via ``set_epoch`` (never auto-bumped — the
+    reference contract), and the resume cursor fast-skips by slicing the
+    precomputed per-rank index array, so skip-to-cursor costs O(1) extra
+    regardless of how deep into the epoch the checkpoint was."""
 
     def __init__(self, dataset, batch_size, nranks=None, rank=None,
                  shuffle=False, drop_last=False, seed=0):
@@ -112,16 +278,65 @@ class DistributedBatchSampler(BatchSampler):
         self.dataset = dataset
         self.shuffle = shuffle
         self.seed = seed
-        self.epoch = 0
         super().__init__(
             sampler=SequenceSampler(dataset), batch_size=batch_size,
             drop_last=drop_last,
         )
 
+    # the public `epoch` attribute IS the base cursor's epoch, so the
+    # shared _begin_epoch/state bookkeeping sees user-driven set_epoch
+    @property
+    def epoch(self):
+        return self._epoch
+
+    @epoch.setter
+    def epoch(self, value):
+        self._epoch = int(value)
+
     def set_epoch(self, epoch):
         self.epoch = int(epoch)
 
+    def _source_len(self):
+        return len(self.dataset)
+
+    def state_dict(self):
+        return {
+            "version": 1,
+            "epoch": self.epoch,
+            "batches_consumed": self._consumed,
+            "batch_size": self.batch_size,
+            "num_samples": self._source_len(),
+            "seed": self.seed,
+            "rank": self.rank,
+            "nranks": self.nranks,
+        }
+
+    def load_state_dict(self, state):
+        if not state:
+            return
+        self._check_cursor_compat(state)
+        # the skipped prefix is only the consumed prefix if the shuffle
+        # stream and the rank slicing are the ones the dead run used:
+        # restore the seed, and refuse a silently different world shape
+        # (an elastically resized pod must re-shard, not fast-skip)
+        if state.get("seed") is not None:
+            self.seed = state["seed"]
+        for field, mine in (("rank", self.rank), ("nranks", self.nranks)):
+            if state.get(field) is not None and state[field] != mine:
+                from ..errors import ResumeMismatchError
+
+                raise ResumeMismatchError(
+                    f"sampler cursor was saved by {field}="
+                    f"{state[field]} but this sampler has {field}={mine}; "
+                    "fast-skipping would replay a different example "
+                    "prefix than the dead run consumed"
+                )
+        self.set_epoch(state.get("epoch", 0))
+        self._consumed = int(state.get("batches_consumed", 0))
+        self._resume_skip = self._consumed
+
     def __iter__(self):
+        skip = self._begin_epoch(bump_epoch=False)
         n = len(self.dataset)
         if self.shuffle:
             order = np.random.RandomState(self.seed + self.epoch).permutation(n)
@@ -132,7 +347,8 @@ class DistributedBatchSampler(BatchSampler):
         padded = np.resize(order, per_rank * self.nranks)
         mine = padded[self.rank::self.nranks]
         batch = []
-        for idx in mine.tolist():
+        # fast skip-to-cursor: drop the consumed prefix before fetching
+        for idx in mine[skip * self.batch_size:].tolist():
             batch.append(idx)
             if len(batch) == self.batch_size:
                 yield batch

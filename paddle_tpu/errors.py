@@ -151,6 +151,21 @@ class NonFiniteError(PreconditionNotMetError):
         super().__init__(message, op=op, loc=loc)
 
 
+class ResumeMismatchError(PreconditionNotMetError):
+    """On resume, a rank's view of the checkpoint is incoherent: its
+    ``rank_<i>/`` state shard carries a different checkpoint number or
+    global step than the checkpoint-level commit record, or a shard the
+    commit record promises is missing. Loading anyway would silently
+    diverge the ranks (one replays a different data prefix than the
+    others), so this is typed and non-retryable — the caller must pick a
+    coherent (usually older) checkpoint; ``Fleet.load_check_point`` skips
+    incomplete checkpoints automatically when no explicit
+    ``checkpoint_no`` was requested."""
+
+    code = ErrorCode.PRECONDITION_NOT_MET
+    retryable = False
+
+
 class TrainingDivergedError(EnforceNotMet, RuntimeError):
     """TrainGuard exhausted its recovery policy: K consecutive non-finite
     steps and no (remaining) checkpoint to roll back to. The run cannot

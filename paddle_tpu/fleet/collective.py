@@ -51,6 +51,19 @@ class DistributedStrategy:
 
 _CHECKPOINT_PREFIX = "__paddle_checkpoint__"
 _TRAIN_STATUS_FILE = "train_status.json"
+_COMMIT_FILE = "commit.json"
+_RANK_PREFIX = "rank_"
+
+#: Schema version written into train_status.json / commit.json. v1 was the
+#: bare ``{"epoch_no": N}`` payload; v2 adds global step, per-program RNG
+#: state, AMP loss-scale state, TrainGuard counters, the data-pipeline
+#: cursor, and the per-rank shard + commit-record layout. v1 files still
+#: load (missing fields keep their defaults).
+TRAIN_STATUS_VERSION = 2
+
+
+def _rank_dir_name(rank):
+    return f"{_RANK_PREFIX}{int(rank)}"
 
 
 def _dir_numbers(dirs):
@@ -153,35 +166,223 @@ class Fleet:
     # -- fault-tolerant checkpointing (reference incubate/fleet/collective/
     # __init__.py:155-240: _save_train_status :155,
     # clean_redundant_check_points :205, save/load_check_point :236+) ------
+    # per-rank shard + commit-record helpers -------------------------------
+    def _commit_record(self, train_status, no, per_rank):
+        return {
+            "version": TRAIN_STATUS_VERSION,
+            "checkpoint_no": int(no),
+            "epoch_no": train_status._epoch_no,
+            "global_step": train_status.global_step,
+            # the commit only PROMISES shards that will actually be
+            # published: per_rank off -> just the first worker's own
+            # shard, so the load-side completeness check stays satisfied
+            # for callers using the classic first-worker-only pattern
+            "nranks": self.worker_num() if (per_rank and self._inited) else 1,
+        }
+
+    @staticmethod
+    def _read_commit(fs, ckpt):
+        """The checkpoint's commit record, or None for a pre-v2 checkpoint
+        (no commit.json) or an FS backend without read_file support."""
+        from ..errors import CheckpointCorruptionError
+
+        try:
+            blob = fs.read_file(os.path.join(ckpt, _COMMIT_FILE))
+        except NotImplementedError:
+            return None
+        if blob is None:
+            return None
+        try:
+            return json.loads(blob.decode())
+        except (UnicodeDecodeError, ValueError) as e:
+            raise CheckpointCorruptionError(
+                f"undecodable commit record in {ckpt!r}: {e}"
+            ) from e
+
+    def _write_rank_shard(self, local_dir, rank, commit, train_status,
+                          local_vars, scope=None):
+        """Materialize one ``rank_<i>/`` shard into `local_dir`: this
+        rank's full TrainStatus, a commit record echoing the checkpoint it
+        belongs to (the rank-coherence check compares the two on load),
+        and — when `local_vars` names non-replicated persistables (sharded
+        optimizer state, per-rank tables) — a CRC-manifested payload of
+        their scope values."""
+        import numpy as np
+
+        from .. import io as _io
+        from ..framework.scope import global_scope
+
+        shard = os.path.join(local_dir, _rank_dir_name(rank))
+        os.makedirs(shard, exist_ok=True)
+        with open(os.path.join(shard, _TRAIN_STATUS_FILE), "w") as f:
+            json.dump(train_status.to_dict(), f)
+        with open(os.path.join(shard, _COMMIT_FILE), "w") as f:
+            json.dump(dict(commit, rank=int(rank)), f)
+        if local_vars:
+            scope = scope or global_scope()
+            arrays = {}
+            for v in local_vars:
+                name = v if isinstance(v, str) else v.name
+                value = scope.find_var(name)
+                if value is not None:
+                    arrays[name] = np.asarray(value)
+            payload = os.path.join(shard, "__params__.npz")
+            _io._atomic_write(payload, lambda f: np.savez(f, **arrays))
+            _io._write_manifest(
+                os.path.join(shard, _io.MANIFEST_NAME), payload, arrays
+            )
+        return shard
+
+    def _publish_rank_shard(self, fs, path, train_status, local_vars,
+                            wait_timeout):
+        """Non-first-worker half of save_check_point: wait for the first
+        worker to publish the replicated checkpoint whose commit record
+        matches this save (same epoch/global step), then publish this
+        rank's shard into it with the same tmp+mv discipline. Returns the
+        checkpoint number."""
+        import shutil
+        import tempfile
+        import time as _time
+
+        from ..errors import ExecutionTimeoutError
+        from ..resilience import retry
+
+        from ..errors import CheckpointCorruptionError
+
+        rank = self.worker_index()
+        deadline = _time.monotonic() + wait_timeout
+        ckpt = no = None
+        inspected = set()  # a published commit is immutable: read it once
+        delay = 0.05
+        while True:
+            try:
+                cands = list(reversed(_checkpoint_numbers(fs, path)))
+            except Exception:
+                cands = []  # transient listing failure: this IS a poll loop
+            for cand in cands:
+                if cand in inspected:
+                    continue
+                d = os.path.join(path, f"{_CHECKPOINT_PREFIX}{cand}")
+                try:
+                    commit = self._read_commit(fs, d)
+                except CheckpointCorruptionError:
+                    # one rotted old commit must not wedge every future
+                    # save of this rank; the scan just skips it
+                    inspected.add(cand)
+                    continue
+                if commit is None:
+                    # pre-v2 dir (immutable) — unless read_file is simply
+                    # unsupported, in which case nothing can ever match
+                    inspected.add(cand)
+                    continue
+                inspected.add(cand)
+                if (commit.get("global_step") == train_status.global_step
+                        and commit.get("epoch_no") == train_status._epoch_no):
+                    ckpt, no = d, cand
+                    break
+            if ckpt is not None:
+                break
+            if _time.monotonic() >= deadline:
+                raise ExecutionTimeoutError(
+                    f"rank {rank}: no checkpoint with epoch="
+                    f"{train_status._epoch_no} step="
+                    f"{train_status.global_step} was published under "
+                    f"{path!r} within {wait_timeout}s (is the first worker "
+                    "saving with the same TrainStatus?)"
+                )
+            _time.sleep(delay)
+            delay = min(1.0, delay * 1.5)  # don't hammer a remote namenode
+        local = tempfile.mkdtemp(prefix="paddle_tpu_shard_")
+        shard_tmp = os.path.join(ckpt, _rank_dir_name(rank) + ".tmp")
+        shard_dst = os.path.join(ckpt, _rank_dir_name(rank))
+
+        def _publish():
+            if fs.is_exist(shard_dst):  # prior attempt's mv already landed
+                fs.delete(shard_tmp)
+                return
+            src = self._write_rank_shard(
+                local, rank, self._commit_record(train_status, no, True),
+                train_status, local_vars,
+            )
+            fs.delete(shard_tmp)
+            fs.upload(src, shard_tmp)
+            fs.mv(shard_tmp, shard_dst)
+
+        try:
+            retry(
+                max_attempts=4, base_delay=0.05, max_delay=2.0,
+                name="checkpoint.shard",
+            ).call(_publish)
+        finally:
+            shutil.rmtree(local, ignore_errors=True)
+        return no
+
     def save_check_point(
         self, executor, path, train_status, main_program=None, fs=None,
-        remain_all_checkpoint=False, max_checkpoint_num=3,
+        remain_all_checkpoint=False, max_checkpoint_num=3, local_vars=None,
+        per_rank=None, shard_wait_timeout=120.0,
     ):
-        """Save persistables + TrainStatus into a new numbered checkpoint
-        dir and rotate old ones. The payload is written locally and
-        published through the FS backend (upload + atomic mv), so remote
-        backends only implement the FS contract; write + publish are
-        retried with backoff (transient FS faults heal, the final state is
-        idempotent). First worker only; returns the checkpoint number."""
+        """Save persistables + the full TrainStatus into a new numbered
+        checkpoint dir and rotate old ones. The payload is written locally
+        and published through the FS backend (upload + atomic mv), so
+        remote backends only implement the FS contract; write + publish
+        are retried with backoff (transient FS faults heal, the final
+        state is idempotent).
+
+        Per-rank decomposition (``per_rank=True``, or implied by passing
+        ``local_vars``; every rank then calls this): replicated state —
+        the persistables plus the first worker's TrainStatus — is written
+        ONCE by the first worker, together with a ``commit.json`` record
+        naming the checkpoint number, global step, and world size; every
+        rank (first worker included) then publishes a ``rank_<i>/`` shard
+        carrying its own TrainStatus (data cursor, RNG position) and any
+        `local_vars` — non-replicated per-rank persistables (sharded
+        optimizer state, PS tables). Non-first workers wait (up to
+        `shard_wait_timeout`) for the matching replicated publish before
+        attaching their shard. With ``per_rank`` off (the default), the
+        classic contract holds: non-first workers return None immediately
+        and the commit promises only the first worker's shard — existing
+        first-worker-only call sites (TrainGuard's preemption drain,
+        epoch-boundary saves) keep their exact old behavior.
+
+        The just-published checkpoint is spot-verified (manifest/CRC
+        readback) BEFORE predecessors rotate away, so a bad publish can
+        never leave zero loadable checkpoints. Returns the checkpoint
+        number."""
         import tempfile
 
         from .fs_wrapper import LocalFS
         from .. import io as _io
+        from ..errors import CheckpointCorruptionError
         from ..resilience import retry
 
         fs = fs or LocalFS()
+        if per_rank is None:
+            per_rank = local_vars is not None
         if not self.is_first_worker():
-            return None
+            if not per_rank:
+                return None
+            return self._publish_rank_shard(
+                fs, path, train_status, local_vars, shard_wait_timeout
+            )
         import shutil
 
-        fs.mkdir(path)
-        dirs = fs.list_dirs(path)
-        # a *.tmp dir is a crashed prior save's half-published payload:
-        # sweep it here, the only writer (list once, reuse for numbering)
-        for d in dirs:
-            if d.startswith(_CHECKPOINT_PREFIX) and d.endswith(".tmp"):
-                fs.delete(os.path.join(path, d))
-        nos = _dir_numbers(dirs)
+        def _prepare():
+            # the scan prelude hits the FS too (fs.mkdir / fs.list_dirs
+            # seams): a flaky remote listing must heal, not fail the save
+            fs.mkdir(path)
+            dirs = fs.list_dirs(path)
+            # a *.tmp dir is a crashed prior save's half-published payload:
+            # sweep it here, the only writer (list once, reuse for numbering)
+            for d in dirs:
+                if d.startswith(_CHECKPOINT_PREFIX) and d.endswith(".tmp"):
+                    fs.delete(os.path.join(path, d))
+            return _dir_numbers(dirs)
+
+        nos = retry(
+            max_attempts=4, base_delay=0.05, max_delay=2.0,
+            name="checkpoint.prepare",
+        ).call(_prepare)
         no = (nos[-1] + 1) if nos else 0
         ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
         tmp = ckpt + ".tmp"
@@ -197,7 +398,12 @@ class Fleet:
                 return
             _io.save_persistables(executor, local, main_program)
             with open(os.path.join(local, _TRAIN_STATUS_FILE), "w") as f:
-                json.dump({"epoch_no": train_status._epoch_no}, f)
+                json.dump(train_status.to_dict(), f)
+            commit = self._commit_record(train_status, no, per_rank)
+            with open(os.path.join(local, _COMMIT_FILE), "w") as f:
+                json.dump(commit, f)
+            # the first worker's own shard rides inside the atomic publish
+            self._write_rank_shard(local, 0, commit, train_status, local_vars)
             fs.delete(tmp)
             fs.upload(local, tmp)
             # atomic publish: a crash mid-save leaves only a .tmp dir
@@ -212,9 +418,97 @@ class Fleet:
         finally:
             shutil.rmtree(local, ignore_errors=True)
         if not remain_all_checkpoint:
-            for old in (nos + [no])[:-max_checkpoint_num]:
+            # spot-verify the JUST-PUBLISHED checkpoint (manifest/CRC
+            # readback through the backend) before deleting predecessors:
+            # rotating first and verifying never could leave a run with
+            # zero loadable checkpoints after one bad publish
+            self._verify_published(fs, ckpt)
+            doomed = (nos + [no])[:-max_checkpoint_num]
+            if per_rank and doomed:
+                # the new checkpoint is complete only once every PEER
+                # attached its shard (asynchronously, after this return);
+                # if no surviving checkpoint is complete yet, spare the
+                # newest complete predecessor so a peer dying before its
+                # attach can never leave zero resumable checkpoints
+                def _complete(n):
+                    d = os.path.join(path, f"{_CHECKPOINT_PREFIX}{n}")
+                    try:
+                        return not self._missing_shards(
+                            fs, d, self._read_commit(fs, d)
+                        )
+                    except Exception:
+                        # corrupt commit or transient scan failure: treat
+                        # as not-complete (errs toward sparing more) —
+                        # the save itself already succeeded, a completeness
+                        # probe must not turn it into a failure
+                        return False
+
+                survivors = (nos + [no])[-max_checkpoint_num:]
+                if not any(_complete(n) for n in survivors):
+                    spared = next(
+                        (n for n in reversed(doomed) if _complete(n)), None
+                    )
+                    doomed = [n for n in doomed if n != spared]
+            for old in doomed:
                 fs.delete(os.path.join(path, f"{_CHECKPOINT_PREFIX}{old}"))
         return no
+
+    @staticmethod
+    def _verify_published(fs, ckpt):
+        """Readback-verify a published checkpoint via the FS backend; a
+        failure raises CheckpointCorruptionError (and the caller skips
+        rotation, keeping the older checkpoints loadable)."""
+        import shutil
+        import tempfile
+
+        from .. import io as _io
+        from .. import observability as _obs
+        from ..resilience import retry
+
+        def _readback():
+            local = tempfile.mkdtemp(prefix="paddle_tpu_verify_")
+            try:
+                try:
+                    # only the replicated payload + manifest are verified:
+                    # fetching the rank shards too would roughly double the
+                    # readback bytes on every rotation-enabled save
+                    for fname in ("__params__.npz", _io.MANIFEST_NAME):
+                        fs.download(
+                            os.path.join(ckpt, fname),
+                            os.path.join(local, fname),
+                        )
+                except Exception:
+                    # backend without single-file download: whole dir
+                    shutil.rmtree(local, ignore_errors=True)
+                    os.makedirs(local, exist_ok=True)
+                    fs.download(ckpt, local)
+                _io.verify_checkpoint_dir(local)
+            finally:
+                shutil.rmtree(local, ignore_errors=True)
+
+        try:
+            # transient download faults heal; actual corruption
+            # (CheckpointCorruptionError) is non-retryable and surfaces
+            retry(
+                max_attempts=3, base_delay=0.05, max_delay=1.0,
+                name="checkpoint.verify",
+            ).call(_readback)
+        except Exception:
+            _obs.add("resilience.checkpoint_publish_verify_failures")
+            raise
+        _obs.add("resilience.checkpoint_publish_verified")
+
+    @staticmethod
+    def _scan_retry():
+        """Shared retry policy for read-side FS scans (list_dirs carries a
+        fault seam now, and a flaky remote listing must heal on the load
+        path exactly as it does in the save prelude)."""
+        from ..resilience import retry
+
+        return retry(
+            max_attempts=3, base_delay=0.05, max_delay=1.0,
+            name="checkpoint.scan",
+        )
 
     def has_check_point(self, path, fs=None):
         """Whether at least one numbered checkpoint exists under `path` —
@@ -224,7 +518,98 @@ class Fleet:
         from .fs_wrapper import LocalFS
 
         fs = fs or LocalFS()
-        return bool(fs.is_exist(path) and _checkpoint_numbers(fs, path))
+        return bool(
+            fs.is_exist(path)
+            and self._scan_retry().call(_checkpoint_numbers, fs, path)
+        )
+
+    def _missing_shards(self, fs, ckpt, commit):
+        """Rank dirs the checkpoint's commit record promises but that are
+        absent — a save interrupted between the replicated publish and the
+        last rank's shard upload. [] for complete or pre-v2 checkpoints."""
+        if not commit:
+            return []
+        present = set(fs.list_dirs(ckpt))
+        return [
+            _rank_dir_name(i)
+            for i in range(int(commit.get("nranks", 1)))
+            if _rank_dir_name(i) not in present
+        ]
+
+    def _fetch_for_rank(self, fs, ckpt, local, tid, commit):
+        """Stage the slice of a checkpoint THIS rank needs: the replicated
+        top-level files plus its own ``rank_<tid>/`` shard. Skipping the
+        peers' shards keeps resume traffic O(shard) per rank instead of
+        O(nranks * shard) — across the pod, linear instead of quadratic.
+        Backends that cannot fetch single paths fall back to the whole
+        directory."""
+        import shutil
+
+        if not commit or int(commit.get("nranks", 1)) <= 1:
+            fs.download(ckpt, local)
+            return
+        try:
+            os.makedirs(local, exist_ok=True)
+            from .. import io as _io
+
+            for fname in ("__params__.npz", _io.MANIFEST_NAME,
+                          _TRAIN_STATUS_FILE, _COMMIT_FILE):
+                src = os.path.join(ckpt, fname)
+                if fs.is_exist(src):
+                    fs.download(src, os.path.join(local, fname))
+            shard = os.path.join(ckpt, _rank_dir_name(tid))
+            if fs.is_exist(shard):
+                fs.download(shard, os.path.join(local, _rank_dir_name(tid)))
+        except Exception:
+            shutil.rmtree(local, ignore_errors=True)
+            os.makedirs(local, exist_ok=True)
+            fs.download(ckpt, local)
+
+    def _load_rank_shard(self, local, trainer_id, dir_commit):
+        """This rank's slice of a downloaded checkpoint: verify the shard's
+        commit record against the checkpoint-level one (the rank-coherence
+        check — a shard that belongs to a different checkpoint number or
+        global step means the ranks would silently train on different
+        timelines), overlay its per-rank payload onto the scope, and
+        return its TrainStatus. None when the checkpoint predates shards
+        or this rank joined after the save (elastic resize)."""
+        import jax.numpy as jnp
+
+        from ..errors import ResumeMismatchError
+        from .. import io as _io
+
+        shard = os.path.join(local, _rank_dir_name(trainer_id))
+        if not os.path.isdir(shard):
+            return None
+        commit_file = os.path.join(shard, _COMMIT_FILE)
+        if dir_commit is not None and os.path.exists(commit_file):
+            with open(commit_file) as f:
+                shard_commit = json.load(f)
+            for field in ("checkpoint_no", "global_step"):
+                if shard_commit.get(field) != dir_commit.get(field):
+                    from .. import observability as _obs
+
+                    _obs.add("resilience.resume_mismatches")
+                    raise ResumeMismatchError(
+                        f"rank {trainer_id} shard disagrees with its "
+                        f"checkpoint on {field}: shard has "
+                        f"{shard_commit.get(field)!r}, commit record has "
+                        f"{dir_commit.get(field)!r} — refusing a resume "
+                        "that would silently diverge the ranks"
+                    )
+        payload = os.path.join(shard, "__params__.npz")
+        if os.path.exists(payload):
+            from ..framework.scope import global_scope
+
+            arrays = _io._load_npz_verified(payload)
+            scope = global_scope()
+            for name, arr in arrays.items():
+                scope.set_var(name, jnp.asarray(arr))
+        status_file = os.path.join(shard, _TRAIN_STATUS_FILE)
+        if os.path.exists(status_file):
+            with open(status_file) as f:
+                return TrainStatus.from_dict(json.load(f))
+        return None
 
     def load_check_point(
         self, executor, path, trainer_id=None, main_program=None, fs=None,
@@ -234,19 +619,35 @@ class Fleet:
         returns its TrainStatus. Missing dir -> TrainStatus(-1) (cold
         start, reference behavior).
 
-        When the newest checkpoint fails integrity verification
-        (CheckpointCorruptionError from io.py's manifest/CRC check), falls
-        back to the next-newest until one loads — never silently-wrong
-        weights, and a torn latest save costs one rotation step, not the
-        run. An explicitly requested checkpoint_no never falls back."""
+        Candidate selection skips checkpoints that fail integrity
+        verification (CheckpointCorruptionError from io.py's manifest/CRC
+        check) AND checkpoints whose commit record promises rank shards
+        that never landed (a save interrupted after the replicated publish)
+        — every rank walks the same FS view newest-first, so all ranks
+        settle on the same newest COMPLETE checkpoint instead of silently
+        diverging. An explicitly requested checkpoint_no never falls back:
+        corruption raises CheckpointCorruptionError, incompleteness raises
+        ResumeMismatchError.
+
+        When this rank's ``rank_<i>/`` shard is present its commit record
+        must match the checkpoint's (number + global step) — mismatch
+        raises ResumeMismatchError — and the returned TrainStatus is the
+        shard's (per-rank cursor and RNG position), with any per-rank
+        payload overlaid on the scope after the replicated load."""
         import tempfile
 
-        from ..errors import CheckpointCorruptionError
+        from ..errors import CheckpointCorruptionError, ResumeMismatchError
         from .fs_wrapper import LocalFS
         from .. import io as _io
 
         fs = fs or LocalFS()
-        nos = _checkpoint_numbers(fs, path) if fs.is_exist(path) else []
+        tid = trainer_id
+        if tid is None:
+            tid = self.worker_index() if self._inited else 0
+        nos = (
+            self._scan_retry().call(_checkpoint_numbers, fs, path)
+            if fs.is_exist(path) else []
+        )
         if not nos:
             return TrainStatus(-1)
         import shutil
@@ -255,40 +656,196 @@ class Fleet:
             [checkpoint_no] if checkpoint_no is not None else list(reversed(nos))
         )
         last_err = None
+        saw_my_shard = had_corruption = False
         for i, no in enumerate(candidates):
+            from .. import observability as _obs
+
             ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
-            local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
             try:
-                fs.download(ckpt, local)
-                _io.load_persistables(executor, local, main_program)
-                if i > 0:
-                    from .. import observability as _obs
-
-                    _obs.add("resilience.checkpoint_fallbacks")
-                status_file = os.path.join(local, _TRAIN_STATUS_FILE)
-                if os.path.exists(status_file):
-                    with open(status_file) as f:
-                        return TrainStatus(json.load(f).get("epoch_no", -1))
-                return TrainStatus(-1)
+                remote_commit = self._read_commit(fs, ckpt)
             except CheckpointCorruptionError as e:
-                from .. import observability as _obs
-
+                # a garbled commit record is corruption like any other:
+                # fall back to an older checkpoint instead of bricking
+                # resume on every rank
                 _obs.add("resilience.checkpoint_corrupt")
                 last_err = e
+                had_corruption = True
+                if checkpoint_no is not None:
+                    raise
+                continue
+            missing = self._scan_retry().call(
+                self._missing_shards, fs, ckpt, remote_commit
+            )
+            if missing:
+                _obs.add("resilience.checkpoint_incomplete")
+                if _rank_dir_name(tid) not in missing:
+                    saw_my_shard = True
+                last_err = ResumeMismatchError(
+                    f"checkpoint {no} under {path!r} is missing rank "
+                    f"shards {missing} its commit record promises (save "
+                    "died between the replicated publish and the last "
+                    "shard upload)"
+                )
+                if checkpoint_no is not None:
+                    raise last_err
+                continue
+            local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
+            try:
+                self._fetch_for_rank(fs, ckpt, local, tid, remote_commit)
+                _io.load_persistables(executor, local, main_program)
+                if i > 0:
+                    _obs.add("resilience.checkpoint_fallbacks")
+                dir_commit = remote_commit
+                commit_file = os.path.join(local, _COMMIT_FILE)
+                if dir_commit is None and os.path.exists(commit_file):
+                    # backend without read_file support: the commit rode
+                    # along in the full-directory download
+                    with open(commit_file) as f:
+                        dir_commit = json.load(f)
+                status = self._load_rank_shard(local, tid, dir_commit)
+                if status is None:
+                    status_file = os.path.join(local, _TRAIN_STATUS_FILE)
+                    if os.path.exists(status_file):
+                        with open(status_file) as f:
+                            status = TrainStatus.from_dict(json.load(f))
+                    else:
+                        status = TrainStatus(-1)
+                status.checkpoint_no = no
+                if status.global_step or status.cursor:
+                    # a v2 mid-run position is being restored, not a bare
+                    # epoch boundary: the exact-resume path fired
+                    _obs.add("resilience.resumes")
+                return status
+            except CheckpointCorruptionError as e:
+                _obs.add("resilience.checkpoint_corrupt")
+                last_err = e
+                had_corruption = True
             finally:
                 shutil.rmtree(local, ignore_errors=True)
+        if (
+            isinstance(last_err, ResumeMismatchError)
+            and not saw_my_shard and not had_corruption
+        ):
+            # every candidate was merely incomplete and NONE of them holds
+            # this rank's shard: this rank never completed a save, so there
+            # is nothing to resume — a cold start, not an error. (The
+            # common shape: a peer published the replicated payload while
+            # this rank was still starting up and hadn't attached yet.)
+            from .. import observability as _obs
+
+            _obs.add("resilience.resume_cold_starts")
+            return TrainStatus(-1)
         raise last_err
 
 
 
 class TrainStatus:
-    """Checkpoint metadata (reference :49): last finished epoch."""
+    """Checkpoint metadata, v2: the FULL training-loop position, not just
+    the last finished epoch (reference :49 stored only that, which made a
+    resumed run replay the interrupted epoch from example 0 with fresh RNG
+    streams — elastic restart silently changed what the model trained on).
 
-    def __init__(self, epoch_no=-1):
+    Fields beyond ``epoch_no``:
+
+    * ``global_step`` — optimizer updates applied so far;
+    * ``rng`` — :meth:`Program.rng_state` (seed mode, per-run step
+      counter, unseeded-program nonce) so replayed steps draw the same
+      dropout masks;
+    * ``amp`` — ``OptimizerWithMixedPrecision.state_dict()`` (dynamic
+      loss scale + good/bad step counters);
+    * ``guard`` — ``TrainGuard.state_dict()`` (bad-step counters and the
+      spent rollback budget);
+    * ``cursor`` — ``DataLoader.state_dict()`` (epoch + batches consumed
+      + sampler seed), the resumable-input-pipeline position.
+
+    :meth:`capture` fills them from live objects; :meth:`restore` applies
+    them back after ``load_check_point``. Serialization is versioned:
+    v1 payloads (bare ``{"epoch_no": N}``) load with defaulted v2 fields.
+    Equality stays epoch-based (the v1 contract callers rely on)."""
+
+    def __init__(self, epoch_no=-1, global_step=0, rng=None, amp=None,
+                 guard=None, cursor=None):
         self._epoch_no = epoch_no
+        self.global_step = int(global_step)
+        self.rng = dict(rng) if rng else {}
+        self.amp = dict(amp) if amp else {}
+        self.guard = dict(guard) if guard else {}
+        self.cursor = dict(cursor) if cursor else {}
+        self.checkpoint_no = None  # set by load_check_point
+
+    @property
+    def epoch_no(self):
+        return self._epoch_no
 
     def next(self):
         return self._epoch_no + 1
+
+    # -- capture / restore -------------------------------------------------
+    @classmethod
+    def capture(cls, epoch_no=-1, global_step=0, program=None, amp=None,
+                guard=None, loader=None, scope=None):
+        """Snapshot the full training-loop state from live objects. Every
+        source is optional — pass what the loop uses; omitted parts stay
+        empty and restore as no-ops."""
+        return cls(
+            epoch_no,
+            global_step=global_step,
+            rng=program.rng_state() if program is not None else None,
+            amp=amp.state_dict(scope=scope) if amp is not None else None,
+            guard=guard.state_dict() if guard is not None else None,
+            cursor=loader.state_dict() if loader is not None else None,
+        )
+
+    def restore(self, program=None, amp=None, guard=None, loader=None,
+                scope=None):
+        """Apply the captured state back onto live objects (call after
+        ``load_check_point`` repopulated the scope). Empty parts — e.g.
+        from a v1 checkpoint — are no-ops. Returns self for chaining."""
+        if program is not None:
+            program.set_rng_state(self.rng)
+        if amp is not None:
+            amp.load_state_dict(self.amp, scope=scope)
+        if guard is not None:
+            guard.load_state_dict(self.guard)
+        if loader is not None and self.cursor:
+            loader.load_state_dict(self.cursor)
+        return self
+
+    # -- versioned serialization --------------------------------------------
+    def to_dict(self):
+        return {
+            "version": TRAIN_STATUS_VERSION,
+            "epoch_no": self._epoch_no,
+            "global_step": self.global_step,
+            "rng": self.rng,
+            "amp": self.amp,
+            "guard": self.guard,
+            "cursor": self.cursor,
+        }
+
+    @classmethod
+    def from_dict(cls, payload):
+        from ..errors import CheckpointCorruptionError
+
+        if not isinstance(payload, dict):
+            raise CheckpointCorruptionError(
+                f"train status payload is not a dict: {type(payload).__name__}"
+            )
+        version = int(payload.get("version", 1))
+        if version > TRAIN_STATUS_VERSION:
+            raise CheckpointCorruptionError(
+                f"train status version {version} is newer than this build "
+                f"understands (max {TRAIN_STATUS_VERSION}); refusing a "
+                "lossy partial load"
+            )
+        return cls(
+            payload.get("epoch_no", -1),
+            global_step=payload.get("global_step", 0),
+            rng=payload.get("rng"),
+            amp=payload.get("amp"),
+            guard=payload.get("guard"),
+            cursor=payload.get("cursor"),
+        )
 
     def __eq__(self, other):
         return isinstance(other, TrainStatus) and self._epoch_no == other._epoch_no
@@ -299,7 +856,8 @@ class TrainStatus:
         return not self.__eq__(other)
 
     def __repr__(self):
-        return f"TrainStatus(epoch_no={self._epoch_no})"
+        extra = f", global_step={self.global_step}" if self.global_step else ""
+        return f"TrainStatus(epoch_no={self._epoch_no}{extra})"
 
 
 class CollectiveOptimizer:

@@ -5,10 +5,13 @@ LocalFS covers single-host and NFS-mounted checkpoint dirs; a HadoopFS-style
 backend plugs in by implementing the same five methods (the reference
 shelled out to `hadoop fs`, framework/io/fs.cc).
 
-Mutating entry points carry resilience fault seams (``fs.upload`` /
-``fs.download`` / ``fs.mv`` / ``fs.delete`` for LocalFS, ``fs.hadoop`` for
-every HadoopFS shell-out) so checkpoint publish/fetch paths are
-chaos-testable; callers (Fleet.save_check_point) retry around them."""
+Entry points carry resilience fault seams (``fs.upload`` /
+``fs.download`` / ``fs.mv`` / ``fs.delete`` / ``fs.mkdir`` /
+``fs.list_dirs`` for LocalFS, ``fs.hadoop`` for every HadoopFS shell-out)
+so checkpoint publish/fetch paths are chaos-testable — including the
+directory-scan prelude of a save, which a flaky remote listing can fail
+just as easily as the upload; callers (Fleet.save_check_point) retry
+around them."""
 
 from __future__ import annotations
 
@@ -42,9 +45,16 @@ class FS:
         """Fetch a checkpoint dir into a local staging dir."""
         raise NotImplementedError
 
+    def read_file(self, path):
+        """Bytes of a small remote file (commit records / status JSON), or
+        None when it does not exist. Lets rank-coherence checks read a
+        checkpoint's commit record without downloading the payload."""
+        raise NotImplementedError
+
 
 class LocalFS(FS):
     def list_dirs(self, path):
+        fault_point("fs.list_dirs")
         if not os.path.isdir(path):
             return []
         return [
@@ -56,6 +66,7 @@ class LocalFS(FS):
         return os.path.exists(path)
 
     def mkdir(self, path):
+        fault_point("fs.mkdir")
         os.makedirs(path, exist_ok=True)
 
     def delete(self, path):
@@ -75,7 +86,19 @@ class LocalFS(FS):
 
     def download(self, remote_path, local_path):
         fault_point("fs.download")
-        shutil.copytree(remote_path, local_path, dirs_exist_ok=True)
+        if os.path.isfile(remote_path):
+            # single-file fetch: lets a rank pull just the replicated
+            # payload + its own shard instead of every peer's
+            os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+            shutil.copy2(remote_path, local_path)
+        else:
+            shutil.copytree(remote_path, local_path, dirs_exist_ok=True)
+
+    def read_file(self, path):
+        if not os.path.isfile(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
 
 
 class HadoopFS(FS):
@@ -133,9 +156,30 @@ class HadoopFS(FS):
     def download(self, remote_path, local_path):
         import os
 
+        if self._run("-test", "-d", remote_path, check=False).returncode != 0:
+            # a single file: fetch it under the local name directly
+            if os.path.exists(local_path):
+                os.remove(local_path)
+            os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+            self._run("-get", remote_path, local_path)
+            return
         # -get refuses an existing destination dir; fetch into it instead
         os.makedirs(local_path, exist_ok=True)
         proc = self._run("-get", f"{remote_path.rstrip('/')}/*", local_path,
                          check=False)
         if proc.returncode != 0:
             self._run("-get", remote_path, local_path)
+
+    def read_file(self, path):
+        proc = self._run("-cat", path, check=False)
+        if proc.returncode != 0:
+            # only a genuinely absent file maps to None; a transient HDFS
+            # error must surface (callers treat None as "pre-v2
+            # checkpoint" and would skip the rank-coherence check)
+            if "No such file" in (proc.stderr or ""):
+                return None
+            raise RuntimeError(
+                f"hadoop fs -cat {path} failed (rc={proc.returncode}): "
+                f"{(proc.stderr or '').strip()}"
+            )
+        return proc.stdout.encode()

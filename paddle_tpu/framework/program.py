@@ -349,6 +349,32 @@ class Program:
         self._structural_seed_cache = (self._version, seed)
         return seed
 
+    def rng_state(self):
+        """Snapshot of the per-program RNG stream position: the seed mode,
+        the per-run step counter the executor folds into each step key,
+        and the unseeded-program nonce. Together with the scope's
+        persistables this makes `Executor.run` bitwise replayable — the
+        exact-resume checkpoint (TrainStatus v2) carries it."""
+        return {
+            "random_seed": int(self.random_seed),
+            "rng_step": int(self._rng_step),
+            "rng_nonce": int(self._rng_nonce),
+        }
+
+    def set_rng_state(self, state):
+        """Restore :meth:`rng_state`. A rebuilt program in a restarted
+        process draws a fresh nonce; restoring the saved one re-aligns the
+        unseeded stream with the run that wrote the checkpoint."""
+        if not state:
+            return
+        if "random_seed" in state:
+            self.random_seed = int(state["random_seed"])
+        if "rng_step" in state:
+            self._rng_step = int(state["rng_step"])
+        nonce = state.get("rng_nonce")
+        if nonce:
+            self._rng_nonce = int(nonce)
+
     @property
     def global_block(self):
         return self.blocks[0]

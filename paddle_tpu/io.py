@@ -49,6 +49,7 @@ __all__ = [
     "save_inference_model",
     "load_inference_model",
     "prune",
+    "verify_checkpoint_dir",
 ]
 
 MANIFEST_NAME = "manifest.json"
@@ -175,6 +176,17 @@ def _load_npz_verified(path, manifest_path=None):
         ) from e
     _verify_arrays(arrays, manifest, path)
     return arrays
+
+
+def verify_checkpoint_dir(dirname, filename=None):
+    """Full readback verification of a persistables checkpoint dir (payload
+    decodes, every manifest array present with matching shape/dtype/CRC)
+    WITHOUT touching any scope. Raises CheckpointCorruptionError on any
+    defect — `Fleet.save_check_point` runs this against the checkpoint it
+    just published before rotating predecessors away, so a bad publish can
+    never leave zero loadable checkpoints behind."""
+    path = os.path.join(dirname, filename or "__params__.npz")
+    _load_npz_verified(path)
 
 
 def _collect(program, scope, predicate):

@@ -95,6 +95,48 @@ class DataLoader:
             raise TypeError("IterableDataset loader has no len()")
         return len(self.batch_sampler)
 
+    # -- exact-resume cursor ----------------------------------------------
+    def state_dict(self):
+        """The input-pipeline cursor (epoch + batches consumed + sampler
+        RNG identity), capturable at any point of an epoch. Stored inside
+        TrainStatus v2 so `load_state_dict` on a fresh process fast-skips
+        to exactly the first batch the dead run never consumed."""
+        if self.batch_sampler is None:
+            raise TypeError(
+                "IterableDataset loaders have no resumable cursor (a stream "
+                "has no random access to skip into); use a map-style "
+                "Dataset for exact resume"
+            )
+        if not hasattr(self.batch_sampler, "state_dict"):
+            raise TypeError(
+                f"{type(self.batch_sampler).__name__} has no "
+                "state_dict/load_state_dict cursor; derive it from "
+                "BatchSampler (or implement the pair) for exact resume"
+            )
+        return self.batch_sampler.state_dict()
+
+    def load_state_dict(self, state):
+        """Arm the next ``__iter__`` to resume from `state` (one-shot).
+        Without a prior load_state_dict, iteration behavior is unchanged —
+        every ``__iter__`` starts a fresh epoch."""
+        if self.batch_sampler is None or not hasattr(
+            self.batch_sampler, "load_state_dict"
+        ):
+            raise TypeError(
+                "this loader's batch sampler has no resumable cursor"
+            )
+        self.batch_sampler.load_state_dict(state or {})
+
+    def _track(self, it):
+        """Advance the sampler cursor once per DELIVERED batch — the
+        consumption notion a mid-epoch checkpoint needs (prefetched but
+        undelivered batches re-fetch on resume)."""
+        advance = getattr(self.batch_sampler, "advance", None)
+        for b in it:
+            if advance is not None:
+                advance(1)
+            yield b
+
     def __iter__(self):
         if self.num_workers > 0:
             it = _MultiWorkerIter(self)
@@ -118,8 +160,10 @@ class DataLoader:
                     )
                 return dict(zip(names, cols))
 
-            return (as_feed(b) for b in it)
-        return it
+            return self._track(as_feed(b) for b in it)
+        if self.batch_sampler is None:
+            return it  # IterableDataset: no cursor to maintain
+        return self._track(it)
 
     def __call__(self):
         return self.__iter__()

@@ -27,8 +27,11 @@ optional ``max_fires`` caps total fires (prob=1 + max_fires=1 = "fail
 exactly once, then heal" — the deterministic shape chaos CI wants).
 
 Wired seams: ``io.save`` / ``io.load`` (io.py), ``fs.upload`` /
-``fs.download`` / ``fs.mv`` / ``fs.delete`` (LocalFS), ``fs.hadoop``
-(HadoopFS shell-outs), ``dataloader.fetch`` (worker batch fetch),
+``fs.download`` / ``fs.mv`` / ``fs.delete`` / ``fs.mkdir`` /
+``fs.list_dirs`` (LocalFS — the last two cover the directory-scan prelude
+of a checkpoint save, which Fleet retries under ``checkpoint.prepare``),
+``fs.hadoop`` (HadoopFS shell-outs), ``dataloader.fetch`` (worker batch
+fetch),
 ``collective.dispatch`` (trace-time collective emission),
 ``guard.step`` (TrainGuard pre-step: corrupt_point over the feed, so
 ``nonfinite`` fabricates a divergence and ``hang`` a stuck step),

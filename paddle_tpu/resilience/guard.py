@@ -180,6 +180,28 @@ class TrainGuard:
     def _on_sigterm(self, signum, frame):
         self.draining = True
 
+    # -- exact-resume state ------------------------------------------------
+    def state_dict(self):
+        """The guard's recovery-policy position (step/bad-step counters and
+        the spent rollback budget) for TrainStatus v2 capture — a resumed
+        run must not get a fresh rollback budget for the same divergence."""
+        return {
+            "steps": self.steps,
+            "bad_steps": self.bad_steps,
+            "bad_streak": self.bad_streak,
+            "rollbacks": self.rollbacks,
+        }
+
+    def load_state_dict(self, state):
+        """Restore :meth:`state_dict`; empty/missing keys keep their
+        defaults, so v1 (epoch-only) checkpoints restore cleanly."""
+        if not state:
+            return
+        self.steps = int(state.get("steps", self.steps))
+        self.bad_steps = int(state.get("bad_steps", self.bad_steps))
+        self.bad_streak = int(state.get("bad_streak", self.bad_streak))
+        self.rollbacks = int(state.get("rollbacks", self.rollbacks))
+
     # -- the guarded step --------------------------------------------------
     def step(self, feed=None, fetch_list=None, program=None,
              return_numpy=True, **run_kw):

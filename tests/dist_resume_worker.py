@@ -1,0 +1,145 @@
+"""Exact-resume audit worker: deterministic 2-rank training with full
+TrainStatus-v2 checkpoints, a consumed-example log, and an optional
+self-SIGKILL mid-epoch.
+
+Each rank trains the same tiny regression on ITS
+DistributedBatchSampler shard (ranks are independent, the established
+chaos-worker pattern — a killed peer cannot wedge the others), feeds every
+persistable as a per-rank `local_vars` shard (the no-collective analog of
+weight-update-sharded state: nothing here is replicated), and checkpoints
+every CKPT_EVERY steps with `TrainStatus.capture` (global step, program
+RNG state, DataLoader cursor).
+
+On attempt 0 with ``kill_rank`` >= 0, that rank SIGKILLs itself at
+KILL_STEP — mid-epoch, off the checkpoint cadence — so the launcher's
+``--elastic`` path restarts it and the restart resumes from its newest
+COMPLETE checkpoint: restore RNG + cursor, truncate the consumed log to
+the checkpoint's step, fast-skip to the cursor, replay. The audit
+(tools/resume_audit.py) diffs final weights and the consumed log bitwise
+against an uninterrupted control run.
+
+argv: out_dir [kill_rank]   (kill_rank defaults to -1 = never kill)
+"""
+
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+
+EPOCHS = 3
+N = 48          # dataset size -> 6 batches per rank per epoch at nranks=2
+BS = 4
+CKPT_EVERY = 5  # steps; deliberately off the 6-step epoch length
+KILL_STEP = 11  # mid epoch 1, one step past the step-10 checkpoint
+
+
+def main(out_dir, kill_rank=-1):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, observability
+    from paddle_tpu.dataloader.dataset import Dataset
+    from paddle_tpu.fleet import collective as fc
+    from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    attempt = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
+
+    W = np.linspace(-1.0, 1.0, 4).reshape(4, 1).astype(np.float32)
+
+    class DS(Dataset):
+        def __len__(self):
+            return N
+
+        def __getitem__(self, i):
+            rs = np.random.RandomState(1000 + i)  # per-example deterministic
+            xa = rs.randn(4).astype(np.float32)
+            return np.float32(i), xa, (xa @ W).astype(np.float32)
+
+    x = fluid.data("x", [-1, 4])
+    y = fluid.data("y", [-1, 1])
+    pred = layers.fc(x, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.05).minimize(loss)
+    main_prog = fluid.default_main_program()
+    main_prog.random_seed = fluid.default_startup_program().random_seed = 7
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    fleet = fc.Fleet()
+    fleet.init(UserDefinedRoleMaker(current_id=rank, worker_num=nranks))
+    ckpt_dir = os.path.join(out_dir, "ckpts")
+    log_path = os.path.join(out_dir, f"consumed_rank{rank}.log")
+
+    ds = DS()
+    sampler = fluid.dataloader.DistributedBatchSampler(
+        ds, BS, nranks=nranks, rank=rank, shuffle=True, seed=13
+    )
+    loader = fluid.DataLoader(ds, batch_sampler=sampler,
+                              use_buffer_reader=False)
+    # every persistable is per-rank state here (independent ranks = fully
+    # weight-update-sharded); in a replicated job this list would name only
+    # the genuinely non-replicated vars
+    local_vars = [
+        v.name for v in main_prog.list_vars()
+        if getattr(v, "persistable", False) and not getattr(v, "is_data", False)
+    ]
+
+    status = fleet.load_check_point(exe, ckpt_dir)
+    step = int(status.global_step)
+    if step > 0:
+        status.restore(program=main_prog, loader=loader)
+        start_epoch = int(status.cursor.get("epoch", status.next()))
+        # drop log entries the resumed timeline will replay: a consumed
+        # line is authoritative only up to the checkpoint's step
+        lines = [
+            ln for ln in open(log_path).read().splitlines()
+            if ln and int(ln.split()[0]) <= step
+        ]
+        with open(log_path, "w") as f:
+            f.writelines(ln + "\n" for ln in lines)
+    else:
+        start_epoch = 0
+        open(log_path, "w").close()
+
+    logf = open(log_path, "a")
+    for epoch in range(start_epoch, EPOCHS):
+        sampler.set_epoch(epoch)
+        for idxb, xb, yb in loader:
+            step += 1
+            exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
+            idxs = ",".join(
+                str(int(i)) for i in np.asarray(idxb).reshape(-1)
+            )
+            logf.write(f"{step} {epoch} {idxs}\n")
+            logf.flush()
+            if rank == kill_rank and attempt == 0 and step == KILL_STEP:
+                os.kill(os.getpid(), signal.SIGKILL)
+            if step % CKPT_EVERY == 0:
+                st = fc.TrainStatus.capture(
+                    epoch_no=epoch - 1, global_step=step,
+                    program=main_prog, loader=loader,
+                )
+                fleet.save_check_point(
+                    exe, ckpt_dir, st, local_vars=local_vars,
+                    remain_all_checkpoint=True,
+                )
+    logf.close()
+
+    scope = fluid.framework.scope.global_scope()
+    arrays = {
+        name: np.asarray(scope.find_var(name))
+        for name in local_vars
+        if scope.find_var(name) is not None
+    }
+    np.savez(os.path.join(out_dir, f"final_rank{rank}.npz"), **arrays)
+    observability.dump(
+        os.path.join(out_dir, f"obs_rank{rank}_attempt{attempt}.json")
+    )
+    with open(os.path.join(out_dir, f"done_rank{rank}.json"), "w") as f:
+        json.dump({"attempt": attempt, "steps": step}, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else -1)

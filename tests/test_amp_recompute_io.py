@@ -238,7 +238,16 @@ if cmd == "-ls":
         kind = "d" if os.path.isdir(os.path.join(d, n)) else "-"
         print(f"{{kind}}rwxr-xr-x - u g 0 d t {{rest[0].rstrip('/')}}/{{n}}")
 elif cmd == "-test":
-    sys.exit(0 if os.path.exists(loc(rest[1])) else 1)
+    p = loc(rest[1])
+    ok = os.path.isdir(p) if rest[0] == "-d" else os.path.exists(p)
+    sys.exit(0 if ok else 1)
+elif cmd == "-cat":
+    p = loc(rest[0])
+    if not os.path.isfile(p):
+        print(f"cat: `{{rest[0]}}': No such file or directory",
+              file=sys.stderr)
+        sys.exit(1)
+    sys.stdout.buffer.write(open(p, "rb").read())
 elif cmd == "-mkdir":
     os.makedirs(loc(rest[-1]), exist_ok=True)
 elif cmd == "-rm":
@@ -252,7 +261,10 @@ elif cmd == "-put":
     shutil.copytree(src, dst, dirs_exist_ok=True)
 elif cmd == "-get":
     src = loc(rest[0].replace("/*", ""))
-    shutil.copytree(src, rest[1], dirs_exist_ok=True)
+    if os.path.isfile(src):
+        shutil.copy2(src, rest[1])
+    else:
+        shutil.copytree(src, rest[1], dirs_exist_ok=True)
 else:
     sys.exit(2)
 """)

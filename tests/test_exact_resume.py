@@ -1,0 +1,656 @@
+"""Exact-resume checkpoints: TrainStatus v2 full-state capture/restore,
+the resumable data-pipeline cursor, per-rank shards + commit-record
+coherence, and the rotate-after-verify publish discipline.
+
+The end-to-end kill/resume equivalence proof lives in
+tools/resume_audit.py (run by the ci.sh chaos stage and by the slow test
+at the bottom); these tests pin each layer in isolation.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import errors, layers, observability
+from paddle_tpu.dataloader import (
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+)
+from paddle_tpu.dataloader.dataset import Dataset
+from paddle_tpu.fleet import collective as fc
+from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+from paddle_tpu.framework import unique_name
+from paddle_tpu.resilience import TrainGuard, faults
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main
+
+
+def _build_model():
+    x = fluid.data("x", [-1, 4])
+    y = layers.fc(x, 2, param_attr=fluid.ParamAttr(name="er_w"))
+    loss = layers.mean(y)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe, loss
+
+
+def _fleet(rank=0, nranks=1):
+    f = fc.Fleet()
+    f.init(UserDefinedRoleMaker(current_id=rank, worker_num=nranks))
+    return f
+
+
+class _Idx(Dataset):
+    def __init__(self, n=24):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.asarray([i], dtype=np.float32)
+
+
+# -- TrainStatus v2 ----------------------------------------------------------
+def test_train_status_v2_dict_round_trip():
+    st = fc.TrainStatus(
+        2, global_step=17, rng={"random_seed": 7, "rng_step": 17,
+                                "rng_nonce": 5},
+        amp={"loss_scaling": 1024.0, "good_steps": 3, "bad_steps": 0},
+        guard={"steps": 17, "bad_steps": 1, "bad_streak": 0, "rollbacks": 1},
+        cursor={"epoch": 2, "batches_consumed": 5},
+    )
+    d = st.to_dict()
+    assert d["version"] == fc.TRAIN_STATUS_VERSION == 2
+    back = fc.TrainStatus.from_dict(json.loads(json.dumps(d)))
+    assert back == st and back.global_step == 17
+    assert back.rng == st.rng and back.amp == st.amp
+    assert back.guard == st.guard and back.cursor == st.cursor
+
+
+def test_train_status_v1_payload_loads_with_defaults():
+    st = fc.TrainStatus.from_dict({"epoch_no": 3})
+    assert st.next() == 4
+    assert st.global_step == 0 and not st.rng and not st.cursor
+
+
+def test_train_status_future_version_refused():
+    with pytest.raises(errors.CheckpointCorruptionError, match="version"):
+        fc.TrainStatus.from_dict({"version": 99, "epoch_no": 0})
+
+
+def test_program_rng_state_round_trip(fresh_programs):
+    main = fresh_programs
+    main.random_seed = 11
+    main._rng_step = 42
+    state = main.rng_state()
+    other = fluid.Program()
+    other.set_rng_state(state)
+    assert other.random_seed == 11 and other._rng_step == 42
+    assert other._rng_nonce == main._rng_nonce
+
+
+def test_guard_state_round_trip():
+    exe = fluid.Executor()
+    g = TrainGuard(exe)
+    g.steps, g.bad_steps, g.bad_streak, g.rollbacks = 9, 2, 1, 1
+    g2 = TrainGuard(exe)
+    g2.load_state_dict(g.state_dict())
+    assert (g2.steps, g2.bad_steps, g2.bad_streak, g2.rollbacks) == (9, 2, 1, 1)
+
+
+def test_amp_state_round_trip(fresh_programs):
+    from paddle_tpu.contrib.mixed_precision import decorate
+
+    x = fluid.data("x", [-1, 4])
+    y = layers.fc(x, 2)
+    loss = layers.mean(y)
+    opt = decorate(fluid.optimizer.SGD(0.1), init_loss_scaling=2.0 ** 10,
+                   dest_dtype="float16")
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    st = opt.state_dict()
+    assert st == {"loss_scaling": 2.0 ** 10, "good_steps": 0, "bad_steps": 0}
+    opt.load_state_dict(
+        {"loss_scaling": 256.0, "good_steps": 5, "bad_steps": 1}
+    )
+    assert opt.state_dict() == {
+        "loss_scaling": 256.0, "good_steps": 5, "bad_steps": 1,
+    }
+    # empty state (v1 checkpoint) is a no-op, not a reset-to-garbage
+    opt.load_state_dict({})
+    assert opt.state_dict()["loss_scaling"] == 256.0
+
+
+# -- deterministic RandomSampler ---------------------------------------------
+def test_random_sampler_unseeded_is_instance_seeded_not_global():
+    ds = _Idx(16)
+    np.random.seed(0)
+    a1 = list(RandomSampler(ds))
+    np.random.seed(0)  # identical global numpy state...
+    s = RandomSampler(ds)
+    b1 = list(s)
+    # ...yet instances draw their own OS-entropy seed: no global coupling
+    # (ranks forking with different global state shuffle from their OWN
+    # seed, and two samplers in one process are decorrelated)
+    assert sorted(a1) == sorted(b1) == list(range(16))
+    # standalone unseeded keeps the classic semantics: every epoch
+    # reshuffles — but deterministically given the instance seed, so a
+    # restored cursor can replay any one of them
+    b2 = list(s)
+    assert b2 != b1 and sorted(b2) == sorted(b1)
+    s.set_epoch(0)
+    assert list(s) == b1  # pinning the epoch replays its permutation
+
+
+def test_random_sampler_legacy_randomstate_cursor_refused():
+    s = RandomSampler(_Idx(16), generator=np.random.RandomState(3))
+    state = s.state_dict()
+    assert state["seed"] is None  # the stream position is not capturable
+    import paddle_tpu.errors as errs
+
+    with pytest.raises(errs.ResumeMismatchError, match="caller-managed"):
+        RandomSampler(_Idx(16)).load_state_dict(state)
+
+
+def test_random_sampler_epoch_reshuffles_deterministically():
+    s = RandomSampler(_Idx(32), generator=5)
+    e0 = list(s)
+    s.set_epoch(1)
+    e1 = list(s)
+    s.set_epoch(0)
+    assert list(s) == e0 and e0 != e1
+    # a fresh process restoring the cursor replays the same permutations
+    s2 = RandomSampler(_Idx(32))
+    s2.load_state_dict({"seed": 5, "epoch": 1})
+    assert list(s2) == e1
+
+
+# -- cursor: BatchSampler / DistributedBatchSampler / DataLoader -------------
+def test_batch_sampler_cursor_skips_consumed_prefix():
+    bs = BatchSampler(dataset=_Idx(20), shuffle=True, batch_size=3)
+    full = list(bs)
+    bs2 = BatchSampler(dataset=_Idx(20), shuffle=True, batch_size=3)
+    bs2.load_state_dict(
+        {"epoch": 0, "batches_consumed": 4,
+         "sampler": bs.sampler.state_dict()}
+    )
+    assert list(bs2) == full[4:]
+
+
+def test_batch_sampler_auto_epoch_bump_reshuffles():
+    bs = BatchSampler(dataset=_Idx(20), shuffle=True, batch_size=5)
+    e0, e1 = list(bs), list(bs)
+    assert e0 != e1  # per-epoch reshuffle survives the deterministic seeding
+    assert sorted(sum(e0, [])) == sorted(sum(e1, [])) == list(range(20))
+
+
+def test_distributed_batch_sampler_cursor_fast_skip():
+    ds = _Idx(48)
+    s = DistributedBatchSampler(ds, 4, nranks=2, rank=1, shuffle=True,
+                                seed=13)
+    s.set_epoch(2)
+    full = list(s)
+    s2 = DistributedBatchSampler(ds, 4, nranks=2, rank=1, shuffle=True,
+                                 seed=13)
+    s2.load_state_dict({"epoch": 2, "batches_consumed": 3})
+    assert list(s2) == full[3:]
+    # the armed skip is one-shot: the next epoch is complete again
+    assert list(s2) == full
+
+
+def test_distributed_sampler_cursor_restores_seed_refuses_resize():
+    ds = _Idx(48)
+    src = DistributedBatchSampler(ds, 4, nranks=2, rank=0, shuffle=True,
+                                  seed=13)
+    state = src.state_dict()
+    # a restart that constructed the sampler with a different seed still
+    # replays the dead run's permutation: the cursor carries the seed
+    other = DistributedBatchSampler(ds, 4, nranks=2, rank=0, shuffle=True,
+                                    seed=99)
+    other.load_state_dict(state)
+    assert other.seed == 13
+    # an elastically resized world cannot fast-skip (different sharding):
+    # typed refusal, not a silently wrong prefix
+    resized = DistributedBatchSampler(ds, 4, nranks=4, rank=0, shuffle=True,
+                                      seed=13)
+    with pytest.raises(errors.ResumeMismatchError, match="nranks"):
+        resized.load_state_dict(state)
+
+
+def test_dataloader_cursor_resume_matches_uninterrupted(fresh_programs):
+    def make():
+        return fluid.DataLoader(_Idx(18), batch_size=4,
+                                use_buffer_reader=False, shuffle=True)
+
+    loader = make()
+    seen, state = [], None
+    it = iter(loader)
+    for k in range(2):
+        seen.append(np.asarray(next(it)).copy())
+    state = loader.state_dict()
+    assert state["batches_consumed"] == 2
+    rest_expected = [np.asarray(b) for b in it]
+
+    resumed = make()
+    resumed.load_state_dict(state)
+    rest = [np.asarray(b) for b in resumed]
+    assert len(rest) == len(rest_expected)
+    for a, b in zip(rest, rest_expected):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dataloader_cursor_multiworker(fresh_programs):
+    loader = fluid.DataLoader(_Idx(30), batch_size=3, num_workers=2,
+                              use_buffer_reader=False)
+    it = iter(loader)
+    first = np.asarray(next(it))
+    np.testing.assert_array_equal(first.ravel(), [0, 1, 2])
+    loader2 = fluid.DataLoader(_Idx(30), batch_size=3, num_workers=2,
+                               use_buffer_reader=False)
+    loader2.load_state_dict(loader.state_dict())
+    nxt = np.asarray(next(iter(loader2)))
+    np.testing.assert_array_equal(nxt.ravel(), [3, 4, 5])
+
+
+def test_dataloader_iterable_dataset_has_no_cursor():
+    from paddle_tpu.dataloader.dataset import IterableDataset
+
+    class Stream(IterableDataset):
+        def __iter__(self):
+            return iter([np.zeros(1, np.float32)])
+
+    loader = fluid.DataLoader(Stream(), batch_size=1)
+    with pytest.raises(TypeError, match="cursor"):
+        loader.state_dict()
+
+
+# -- checkpoint layout: commit record + rank shard ---------------------------
+def test_save_writes_commit_and_rank_shard(tmp_path, fresh_programs):
+    exe, _ = _build_model()
+    fleet = _fleet()
+    path = str(tmp_path / "ckpts")
+    st = fc.TrainStatus(1, global_step=12, rng={"rng_step": 12})
+    assert fleet.save_check_point(exe, path, st) == 0
+    ckpt = os.path.join(path, "__paddle_checkpoint__0")
+    commit = json.load(open(os.path.join(ckpt, "commit.json")))
+    assert commit == {
+        "version": 2, "checkpoint_no": 0, "epoch_no": 1, "global_step": 12,
+        "nranks": 1,
+    }
+    shard_commit = json.load(
+        open(os.path.join(ckpt, "rank_0", "commit.json"))
+    )
+    assert shard_commit["rank"] == 0 and shard_commit["checkpoint_no"] == 0
+    back = fleet.load_check_point(exe, path)
+    assert back.global_step == 12 and back.rng["rng_step"] == 12
+    assert back.checkpoint_no == 0
+
+
+def test_local_vars_land_in_rank_shard_and_overlay_on_load(
+    tmp_path, fresh_programs
+):
+    exe, loss = _build_model()
+    scope = fluid.framework.scope.global_scope()
+    fleet = _fleet()
+    path = str(tmp_path / "ckpts")
+    want = np.asarray(scope.find_var("er_w")).copy()
+    fleet.save_check_point(
+        exe, path, fc.TrainStatus(0, global_step=1), local_vars=["er_w"]
+    )
+    shard = os.path.join(path, "__paddle_checkpoint__0", "rank_0")
+    assert os.path.exists(os.path.join(shard, "__params__.npz"))
+    scope.set_var("er_w", np.zeros_like(want))
+    fleet.load_check_point(exe, path)
+    np.testing.assert_array_equal(np.asarray(scope.find_var("er_w")), want)
+
+
+def test_rank_shard_commit_mismatch_raises(tmp_path, fresh_programs):
+    exe, _ = _build_model()
+    fleet = _fleet()
+    path = str(tmp_path / "ckpts")
+    fleet.save_check_point(exe, path, fc.TrainStatus(0, global_step=5))
+    # tamper: the rank shard claims a different global step than the
+    # checkpoint's commit record — the silent-divergence shape
+    shard_commit = os.path.join(
+        path, "__paddle_checkpoint__0", "rank_0", "commit.json"
+    )
+    c = json.load(open(shard_commit))
+    c["global_step"] = 999
+    json.dump(c, open(shard_commit, "w"))
+    c0 = observability.snapshot()["counters"].get(
+        "resilience.resume_mismatches", 0
+    )
+    with pytest.raises(errors.ResumeMismatchError, match="global_step"):
+        fleet.load_check_point(exe, path)
+    c1 = observability.snapshot()["counters"].get(
+        "resilience.resume_mismatches", 0
+    )
+    assert c1 - c0 == 1
+
+
+def test_incomplete_checkpoint_skipped_for_older_complete(
+    tmp_path, fresh_programs
+):
+    import shutil
+
+    exe, _ = _build_model()
+    fleet = _fleet()
+    path = str(tmp_path / "ckpts")
+    fleet.save_check_point(exe, path, fc.TrainStatus(0, global_step=5))
+    fleet.save_check_point(exe, path, fc.TrainStatus(1, global_step=10))
+    # simulate "save died between the replicated publish and the shard
+    # upload" on the NEWEST checkpoint: promise 2 ranks, deliver 1
+    ckpt1 = os.path.join(path, "__paddle_checkpoint__1")
+    commit = json.load(open(os.path.join(ckpt1, "commit.json")))
+    commit["nranks"] = 2
+    json.dump(commit, open(os.path.join(ckpt1, "commit.json"), "w"))
+    status = fleet.load_check_point(exe, path)
+    assert status.global_step == 5  # fell back to the complete one
+    c = observability.snapshot()["counters"]
+    assert c.get("resilience.checkpoint_incomplete", 0) >= 1
+    # an explicit request for the incomplete checkpoint must raise, not
+    # silently fall back
+    with pytest.raises(errors.ResumeMismatchError, match="missing rank"):
+        fleet.load_check_point(exe, path, checkpoint_no=1)
+    # once every shard is gone the checkpoint is just incoherent for
+    # everyone: no complete candidate -> typed error, not silent cold start
+    shutil.rmtree(os.path.join(ckpt1, "rank_0"))
+    ckpt0 = os.path.join(path, "__paddle_checkpoint__0")
+    c0 = json.load(open(os.path.join(ckpt0, "commit.json")))
+    c0["nranks"] = 2
+    json.dump(c0, open(os.path.join(ckpt0, "commit.json"), "w"))
+    with pytest.raises(errors.ResumeMismatchError):
+        fleet.load_check_point(exe, path)
+
+
+def test_rank_with_no_shard_anywhere_cold_starts(tmp_path, fresh_programs):
+    """Startup race: the first worker published a per-rank checkpoint
+    before this rank attached its first shard. The rank has no state in
+    ANY checkpoint — that is a cold start, not a resume error."""
+    exe, _ = _build_model()
+    path = str(tmp_path / "ckpts")
+    _fleet(0, 2).save_check_point(exe, path, fc.TrainStatus(0, global_step=5),
+                                  per_rank=True)
+    c0 = observability.snapshot()["counters"].get(
+        "resilience.resume_cold_starts", 0
+    )
+    status = _fleet(1, 2).load_check_point(exe, path)
+    assert status == fc.TrainStatus(-1) and status.global_step == 0
+    c1 = observability.snapshot()["counters"].get(
+        "resilience.resume_cold_starts", 0
+    )
+    assert c1 - c0 == 1
+    # but a rank that HAS a shard somewhere still refuses incoherence:
+    # rank 0's shard exists in the (incomplete) checkpoint, so rank 0
+    # must not silently cold-start over its own history
+    with pytest.raises(errors.ResumeMismatchError):
+        _fleet(0, 2).load_check_point(exe, path)
+
+
+def test_second_rank_attaches_shard_and_loads_its_own_cursor(
+    tmp_path, fresh_programs
+):
+    exe, _ = _build_model()
+    path = str(tmp_path / "ckpts")
+    st0 = fc.TrainStatus(0, global_step=5,
+                         cursor={"epoch": 0, "batches_consumed": 5})
+    st1 = fc.TrainStatus(0, global_step=5,
+                         cursor={"epoch": 0, "batches_consumed": 7})
+    rank0, rank1 = _fleet(0, 2), _fleet(1, 2)
+    assert rank0.save_check_point(exe, path, st0, per_rank=True) == 0
+    # rank 1 finds the matching publish and attaches its shard
+    assert rank1.save_check_point(
+        exe, path, st1, per_rank=True, shard_wait_timeout=5
+    ) == 0
+    ckpt = os.path.join(path, "__paddle_checkpoint__0")
+    assert os.path.isdir(os.path.join(ckpt, "rank_1"))
+    # each rank resumes with ITS cursor
+    back0 = rank0.load_check_point(exe, path)
+    back1 = rank1.load_check_point(exe, path)
+    assert back0.cursor["batches_consumed"] == 5
+    assert back1.cursor["batches_consumed"] == 7
+
+
+def test_second_rank_times_out_without_matching_publish(
+    tmp_path, fresh_programs
+):
+    exe, _ = _build_model()
+    path = str(tmp_path / "ckpts")
+    _fleet(0, 2).save_check_point(
+        exe, path, fc.TrainStatus(0, global_step=5), per_rank=True
+    )
+    with pytest.raises(errors.ExecutionTimeoutError, match="step=42"):
+        _fleet(1, 2).save_check_point(
+            exe, path, fc.TrainStatus(0, global_step=42),
+            per_rank=True, shard_wait_timeout=0.3,
+        )
+
+
+def test_non_first_worker_save_is_noop_without_per_rank(
+    tmp_path, fresh_programs
+):
+    """The classic contract: without per_rank (or local_vars) a non-first
+    worker's save returns None IMMEDIATELY — no blocking wait — and the
+    first worker's commit promises only its own shard, so the checkpoint
+    is complete for loaders."""
+    exe, _ = _build_model()
+    path = str(tmp_path / "ckpts")
+    assert _fleet(1, 2).save_check_point(
+        exe, path, fc.TrainStatus(0)
+    ) is None
+    assert not os.path.exists(path)  # it wrote nothing, waited for nothing
+    _fleet(0, 2).save_check_point(exe, path, fc.TrainStatus(0))
+    ckpt = os.path.join(path, "__paddle_checkpoint__0")
+    assert json.load(open(os.path.join(ckpt, "commit.json")))["nranks"] == 1
+    # complete as promised: a non-first rank load works (replicated status)
+    assert _fleet(1, 2).load_check_point(exe, path).next() == 1
+
+
+def test_corrupt_commit_record_falls_back_not_bricks(
+    tmp_path, fresh_programs
+):
+    exe, _ = _build_model()
+    fleet = _fleet()
+    path = str(tmp_path / "ckpts")
+    fleet.save_check_point(exe, path, fc.TrainStatus(0, global_step=5))
+    fleet.save_check_point(exe, path, fc.TrainStatus(1, global_step=10))
+    with open(os.path.join(path, "__paddle_checkpoint__1",
+                           "commit.json"), "w") as f:
+        f.write("{torn")  # bit-rot / torn write on the newest commit
+    status = fleet.load_check_point(exe, path)
+    assert status.global_step == 5  # fell back instead of raising
+    # an explicit request for the garbled one DOES surface the corruption
+    with pytest.raises(errors.CheckpointCorruptionError, match="commit"):
+        fleet.load_check_point(exe, path, checkpoint_no=1)
+
+
+def test_per_rank_rotation_spares_newest_complete_checkpoint(
+    tmp_path, fresh_programs
+):
+    """per_rank publishes are complete only after every peer attaches its
+    shard; rotation must not delete the last COMPLETE checkpoint while the
+    survivors are still waiting for peers."""
+    exe, _ = _build_model()
+    path = str(tmp_path / "ckpts")
+    rank0, rank1 = _fleet(0, 2), _fleet(1, 2)
+    st = fc.TrainStatus(0, global_step=5)
+    rank0.save_check_point(exe, path, st, per_rank=True,
+                           max_checkpoint_num=1)
+    rank1.save_check_point(exe, path, st, per_rank=True,
+                           shard_wait_timeout=5)
+    # checkpoint 0 is now complete; rank 0 publishes 1 and 2 but the peer
+    # never attaches (it died): with max_checkpoint_num=1 naive rotation
+    # would delete 0 (and then 1), leaving only incomplete checkpoints
+    rank0.save_check_point(exe, path, fc.TrainStatus(1, global_step=10),
+                           per_rank=True, max_checkpoint_num=1)
+    rank0.save_check_point(exe, path, fc.TrainStatus(2, global_step=15),
+                           per_rank=True, max_checkpoint_num=1)
+    dirs = sorted(os.listdir(path))
+    assert "__paddle_checkpoint__0" in dirs, dirs  # the complete one lives
+    status = rank1.load_check_point(exe, path)
+    assert status.global_step == 5  # and it is what a resume lands on
+
+
+def test_batch_size_mismatch_refused():
+    ds = _Idx(48)
+    src = DistributedBatchSampler(ds, 4, nranks=2, rank=0)
+    state = src.state_dict()
+    with pytest.raises(errors.ResumeMismatchError, match="batch_size"):
+        DistributedBatchSampler(ds, 8, nranks=2, rank=0).load_state_dict(
+            state
+        )
+    bs = BatchSampler(dataset=ds, batch_size=4)
+    with pytest.raises(errors.ResumeMismatchError, match="batch_size"):
+        BatchSampler(dataset=ds, batch_size=6).load_state_dict(
+            bs.state_dict()
+        )
+
+
+def test_dataset_size_change_refused():
+    """A grown/shrunk dataset reshuffles into a different permutation —
+    the consumed prefix no longer matches, so fast-skip must refuse."""
+    state = DistributedBatchSampler(_Idx(48), 4, nranks=2, rank=0,
+                                    shuffle=True).state_dict()
+    grown = DistributedBatchSampler(_Idx(60), 4, nranks=2, rank=0,
+                                    shuffle=True)
+    with pytest.raises(errors.ResumeMismatchError, match="48 samples"):
+        grown.load_state_dict(state)
+    state = BatchSampler(dataset=_Idx(20), shuffle=True,
+                         batch_size=4).state_dict()
+    with pytest.raises(errors.ResumeMismatchError, match="20 samples"):
+        BatchSampler(dataset=_Idx(24), shuffle=True,
+                     batch_size=4).load_state_dict(state)
+
+
+# -- rotate-after-verify + corrupt-target loads ------------------------------
+def test_publish_verify_failure_keeps_old_checkpoints(
+    tmp_path, fresh_programs
+):
+    from paddle_tpu.fleet.fs_wrapper import LocalFS
+
+    exe, _ = _build_model()
+    fleet = _fleet()
+    path = str(tmp_path / "ckpts")
+    for epoch in range(3):
+        fleet.save_check_point(exe, path, fc.TrainStatus(epoch),
+                               max_checkpoint_num=2)
+    kept = sorted(os.listdir(path))
+    assert kept == ["__paddle_checkpoint__1", "__paddle_checkpoint__2"]
+
+    class TearOnPublish(LocalFS):
+        def mv(self, src, dst):
+            super().mv(src, dst)
+            if dst.endswith("__paddle_checkpoint__3"):
+                # the publish "succeeds" but the landed payload is torn
+                npz = os.path.join(dst, "__params__.npz")
+                blob = open(npz, "rb").read()
+                open(npz, "wb").write(blob[: len(blob) // 2])
+
+    with pytest.raises(errors.CheckpointCorruptionError):
+        fleet.save_check_point(
+            exe, path, fc.TrainStatus(3), fs=TearOnPublish(),
+            max_checkpoint_num=2,
+        )
+    # the bad publish must NOT have rotated the older checkpoints away
+    assert "__paddle_checkpoint__1" in os.listdir(path)
+    assert "__paddle_checkpoint__2" in os.listdir(path)
+    status = fleet.load_check_point(exe, path)  # falls back past the torn one
+    assert status.next() == 3
+    c = observability.snapshot()["counters"]
+    assert c.get("resilience.checkpoint_publish_verify_failures", 0) >= 1
+
+
+def test_corrupt_explicit_checkpoint_no_fallback_counter_exactly_once(
+    tmp_path, fresh_programs
+):
+    exe, _ = _build_model()
+    fleet = _fleet()
+    path = str(tmp_path / "ckpts")
+    for epoch in range(2):
+        fleet.save_check_point(exe, path, fc.TrainStatus(epoch))
+    npz = os.path.join(path, "__paddle_checkpoint__1", "__params__.npz")
+    blob = open(npz, "rb").read()
+    open(npz, "wb").write(blob[: len(blob) // 2])
+    c0 = observability.snapshot()["counters"].get(
+        "resilience.checkpoint_corrupt", 0
+    )
+    with pytest.raises(errors.CheckpointCorruptionError):
+        fleet.load_check_point(exe, path, checkpoint_no=1)
+    c1 = observability.snapshot()["counters"].get(
+        "resilience.checkpoint_corrupt", 0
+    )
+    assert c1 - c0 == 1  # exactly once: no fallback was attempted
+    # checkpoint 0 is untouched and still loads when asked for
+    assert fleet.load_check_point(exe, path, checkpoint_no=0).next() == 1
+
+
+# -- fs.mkdir / fs.list_dirs fault seams -------------------------------------
+@pytest.mark.parametrize("site", ["fs.mkdir", "fs.list_dirs"])
+def test_save_heals_transient_prepare_faults(site, tmp_path, fresh_programs):
+    exe, _ = _build_model()
+    fleet = _fleet()
+    faults.inject(site, "io", prob=1.0, max_fires=1)
+    c0 = observability.snapshot()["counters"].get(
+        "resilience.retries.checkpoint.prepare", 0
+    )
+    path = str(tmp_path / "ckpts")
+    assert fleet.save_check_point(exe, path, fc.TrainStatus(0)) == 0
+    c1 = observability.snapshot()["counters"].get(
+        "resilience.retries.checkpoint.prepare", 0
+    )
+    assert c1 - c0 >= 1
+    assert fleet.load_check_point(exe, path).next() == 1
+
+
+# -- v1 compatibility --------------------------------------------------------
+def test_v1_epoch_only_checkpoint_still_loads(tmp_path, fresh_programs):
+    exe, _ = _build_model()
+    fleet = _fleet()
+    path = str(tmp_path / "ckpts")
+    ckpt = os.path.join(path, "__paddle_checkpoint__0")
+    fluid.io.save_persistables(exe, ckpt)
+    with open(os.path.join(ckpt, "train_status.json"), "w") as f:
+        json.dump({"epoch_no": 2}, f)  # the PR-2/3 on-disk format
+    status = fleet.load_check_point(exe, path)
+    assert status.next() == 3
+    assert status.global_step == 0 and not status.cursor and not status.rng
+
+
+# -- the full kill/resume equivalence audit (slow) ---------------------------
+@pytest.mark.slow
+def test_resume_audit_end_to_end(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "resume_audit.py"),
+         "--out", str(tmp_path / "audit")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "resume audit OK" in proc.stdout
