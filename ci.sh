@@ -9,6 +9,17 @@ cd "$(dirname "$0")"
 echo "== pytest (8 virtual CPU devices via tests/conftest.py) =="
 python -m pytest tests/ -q
 
+echo "== program lint (static verifier over every bundled model) =="
+# every bundled model must build and verify with ZERO error findings
+# (strict also escalates silent-redefinition warnings)
+python tools/program_lint.py --all-models --strict
+# ...and the linter itself must still catch a seeded broken program
+# (use-before-def + shape desync + rank-divergent collective => exit 1)
+if python tools/program_lint.py --broken-fixture > /dev/null 2>&1; then
+    echo "program_lint failed to reject the seeded broken fixture" >&2
+    exit 1
+fi
+
 echo "== bench smoke =="
 python bench.py
 
@@ -28,7 +39,7 @@ exe.run(main, feed={"x": np.ones((4, 4), "float32")}, fetch_list=[y])
 observability.dump("/tmp/paddle_tpu_obs_snapshot.json")
 EOF
 python tools/stats_report.py /tmp/paddle_tpu_obs_snapshot.json \
-    --require executor.
+    --require executor. --require analysis.
 
 echo "== resilience chaos smoke (injected IO + dataloader faults) =="
 PADDLE_TPU_FAULT_INJECT="io.save:io:1.0:0:1,dataloader.fetch:io:1.0:0:2" \

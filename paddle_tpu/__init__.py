@@ -48,6 +48,7 @@ from .param_attr import ParamAttr  # noqa: F401
 from . import dataloader  # noqa: F401
 from . import profiler  # noqa: F401
 from . import observability  # noqa: F401  (metrics/histograms/spans/exporters)
+from . import analysis  # noqa: F401  (pre-compile static verifier + collective lint)
 from . import resilience  # noqa: F401  (retry/backoff, fault injection)
 from . import monitor  # noqa: F401  (back-compat facade over observability)
 from . import debugger  # noqa: F401  (draw_block_graphviz)

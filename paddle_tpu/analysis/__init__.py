@@ -1,0 +1,58 @@
+"""Static program analysis: pre-compile verification + cross-rank
+collective lint over the Program IR.
+
+Every Program otherwise goes straight from graph construction into one
+``jax.jit`` trace (framework/executor.py:_compile), where a malformed
+graph surfaces as an opaque XLA error with no op attribution — or, for a
+mismatched collective, as a silent multi-rank hang. Pass-based IR
+verification is standard in tensor compilers (TVM, arXiv:1802.04799), and
+whole-block fusion (arXiv:2301.13062) makes *pre-trace* the only point
+where per-op source provenance still exists. This package runs three
+analysis families and returns structured :class:`Finding`\\ s:
+
+* structural  — use-before-def vs feeds/persistables/scope, undeclared
+  reads/writes, silent name redefinition, unknown op types, dead ops and
+  unreachable variables (structural.py);
+* shape/dtype — per-op replay of ``registry.infer_shapes`` cross-checked
+  against every declared Variable, with -1/BATCH_SENTINEL handling
+  (shapes.py);
+* collective schedule — per-rank simulation of the op streams the
+  SPMD/pipeline transpilers produce; order/kind/axis must agree across
+  ranks and every axis must exist in the Program's mesh (collectives.py).
+
+Wired into ``Executor._compile`` behind ``PADDLE_TPU_VERIFY``
+(``strict`` | ``warn`` (default) | ``0``); ``tools/program_lint.py``
+lints every bundled model from the command line. README §Static analysis
+documents categories and severity semantics.
+"""
+
+from __future__ import annotations
+
+from .findings import (  # noqa: F401
+    COLLECTIVE_BRANCH_DIVERGENCE,
+    COLLECTIVE_DIVERGENCE,
+    DEAD_OP,
+    DTYPE_DESYNC,
+    MISSING_FEED,
+    REDEFINITION,
+    SHAPE_DESYNC,
+    STRICT_ESCALATIONS,
+    UNDECLARED_VAR,
+    UNDECLARED_WRITE,
+    UNKNOWN_MESH_AXIS,
+    UNKNOWN_OP,
+    UNREACHABLE_VAR,
+    USE_BEFORE_DEF,
+    Finding,
+    Report,
+    Severity,
+)
+from .collectives import analyze_collectives, collective_axis  # noqa: F401
+from .shapes import analyze_shapes  # noqa: F401
+from .structural import analyze_structural  # noqa: F401
+from .verify import (  # noqa: F401
+    check_before_compile,
+    set_verify_mode,
+    verify_mode,
+    verify_program,
+)

@@ -1,0 +1,143 @@
+"""Structured findings produced by the pre-compile program verifier.
+
+A Finding is one defect (or observation) anchored to an op: severity,
+a stable category slug (tests and CI grep these), the offending names,
+and the user source frame the Operator captured at build time
+(program.py:_user_frame / the ``__loc__`` attr) — so a build-time lint
+names the Python line that created the bad op, which no post-trace XLA
+error can do (the whole-block jit erases op boundaries).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class Severity(enum.IntEnum):
+    """INFO: stylistic/dead code — never fails a build.
+    WARNING: suspicious but runnable (silent redefinition, a collective
+    over an axis the mesh does not bind, ...).
+    ERROR: the program will fail to trace, compute garbage, or deadlock
+    a multi-rank run; strict mode refuses to compile it."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+
+# categories (stable slugs; tests/test_program_analysis.py keys on these)
+USE_BEFORE_DEF = "use-before-def"
+UNDECLARED_VAR = "undeclared-var"
+UNDECLARED_WRITE = "undeclared-write"
+REDEFINITION = "redefinition"
+UNKNOWN_OP = "unknown-op"
+DEAD_OP = "dead-op"
+UNREACHABLE_VAR = "unreachable-var"
+SHAPE_DESYNC = "shape-desync"
+DTYPE_DESYNC = "dtype-desync"
+COLLECTIVE_DIVERGENCE = "collective-divergence"
+COLLECTIVE_BRANCH_DIVERGENCE = "collective-branch-divergence"
+UNKNOWN_MESH_AXIS = "unknown-mesh-axis"
+MISSING_FEED = "missing-feed"
+
+# WARNING findings in these categories count as errors under strict
+# verify (the redefinition satellite: "warn; error under strict")
+STRICT_ESCALATIONS = frozenset({REDEFINITION})
+
+
+@dataclass
+class Finding:
+    severity: Severity
+    category: str
+    message: str
+    block_idx: int = 0
+    op_index: int | None = None
+    op_type: str | None = None
+    names: tuple = ()
+    loc: str | None = None  # user source frame that created the op/var
+
+    def format(self) -> str:
+        where = []
+        if self.op_index is not None:
+            where.append(f"op #{self.op_index}")
+        if self.op_type:
+            where.append(f"{self.op_type!r}")
+        if self.block_idx:
+            where.append(f"block {self.block_idx}")
+        if self.loc:
+            where.append(f"created at {self.loc}")
+        suffix = f"  [{', '.join(where)}]" if where else ""
+        return f"{self.severity.name}[{self.category}] {self.message}{suffix}"
+
+
+def finding_for_op(severity, category, message, op=None, op_index=None,
+                   block_idx=0, names=()):
+    """Build a Finding anchored to an Operator, pulling its ``__loc__``."""
+    return Finding(
+        severity=severity,
+        category=category,
+        message=message,
+        block_idx=block_idx,
+        op_index=op_index,
+        op_type=getattr(op, "type", None),
+        names=tuple(names),
+        loc=op.attr("__loc__") if hasattr(op, "attr") else None,
+    )
+
+
+@dataclass
+class Report:
+    """The verifier's output: an ordered finding list plus helpers."""
+
+    findings: list = field(default_factory=list)
+
+    def add(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+    def extend(self, findings) -> None:
+        self.findings.extend(findings)
+
+    @property
+    def errors(self):
+        return [f for f in self.findings if f.severity == Severity.ERROR]
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == Severity.WARNING]
+
+    @property
+    def infos(self):
+        return [f for f in self.findings if f.severity == Severity.INFO]
+
+    def by_category(self, category):
+        return [f for f in self.findings if f.category == category]
+
+    def strict_errors(self):
+        """Errors under strict mode: ERROR findings plus WARNING findings
+        in the escalated categories (silent redefinition)."""
+        return [
+            f for f in self.findings
+            if f.severity == Severity.ERROR
+            or (f.severity == Severity.WARNING
+                and f.category in STRICT_ESCALATIONS)
+        ]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def render(self, min_severity=Severity.INFO) -> str:
+        picked = [
+            f for f in sorted(
+                self.findings, key=lambda f: -int(f.severity)
+            )
+            if f.severity >= min_severity
+        ]
+        if not picked:
+            return "program verifier: clean bill (no findings)"
+        head = (
+            f"program verifier: {len(self.errors)} error(s), "
+            f"{len(self.warnings)} warning(s), {len(self.infos)} info"
+        )
+        return "\n".join([head] + ["  " + f.format() for f in picked])

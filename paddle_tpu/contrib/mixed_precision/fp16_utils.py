@@ -154,10 +154,32 @@ def _rewrite_block(program, block, amp_lists, dest_dtype):
             inserted += _insert_cast(block, i, op, name, target, force)
         if target == dest_dtype:
             # declared output dtypes follow the compute dtype so later
-            # white-op cast checks see the truth
-            for n in op.output_names():
-                v = block._find_var_recursive(n)
-                if v is not None and is_float(v.dtype):
-                    v.dtype = dest_dtype
+            # white-op cast checks see the truth. Replay the emitter's
+            # abstract eval instead of blindly stamping dest_dtype:
+            # fp32-accumulating emitters (softmax_with_cross_entropy
+            # reduces in fp32 from bf16 logits) keep fp32 outputs, and
+            # stamping them bf16 desyncs declaration from emitter — the
+            # drift the static verifier (paddle_tpu/analysis) flags.
+            specs = None
+            try:
+                from ...framework.registry import infer_shapes
+
+                specs = infer_shapes(op.type, block, op.inputs, op.attrs)
+            except Exception:
+                pass  # fall back to the compute dtype
+            for slot, names in op.outputs.items():
+                slot_specs = (specs or {}).get(slot, [])
+                for j, n in enumerate(names):
+                    v = block._find_var_recursive(n)
+                    if v is None or not is_float(v.dtype):
+                        continue
+                    inferred = (
+                        slot_specs[j][1] if j < len(slot_specs) else None
+                    )
+                    v.dtype = (
+                        inferred
+                        if inferred is not None and is_float(inferred)
+                        else dest_dtype
+                    )
         i += 1 + inserted
     return block

@@ -166,6 +166,32 @@ class ResumeMismatchError(PreconditionNotMetError):
     retryable = False
 
 
+class ProgramVerifyError(PreconditionNotMetError):
+    """The pre-compile static verifier (paddle_tpu/analysis) found ERROR
+    findings under ``PADDLE_TPU_VERIFY=strict``: the Program is structurally
+    malformed (use-before-def, shape/dtype desync vs the emitters, a
+    rank-divergent collective schedule, ...). Raised at
+    ``Executor._compile`` time BEFORE any XLA trace, so the message carries
+    per-op provenance instead of an opaque trace error — and a mismatched
+    collective fails here instead of deadlocking the pod. ``findings``
+    holds the full, structured ``analysis.Finding`` list (errors first).
+    Non-retryable: the graph itself must be fixed."""
+
+    code = ErrorCode.PRECONDITION_NOT_MET
+    retryable = False
+
+    def __init__(self, message, findings=None, op=None, loc=None):
+        self.findings = list(findings or [])
+        super().__init__(message, op=op, loc=loc)
+
+
+class ProgramVerifyWarning(UserWarning):
+    """Category for warnings emitted by the static program verifier in its
+    default ``PADDLE_TPU_VERIFY=warn`` mode (and by ``Block.create_var``
+    when a name is silently redefined). Filter with
+    ``warnings.filterwarnings(..., category=ProgramVerifyWarning)``."""
+
+
 class TrainingDivergedError(EnforceNotMet, RuntimeError):
     """TrainGuard exhausted its recovery policy: K consecutive non-finite
     steps and no (remaining) checkpoint to roll back to. The run cannot

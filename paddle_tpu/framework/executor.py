@@ -462,6 +462,14 @@ class Executor:
     def _compile(self, program, block, feed_names, fetch_names, scope):
         from ..flags import flag
 
+        # pre-trace static verification (PADDLE_TPU_VERIFY=strict|warn|0):
+        # a malformed graph fails HERE with per-op provenance — strict mode
+        # refuses to trace at all, so a rank-divergent collective schedule
+        # can never reach the mesh and deadlock it
+        from ..analysis import check_before_compile
+
+        check_before_compile(program, feed_names, fetch_names)
+
         check_nan = bool(flag("check_nan_inf"))
         state_ro, state_mut, write_back = _analyze_block(
             block, feed_names, fetch_names

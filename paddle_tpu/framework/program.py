@@ -236,10 +236,46 @@ class Block:
         if v is None:
             from ..errors import NotFoundError
 
-            raise NotFoundError(
-                f"variable {name!r} not found in block {self.idx}"
-            )
+            raise NotFoundError(self._not_found_message(name))
         return v
+
+    def _not_found_message(self, name):
+        """Lookup-failure diagnostic: nearest existing names (did-you-mean)
+        plus the block's feed and persistable sets, so a typo'd fetch/feed
+        is obvious without dumping the whole Program."""
+        import difflib
+
+        names, feeds, persist = [], [], []
+        blk = self
+        while blk is not None:
+            for n, v in blk.vars.items():
+                names.append(n)
+                if v.is_data:
+                    feeds.append(n)
+                elif v.persistable:
+                    persist.append(n)
+            blk = blk.parent_block
+        msg = [f"variable {name!r} not found in block {self.idx}"]
+        close = difflib.get_close_matches(name, names, n=3, cutoff=0.6)
+        if close:
+            msg.append(
+                "did you mean " + " / ".join(repr(c) for c in close) + "?"
+            )
+
+        def _fmt(group, cap=8):
+            shown = ", ".join(sorted(group)[:cap])
+            more = len(group) - cap
+            return shown + (f", ... +{more} more" if more > 0 else "")
+
+        msg.append(
+            f"block declares {len(names)} vars"
+            + (f"; feeds: [{_fmt(feeds)}]" if feeds else "; no feed vars")
+            + (
+                f"; persistables: [{_fmt(persist)}]"
+                if persist else "; no persistables"
+            )
+        )
+        return "; ".join(msg)
 
     def has_var(self, name):
         return self._find_var_recursive(name) is not None
@@ -256,15 +292,60 @@ class Block:
         if name is None:
             name = unique_name.generate("tmp")
         v = Variable(self, name, **kw)
+        if name in self.vars:
+            self._note_redefinition(name, v)
         self.vars[name] = v
         self.program._bump()
         return v
 
     def create_parameter(self, name, shape, dtype, **kw):
         p = Parameter(self, name, shape, dtype, **kw)
+        if name in self.vars:
+            self._note_redefinition(name, p)
         self.vars[name] = p
         self.program._bump()
         return p
+
+    def _note_redefinition(self, name, new_v):
+        """create_var/create_parameter used to overwrite an existing entry
+        in self.vars with no signal — orphaning the old Variable while ops
+        keep referencing the name. Record the event for the static
+        verifier (analysis/structural.py reports it; ERROR under strict)
+        and warn immediately when the respec is observable (shape, dtype,
+        persistability, or Parameter-ness changed)."""
+        old = self.vars[name]
+        changes = []
+        if tuple(old.shape or ()) != tuple(new_v.shape or ()):
+            changes.append(f"shape {old.shape} -> {new_v.shape}")
+        if old.dtype != new_v.dtype:
+            changes.append(f"dtype {old.dtype} -> {new_v.dtype}")
+        if bool(old.persistable) != bool(new_v.persistable):
+            changes.append(
+                f"persistable {old.persistable} -> {new_v.persistable}"
+            )
+        if type(old) is not type(new_v):
+            changes.append(
+                f"class {type(old).__name__} -> {type(new_v).__name__}"
+            )
+        detail = ", ".join(changes) if changes else "identical spec"
+        self.__dict__.setdefault("_redefinitions", []).append({
+            "name": name,
+            "spec_changed": bool(changes),
+            "detail": detail,
+            "loc": _user_frame(),
+        })
+        if changes:
+            import warnings
+
+            from ..errors import ProgramVerifyWarning
+
+            warnings.warn(
+                f"variable {name!r} in block {self.idx} silently redefined "
+                f"({detail}); the previous Variable object is orphaned but "
+                "existing ops still reference this name",
+                ProgramVerifyWarning,
+                stacklevel=3,
+            )
 
     def append_op(self, type, inputs=None, outputs=None, attrs=None, index=None):
         op = Operator(self, type, inputs, outputs, attrs)
@@ -404,9 +485,11 @@ class Program:
 
     def __getstate__(self):
         # the Mesh holds live device handles — never serialized; a loaded
-        # Program is re-attached to a mesh by the caller (shard_program)
+        # Program is re-attached to a mesh by the caller (shard_program).
+        # The verifier's per-version report cache is transient state.
         state = self.__dict__.copy()
         state["_mesh"] = None
+        state.pop("_verify_cache", None)
         return state
 
     def __setstate__(self, state):

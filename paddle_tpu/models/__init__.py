@@ -26,6 +26,7 @@ from .gpt import (  # noqa: F401
     gpt_lm_loss,
     gpt_tp_shardings,
 )
+from .zoo import MODEL_BUILDERS, BuiltModel, build_model  # noqa: F401
 from .yolov3 import (  # noqa: F401
     YoloConfig,
     darknet53,

@@ -1,0 +1,208 @@
+"""One-call builders for every bundled model, sized for CI.
+
+Shared by ``tools/program_lint.py`` (build every model, run the static
+verifier over it) and the clean-bill tests in
+``tests/test_program_analysis.py``. Each builder constructs a FRESH
+(main, startup) pair with an optimizer applied — the trained program is
+what the verifier must pass, since backward + optimizer rewrites are where
+declaration/emitter drift historically hides — and returns a
+:class:`BuiltModel` naming the feeds and fetches the dataflow analyses
+key on.
+
+Builders only *build* graphs (no Executor.run), so the zoo stays cheap
+enough for a lint stage: a few seconds per model on CPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BuiltModel:
+    name: str
+    main: object
+    startup: object
+    feed_names: tuple
+    fetch_names: tuple
+    # mesh axes this model is meant to shard over, when linting the
+    # collective schedule: {axis: size}; None = single-chip program
+    mesh_axes: dict | None = None
+    spmd_mode: str = "shard_map"
+    manual_axes: tuple = ()
+    shardings: dict = field(default_factory=dict)
+
+
+def _fresh(seed=7):
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    return main, startup
+
+
+def build_resnet():
+    import paddle_tpu as fluid
+    from .resnet import resnet_train_net
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("image", [4, 3, 32, 32], "float32")
+        label = fluid.data("label", [4, 1], "int64")
+        loss, acc = resnet_train_net(img, label, depth=18, class_num=10)
+        fluid.optimizer.SGD(0.01).minimize(loss, startup)
+    return BuiltModel(
+        "resnet", main, startup, ("image", "label"),
+        (loss.name, acc.name),
+    )
+
+
+def build_bert():
+    import paddle_tpu as fluid
+    from .bert import BertConfig, bert_pretrain
+
+    cfg = BertConfig.tiny()
+    b, s = 2, 16
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [b, s], "int64")
+        types = fluid.data("types", [b, s], "int64")
+        mask = fluid.data("mask", [b, s], "float32")
+        labels = fluid.data("labels", [b, s], "int64")
+        loss = bert_pretrain(ids, types, mask, labels, cfg)
+        fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+    return BuiltModel(
+        "bert", main, startup, ("ids", "types", "mask", "labels"),
+        (loss.name,),
+    )
+
+
+def build_gpt():
+    import paddle_tpu as fluid
+    from .gpt import GPTConfig, gpt_lm_loss
+
+    cfg = GPTConfig.tiny()
+    b, s = 2, 16
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [b, s], "int64")
+        loss = gpt_lm_loss(ids, cfg)
+        fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+    return BuiltModel("gpt", main, startup, ("ids",), (loss.name,))
+
+
+def build_yolov3():
+    import paddle_tpu as fluid
+    from .yolov3 import YoloConfig, yolov3_train
+
+    cfg = YoloConfig.tiny(class_num=3)
+    n, s, b = 2, 64, 4
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [n, 3, s, s])
+        gt = fluid.data("gt", [n, b, 4])
+        lab = fluid.data("lab", [n, b], "int64")
+        loss = yolov3_train(img, gt, lab, cfg)
+        fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+    return BuiltModel(
+        "yolov3", main, startup, ("img", "gt", "lab"), (loss.name,)
+    )
+
+
+def build_deepfm():
+    import paddle_tpu as fluid
+    from .deepfm import DeepFMConfig, deepfm
+
+    cfg = DeepFMConfig(
+        vocab_size=512, num_fields=6, embed_dim=8, mlp_sizes=(16,)
+    )
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("feat_ids", [8, cfg.num_fields], "int64")
+        label = fluid.data("label", [8, 1], "float32")
+        loss, predict = deepfm(ids, label, cfg)
+        fluid.optimizer.Adam(1e-2).minimize(loss, startup)
+    return BuiltModel(
+        "deepfm", main, startup, ("feat_ids", "label"),
+        (loss.name, predict.name),
+    )
+
+
+def build_mask_rcnn():
+    import paddle_tpu as fluid
+    from . import mask_rcnn
+
+    cfg = mask_rcnn.MaskRCNNConfig.tiny()
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        image = fluid.data("image", [1, 3, 64, 64])
+        gt_boxes = fluid.data("gt_boxes", [2, 4])
+        gt_classes = fluid.data("gt_classes", [2], dtype="int32")
+        is_crowd = fluid.data("is_crowd", [2], dtype="int32")
+        gt_segms = fluid.data("gt_segms", [2, 64, 64])
+        im_info = fluid.data("im_info", [1, 3])
+        losses = mask_rcnn.mask_rcnn_train(
+            image, gt_boxes, gt_classes, is_crowd, gt_segms, im_info, cfg
+        )
+        fluid.optimizer.SGD(0.01).minimize(losses[0])
+    return BuiltModel(
+        "mask_rcnn", main, startup,
+        ("image", "gt_boxes", "gt_classes", "is_crowd", "gt_segms",
+         "im_info"),
+        tuple(v.name for v in losses),
+    )
+
+
+def build_bert_3d():
+    from .bert import BertConfig
+    from .bert_3d import bert_3d_shardings, build_bert_3d
+
+    cfg = BertConfig.tiny()
+    num_stages = 2
+    main, startup, loss = build_bert_3d(
+        cfg, batch=4, seq_len=16, num_stages=num_stages, microbatches=2,
+        dp=2, pipeline_mode="uniform",
+    )
+    return BuiltModel(
+        "bert_3d", main, startup, ("ids", "types", "mask", "labels"),
+        (loss.name,),
+        mesh_axes={"dp": 2, "mp": 2, "pp": num_stages},
+        spmd_mode="hybrid",
+        manual_axes=("dp", "pp"),
+        shardings=bert_3d_shardings(cfg, num_stages),
+    )
+
+
+MODEL_BUILDERS = {
+    "resnet": build_resnet,
+    "bert": build_bert,
+    "gpt": build_gpt,
+    "yolov3": build_yolov3,
+    "deepfm": build_deepfm,
+    "mask_rcnn": build_mask_rcnn,
+    "bert_3d": build_bert_3d,
+}
+
+
+def build_model(name, with_mesh=True):
+    """Build one bundled model; attach its mesh (when it declares axes and
+    enough devices exist) so the collective-schedule lint has bound axes
+    to check. Returns the BuiltModel with ``main._mesh`` set or not."""
+    bm = MODEL_BUILDERS[name]()
+    if with_mesh and bm.mesh_axes:
+        import numpy as np
+
+        import jax
+
+        need = int(np.prod(list(bm.mesh_axes.values())))
+        if len(jax.devices()) >= need:
+            from ..parallel import make_mesh, shard_program
+
+            mesh = make_mesh(
+                dict(bm.mesh_axes), jax.devices()[:need]
+            )
+            shard_program(
+                bm.main, mesh, bm.shardings or None, mode=bm.spmd_mode,
+                manual_axes=bm.manual_axes or None,
+            )
+    return bm

@@ -34,6 +34,8 @@ def _feed(rng, size=64, n_gt=2):
             "is_crowd": is_crowd, "gt_segms": segms, "im_info": im_info}
 
 
+@pytest.mark.slow  # ~58s on the CI CPU: the single heaviest tier-1 test;
+# ci.sh's unfiltered pytest still runs it (tier-1 runs -m 'not slow')
 def test_mask_rcnn_train_step_converges(fresh):
     cfg = mask_rcnn.MaskRCNNConfig.tiny()
     image = fluid.data("image", [1, 3, 64, 64])
@@ -64,6 +66,7 @@ def test_mask_rcnn_train_step_converges(fresh):
     assert np.mean(totals[-3:]) < totals[0], totals
 
 
+@pytest.mark.slow  # ~25s on the CI CPU; ci.sh's unfiltered pytest runs it
 def test_mask_rcnn_infer_shapes(fresh):
     cfg = mask_rcnn.MaskRCNNConfig.tiny()
     image = fluid.data("image", [1, 3, 64, 64])

@@ -1,0 +1,509 @@
+"""Static program verifier (paddle_tpu/analysis): one deliberately broken
+Program per finding category, the executor wiring (strict / warn / off),
+the did-you-mean lookup diagnostics, and a clean bill over every bundled
+model."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.analysis import (
+    COLLECTIVE_BRANCH_DIVERGENCE,
+    COLLECTIVE_DIVERGENCE,
+    DEAD_OP,
+    DTYPE_DESYNC,
+    MISSING_FEED,
+    REDEFINITION,
+    SHAPE_DESYNC,
+    UNDECLARED_WRITE,
+    UNKNOWN_MESH_AXIS,
+    UNKNOWN_OP,
+    UNREACHABLE_VAR,
+    USE_BEFORE_DEF,
+    Severity,
+    set_verify_mode,
+    verify_mode,
+    verify_program,
+)
+from paddle_tpu.errors import (
+    NotFoundError,
+    ProgramVerifyError,
+    ProgramVerifyWarning,
+)
+from paddle_tpu.framework import unique_name
+from paddle_tpu.parallel import make_mesh, shard_program
+from paddle_tpu.parallel.pipeline import slice_program_into_stages
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+    set_verify_mode(None)  # never leak a mode override across tests
+
+
+def _cats(report):
+    return {f.category for f in report.findings}
+
+
+# ---------------------------------------------------------------------------
+# one broken program per category
+# ---------------------------------------------------------------------------
+
+
+def test_use_before_def_detected(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    blk.create_var(name="ghost", shape=[4, 4], dtype="float32")
+    blk.create_var(name="out", shape=[4, 4], dtype="float32")
+    blk.append_op("relu", {"X": ["ghost"]}, {"Out": ["out"]})
+    rep = verify_program(main, ("x",), ("out",))
+    (f,) = rep.by_category(USE_BEFORE_DEF)
+    assert f.severity == Severity.ERROR
+    assert "ghost" in f.names and f.op_type == "relu"
+    assert not rep.ok
+
+
+def test_use_before_def_names_late_producer(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    blk.create_var(name="late", shape=[4, 4], dtype="float32")
+    blk.create_var(name="out", shape=[4, 4], dtype="float32")
+    blk.append_op("relu", {"X": ["late"]}, {"Out": ["out"]})
+    blk.append_op("relu", {"X": ["x"]}, {"Out": ["late"]})
+    rep = verify_program(main, ("x",), ("out",))
+    (f,) = rep.by_category(USE_BEFORE_DEF)
+    assert "produced later" in f.message
+
+
+def test_feeds_and_persistables_are_not_use_before_def(fresh):
+    main, startup, _ = fresh
+    x = fluid.data("x", [4, 4])
+    y = layers.fc(x, 3)
+    loss = layers.mean(y)
+    fluid.optimizer.SGD(0.1).minimize(loss, startup)
+    rep = verify_program(main, ("x",), (loss.name,))
+    assert not rep.by_category(USE_BEFORE_DEF)
+    assert rep.ok
+
+
+def test_shadowing_redefinition_detected(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    blk.create_var(name="v", shape=[2, 2], dtype="float32")
+    with pytest.warns(ProgramVerifyWarning, match="silently redefined"):
+        blk.create_var(name="v", shape=[3, 3], dtype="float32")
+    rep = verify_program(main)
+    (f,) = rep.by_category(REDEFINITION)
+    assert f.severity == Severity.WARNING
+    assert "shape" in f.message
+    # escalated under strict: counts as an error there, not in warn mode
+    assert f in rep.strict_errors() and f not in rep.errors
+
+
+def test_same_spec_redefinition_is_info_and_silent(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    blk.create_var(name="v", shape=[2, 2], dtype="float32")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", ProgramVerifyWarning)
+        blk.create_var(name="v", shape=[2, 2], dtype="float32")  # no warn
+    rep = verify_program(main)
+    (f,) = rep.by_category(REDEFINITION)
+    assert f.severity == Severity.INFO
+    assert not rep.strict_errors()
+
+
+def test_parameter_redefined_as_var_warns(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    blk.create_parameter("w", [2, 2], "float32")
+    with pytest.warns(ProgramVerifyWarning, match="class Parameter"):
+        blk.create_var(name="w", shape=[2, 2], dtype="float32",
+                       persistable=True)
+
+
+def test_shape_desync_detected(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    blk.create_var(name="out", shape=[9, 9], dtype="float32")
+    blk.append_op("relu", {"X": ["x"]}, {"Out": ["out"]})
+    rep = verify_program(main, ("x",), ("out",))
+    (f,) = rep.by_category(SHAPE_DESYNC)
+    assert f.severity == Severity.ERROR
+    assert "(9, 9)" in f.message and "(4, 4)" in f.message
+
+
+def test_batch_dim_is_shape_wildcard(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [-1, 4])
+    blk.create_var(name="out", shape=[-1, 4], dtype="float32")
+    blk.append_op("relu", {"X": ["x"]}, {"Out": ["out"]})
+    rep = verify_program(main, ("x",), ("out",))
+    assert not rep.by_category(SHAPE_DESYNC)
+
+
+def test_dtype_desync_detected(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    blk.create_var(name="out", shape=[4, 4], dtype="int64")
+    blk.append_op("relu", {"X": ["x"]}, {"Out": ["out"]})
+    rep = verify_program(main, ("x",), ("out",))
+    (f,) = rep.by_category(DTYPE_DESYNC)
+    assert f.severity == Severity.ERROR
+    assert "int64" in f.message and "float32" in f.message
+
+
+def test_dead_op_detected(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    x = fluid.data("x", [4, 4])
+    live = layers.scale(x, scale=2.0)
+    dead = layers.scale(x, scale=3.0)  # never fetched, feeds nothing
+    rep = verify_program(main, ("x",), (live.name,))
+    dead_findings = rep.by_category(DEAD_OP)
+    assert len(dead_findings) == 1
+    assert dead.name in dead_findings[0].names
+    assert dead_findings[0].severity == Severity.INFO
+    assert rep.ok  # INFO never fails a build
+
+
+def test_unreachable_var_detected(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    blk.create_var(name="orphan", shape=[2], dtype="float32")
+    rep = verify_program(main)
+    (f,) = rep.by_category(UNREACHABLE_VAR)
+    assert "orphan" in f.names
+
+
+def test_unknown_op_detected(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    blk.create_var(name="out", shape=[4, 4], dtype="float32")
+    blk.append_op("definitely_not_an_op", {"X": ["x"]}, {"Out": ["out"]})
+    rep = verify_program(main, ("x",), ("out",))
+    (f,) = rep.by_category(UNKNOWN_OP)
+    assert f.severity == Severity.ERROR
+    assert f.op_type == "definitely_not_an_op"
+
+
+def test_undeclared_write_detected(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    blk.append_op("relu", {"X": ["x"]}, {"Out": ["nowhere_declared"]})
+    rep = verify_program(main, ("x",), ())
+    (f,) = rep.by_category(UNDECLARED_WRITE)
+    assert "nowhere_declared" in f.names
+
+
+def test_missing_feed_detected(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    fluid.data("y", [4, 4])
+    blk.create_var(name="out", shape=[4, 4], dtype="float32")
+    blk.append_op("elementwise_add", {"X": ["x"], "Y": ["y"]},
+                  {"Out": ["out"]})
+    rep = verify_program(main, ("x",), ("out",))  # y not fed
+    (f,) = rep.by_category(MISSING_FEED)
+    assert "y" in f.names and f.severity == Severity.ERROR
+
+
+# ---------------------------------------------------------------------------
+# collective schedule
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_program(poison_stage=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [8, 4])
+        with fluid.device_guard("pipeline:0"):
+            h = layers.fc(x, 4)
+        with fluid.device_guard("pipeline:1"):
+            loss = layers.mean(layers.fc(h, 4))
+        main._pipeline = {"num_microbatches": 2, "axis_name": "pp"}
+        _, pipe_op = slice_program_into_stages(main, loss)
+    if poison_stage is not None:
+        stage = main.blocks[pipe_op.attr("stage_blocks")[poison_stage]]
+        stage.append_op(
+            "c_allreduce_sum", {"X": [h.name]}, {"Out": [h.name]},
+            {"axis_name": "dp"},
+        )
+    mesh = make_mesh({"dp": 4, "pp": 2})
+    shard_program(main, mesh, {"x": ("dp",)})
+    return main, loss
+
+
+def test_mismatched_collective_order_detected(fresh):
+    main, loss = _pipeline_program(poison_stage=0)
+    rep = verify_program(main, ("x",), (loss.name,))
+    (f,) = rep.by_category(COLLECTIVE_DIVERGENCE)
+    assert f.severity == Severity.ERROR
+    # the finding names the op, the axis, and the user source line
+    assert f.op_type == "c_allreduce_sum"
+    assert "dp" in f.names
+    assert f.loc and "test_program_analysis.py" in f.loc
+
+
+def test_uniform_collective_schedule_is_clean(fresh):
+    main, loss = _pipeline_program(poison_stage=None)
+    rep = verify_program(main, ("x",), (loss.name,))
+    assert not rep.by_category(COLLECTIVE_DIVERGENCE)
+    assert rep.ok
+
+
+def test_unknown_mesh_axis_detected(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    x = fluid.data("x", [8, 4])
+    blk.create_var(name="red", shape=[8, 4], dtype="float32")
+    blk.append_op("c_allreduce_sum", {"X": ["x"]}, {"Out": ["red"]},
+                  {"axis_name": "dpp"})  # typo'd axis
+    shard_program(main, make_mesh({"dp": 8}))
+    rep = verify_program(main, ("x",), ("red",))
+    (f,) = rep.by_category(UNKNOWN_MESH_AXIS)
+    assert f.severity == Severity.WARNING
+    assert "dpp" in f.names
+
+
+def test_collective_in_divergent_cond_branches_flagged(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    x = fluid.data("x", [8, 4])
+    cond_v = fluid.data("c", [1], "bool")
+    tb = main.create_block()
+    main.rollback()
+    tb.append_op("c_allreduce_sum", {"X": ["x"]}, {"Out": ["x"]},
+                 {"axis_name": "dp"})
+    fb = main.create_block()
+    main.rollback()
+    blk.create_var(name="out", shape=[8, 4], dtype="float32")
+    blk.append_op(
+        "cond", {"Cond": ["c"], "TrueIn": ["x"], "FalseIn": ["x"]},
+        {"Out": ["out"]},
+        {"true_block": tb.idx, "false_block": fb.idx,
+         "true_out_names": ["x"], "false_out_names": ["x"]},
+    )
+    shard_program(main, make_mesh({"dp": 8}))
+    rep = verify_program(main, ("x", "c"), ("out",))
+    (f,) = rep.by_category(COLLECTIVE_BRANCH_DIVERGENCE)
+    assert f.op_type == "cond"
+
+
+def test_collective_hidden_in_recompute_segment_detected(fresh):
+    """recompute_segment folds ops into a `sub_ops` attr, not a sub-block;
+    a collective rematerialized inside one stage's segment must still
+    count toward that rank's stream."""
+    main, loss = _pipeline_program(poison_stage=None)
+    pipe_op = main.global_block.ops[0]
+    stage0 = main.blocks[pipe_op.attr("stage_blocks")[0]]
+    h = pipe_op.attr("boundary_names")[0]
+    stage0.append_op(
+        "recompute_segment", {"X": [h]}, {"Out": [h]},
+        {"sub_ops": [("c_allreduce_sum", {"X": [h]}, {"Out": [h]},
+                      {"axis_name": "dp"})],
+         "in_names": [h], "out_names": [h]},
+    )
+    main._bump()
+    rep = verify_program(main, ("x",), (loss.name,))
+    (f,) = rep.by_category(COLLECTIVE_DIVERGENCE)
+    assert f.op_type == "c_allreduce_sum"
+
+
+def test_meshless_program_skips_collective_analysis(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    x = fluid.data("x", [8, 4])
+    blk.create_var(name="red", shape=[8, 4], dtype="float32")
+    blk.append_op("c_allreduce_sum", {"X": ["x"]}, {"Out": ["red"]},
+                  {"axis_name": "nonexistent"})
+    rep = verify_program(main, ("x",), ("red",))
+    assert not rep.by_category(UNKNOWN_MESH_AXIS)
+
+
+# ---------------------------------------------------------------------------
+# executor wiring: strict rejects, warn warns, off is silent
+# ---------------------------------------------------------------------------
+
+
+def test_strict_mode_rejects_divergent_program_before_trace(fresh):
+    main, loss = _pipeline_program(poison_stage=0)
+    set_verify_mode("strict")
+    exe = fluid.Executor()
+    with pytest.raises(ProgramVerifyError) as ei:
+        exe.run(main, feed={"x": np.ones((8, 4), "float32")},
+                fetch_list=[loss])
+    err = ei.value
+    assert err.findings, "typed error must carry the structured findings"
+    msgs = str(err)
+    assert "collective" in msgs and "dp" in msgs
+    assert "test_program_analysis.py" in msgs  # user source line
+
+
+def test_strict_mode_rejects_use_before_def_at_run(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    blk.create_var(name="ghost", shape=[4, 4], dtype="float32")
+    blk.create_var(name="out", shape=[4, 4], dtype="float32")
+    blk.append_op("relu", {"X": ["ghost"]}, {"Out": ["out"]})
+    set_verify_mode("strict")
+    exe = fluid.Executor()
+    with pytest.raises(ProgramVerifyError):
+        exe.run(main, feed={"x": np.ones((4, 4), "float32")},
+                fetch_list=["out"])
+
+
+def test_strict_mode_rejects_shape_desync_at_run(fresh):
+    """strict is the mode that replays shape inference at compile time."""
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    blk.create_var(name="out", shape=[9, 9], dtype="float32")  # desynced
+    blk.append_op("relu", {"X": ["x"]}, {"Out": ["out"]})
+    set_verify_mode("strict")
+    exe = fluid.Executor()
+    with pytest.raises(ProgramVerifyError, match="shape-desync"):
+        exe.run(main, feed={"x": np.ones((4, 4), "float32")},
+                fetch_list=["out"])
+
+
+def test_warn_mode_warns_and_still_runs(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    x = fluid.data("x", [4, 4])
+    out = layers.scale(x, scale=2.0)
+    # undeclared write: a WARNING from the structural family, which warn
+    # mode runs at compile time — the program still executes
+    blk.append_op("relu", {"X": [out.name]}, {"Out": ["undeclared_sink"]})
+    set_verify_mode("warn")
+    exe = fluid.Executor()
+    with pytest.warns(ProgramVerifyWarning, match="undeclared-write"):
+        (got,) = exe.run(main, feed={"x": np.ones((4, 4), "float32")},
+                         fetch_list=[out])
+    np.testing.assert_allclose(got, 2.0 * np.ones((4, 4)))
+
+
+def test_off_mode_skips_verification(fresh):
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    blk.create_var(name="out", shape=[9, 9], dtype="float32")
+    blk.append_op("relu", {"X": ["x"]}, {"Out": ["out"]})
+    set_verify_mode("off")
+    exe = fluid.Executor()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", ProgramVerifyWarning)
+        exe.run(main, feed={"x": np.ones((4, 4), "float32")},
+                fetch_list=["out"])
+
+
+def test_verify_mode_env_parsing(fresh, monkeypatch):
+    set_verify_mode(None)
+    for raw, want in (
+        ("strict", "strict"), ("warn", "warn"), ("0", "off"),
+        ("off", "off"), ("", "off"), ("garbage", "warn"),
+    ):
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", raw)
+        assert verify_mode() == want
+    monkeypatch.delenv("PADDLE_TPU_VERIFY")
+    assert verify_mode() == "warn"
+    with pytest.raises(ValueError):
+        set_verify_mode("not-a-mode")
+
+
+def test_verify_cached_per_program_version(fresh):
+    from paddle_tpu import observability as obs
+
+    main, startup, _ = fresh
+    x = fluid.data("x", [-1, 4])
+    y = layers.scale(x, scale=2.0)
+    set_verify_mode("warn")
+    exe = fluid.Executor()
+    before = obs.snapshot()["counters"].get("analysis.programs_verified", 0)
+    exe.run(main, feed={"x": np.ones((4, 4), "float32")}, fetch_list=[y])
+    # same program version, new feed shape -> recompile, but NO re-verify
+    exe.run(main, feed={"x": np.ones((8, 4), "float32")}, fetch_list=[y])
+    after = obs.snapshot()["counters"].get("analysis.programs_verified", 0)
+    assert after == before + 1
+
+
+def test_observability_counters_and_latency(fresh):
+    from paddle_tpu import observability as obs
+
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [4, 4])
+    blk.create_var(name="out", shape=[9, 9], dtype="float32")
+    blk.append_op("relu", {"X": ["x"]}, {"Out": ["out"]})
+    obs.reset()
+    verify_program(main, ("x",), ("out",))
+    snap = obs.snapshot()
+    assert snap["counters"]["analysis.programs_verified"] == 1
+    assert snap["counters"]["analysis.findings.error"] >= 1
+    assert snap["histograms"]["analysis.verify_latency"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# did-you-mean lookup diagnostics
+# ---------------------------------------------------------------------------
+
+
+def test_var_lookup_suggests_nearest_name(fresh):
+    main, _, _ = fresh
+    fluid.data("learning_rate", [1])
+    main.global_block.create_parameter("fc_weight", [4, 4], "float32")
+    with pytest.raises(NotFoundError) as ei:
+        main.global_block.var("fc_wieght")
+    msg = str(ei.value)
+    assert "did you mean" in msg and "'fc_weight'" in msg
+    assert "feeds: [learning_rate]" in msg
+    assert "persistables: [fc_weight]" in msg
+
+
+def test_var_lookup_without_close_match_names_sets(fresh):
+    main, _, _ = fresh
+    fluid.data("x", [1])
+    with pytest.raises(NotFoundError) as ei:
+        main.global_block.var("zzzzqqqq")
+    msg = str(ei.value)
+    assert "did you mean" not in msg
+    assert "declares 1 vars" in msg
+
+
+# ---------------------------------------------------------------------------
+# clean bill over every bundled model
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("model", [
+    "resnet", "bert", "gpt", "deepfm", "bert_3d",
+    pytest.param("yolov3", marks=pytest.mark.slow),
+    pytest.param("mask_rcnn", marks=pytest.mark.slow),
+])
+def test_bundled_model_clean_bill(fresh, model):
+    from paddle_tpu.models import build_model
+
+    bm = build_model(model)
+    rep = verify_program(bm.main, bm.feed_names, bm.fetch_names)
+    assert not rep.strict_errors(), rep.render(Severity.WARNING)
+    startup_rep = verify_program(bm.startup)
+    assert not startup_rep.strict_errors(), startup_rep.render(
+        Severity.WARNING
+    )

@@ -141,6 +141,9 @@ def test_yolov3_loss_matches_reference_port():
                                atol=2e-4)
 
 
+@pytest.mark.slow  # ~55s on the CI CPU (tier-1 runtime brushes its 870s
+# budget); the loss-port oracle + infer decode tests keep tier-1 coverage,
+# and ci.sh's unfiltered pytest still runs this end-to-end convergence
 def test_yolov3_trains_on_toy_boxes():
     cfg = YoloConfig.tiny(class_num=3)
     N, S, B = 2, 64, 4

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Build every bundled model and run the static program verifier over it.
+
+Usage:
+    python tools/program_lint.py --all-models [--strict]
+    python tools/program_lint.py --model bert --model gpt
+    python tools/program_lint.py --broken-fixture   # must exit non-zero
+
+Exit status: 0 when no model produced an ERROR finding (under --strict,
+escalated WARNINGs — silent redefinition — also count), non-zero
+otherwise. ``--broken-fixture`` builds a deliberately malformed Program
+(use-before-def + shape desync + rank-divergent collective) and lints it:
+CI asserts the exit status is NON-zero, the linter's own regression test.
+
+Models are built through ``paddle_tpu.models.zoo`` (CI-sized configs,
+training programs with optimizer applied); meshed models (bert_3d) get a
+virtual-device mesh so the collective-schedule lint has bound axes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# runnable as `python tools/program_lint.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# an 8-device virtual CPU mesh for the meshed models, before jax loads
+# (mirrors tests/conftest.py)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _lint_one(name, strict, verbose):
+    import time
+
+    from paddle_tpu.analysis import Severity, verify_program
+    from paddle_tpu.models import build_model
+
+    t0 = time.time()
+    bm = build_model(name)
+    built = time.time() - t0
+    report = verify_program(bm.main, bm.feed_names, bm.fetch_names)
+    startup_report = verify_program(bm.startup, (), ())
+    report.extend(startup_report.findings)
+    verified = time.time() - t0 - built
+    failing = report.strict_errors() if strict else report.errors
+    status = "FAIL" if failing else "ok"
+    print(
+        f"[{status}] {name:<10} build {built:5.1f}s verify {verified:5.1f}s"
+        f"  errors={len(report.errors)} warnings={len(report.warnings)} "
+        f"info={len(report.infos)}"
+    )
+    min_sev = Severity.INFO if verbose else Severity.WARNING
+    shown = [f for f in report.findings if f.severity >= min_sev]
+    for f in shown:
+        print("    " + f.format())
+    return not failing
+
+
+def _broken_fixture():
+    """A deliberately malformed Program: the linter must reject it."""
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import make_mesh, shard_program
+    from paddle_tpu.parallel.pipeline import slice_program_into_stages
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        from paddle_tpu import layers
+
+        x = fluid.data("x", [8, 4])
+        with fluid.device_guard("pipeline:0"):
+            h = layers.fc(x, 4)
+        with fluid.device_guard("pipeline:1"):
+            loss = layers.mean(layers.fc(h, 4))
+        main._pipeline = {"num_microbatches": 2, "axis_name": "pp"}
+        _, pipe_op = slice_program_into_stages(main, loss)
+        blk = main.global_block
+        # use-before-def: a temp no op ever produces
+        blk.create_var(name="never_written", shape=[8, 4], dtype="float32")
+        blk.append_op("relu", {"X": ["never_written"]}, {"Out": ["r0"]})
+        blk.create_var(name="r0", shape=[8, 4], dtype="float32")
+        # shape desync: declaration disagrees with the emitter
+        blk.create_var(name="desynced", shape=[3, 3], dtype="float32")
+        blk.append_op("relu", {"X": ["r0"]}, {"Out": ["desynced"]})
+    # rank-divergent collective: stage 0 allreduces, stage 1 does not
+    stage0 = main.blocks[pipe_op.attr("stage_blocks")[0]]
+    stage0.append_op(
+        "c_allreduce_sum", {"X": [h.name]}, {"Out": [h.name]},
+        {"axis_name": "dp"},
+    )
+    mesh = make_mesh({"dp": 4, "pp": 2})
+    shard_program(main, mesh, {"x": ("dp",)})
+    return main, ("x",), (loss.name,)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--all-models", action="store_true",
+                    help="lint every bundled model")
+    ap.add_argument("--model", action="append", default=[],
+                    help="lint one model by name (repeatable)")
+    ap.add_argument("--strict", action="store_true",
+                    help="escalated warnings (redefinition) also fail")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print INFO findings too")
+    ap.add_argument("--broken-fixture", action="store_true",
+                    help="lint the seeded broken program (must fail)")
+    args = ap.parse_args(argv)
+
+    if args.broken_fixture:
+        from paddle_tpu.analysis import verify_program
+
+        program, feeds, fetches = _broken_fixture()
+        report = verify_program(program, feeds, fetches)
+        for f in report.findings:
+            print("    " + f.format())
+        if report.errors:
+            print(f"broken fixture: {len(report.errors)} error(s) found "
+                  "(exit 1, as CI expects)")
+            return 1
+        print("broken fixture: linter found NO errors — the verifier "
+              "regressed", file=sys.stderr)
+        return 0
+
+    from paddle_tpu.models import MODEL_BUILDERS
+
+    names = list(MODEL_BUILDERS) if args.all_models else args.model
+    if not names:
+        ap.error("pass --all-models, --model NAME, or --broken-fixture")
+    unknown = [n for n in names if n not in MODEL_BUILDERS]
+    if unknown:
+        ap.error(f"unknown models {unknown}; have {sorted(MODEL_BUILDERS)}")
+    ok = True
+    for n in names:
+        ok = _lint_one(n, args.strict, args.verbose) and ok
+    print("lint:", "PASS" if ok else "FAIL",
+          f"({len(names)} model(s), strict={args.strict})")
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
