@@ -44,6 +44,9 @@ ROUND2_RESNET_IMG_S = 1631.0
 # midpoint — the denominator for the stabler b=16 leg introduced in r4
 ROUND3_YOLO_IMG_S = 300.0
 ROUND3_GPT2048_TOK_S = 50787.0
+# r5 Mask R-CNN: AMP bf16 + dynamic loss scaling, 4x1-image unroll
+# (BASELINE.md r5 table) — denominator for the r6 batched leg
+ROUND5_MASK_RCNN_IMG_S = 20.99
 V5E_BF16_PEAK = 197e12
 
 
@@ -434,17 +437,13 @@ def bench_deepfm(on_accel):
     }
 
 
-def bench_mask_rcnn(on_accel):
-    """Mask R-CNN train step (BASELINE.json detection-config capability):
-    a half-width R-50-FPN at 256^2 on chip, the tiny config on CPU.
-
-    r5: AMP bf16 with DYNAMIC LOSS SCALING (the r4 fp32 retreat is gone —
-    the overflow the r4 note blamed is precisely what loss scaling
-    handles), and FOUR one-image graphs unrolled into one program (the
-    reference's detection loaders batch 1-2 images per card; unrolling
-    keeps the per-image LoD-free shape contract while amortizing the
-    per-step launch+bookkeeping floor — see the BASELINE.md mask limiter
-    analysis)."""
+def bench_mask_rcnn_legacy(on_accel):
+    """LEGACY Mask R-CNN leg (r5 configuration, kept for like-for-like
+    comparison under PADDLE_TPU_BATCHED_DETECTION=0): AMP bf16 + dynamic
+    loss scaling, FOUR one-image graphs unrolled into one program. The r5
+    BASELINE.md limiter analysis measured ~50-58 ms/image of device-busy
+    small-op bookkeeping in this unroll — the batched leg below is the
+    re-architecture that deletes it."""
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
@@ -540,8 +539,154 @@ def bench_mask_rcnn(on_accel):
                          "chip conditions)",
         "config": {"images_per_step": n_img, "size": size,
                    "scale": cfg.scale, "depth": cfg.depth,
-                   "amp": bool(on_accel), "dynamic_loss_scaling": True},
+                   "amp": bool(on_accel), "dynamic_loss_scaling": True,
+                   "batched_detection_ops": False},
         "samples": _samples(n_steps * n_img, dts),
+        **_mfu_fields(step_flops, dt, n_steps, on_accel),
+        "final_loss": round(final_loss, 4),
+    }
+
+
+def bench_mask_rcnn(on_accel):
+    """Mask R-CNN train step, r6 cross-image batched detection ops: ONE
+    [B, ...] program feeds B images through batched roi_align /
+    generate_proposals / NMS / target-assign / label ops (fixed per-image
+    RoI caps + validity masks) — the re-architecture BASELINE.md r5 named
+    as the only path past the ~50-58 ms/image bookkeeping floor of the
+    per-image unroll. images_per_step=8 on accel (vs the r5 4x unroll);
+    PADDLE_TPU_BATCHED_DETECTION=0 selects the legacy r5 leg for
+    like-for-like comparison. The "unroll_proxy" fields evidence the
+    elimination on CPU-only CI where MFU cannot be measured: 1 program
+    for B images, and the batched op count vs what the unroll would cost.
+    """
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.models import mask_rcnn
+    from paddle_tpu.ops.detection_stats import record_roi_stats
+    from paddle_tpu.optimizer import Momentum
+
+    if not mask_rcnn.batched_detection_enabled():
+        return bench_mask_rcnn_legacy(on_accel)
+
+    if on_accel:
+        size, n_gt, B = 256, 8, 8
+        cfg = mask_rcnn.MaskRCNNConfig(
+            class_num=81, scale=0.5, rpn_pre_nms=512, rpn_post_nms=128,
+            batch_size_per_im=64, depth=50,
+        )
+    else:
+        size, n_gt, B = 64, 2, 2
+        cfg = mask_rcnn.MaskRCNNConfig.tiny()
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main_prog, startup):
+        images = fluid.data("images", [B, 3, size, size])
+        gt_boxes = fluid.data("gt_boxes", [B, n_gt, 4])
+        gt_classes = fluid.data("gt_classes", [B, n_gt], dtype="int32")
+        is_crowd = fluid.data("is_crowd", [B, n_gt], dtype="int32")
+        gt_segms = fluid.data("gt_segms", [B, n_gt, size, size])
+        im_info = fluid.data("im_info", [B, 3])
+        losses, aux = mask_rcnn.mask_rcnn_train_batched(
+            images, gt_boxes, gt_classes, is_crowd, gt_segms, im_info, cfg,
+        )
+        loss = losses[0]
+        batched_fwd_ops = len(main_prog.global_block.ops)
+        opt = Momentum(0.002, 0.9)
+        if on_accel:
+            from paddle_tpu.contrib import mixed_precision as mp
+
+            opt = mp.decorate(
+                opt,
+                amp_lists=mp.AutoMixedPrecisionLists(
+                    custom_white_list={"softmax", "layer_norm"}),
+                use_dynamic_loss_scaling=True,
+                init_loss_scaling=2.0 ** 12,
+                dest_dtype="bfloat16",
+            )
+        opt.minimize(loss, startup)
+    batched_op_count = len(main_prog.global_block.ops)
+
+    # unroll-eliminated proxy: what ONE legacy per-image graph costs in
+    # FORWARD ops (build only, never run; no optimizer on either side of
+    # the comparison) -> the unroll would be B x that
+    legacy_prog, legacy_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(legacy_prog, legacy_startup):
+        li = fluid.data("image", [1, 3, size, size])
+        lb = fluid.data("gt_boxes", [n_gt, 4])
+        lc = fluid.data("gt_classes", [n_gt], dtype="int32")
+        lcr = fluid.data("is_crowd", [n_gt], dtype="int32")
+        ls = fluid.data("gt_segms", [n_gt, size, size])
+        lii = fluid.data("im_info", [1, 3])
+        mask_rcnn.mask_rcnn_train(li, lb, lc, lcr, ls, lii, cfg)
+    legacy_ops_per_image = len(legacy_prog.global_block.ops)
+
+    scope = Scope()
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    boxes = rng.rand(B, n_gt, 4).astype("float32") * (size / 2)
+    boxes[..., 2:] = boxes[..., :2] + 8 + boxes[..., 2:] / 2
+    feed = {
+        "images": jnp.asarray(
+            rng.rand(B, 3, size, size).astype("float32")),
+        "gt_boxes": jnp.asarray(boxes),
+        "gt_classes": jnp.asarray(
+            rng.randint(1, cfg.class_num, (B, n_gt)).astype("int32")),
+        "is_crowd": jnp.asarray(np.zeros((B, n_gt), "int32")),
+        "gt_segms": jnp.asarray(
+            (rng.rand(B, n_gt, size, size) > 0.5).astype("float32")),
+        "im_info": jnp.asarray(
+            np.tile([[size, size, 1.0]], (B, 1)).astype("float32")),
+    }
+    # padding stats fetch once, then warm the EXACT [loss] fetch set the
+    # timed loop uses (executables are cached per fetch set; a cold set
+    # would put trace+compile inside the timed region)
+    wv, rois_num = exe.run(main_prog, feed=feed,
+                           fetch_list=[loss, aux["rois_num"]],
+                           scope=scope, return_numpy=False)
+    padding_waste = record_roi_stats(
+        np.asarray(rois_num), cfg.batch_size_per_im
+    )
+    for _ in range(3):
+        (wv,) = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                        scope=scope, return_numpy=False)
+    np.asarray(wv)
+    step_flops = exe.flops(main_prog, feed=feed, fetch_list=[loss],
+                           scope=scope)
+    n_steps = 20 if on_accel else 3
+    dt, dts, final_loss = _timed_loop(
+        exe, main_prog, scope, [feed], loss, n_steps, 3 if on_accel else 1
+    )
+    img_s = n_steps * B / dt
+    return {
+        "metric": "mask_rcnn_half_train_images_per_sec" if on_accel
+        else "mask_rcnn_tiny_train_images_per_sec_cpu",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": (round(img_s / ROUND5_MASK_RCNN_IMG_S, 3)
+                        if on_accel else 1.0),
+        "baseline_note": "r5 denominator 20.99 img/s = AMP bf16+DLS "
+                         "4x1-image unroll at 256^2 half-width (r4 fp32 "
+                         "b=1: 20.8); PADDLE_TPU_BATCHED_DETECTION=0 "
+                         "re-runs that legacy leg like-for-like",
+        "config": {"images_per_step": B, "size": size,
+                   "scale": cfg.scale, "depth": cfg.depth,
+                   "roi_cap_per_image": cfg.batch_size_per_im,
+                   "amp": bool(on_accel),
+                   "dynamic_loss_scaling": bool(on_accel),
+                   "batched_detection_ops": True},
+        "unroll_proxy": {
+            "programs_per_step": 1,
+            "images_per_program": B,
+            "batched_op_count": batched_op_count,
+            "batched_fwd_ops": batched_fwd_ops,
+            "legacy_fwd_ops_per_image": legacy_ops_per_image,
+            "legacy_fwd_ops_if_unrolled": legacy_ops_per_image * B,
+        },
+        "padding_waste": round(padding_waste, 3),
+        "samples": _samples(n_steps * B, dts),
         **_mfu_fields(step_flops, dt, n_steps, on_accel),
         "final_loss": round(final_loss, 4),
     }

@@ -7,11 +7,17 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 echo "== pytest (8 virtual CPU devices via tests/conftest.py) =="
+# includes the batched-detection golden-parity suite
+# (tests/test_detection_batched.py, CPU-sized; its >25s model-level
+# loss-parity case is @slow so tier-1 'not slow' runs stay in budget —
+# it still runs here)
 python -m pytest tests/ -q
 
 echo "== program lint (static verifier over every bundled model) =="
 # every bundled model must build and verify with ZERO error findings
-# (strict also escalates silent-redefinition warnings)
+# (strict also escalates silent-redefinition warnings); --all-models
+# includes the r6 batched mask_rcnn graph (zoo: mask_rcnn_batched),
+# which replays the batched detection-op infer_shapes signatures
 python tools/program_lint.py --all-models --strict
 # ...and the linter itself must still catch a seeded broken program
 # (use-before-def + shape desync + rank-divergent collective => exit 1)
@@ -28,18 +34,29 @@ python - <<'EOF'
 import numpy as np
 import paddle_tpu as fluid
 from paddle_tpu import layers, observability
+from paddle_tpu.ops.detection_stats import record_roi_stats
 
 main, startup = fluid.Program(), fluid.Program()
 with fluid.program_guard(main, startup):
     x = fluid.data("x", [4, 4])
     y = layers.scale(x, scale=2.0)
+    # one cross-image batched detection op: rois [B, R, 4] against
+    # feats [B, C, H, W] -> detection.* trace-time counters
+    feats = fluid.data("feats", [2, 2, 8, 8])
+    rois = fluid.data("rois", [2, 3, 4])
+    pooled = layers.roi_align(feats, rois, pooled_height=2, pooled_width=2)
 exe = fluid.Executor()
 exe.run(startup)
-exe.run(main, feed={"x": np.ones((4, 4), "float32")}, fetch_list=[y])
+rb = np.zeros((2, 3, 4), "float32"); rb[..., 2:] = 4.0
+exe.run(main, feed={"x": np.ones((4, 4), "float32"),
+                    "feats": np.ones((2, 2, 8, 8), "float32"),
+                    "rois": rb}, fetch_list=[y, pooled])
+# host-side padding-waste gauge + rois-per-image histogram
+record_roi_stats(np.array([2, 3]), cap=3)
 observability.dump("/tmp/paddle_tpu_obs_snapshot.json")
 EOF
 python tools/stats_report.py /tmp/paddle_tpu_obs_snapshot.json \
-    --require executor. --require analysis.
+    --require executor. --require analysis. --require detection.
 
 echo "== resilience chaos smoke (injected IO + dataloader faults) =="
 PADDLE_TPU_FAULT_INJECT="io.save:io:1.0:0:1,dataloader.fetch:io:1.0:0:2" \

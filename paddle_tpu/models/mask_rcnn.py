@@ -6,20 +6,49 @@ generate_proposal_labels / generate_mask_labels / distribute_fpn_proposals /
 collect_fpn_proposals / roi_align (all per-op files under
 paddle/fluid/operators/detection/, cited in ops/detection_ext.py).
 
-TPU-native shape contract: batch = 1 image per step (the reference's LoD
-image walk), every stage emits fixed-size tensors with -1/0 padding and
-live counts, so the whole train step is ONE static XLA computation —
-RPN losses gather sampled anchors with mode="fill", head losses mask by
-label validity. GtSegms are dense per-gt bitmaps (rasterization is the
-data pipeline's job).
+TPU-native shape contract: every stage emits fixed-size tensors with
+-1/0 padding and live counts, so the whole train step is ONE static XLA
+computation — RPN losses gather sampled anchors with mode="fill", head
+losses mask by label validity. GtSegms are dense per-gt bitmaps
+(rasterization is the data pipeline's job).
+
+Two train paths:
+
+* ``mask_rcnn_train`` — the legacy single-image graph (batch = 1, the
+  reference's LoD image walk). DEPRECATED for training throughput: B
+  images need B unrolled copies of every detection op, and the r5
+  BASELINE.md limiter analysis measured ~50-58 ms/image of device-busy
+  small-op bookkeeping in exactly that unroll.
+* ``mask_rcnn_train_batched`` — the r6 cross-image batched graph: images
+  [B, 3, H, W] flow through the conv tower, heads, and the rank-lifted
+  detection ops (ops/detection.py, ops/detection_ext.py) as single wide
+  [B, ...] ops with fixed per-image RoI caps and validity masks. Losses
+  are normalized per image then averaged, so B=1 reproduces the legacy
+  losses exactly and the batched loss equals the mean of per-image
+  losses up to sampling jitter (fp-order tolerance when caps saturate).
+
+``batched_detection_enabled()`` reads the PADDLE_TPU_BATCHED_DETECTION
+env knob (default on) — bench.py and builders use it to pick the path.
 """
 
 from __future__ import annotations
+
+import os
 
 from .. import layers
 from ..initializer import Normal
 from ..layers import detection as det
 from ..param_attr import ParamAttr
+
+
+def batched_detection_enabled():
+    """Env/config knob for the batched vs legacy per-image detection path
+    (PADDLE_TPU_BATCHED_DETECTION, default on). The ops themselves
+    dispatch on input rank; this only selects which graph builders and
+    bench legs construct."""
+    return os.environ.get(
+        "PADDLE_TPU_BATCHED_DETECTION", "1"
+    ).lower() not in ("0", "false", "off")
 
 
 def _head_attr(std=0.01):
@@ -285,6 +314,218 @@ def mask_rcnn_train(image, gt_boxes, gt_classes, is_crowd, gt_segms,
              + mask_loss)
     return total, rpn_cls_loss, rpn_reg_loss, head_cls_loss, head_reg_loss, \
         mask_loss
+
+
+# ---------------------------------------------------------------------------
+# cross-image batched train path (r6)
+# ---------------------------------------------------------------------------
+
+
+def _per_image_mean(num, den):
+    """mean_b(num_b / (den_b + 1)): the per-image-normalized loss
+    reduction. num/den are [B]; matches the legacy single-image
+    sum/(count+1) exactly at B=1."""
+    return layers.reduce_mean(
+        layers.elementwise_div(num, layers.scale(den, bias=1.0))
+    )
+
+
+def _rpn_losses_batched(rpn_outs, gt_boxes, is_crowd, im_info, cfg, B):
+    """Batched RPN losses: anchors stay [A_tot, 4] (shared across images),
+    scores/deltas carry [B, A_tot, ...], one batched target assignment
+    emits per-image sampled indices gathered with take_along_axis."""
+    all_scores, all_deltas, all_anchors = [], [], []
+    for scores, deltas, anchors, _ in rpn_outs:
+        s = layers.reshape(layers.transpose(scores, [0, 2, 3, 1]),
+                           [B, -1, 1])
+        d = layers.reshape(layers.transpose(deltas, [0, 2, 3, 1]),
+                           [B, -1, 4])
+        a = layers.reshape(anchors, [-1, 4])
+        all_scores.append(s)
+        all_deltas.append(d)
+        all_anchors.append(a)
+    scores = layers.concat(all_scores, axis=1)  # [B, A_tot, 1]
+    deltas = layers.concat(all_deltas, axis=1)  # [B, A_tot, 4]
+    anchors = layers.concat(all_anchors, axis=0)  # [A_tot, 4]
+
+    loc_idx, score_idx, tgt_label, tgt_bbox, bbox_w = det.rpn_target_assign(
+        anchors, gt_boxes, is_crowd=is_crowd, im_info=im_info,
+        rpn_batch_size_per_im=cfg.batch_size_per_im,
+    )  # [B, fg_cap] / [B, S] / [B, S, 1] / [B, fg_cap, 4] / [B, fg_cap, 4]
+    S = score_idx.shape[1]
+    samp_score = layers.take_along_axis(
+        scores, layers.reshape(layers.relu(score_idx), [B, S, 1]), axis=1
+    )  # [B, S, 1]
+    label_f = layers.cast(tgt_label, "float32")
+    valid = layers.cast(
+        layers.greater_equal(
+            label_f, layers.fill_constant([1], "float32", 0.0)
+        ),
+        "float32",
+    )
+    eps = 1e-6
+    p = layers.clip(samp_score, eps, 1.0 - eps)
+    ce = (0.0 - (label_f * layers.log(p)
+                 + (1.0 - label_f) * layers.log(1.0 - p))) * valid
+    cls_loss = _per_image_mean(
+        layers.reduce_sum(ce, dim=[1, 2]),
+        layers.reduce_sum(valid, dim=[1, 2]),
+    )
+
+    F = loc_idx.shape[1]
+    samp_delta = layers.take_along_axis(
+        deltas, layers.reshape(layers.relu(loc_idx), [B, F, 1]), axis=1
+    )  # [B, F, 4]
+    reg_valid = layers.cast(
+        layers.greater_equal(
+            layers.cast(loc_idx, "float32"),
+            layers.fill_constant([1], "float32", 0.0),
+        ),
+        "float32",
+    )  # [B, F]
+    diff = (samp_delta - tgt_bbox) * bbox_w
+    reg = layers.reduce_sum(layers.abs(diff), dim=[2])  # [B, F]
+    reg_loss = _per_image_mean(
+        layers.reduce_sum(reg * reg_valid, dim=[1]),
+        layers.reduce_sum(reg_valid, dim=[1]),
+    )
+    return cls_loss, reg_loss
+
+
+def _fpn_roi_extract_batched(ps, rois, cfg, resolution, B):
+    """Batched FPN roi feature extraction: rois [B, R, 4] -> features
+    [B*R, C, res, res] (B folded into the roi dim so the conv/fc heads
+    run one wide op over every image's rois)."""
+    multi_rois, restore, _nums = det.distribute_fpn_proposals(
+        rois, cfg.min_level, cfg.max_level, 4, 224,
+    )  # L x [B, R, 4], [B, R, 1]
+    feats = []
+    for lvl, (p, r) in enumerate(zip(ps, multi_rois)):
+        f = det.roi_align(
+            p, r, pooled_height=resolution, pooled_width=resolution,
+            spatial_scale=1.0 / (2 ** (lvl + 2)), sampling_ratio=2,
+        )  # [B, R, C, res, res]
+        feats.append(f)
+    stacked = layers.concat(feats, axis=1)  # [B, L*R, C, res, res]
+    R = rois.shape[1]
+    # restore[b, i] = row of roi i in image b's level-major concat (-1 for
+    # dead rois -> clamps to row 0, masked by the losses downstream)
+    idx = layers.reshape(layers.relu(restore), [B, R, 1, 1, 1])
+    picked = layers.take_along_axis(stacked, idx, axis=1)
+    C = stacked.shape[2]
+    return layers.reshape(picked, [B * R, C, resolution, resolution])
+
+
+def mask_rcnn_train_batched(images, gt_boxes, gt_classes, is_crowd,
+                            gt_segms, im_info, cfg=None):
+    """Cross-image batched train graph: ONE [B, ...] program for B images
+    (the r6 re-architecture deleting the per-image unroll).
+
+    images [B, 3, H, W]; gt_boxes [B, G, 4]; gt_classes/is_crowd [B, G];
+    gt_segms [B, G, H, W]; im_info [B, 3]. Returns ``(losses, aux)``:
+    losses = (total, rpn_cls, rpn_reg, head_cls, head_reg, mask) scalars
+    (each per-image normalized then averaged over B) and aux =
+    {"rois_num": [B] live-roi counts} for padding-waste observability
+    (ops/detection_stats.record_roi_stats)."""
+    cfg = cfg or MaskRCNNConfig()
+    B = images.shape[0]
+    cap = cfg.batch_size_per_im  # per-image RoI cap
+    ps = resnet_fpn_backbone(images, cfg, is_test=False)
+    rpn_outs = rpn_heads(ps, cfg)
+    rpn_cls_loss, rpn_reg_loss = _rpn_losses_batched(
+        rpn_outs, gt_boxes, is_crowd, im_info, cfg, B
+    )
+
+    # proposals per level -> collect (generate_proposals is natively
+    # rank-lifted over the image batch)
+    lvl_rois, lvl_scores, lvl_nums = [], [], []
+    for scores, deltas, anchors, variances in rpn_outs:
+        rois, probs, nums = det.generate_proposals(
+            scores, deltas, im_info, anchors, variances,
+            pre_nms_top_n=cfg.rpn_pre_nms, post_nms_top_n=cfg.rpn_post_nms,
+            nms_thresh=0.7, min_size=0.0,
+        )  # [B, post, 4] / [B, post, 1] / [B]
+        lvl_rois.append(rois)
+        lvl_scores.append(probs)
+        lvl_nums.append(nums)
+    rois, _collect_num = det.collect_fpn_proposals(
+        lvl_rois, lvl_scores, cfg.min_level, cfg.max_level,
+        post_nms_top_n=cfg.rpn_post_nms, rois_nums=lvl_nums,
+    )  # [B, post, 4]
+
+    (rois, labels, bbox_targets, bbox_iw, _bbox_ow, rois_num,
+     _ov) = det.generate_proposal_labels(
+        rois, gt_classes, is_crowd, gt_boxes, im_info,
+        batch_size_per_im=cap, class_nums=cfg.class_num,
+        rois_num=_collect_num,
+    )  # [B, cap, 4] / [B, cap, 1] / [B, cap, 4C] / ... / [B]
+
+    feat = _fpn_roi_extract_batched(ps, rois, cfg, cfg.resolution, B)
+    cls_score, bbox_pred = box_head(feat, cfg)  # [B*cap, C] / [B*cap, 4C]
+
+    labels_flat = layers.reshape(labels, [B * cap, 1])
+    valid = layers.cast(
+        layers.greater_equal(
+            layers.cast(labels_flat, "float32"),
+            layers.fill_constant([1], "float32", 0.0),
+        ),
+        "float32",
+    )  # [B*cap, 1]
+    valid_im = layers.reshape(valid, [B, cap])
+    cls_loss_all = layers.softmax_with_cross_entropy(
+        cls_score, layers.relu(labels_flat)
+    )  # [B*cap, 1]
+    head_cls_loss = _per_image_mean(
+        layers.reduce_sum(
+            layers.reshape(cls_loss_all, [B, cap]) * valid_im, dim=[1]
+        ),
+        layers.reduce_sum(valid_im, dim=[1]),
+    )
+    diff = (bbox_pred - layers.reshape(bbox_targets, [B * cap, -1])) \
+        * layers.reshape(bbox_iw, [B * cap, -1])
+    reg_rows = layers.reduce_sum(layers.abs(diff), dim=[1], keep_dim=True)
+    head_reg_loss = _per_image_mean(
+        layers.reduce_sum(
+            layers.reshape(reg_rows, [B, cap]) * valid_im, dim=[1]
+        ),
+        layers.reduce_sum(valid_im, dim=[1]),
+    )
+
+    # mask branch on the sampled roi set
+    mask_rois, _has_mask, mask_targets = det.generate_mask_labels(
+        im_info, gt_classes, is_crowd, gt_segms, rois, labels,
+        num_classes=cfg.class_num, resolution=cfg.resolution,
+    )  # [B, cap, 4] / [B, cap, 1] / [B, cap, C*M^2]
+    mfeat = _fpn_roi_extract_batched(ps, mask_rois, cfg, cfg.resolution, B)
+    mlogits = mask_head(mfeat, cfg)  # [B*cap, C, 2M, 2M]
+    mlogits = layers.pool2d(mlogits, pool_size=2, pool_stride=2,
+                            pool_type="avg")  # back to [B*cap, C, M, M]
+    mlogits = layers.reshape(
+        mlogits, [B * cap, cfg.class_num * cfg.resolution ** 2]
+    )
+    mtgt = layers.cast(
+        layers.reshape(mask_targets, [B * cap, -1]), "float32"
+    )
+    mvalid = layers.cast(
+        layers.greater_equal(mtgt, layers.fill_constant([1], "float32", 0.0)),
+        "float32",
+    )
+    mce = layers.sigmoid_cross_entropy_with_logits(mlogits, layers.relu(mtgt))
+    K = cfg.class_num * cfg.resolution ** 2
+    mask_loss = _per_image_mean(
+        layers.reduce_sum(
+            layers.reshape(mce * mvalid, [B, cap * K]), dim=[1]
+        ),
+        layers.reduce_sum(
+            layers.reshape(mvalid, [B, cap * K]), dim=[1]
+        ),
+    )
+
+    total = (rpn_cls_loss + rpn_reg_loss + head_cls_loss + head_reg_loss
+             + mask_loss)
+    losses = (total, rpn_cls_loss, rpn_reg_loss, head_cls_loss,
+              head_reg_loss, mask_loss)
+    return losses, {"rois_num": rois_num}
 
 
 def mask_rcnn_infer(image, im_info, cfg=None):

@@ -153,6 +153,36 @@ def build_mask_rcnn():
     )
 
 
+def build_mask_rcnn_batched():
+    """The r6 cross-image batched Mask R-CNN graph (ONE [B, ...] program
+    for B images instead of B unrolled one-image graphs) — the shape the
+    bench leg trains; linting it keeps the batched detection-op
+    `infer_shapes` signatures under the PR-5 shape replay."""
+    import paddle_tpu as fluid
+    from . import mask_rcnn
+
+    cfg = mask_rcnn.MaskRCNNConfig.tiny()
+    B, size, G = 2, 64, 2
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        images = fluid.data("images", [B, 3, size, size])
+        gt_boxes = fluid.data("gt_boxes", [B, G, 4])
+        gt_classes = fluid.data("gt_classes", [B, G], dtype="int32")
+        is_crowd = fluid.data("is_crowd", [B, G], dtype="int32")
+        gt_segms = fluid.data("gt_segms", [B, G, size, size])
+        im_info = fluid.data("im_info", [B, 3])
+        losses, aux = mask_rcnn.mask_rcnn_train_batched(
+            images, gt_boxes, gt_classes, is_crowd, gt_segms, im_info, cfg
+        )
+        fluid.optimizer.SGD(0.01).minimize(losses[0])
+    return BuiltModel(
+        "mask_rcnn_batched", main, startup,
+        ("images", "gt_boxes", "gt_classes", "is_crowd", "gt_segms",
+         "im_info"),
+        tuple(v.name for v in losses) + (aux["rois_num"].name,),
+    )
+
+
 def build_bert_3d():
     from .bert import BertConfig
     from .bert_3d import bert_3d_shardings, build_bert_3d
@@ -180,6 +210,7 @@ MODEL_BUILDERS = {
     "yolov3": build_yolov3,
     "deepfm": build_deepfm,
     "mask_rcnn": build_mask_rcnn,
+    "mask_rcnn_batched": build_mask_rcnn_batched,
     "bert_3d": build_bert_3d,
 }
 

@@ -8,6 +8,18 @@ set padded with -1 labels plus a validity count, so the whole detection
 head stays inside one static-shape XLA computation (the standard TPU
 object-detection formulation). Suppression uses the O(k^2) masked matrix
 form on the VPU instead of the reference's sequential CPU loop.
+
+Cross-image batching (r6): the roi family accepts a leading batch dim —
+ROIs [B, R, 4] against X [B, C, H, W] runs the single-image kernel
+vmapped over images (fixed per-image RoI cap R, padded rows are
+degenerate boxes the consumers mask) — so a B-image detection step is ONE
+wide program instead of B unrolled one-image graphs (the BASELINE.md r5
+Mask R-CNN limiter: ~50-58 ms/image of small-op bookkeeping that one
+wide op family amortizes; same conclusion as the XLA fusion analysis,
+arXiv:2301.13062, that many small fusions lose to one wide one).
+`generate_proposals` and `multiclass_nms` were already rank-lifted over
+[N, ...]; ops/detection_ext.py lifts the assignment/label family the
+same way.
 """
 
 from __future__ import annotations
@@ -19,6 +31,20 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework.registry import register_op
+from ..observability import metrics as _metrics
+
+
+def _tally(ctx, name, batched):
+    """Trace-time detection.* op counters: one bump per emitter
+    instantiation (a program trace, including __vjp__ replays), NOT per
+    step — runtime values never leave the device inside a jit trace.
+    Skipped during abstract shape replay so the PR-5 verifier doesn't
+    inflate the counts."""
+    if getattr(ctx, "abstract", False):
+        return
+    _metrics.add(f"detection.{name}.instantiations")
+    if batched:
+        _metrics.add(f"detection.{name}.batched_instantiations")
 
 
 def _iou_matrix(a, b):
@@ -293,6 +319,7 @@ def _multiclass_nms(ctx, op, ins):
     """Fixed-size NMS (multiclass_nms_op.cc re-designed for static shapes):
     Out [B, keep_top_k, 6] rows [label, score, x0, y0, x1, y1], invalid
     rows label=-1; NmsRoisNum [B]."""
+    _tally(ctx, "multiclass_nms", batched=ins["BBoxes"][0].shape[0] > 1)
     out, num, _ = multiclass_nms_core(
         ins["BBoxes"][0], ins["Scores"][0], op.attrs
     )
@@ -486,33 +513,10 @@ def _roi_batch_idx(rois_num, R, N, abstract=False):
     return jnp.sum(r[:, None] >= bounds[None, :], axis=1).astype(jnp.int32)
 
 
-@register_op(
-    "roi_align", inputs=["X", "ROIs", "RoisNum"], outputs=["Out"]
-)
-def _roi_align(ctx, op, ins):
-    """RoIAlign (roi_align_op.h, Mask R-CNN head input): average of
-    bilinear samples per output bin. The reference's adaptive sampling
-    count ceil(bin_size) is data-dependent — static-shape re-design uses a
-    fixed grid (sampling_ratio attr; <=0 falls back to 2, the standard
-    detectron setting) so the whole op is gathers + one mean on the MXU
-    host. Differentiable via the generic vjp (gather grad = scatter-add,
-    exactly the reference's hand-written bilinear backward)."""
-    x = ins["X"][0]
-    rois = ins["ROIs"][0].astype(jnp.float32)
-    rois_num = (
-        ins["RoisNum"][0]
-        if ins.get("RoisNum") and ins["RoisNum"][0] is not None
-        else None
-    )
-    ph = int(op.attr("pooled_height", 1))
-    pw = int(op.attr("pooled_width", 1))
-    scale = float(op.attr("spatial_scale", 1.0))
-    sr = int(op.attr("sampling_ratio", -1))
-    s = sr if sr > 0 else 2
+def _roi_align_compute(x, rois, bidx, ph, pw, scale, s):
+    """Single-batch RoIAlign body: x [N, C, H, W], rois [R, 4], bidx [R]
+    image index per roi -> [R, C, ph, pw] (float32)."""
     N, C, H, W = x.shape
-    R = rois.shape[0]
-    bidx = _roi_batch_idx(rois_num, R, N, ctx.abstract)
-
     xmin = rois[:, 0] * scale
     ymin = rois[:, 1] * scale
     xmax = rois[:, 2] * scale
@@ -573,17 +577,24 @@ def _roi_align(ctx, op, ins):
     inb = (yin[:, :, None, :, None] & xin[:, None, :, None, :])[..., None]
     val = jnp.where(inb, val, 0.0)
     out = jnp.mean(val, axis=(3, 4))  # average the s*s samples
-    return {"Out": [jnp.transpose(out, (0, 3, 1, 2)).astype(x.dtype)]}
+    return jnp.transpose(out, (0, 3, 1, 2))
 
 
 @register_op(
-    "roi_pool", inputs=["X", "ROIs", "RoisNum"], outputs=["Out", "Argmax"]
+    "roi_align", inputs=["X", "ROIs", "RoisNum"], outputs=["Out"]
 )
-def _roi_pool(ctx, op, ins):
-    """RoIPool (roi_pool_op.cc): max over integer-quantized bins. Static
-    re-design: every bin maxes a masked view of the full feature map
-    (O(H*W) per bin — fine for head-sized maps; roi_align is the
-    recommended TPU path)."""
+def _roi_align(ctx, op, ins):
+    """RoIAlign (roi_align_op.h, Mask R-CNN head input): average of
+    bilinear samples per output bin. The reference's adaptive sampling
+    count ceil(bin_size) is data-dependent — static-shape re-design uses a
+    fixed grid (sampling_ratio attr; <=0 falls back to 2, the standard
+    detectron setting) so the whole op is gathers + one mean on the MXU
+    host. Differentiable via the generic vjp (gather grad = scatter-add,
+    exactly the reference's hand-written bilinear backward).
+
+    Batched contract (r6): ROIs [B, R, 4] with X [B, C, H, W] — image b's
+    rois sample ONLY feature map b (no RoisNum needed; the per-image RoI
+    cap R is static) -> Out [B, R, C, ph, pw]."""
     x = ins["X"][0]
     rois = ins["ROIs"][0].astype(jnp.float32)
     rois_num = (
@@ -594,9 +605,30 @@ def _roi_pool(ctx, op, ins):
     ph = int(op.attr("pooled_height", 1))
     pw = int(op.attr("pooled_width", 1))
     scale = float(op.attr("spatial_scale", 1.0))
-    N, C, H, W = x.shape
+    sr = int(op.attr("sampling_ratio", -1))
+    s = sr if sr > 0 else 2
+    if rois.ndim == 3:  # [B, R, 4] cross-image batched form
+        _tally(ctx, "roi_align", batched=True)
+        zeros = jnp.zeros((rois.shape[1],), jnp.int32)
+        out = jax.vmap(
+            lambda xb, rb: _roi_align_compute(
+                xb[None], rb, zeros, ph, pw, scale, s
+            )
+        )(x, rois)  # [B, R, C, ph, pw]
+        return {"Out": [out.astype(x.dtype)]}
+    _tally(ctx, "roi_align", batched=False)
+    N = x.shape[0]
     R = rois.shape[0]
     bidx = _roi_batch_idx(rois_num, R, N, ctx.abstract)
+    out = _roi_align_compute(x, rois, bidx, ph, pw, scale, s)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+def _roi_pool_compute(x, rois, bidx, ph, pw, scale):
+    """Single-batch RoIPool body: x [N, C, H, W], rois [R, 4], bidx [R]
+    -> (out [R, C, ph, pw], argmax [R, C, ph, pw])."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
 
     def cround(v):
         # std::round = half away from zero (coords are >= 0 here); jnp.round
@@ -647,6 +679,41 @@ def _roi_pool(ctx, op, ins):
     empty = ~jnp.any(mask, axis=(3, 4))  # [R, ph, pw]
     out = jnp.where(empty[:, None], 0.0, out)
     arg = jnp.where(empty[:, None], -1, arg)
+    return out, arg
+
+
+@register_op(
+    "roi_pool", inputs=["X", "ROIs", "RoisNum"], outputs=["Out", "Argmax"]
+)
+def _roi_pool(ctx, op, ins):
+    """RoIPool (roi_pool_op.cc): max over integer-quantized bins. Static
+    re-design: every bin maxes a masked view of the full feature map
+    (O(H*W) per bin — fine for head-sized maps; roi_align is the
+    recommended TPU path). Batched contract as roi_align: ROIs [B, R, 4]
+    with X [B, C, H, W] -> Out/Argmax [B, R, C, ph, pw]."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0].astype(jnp.float32)
+    rois_num = (
+        ins["RoisNum"][0]
+        if ins.get("RoisNum") and ins["RoisNum"][0] is not None
+        else None
+    )
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    scale = float(op.attr("spatial_scale", 1.0))
+    if rois.ndim == 3:  # [B, R, 4] cross-image batched form
+        _tally(ctx, "roi_pool", batched=True)
+        zeros = jnp.zeros((rois.shape[1],), jnp.int32)
+        out, arg = jax.vmap(
+            lambda xb, rb: _roi_pool_compute(xb[None], rb, zeros, ph, pw,
+                                             scale)
+        )(x, rois)
+        return {"Out": [out.astype(x.dtype)], "Argmax": [arg]}
+    _tally(ctx, "roi_pool", batched=False)
+    N = x.shape[0]
+    R = rois.shape[0]
+    bidx = _roi_batch_idx(rois_num, R, N, ctx.abstract)
+    out, arg = _roi_pool_compute(x, rois, bidx, ph, pw, scale)
     return {"Out": [out.astype(x.dtype)], "Argmax": [arg]}
 
 
@@ -789,7 +856,10 @@ def _generate_proposals(ctx, op, ins):
     degenerate boxes, take pre_nms_topN by score, greedy-NMS on the fixed
     set, emit exactly post_nms_topN rois per image (padded; RpnRoisNum
     counts the valid ones) — the reference emits a variable count via LoD.
+    Natively rank-lifted over the image batch N (the one detection op the
+    seed already batched); N>1 is the cross-image path.
     """
+    _tally(ctx, "generate_proposals", batched=ins["Scores"][0].shape[0] > 1)
     scores = ins["Scores"][0]          # [N, A, H, W]
     deltas = ins["BboxDeltas"][0]      # [N, A*4, H, W]
     im_info = ins["ImInfo"][0].astype(jnp.float32)  # [N, 3]

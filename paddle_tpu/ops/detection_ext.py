@@ -31,7 +31,7 @@ from jax import lax
 
 from ..framework.registry import register_op
 from ._helpers import op_key
-from .detection import _greedy_nms, _iou_matrix
+from .detection import _greedy_nms, _iou_matrix, _tally
 
 
 def _pack_left(values, mask, fill, cap=None):
@@ -72,12 +72,13 @@ def _encode_boxes(anchors, gts, weights=(1.0, 1.0, 1.0, 1.0)):
 # ---------------------------------------------------------------------------
 
 
-def _anchor_assign(ctx, op, ins, *, pos_thresh, neg_thresh, sample_frac,
-                   batch_size, retina):
-    anchors = ins["Anchor"][0].reshape(-1, 4).astype(jnp.float32)  # [A,4]
-    gt = ins["GtBoxes"][0].astype(jnp.float32)  # [G,4], -1 pad rows
-    is_crowd = ins.get("IsCrowd", [None])[0]
-    im_info = ins.get("ImInfo", [None])[0]
+def _anchor_assign_single(anchors, gt, is_crowd, im_info, key, *, pos_thresh,
+                          neg_thresh, sample_frac, batch_size, retina,
+                          straddle):
+    """One image's anchor->gt assignment (the reference's per-LoD-image
+    walk). anchors [A, 4] shared; gt [G, 4] -1/0-pad rows; key drives the
+    sampling jitter. Returns flat arrays; the op wrappers add the output
+    reshapes (and the leading [B] in the vmapped batched form)."""
     A = anchors.shape[0]
     G = gt.shape[0]
     valid_gt = gt[:, 2] > gt[:, 0]
@@ -87,7 +88,6 @@ def _anchor_assign(ctx, op, ins, *, pos_thresh, neg_thresh, sample_frac,
     # straddle filter (rpn_target_assign_op.cc:99-110): with
     # rpn_straddle_thresh >= 0, anchors not inside the image (within the
     # threshold) are excluded from both fg and bg sampling
-    straddle = op.attr("rpn_straddle_thresh", -1.0)
     inside = jnp.ones((A,), bool)
     if not retina and im_info is not None and straddle >= 0.0:
         info = im_info.reshape(-1)
@@ -114,7 +114,6 @@ def _anchor_assign(ctx, op, ins, *, pos_thresh, neg_thresh, sample_frac,
     fg = (fg | is_best) & inside
     bg = (a_max < neg_thresh) & ~fg & inside
 
-    key = op_key(ctx, op)
     jitter = jax.random.uniform(key, (A,))
     if retina:
         n_fg_cap = batch_size  # all fg used; cap = buffer size
@@ -149,17 +148,71 @@ def _anchor_assign(ctx, op, ins, *, pos_thresh, neg_thresh, sample_frac,
     score_index = _pack_left(idx, both, -1, batch_size)
     labels = jnp.where(fg_sel, 1, 0).astype(jnp.int32)
     tgt_label = _pack_left(labels, both, -1, batch_size)
+    return (loc_index, score_index, tgt_label, tgt_bbox, bbox_w,
+            jnp.maximum(n_fg, 1).astype(jnp.int32))
+
+
+def _anchor_assign(ctx, op, ins, *, pos_thresh, neg_thresh, sample_frac,
+                   batch_size, retina):
+    """Op-facing wrapper: single image (GtBoxes [G, 4]) runs the core
+    directly; the batched form (GtBoxes [B, G, 4], IsCrowd [B, G], ImInfo
+    [B, 3]) vmaps it over images with per-image keys split off the op's
+    stream, every output gaining a leading [B]."""
+    anchors = ins["Anchor"][0].reshape(-1, 4).astype(jnp.float32)  # [A,4]
+    gt = ins["GtBoxes"][0].astype(jnp.float32)  # [(B,) G, 4], pad rows
+    is_crowd = ins.get("IsCrowd", [None])[0]
+    im_info = ins.get("ImInfo", [None])[0]
+    kw = dict(
+        pos_thresh=pos_thresh, neg_thresh=neg_thresh,
+        sample_frac=sample_frac, batch_size=batch_size, retina=retina,
+        straddle=op.attr("rpn_straddle_thresh", -1.0),
+    )
+    op_name = "retinanet_target_assign" if retina else "rpn_target_assign"
+    key = op_key(ctx, op)
+    if gt.ndim == 3:
+        _tally(ctx, op_name, batched=True)
+        B, G = gt.shape[:2]
+        keys = jax.random.split(key, B)
+        # zeros is crowd-free == absent IsCrowd (valid_gt unchanged)
+        crowd = (
+            is_crowd.reshape(B, -1) if is_crowd is not None
+            else jnp.zeros((B, G), jnp.int32)
+        )
+        has_info = im_info is not None
+        info = (
+            im_info.reshape(B, -1) if has_info
+            else jnp.zeros((B, 3), jnp.float32)
+        )
+
+        def one(g, c, i, k):
+            return _anchor_assign_single(
+                anchors, g, c, i if has_info else None, k, **kw
+            )
+
+        loc, score, lbl, tbb, bw, n_fg = jax.vmap(one)(gt, crowd, info, keys)
+        out = {
+            "LocationIndex": [loc],
+            "ScoreIndex": [score],
+            "TargetLabel": [lbl[..., None]],
+            "TargetBBox": [tbb],
+            "BBoxInsideWeight": [bw],
+        }
+        if retina:
+            out["ForegroundNumber"] = [n_fg.reshape(B, 1)]
+        return out
+    _tally(ctx, op_name, batched=False)
+    loc, score, lbl, tbb, bw, n_fg = _anchor_assign_single(
+        anchors, gt, is_crowd, im_info, key, **kw
+    )
     out = {
-        "LocationIndex": [loc_index],
-        "ScoreIndex": [score_index],
-        "TargetLabel": [tgt_label.reshape(-1, 1)],
-        "TargetBBox": [tgt_bbox],
-        "BBoxInsideWeight": [bbox_w],
+        "LocationIndex": [loc],
+        "ScoreIndex": [score],
+        "TargetLabel": [lbl.reshape(-1, 1)],
+        "TargetBBox": [tbb],
+        "BBoxInsideWeight": [bw],
     }
     if retina:
-        out["ForegroundNumber"] = [
-            jnp.maximum(n_fg, 1).astype(jnp.int32).reshape(1, 1)
-        ]
+        out["ForegroundNumber"] = [n_fg.reshape(1, 1)]
     return out
 
 
@@ -211,20 +264,36 @@ def _retinanet_target_assign(ctx, op, ins):
         anchors = ins["Anchor"][0].reshape(-1, 4).astype(jnp.float32)
         gt = ins["GtBoxes"][0].astype(jnp.float32)
         is_crowd = ins.get("IsCrowd", [None])[0]
-        valid_gt = gt[:, 2] > gt[:, 0]
-        if is_crowd is not None:
-            valid_gt = valid_gt & (
-                is_crowd.reshape(-1)[:gt.shape[0]] == 0
+
+        def relabel_one(gt_i, crowd_i, labels_i, si, tl):
+            valid_gt = gt_i[:, 2] > gt_i[:, 0]
+            if crowd_i is not None:
+                valid_gt = valid_gt & (
+                    crowd_i.reshape(-1)[:gt_i.shape[0]] == 0
+                )
+            iou = jnp.where(
+                valid_gt[None, :], _iou_matrix(anchors, gt_i), -1.0
             )
-        iou = jnp.where(valid_gt[None, :], _iou_matrix(anchors, gt), -1.0)
-        a_arg = jnp.argmax(iou, axis=1)
-        cls = gt_labels.reshape(-1).astype(jnp.int32)[a_arg]  # [A]
+            a_arg = jnp.argmax(iou, axis=1)
+            cls = labels_i.reshape(-1).astype(jnp.int32)[a_arg]  # [A]
+            return jnp.where(tl > 0, cls[jnp.maximum(si, 0)], tl)
+
         si = out["ScoreIndex"][0]
-        tl = out["TargetLabel"][0].reshape(-1)
-        relabel = jnp.where(
-            tl > 0, cls[jnp.maximum(si, 0)], tl
-        )
-        out["TargetLabel"] = [relabel.reshape(-1, 1)]
+        if gt.ndim == 3:
+            B, G = gt.shape[:2]
+            crowd = (
+                is_crowd.reshape(B, -1) if is_crowd is not None
+                else jnp.zeros((B, G), jnp.int32)
+            )
+            tl = out["TargetLabel"][0].reshape(B, -1)
+            relabel = jax.vmap(relabel_one)(
+                gt, crowd, gt_labels.reshape(B, -1), si, tl
+            )
+            out["TargetLabel"] = [relabel[..., None]]
+        else:
+            tl = out["TargetLabel"][0].reshape(-1)
+            relabel = relabel_one(gt, is_crowd, gt_labels, si, tl)
+            out["TargetLabel"] = [relabel.reshape(-1, 1)]
     return out
 
 
@@ -233,32 +302,10 @@ def _retinanet_target_assign(ctx, op, ins):
 # ---------------------------------------------------------------------------
 
 
-@register_op(
-    "generate_proposal_labels",
-    inputs=["RpnRois", "GtClasses", "IsCrowd", "GtBoxes", "ImInfo",
-            "RpnRoisNum"],
-    outputs=["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
-             "BboxOutsideWeights", "RoisNum", "MaxOverlapWithGT"],
-    differentiable=False,
-)
-def _generate_proposal_labels(ctx, op, ins):
-    """generate_proposal_labels_op.cc (single image): append gts to the
-    proposal set, sample batch_size_per_im rois (fg_fraction at
-    fg_thresh, rest bg in [bg_thresh_lo, bg_thresh_hi)), emit class labels
-    and per-class box regression targets. Output size is exactly
-    batch_size_per_im; RoisNum counts the live rows."""
-    rois = ins["RpnRois"][0].reshape(-1, 4).astype(jnp.float32)
-    gt_cls = ins["GtClasses"][0].reshape(-1).astype(jnp.int32)
-    gt = ins["GtBoxes"][0].astype(jnp.float32)
-    is_crowd = ins.get("IsCrowd", [None])[0]
-    B = int(op.attr("batch_size_per_im", 512))
-    fg_frac = op.attr("fg_fraction", 0.25)
-    fg_thresh = op.attr("fg_thresh", 0.5)
-    bg_hi = op.attr("bg_thresh_hi", 0.5)
-    bg_lo = op.attr("bg_thresh_lo", 0.0)
-    num_classes = int(op.attr("class_nums", 81))
-    bbox_w = op.attr("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
-
+def _proposal_labels_single(rois, gt_cls, is_crowd, gt, key, *, B, fg_frac,
+                            fg_thresh, bg_hi, bg_lo, num_classes, bbox_w):
+    """One image's proposal->label sampling. rois [R, 4] (padded rows are
+    degenerate boxes and score as invalid), gt [G, 4], gt_cls [G]."""
     valid_gt = gt[:, 2] > gt[:, 0]
     if is_crowd is not None:
         valid_gt = valid_gt & (is_crowd.reshape(-1)[:gt.shape[0]] == 0)
@@ -277,7 +324,6 @@ def _generate_proposal_labels(ctx, op, ins):
     fg = max_iou >= fg_thresh
     bg = (max_iou < bg_hi) & (max_iou >= bg_lo) & roi_valid
 
-    key = op_key(ctx, op)
     jitter = jax.random.uniform(key, (R,))
     fg_cap = int(B * fg_frac)
     fg_rank = jnp.argsort(-(fg.astype(jnp.float32) + jitter))
@@ -315,38 +361,93 @@ def _generate_proposal_labels(ctx, op, ins):
     targets = (one_hot[:, :, None] * tgt_packed[:, None, :] * fg_row)
     inside_w = (one_hot[:, :, None] * fg_row) * jnp.ones((1, 1, 4))
     n_live = both.sum().astype(jnp.int32)
+    return (out_rois, out_labels, targets.reshape(B, num_classes * 4),
+            inside_w.reshape(B, num_classes * 4), n_live, max_ov)
+
+
+@register_op(
+    "generate_proposal_labels",
+    inputs=["RpnRois", "GtClasses", "IsCrowd", "GtBoxes", "ImInfo",
+            "RpnRoisNum"],
+    outputs=["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+             "BboxOutsideWeights", "RoisNum", "MaxOverlapWithGT"],
+    differentiable=False,
+)
+def _generate_proposal_labels(ctx, op, ins):
+    """generate_proposal_labels_op.cc: append gts to the proposal set,
+    sample batch_size_per_im rois (fg_fraction at fg_thresh, rest bg in
+    [bg_thresh_lo, bg_thresh_hi)), emit class labels and per-class box
+    regression targets. Output size is exactly batch_size_per_im (the
+    per-image RoI cap); RoisNum counts the live rows.
+
+    Batched contract (r6): RpnRois [B, R, 4] + GtBoxes [B, G, 4] (+
+    GtClasses/IsCrowd [B, G], ImInfo [B, 3]) vmaps the single-image core
+    with per-image keys -> every output gains a leading [B], RoisNum is
+    [B]. RpnRoisNum is accepted but unused either way: padded proposal
+    rows are degenerate (0-area) boxes that never sample as fg or bg."""
+    rois = ins["RpnRois"][0].astype(jnp.float32)
+    gt_cls = ins["GtClasses"][0].astype(jnp.int32)
+    gt = ins["GtBoxes"][0].astype(jnp.float32)
+    is_crowd = ins.get("IsCrowd", [None])[0]
+    kw = dict(
+        B=int(op.attr("batch_size_per_im", 512)),
+        fg_frac=op.attr("fg_fraction", 0.25),
+        fg_thresh=op.attr("fg_thresh", 0.5),
+        bg_hi=op.attr("bg_thresh_hi", 0.5),
+        bg_lo=op.attr("bg_thresh_lo", 0.0),
+        num_classes=int(op.attr("class_nums", 81)),
+        bbox_w=op.attr("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2]),
+    )
+    key = op_key(ctx, op)
+    cap = kw["B"]
+    if gt.ndim == 3:
+        _tally(ctx, "generate_proposal_labels", batched=True)
+        Bimg, G = gt.shape[:2]
+        keys = jax.random.split(key, Bimg)
+        crowd = (
+            is_crowd.reshape(Bimg, -1) if is_crowd is not None
+            else jnp.zeros((Bimg, G), jnp.int32)
+        )
+
+        def one(r, gc, c, g, k):
+            return _proposal_labels_single(
+                r.reshape(-1, 4), gc.reshape(-1), c, g, k, **kw
+            )
+
+        (out_rois, out_labels, targets, inside_w, n_live,
+         max_ov) = jax.vmap(one)(
+            rois, gt_cls.reshape(Bimg, -1), crowd, gt, keys
+        )
+        return {
+            "Rois": [out_rois],
+            "LabelsInt32": [out_labels[..., None]],
+            "BboxTargets": [targets],
+            "BboxInsideWeights": [inside_w],
+            "BboxOutsideWeights": [inside_w],
+            "RoisNum": [n_live],
+            "MaxOverlapWithGT": [max_ov[..., None]],
+        }
+    _tally(ctx, "generate_proposal_labels", batched=False)
+    out_rois, out_labels, targets, inside_w, n_live, max_ov = (
+        _proposal_labels_single(
+            rois.reshape(-1, 4), gt_cls.reshape(-1), is_crowd, gt, key, **kw
+        )
+    )
     return {
         "Rois": [out_rois],
         "LabelsInt32": [out_labels.reshape(-1, 1)],
-        "BboxTargets": [targets.reshape(B, num_classes * 4)],
-        "BboxInsideWeights": [inside_w.reshape(B, num_classes * 4)],
-        "BboxOutsideWeights": [inside_w.reshape(B, num_classes * 4)],
+        "BboxTargets": [targets],
+        "BboxInsideWeights": [inside_w],
+        "BboxOutsideWeights": [inside_w],
         "RoisNum": [n_live.reshape(1)],
         "MaxOverlapWithGT": [max_ov.reshape(-1, 1)],
     }
 
 
-@register_op(
-    "generate_mask_labels",
-    inputs=["ImInfo", "GtClasses", "IsCrowd", "GtSegms", "Rois",
-            "LabelsInt32"],
-    outputs=["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
-    differentiable=False,
-)
-def _generate_mask_labels(ctx, op, ins):
-    """generate_mask_labels_op.cc with a dense-mask contract: GtSegms is
-    [G, Hs, Ws] binary bitmaps in image coordinates (the reference takes
-    LoD polygon lists and rasterizes them on the CPU with mask_util.cc;
-    rasterization is the data pipeline's job in this framework). Each fg
-    roi crops its matched gt's bitmap and resizes to resolution^2; the
-    target lands in the roi's class slot, all other class slots are -1
-    (ignored by sigmoid mask loss)."""
-    gt_cls = ins["GtClasses"][0].reshape(-1).astype(jnp.int32)
-    segms = ins["GtSegms"][0].astype(jnp.float32)  # [G, Hs, Ws]
-    rois = ins["Rois"][0].reshape(-1, 4).astype(jnp.float32)
-    labels = ins["LabelsInt32"][0].reshape(-1).astype(jnp.int32)
-    M = int(op.attr("resolution", 14))
-    num_classes = int(op.attr("num_classes", 81))
+def _mask_labels_single(gt_cls, segms, rois, labels, M, num_classes):
+    """One image's mask-target generation: segms [G, Hs, Ws], rois [R, 4],
+    labels [R] -> (mask_rois [R, 4], has_mask [R], mask_int32
+    [R, num_classes*M*M])."""
     G, Hs, Ws = segms.shape
     R = rois.shape[0]
 
@@ -389,10 +490,60 @@ def _generate_mask_labels(ctx, op, ins):
     )
     tgt = jnp.where(fg[:, None, None], tgt, -1.0)
     mask_rois = jnp.where(fg[:, None], rois, 0.0)
+    return (mask_rois, fg.astype(jnp.int32),
+            tgt.reshape(R, num_classes * M * M).astype(jnp.int32))
+
+
+@register_op(
+    "generate_mask_labels",
+    inputs=["ImInfo", "GtClasses", "IsCrowd", "GtSegms", "Rois",
+            "LabelsInt32"],
+    outputs=["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+    differentiable=False,
+)
+def _generate_mask_labels(ctx, op, ins):
+    """generate_mask_labels_op.cc with a dense-mask contract: GtSegms is
+    [G, Hs, Ws] binary bitmaps in image coordinates (the reference takes
+    LoD polygon lists and rasterizes them on the CPU with mask_util.cc;
+    rasterization is the data pipeline's job in this framework). Each fg
+    roi crops its matched gt's bitmap and resizes to resolution^2; the
+    target lands in the roi's class slot, all other class slots are -1
+    (ignored by sigmoid mask loss).
+
+    Batched contract (r6): GtSegms [B, G, Hs, Ws] with Rois [B, R, 4],
+    LabelsInt32 [B, R(, 1)], GtClasses [B, G] vmaps the (RNG-free) core
+    over images -> MaskRois [B, R, 4], RoiHasMaskInt32 [B, R, 1],
+    MaskInt32 [B, R, num_classes*resolution^2]."""
+    M = int(op.attr("resolution", 14))
+    num_classes = int(op.attr("num_classes", 81))
+    segms = ins["GtSegms"][0].astype(jnp.float32)
+    if segms.ndim == 4:
+        _tally(ctx, "generate_mask_labels", batched=True)
+        B = segms.shape[0]
+        gt_cls = ins["GtClasses"][0].reshape(B, -1).astype(jnp.int32)
+        rois = ins["Rois"][0].reshape(B, -1, 4).astype(jnp.float32)
+        labels = ins["LabelsInt32"][0].reshape(B, -1).astype(jnp.int32)
+        mask_rois, has_mask, tgt = jax.vmap(
+            lambda gc, sg, r, lb: _mask_labels_single(
+                gc, sg, r, lb, M, num_classes
+            )
+        )(gt_cls, segms, rois, labels)
+        return {
+            "MaskRois": [mask_rois],
+            "RoiHasMaskInt32": [has_mask[..., None]],
+            "MaskInt32": [tgt],
+        }
+    _tally(ctx, "generate_mask_labels", batched=False)
+    gt_cls = ins["GtClasses"][0].reshape(-1).astype(jnp.int32)
+    rois = ins["Rois"][0].reshape(-1, 4).astype(jnp.float32)
+    labels = ins["LabelsInt32"][0].reshape(-1).astype(jnp.int32)
+    mask_rois, has_mask, tgt = _mask_labels_single(
+        gt_cls, segms, rois, labels, M, num_classes
+    )
     return {
         "MaskRois": [mask_rois],
-        "RoiHasMaskInt32": [fg.astype(jnp.int32).reshape(-1, 1)],
-        "MaskInt32": [tgt.reshape(R, num_classes * M * M).astype(jnp.int32)],
+        "RoiHasMaskInt32": [has_mask.reshape(-1, 1)],
+        "MaskInt32": [tgt],
     }
 
 
@@ -401,24 +552,10 @@ def _generate_mask_labels(ctx, op, ins):
 # ---------------------------------------------------------------------------
 
 
-@register_op(
-    "distribute_fpn_proposals",
-    inputs=["FpnRois", "RoisNum"],
-    outputs=["MultiFpnRois", "RestoreIndex", "MultiLevelRoIsNum"],
-    differentiable=False,
-)
-def _distribute_fpn_proposals(ctx, op, ins):
-    """distribute_fpn_proposals_op.cc: level(roi) = floor(level0 +
-    log2(sqrt(area) / refer_scale + eps)) clamped to [min, max]. Each
-    level's output is the full-size buffer left-packed (zero padding) with
-    its live count in MultiLevelRoIsNum; RestoreIndex maps the level-major
-    concat order back to the input order."""
-    rois = ins["FpnRois"][0].reshape(-1, 4).astype(jnp.float32)
+def _distribute_single(rois, min_level, max_level, refer_level, refer_scale):
+    """One image's FPN roi routing: rois [R, 4] -> (per-level packed list
+    L x [R, 4], nums [L], restore [R])."""
     R = rois.shape[0]
-    min_level = int(op.attr("min_level", 2))
-    max_level = int(op.attr("max_level", 5))
-    refer_level = int(op.attr("refer_level", 4))
-    refer_scale = float(op.attr("refer_scale", 224))
     L = max_level - min_level + 1
 
     w = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
@@ -435,7 +572,7 @@ def _distribute_fpn_proposals(ctx, op, ins):
     for lev in range(min_level, max_level + 1):
         m = live & (lvl == lev)
         multi.append(_pack_left(rois, m, 0.0, R))
-        nums.append(m.sum().astype(jnp.int32).reshape(1))
+        nums.append(m.sum().astype(jnp.int32))
         orders.append(_pack_left(idx, m, -1, R))
     # RestoreIndex: position in the level-major packed concat for each
     # input roi (reference restore semantics: out[restore[i]] = in[i])
@@ -449,11 +586,73 @@ def _distribute_fpn_proposals(ctx, op, ins):
     restore = jnp.full((R + 1,), -1, jnp.int32).at[
         jnp.where(live_slot, concat_src, R)
     ].set(jnp.where(live_slot, slots, -1))[:R]
+    return multi, jnp.stack(nums), restore
+
+
+@register_op(
+    "distribute_fpn_proposals",
+    inputs=["FpnRois", "RoisNum"],
+    outputs=["MultiFpnRois", "RestoreIndex", "MultiLevelRoIsNum"],
+    differentiable=False,
+)
+def _distribute_fpn_proposals(ctx, op, ins):
+    """distribute_fpn_proposals_op.cc: level(roi) = floor(level0 +
+    log2(sqrt(area) / refer_scale + eps)) clamped to [min, max]. Each
+    level's output is the full-size buffer left-packed (zero padding) with
+    its live count in MultiLevelRoIsNum; RestoreIndex maps the level-major
+    concat order back to the input order.
+
+    Batched contract (r6): FpnRois [B, R, 4] packs PER IMAGE ->
+    MultiFpnRois each [B, R, 4], RestoreIndex [B, R, 1] (row in image b's
+    own level-major concat), MultiLevelRoIsNum each [B]."""
+    min_level = int(op.attr("min_level", 2))
+    max_level = int(op.attr("max_level", 5))
+    refer_level = int(op.attr("refer_level", 4))
+    refer_scale = float(op.attr("refer_scale", 224))
+    rois = ins["FpnRois"][0].astype(jnp.float32)
+    if rois.ndim == 3:
+        _tally(ctx, "distribute_fpn_proposals", batched=True)
+        multi, nums, restore = jax.vmap(
+            lambda r: _distribute_single(
+                r, min_level, max_level, refer_level, refer_scale
+            )
+        )(rois)  # L x [B, R, 4], [B, L], [B, R]
+        return {
+            "MultiFpnRois": multi,
+            "RestoreIndex": [restore[..., None]],
+            "MultiLevelRoIsNum": [nums[:, i] for i in range(nums.shape[1])],
+        }
+    _tally(ctx, "distribute_fpn_proposals", batched=False)
+    multi, nums, restore = _distribute_single(
+        rois.reshape(-1, 4), min_level, max_level, refer_level, refer_scale
+    )
     return {
         "MultiFpnRois": multi,
         "RestoreIndex": [restore.reshape(-1, 1)],
-        "MultiLevelRoIsNum": nums,
+        "MultiLevelRoIsNum": [nums[i].reshape(1) for i in range(nums.shape[0])],
     }
+
+
+def _collect_single(rois_list, scores_list, nums_list, topn):
+    """One image's FPN roi collection: per-level rois [k, 4] / scores [k]
+    (+ optional live counts) -> (out [topn, 4], n)."""
+    rois = jnp.concatenate([r.reshape(-1, 4) for r in rois_list], axis=0)
+    scores = jnp.concatenate([s.reshape(-1) for s in scores_list], axis=0)
+    if nums_list is not None:
+        # zero out padded rows beyond each level's live count
+        offs = []
+        for r, n in zip(rois_list, nums_list):
+            k = r.reshape(-1, 4).shape[0]
+            offs.append(jnp.arange(k) < n.reshape(()))
+        livem = jnp.concatenate(offs)
+    else:
+        livem = (rois[:, 2] > rois[:, 0])
+    scores = jnp.where(livem, scores, -jnp.inf)
+    topn = min(topn, rois.shape[0])
+    top_s, top_i = lax.top_k(scores, topn)
+    out = jnp.where((top_s > -jnp.inf)[:, None], rois[top_i], 0.0)
+    n = jnp.sum(top_s > -jnp.inf).astype(jnp.int32)
+    return out, n
 
 
 @register_op(
@@ -464,29 +663,38 @@ def _distribute_fpn_proposals(ctx, op, ins):
 )
 def _collect_fpn_proposals(ctx, op, ins):
     """collect_fpn_proposals_op.cc: concat per-level (roi, score) sets and
-    keep the global post_nms_topN by score."""
-    rois = jnp.concatenate(
-        [r.reshape(-1, 4) for r in ins["MultiLevelRois"]], axis=0
-    )
-    scores = jnp.concatenate(
-        [s.reshape(-1) for s in ins["MultiLevelScores"]], axis=0
-    )
+    keep the global post_nms_topN by score — per image. Batched contract
+    (r6): per-level rois [B, k, 4] / scores [B, k(, 1)] / counts [B]
+    (exactly what batched generate_proposals emits) -> FpnRois
+    [B, topn, 4], RoisNum [B]."""
+    topn = int(op.attr("post_nms_topN", 1000))
+    rois_list = ins["MultiLevelRois"]
     nums = ins.get("MultiLevelRoIsNum", [])
-    if nums and nums[0] is not None:
-        # zero out padded rows beyond each level's live count
-        offs = []
-        for r, n in zip(ins["MultiLevelRois"], nums):
-            k = r.reshape(-1, 4).shape[0]
-            offs.append(jnp.arange(k) < n.reshape(()))
-        livem = jnp.concatenate(offs)
-        scores = jnp.where(livem, scores, -jnp.inf)
-    else:
-        livem = (rois[:, 2] > rois[:, 0])
-        scores = jnp.where(livem, scores, -jnp.inf)
-    topn = min(int(op.attr("post_nms_topN", 1000)), rois.shape[0])
-    top_s, top_i = lax.top_k(scores, topn)
-    out = jnp.where((top_s > -jnp.inf)[:, None], rois[top_i], 0.0)
-    n = jnp.sum(top_s > -jnp.inf).astype(jnp.int32)
+    nums_list = list(nums) if (nums and nums[0] is not None) else None
+    if rois_list[0].ndim == 3:
+        _tally(ctx, "collect_fpn_proposals", batched=True)
+        B = rois_list[0].shape[0]
+        scores_list = [s.reshape(B, -1) for s in ins["MultiLevelScores"]]
+
+        def one(rl, sl, nl):
+            return _collect_single(
+                rl, sl, nl if nums_list is not None else None, topn
+            )
+
+        out, n = jax.vmap(one)(
+            [r.reshape(B, -1, 4) for r in rois_list],
+            scores_list,
+            (
+                [n.reshape(B) for n in nums_list]
+                if nums_list is not None
+                else [jnp.zeros((B,), jnp.int32) for _ in rois_list]
+            ),
+        )
+        return {"FpnRois": [out], "RoisNum": [n]}
+    _tally(ctx, "collect_fpn_proposals", batched=False)
+    out, n = _collect_single(
+        rois_list, ins["MultiLevelScores"], nums_list, topn
+    )
     return {"FpnRois": [out], "RoisNum": [n.reshape(1)]}
 
 

@@ -1,0 +1,49 @@
+"""Runtime detection-padding observability.
+
+The batched detection ops keep shapes static with fixed per-image RoI
+caps + validity masks (ops/detection.py, ops/detection_ext.py), so the
+interesting runtime quantity is how much of the cap is DEAD padding —
+compute spent on masked-out slots. Live counts (``RoisNum`` outputs)
+only exist on the device inside the jit trace, so recording happens
+host-side on fetched values: bench legs and tests fetch the counts and
+call :func:`record_roi_stats`.
+
+Exports through the existing observability registry (visible in
+``tools/stats_report.py``):
+
+* ``detection.rois_per_image`` histogram — live rois per image,
+  count-valued buckets;
+* ``detection.padding_waste`` gauge — fraction of RoI-cap slots that
+  were masked out in the latest recorded batch (0 = cap fully used);
+* ``detection.roi_batches_recorded`` counter.
+
+Trace-time op counters (``detection.<op>.instantiations`` /
+``.batched_instantiations``) live in ops/detection.py `_tally`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..observability import metrics
+
+# count-valued bucket edges (rois per image), not latencies
+ROI_COUNT_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def record_roi_stats(rois_num, cap):
+    """Record live-roi counts for one batch: ``rois_num`` is the fetched
+    per-image live count vector (any array-like, e.g. the ``RoisNum``
+    output of batched generate_proposal_labels), ``cap`` the static
+    per-image RoI cap those counts are padded to. Returns the
+    padding-waste fraction recorded to the gauge."""
+    arr = np.asarray(rois_num).reshape(-1).astype(np.int64)
+    for n in arr:
+        metrics.observe(
+            "detection.rois_per_image", float(n), buckets=ROI_COUNT_BUCKETS
+        )
+    total = int(cap) * max(len(arr), 1)
+    waste = 1.0 - float(arr.sum()) / total if total else 0.0
+    metrics.set_gauge("detection.padding_waste", waste)
+    metrics.add("detection.roi_batches_recorded")
+    return waste

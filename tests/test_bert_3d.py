@@ -109,6 +109,8 @@ def test_uniform_3d_structure():
     assert "word_embedding" not in stacked
 
 
+@pytest.mark.slow  # ~42s on the CI CPU (heaviest tier-1 case after the
+# PR-5 marks); ci.sh's unfiltered pytest still runs it
 def test_blocks_pipeline_composes_amp_recompute_dp():
     """Reference-parity heterogeneous pipeline (device_guard stages) also
     stacks with AMP + recompute + dp in hybrid mode (no mp — lax.switch

@@ -170,6 +170,7 @@ def test_yolov3_trains_on_toy_boxes():
     assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
 
 
+@pytest.mark.slow  # ~24s on the CI CPU; ci.sh's unfiltered pytest runs it
 def test_yolov3_infer_decodes_boxes():
     cfg = YoloConfig.tiny(class_num=3)
     N, S = 1, 64
