@@ -47,7 +47,6 @@ ROUND3_GPT2048_TOK_S = 50787.0
 # r5 Mask R-CNN: AMP bf16 + dynamic loss scaling, 4x1-image unroll
 # (BASELINE.md r5 table) — denominator for the r6 batched leg
 ROUND5_MASK_RCNN_IMG_S = 20.99
-V5E_BF16_PEAK = 197e12
 
 
 def _amp(opt):
@@ -83,17 +82,79 @@ def _timed_loop(exe, prog, scope, batches, loss, n_steps, rounds):
 
 
 def _mfu_fields(per_step_flops, best_dt, n_steps, on_accel):
+    # the SAME configurable peak the live perf.mfu gauge divides by
+    # (PADDLE_TPU_PEAK_TFLOPS, default v5e bf16), so offline and live MFU
+    # agree by construction
+    from paddle_tpu.analysis.cost import peak_flops
+
     achieved = per_step_flops * n_steps / best_dt
     return {
         "tflops": round(achieved / 1e12, 1),
         "mfu_vs_v5e_bf16_peak": (
-            round(achieved / V5E_BF16_PEAK, 3) if on_accel else None
+            round(achieved / peak_flops(), 3) if on_accel else None
         ),
+        # the denominator actually used: when PADDLE_TPU_PEAK_TFLOPS
+        # overrides the v5e default the key above keeps its historical
+        # name but this field keeps the artifact honest
+        "mfu_peak_tflops": round(peak_flops() / 1e12, 1),
     }
 
 
 def _samples(unit_count, dts):
     return [round(unit_count / dt, 1) for dt in dts]
+
+
+def _estimated_step_flops(prog, feed, legacy=None, legacy_name=None,
+                          xla_flops=None):
+    """Per-step FLOPs from the IR cost model (`Program.estimate`), plus a
+    one-time cross-check block against the retired hand-coded closed form
+    (r1-r6 bench methodology) and/or XLA's own cost_analysis. >20%
+    divergence from the legacy formula is loud on stderr — that formula
+    anchored every per-round MFU comparison, so a silent drift would
+    rewrite history."""
+    est = prog.estimate(
+        feed_shapes={k: tuple(np.asarray(v).shape) for k, v in feed.items()}
+    )
+    fields = {"estimated_step_tflops": round(est.total_flops / 1e12, 6)}
+    if legacy:
+        div = abs(est.total_flops - legacy) / legacy
+        fields["legacy_formula_tflops"] = round(legacy / 1e12, 6)
+        fields["divergence_vs_legacy"] = round(div, 3)
+        if div > 0.20:
+            print(
+                f"WARNING: cost-model step FLOPs diverge "
+                f"{div:.0%} from the retired {legacy_name or 'closed-form'} "
+                f"formula ({est.total_flops / 1e12:.4f} vs "
+                f"{legacy / 1e12:.4f} TFLOP/step)",
+                file=sys.stderr,
+            )
+    if xla_flops:
+        fields["xla_step_tflops"] = round(xla_flops / 1e12, 6)
+        fields["divergence_vs_xla"] = round(
+            abs(est.total_flops - xla_flops) / xla_flops, 3
+        )
+    return est.total_flops, fields
+
+
+def _perf_gauge_fields(est_step_flops, best_dt, n_steps, on_accel):
+    """Live perf.* gauges after a timed loop: the executor-side MFU must
+    agree with the offline per-leg number (acceptance: within 2 points).
+    Both sides of the delta use the SAME cost-model numerator
+    (est_step_flops), so the delta measures only timing skew (gauge's
+    mean steady-state window vs offline best-of-N) — never
+    estimate-vs-XLA divergence, which flops_model reports separately.
+    The executor drops stale perf gauges on every compile-carrying run,
+    so the gauge read here is this leg's own."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.analysis.cost import peak_flops
+
+    gauges = obs.snapshot()["gauges"]
+    mfu = gauges.get("perf.mfu")
+    out = {"perf_mfu_gauge": None if mfu is None else round(mfu, 4)}
+    if mfu is not None and on_accel:
+        offline = est_step_flops * n_steps / best_dt / peak_flops()
+        out["perf_mfu_gauge_delta"] = round(mfu - offline, 4)
+    return out
 
 
 def bench_bert(on_accel):
@@ -156,12 +217,16 @@ def bench_bert(on_accel):
     tokens_per_sec = n_steps * b * s / dt
 
     h, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
-    # fwd matmul flops/token: L*(qkv 6h^2 + attn-out 2h^2 + ffn 16h^2 +
-    # attention 4sh) + MLM head 2hV * (P masked rows / B*s tokens);
-    # training ~= 3x fwd
-    flops_per_token = 3 * (
+    # retired r1-r6 closed form, kept as the cross-check: fwd matmul
+    # flops/token L*(qkv 6h^2 + attn-out 2h^2 + ffn 16h^2 + attention
+    # 4sh) + MLM head 2hV * (P/B*s); training ~= 3x fwd
+    legacy = 3 * (
         L * (24 * h * h + 4 * s * h) + 2 * h * V * P / (b * s)
+    ) * b * s
+    step_flops, flops_model = _estimated_step_flops(
+        main_prog, batches[0], legacy=legacy, legacy_name="transformer"
     )
+    mfu = _mfu_fields(step_flops, dt, n_steps, on_accel)
     return {
         "metric": ("bert_base_mlm_train_tokens_per_sec" if on_accel
                    else "bert_tiny_mlm_train_tokens_per_sec_cpu"),
@@ -172,7 +237,9 @@ def bench_bert(on_accel):
         "config": {"batch": b, "seq": s, "amp": bool(on_accel),
                    "mask_pos": P},
         "samples": _samples(n_steps * b * s, dts),
-        **_mfu_fields(flops_per_token * b * s, dt, n_steps, on_accel),
+        **mfu,
+        "flops_model": flops_model,
+        **_perf_gauge_fields(step_flops, dt, n_steps, on_accel),
         "final_loss": round(final_loss, 4),
     }
 
@@ -222,6 +289,10 @@ def bench_resnet(on_accel):
         exe, main_prog, scope, batches, loss, n_steps, 3 if on_accel else 1
     )
     img_s = n_steps * b / dt
+    est_flops, flops_model = _estimated_step_flops(
+        main_prog, batches[0], xla_flops=step_flops
+    )
+    mfu = _mfu_fields(step_flops, dt, n_steps, on_accel)
     return {
         "metric": "resnet50_train_images_per_sec" if on_accel
         else "resnet18_tiny_train_images_per_sec_cpu",
@@ -232,7 +303,9 @@ def bench_resnet(on_accel):
         "config": {"batch": b, "size": hw, "depth": depth,
                    "amp": bool(on_accel)},
         "samples": _samples(n_steps * b, dts),
-        **_mfu_fields(step_flops, dt, n_steps, on_accel),
+        **mfu,
+        "flops_model": flops_model,
+        **_perf_gauge_fields(est_flops, dt, n_steps, on_accel),
         "final_loss": round(final_loss, 4),
     }
 
@@ -290,6 +363,10 @@ def bench_yolov3(on_accel):
         exe, main_prog, scope, batches, loss, n_steps, 3 if on_accel else 1
     )
     img_s = n_steps * b / dt
+    est_flops, flops_model = _estimated_step_flops(
+        main_prog, batches[0], xla_flops=step_flops
+    )
+    mfu = _mfu_fields(step_flops, dt, n_steps, on_accel)
     return {
         "metric": "yolov3_half_train_images_per_sec" if on_accel
         else "yolov3_tiny_train_images_per_sec_cpu",
@@ -302,7 +379,9 @@ def bench_yolov3(on_accel):
         "config": {"batch": b, "size": hw, "scale": cfg.scale,
                    "amp": bool(on_accel)},
         "samples": _samples(n_steps * b, dts),
-        **_mfu_fields(step_flops, dt, n_steps, on_accel),
+        **mfu,
+        "flops_model": flops_model,
+        **_perf_gauge_fields(est_flops, dt, n_steps, on_accel),
         "final_loss": round(final_loss, 4),
     }
 
@@ -354,9 +433,14 @@ def bench_gpt_longctx(on_accel, seq_len=2048, batch=4):
     )
     tok_s = n_steps * b * s / dt
     h, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
-    # causal attention: s/2 useful key positions per token (standard MFU
-    # convention; the kernel's dead-tile skip makes hardware work track it)
-    flops_per_token = 3 * (L * (24 * h * h + 4 * (s // 2) * h) + 2 * h * V)
+    # retired closed form (cross-check): causal attention counts s/2
+    # useful key positions per token (standard MFU convention; the
+    # kernel's dead-tile skip makes hardware work track it)
+    legacy = 3 * (L * (24 * h * h + 4 * (s // 2) * h) + 2 * h * V) * b * s
+    step_flops, flops_model = _estimated_step_flops(
+        main_prog, batches[0], legacy=legacy, legacy_name="causal GPT"
+    )
+    mfu = _mfu_fields(step_flops, dt, n_steps, on_accel)
     vs = (round(tok_s / ROUND3_GPT2048_TOK_S, 3)
           if (on_accel and seq_len == 2048) else None)
     return {
@@ -369,7 +453,9 @@ def bench_gpt_longctx(on_accel, seq_len=2048, batch=4):
                    "attention": "flash_tiled (S beyond whole-row cap)"
                    if on_accel else "whole-row"},
         "samples": _samples(n_steps * b * s, dts),
-        **_mfu_fields(flops_per_token * b * s, dt, n_steps, on_accel),
+        **mfu,
+        "flops_model": flops_model,
+        **_perf_gauge_fields(step_flops, dt, n_steps, on_accel),
         "final_loss": round(final_loss, 4),
     }
 
@@ -421,6 +507,10 @@ def bench_deepfm(on_accel):
         exe, main_prog, scope, batches, loss, n_steps, 3 if on_accel else 1
     )
     ex_s = n_steps * b / dt
+    est_flops, flops_model = _estimated_step_flops(
+        main_prog, batches[0], xla_flops=step_flops
+    )
+    mfu = _mfu_fields(step_flops, dt, n_steps, on_accel)
     return {
         "metric": "deepfm_criteo_train_examples_per_sec" if on_accel
         else "deepfm_tiny_train_examples_per_sec_cpu",
@@ -432,7 +522,9 @@ def bench_deepfm(on_accel):
                    "dense": cfg.dense_dim, "vocab": cfg.vocab_size,
                    "mlp": list(cfg.mlp_sizes)},
         "samples": _samples(n_steps * b, dts),
-        **_mfu_fields(step_flops, dt, n_steps, on_accel),
+        **mfu,
+        "flops_model": flops_model,
+        **_perf_gauge_fields(est_flops, dt, n_steps, on_accel),
         "final_loss": round(final_loss, 4),
     }
 
@@ -660,6 +752,10 @@ def bench_mask_rcnn(on_accel):
         exe, main_prog, scope, [feed], loss, n_steps, 3 if on_accel else 1
     )
     img_s = n_steps * B / dt
+    est_flops, flops_model = _estimated_step_flops(
+        main_prog, feed, xla_flops=step_flops
+    )
+    mfu = _mfu_fields(step_flops, dt, n_steps, on_accel)
     return {
         "metric": "mask_rcnn_half_train_images_per_sec" if on_accel
         else "mask_rcnn_tiny_train_images_per_sec_cpu",
@@ -687,7 +783,9 @@ def bench_mask_rcnn(on_accel):
         },
         "padding_waste": round(padding_waste, 3),
         "samples": _samples(n_steps * B, dts),
-        **_mfu_fields(step_flops, dt, n_steps, on_accel),
+        **mfu,
+        "flops_model": flops_model,
+        **_perf_gauge_fields(est_flops, dt, n_steps, on_accel),
         "final_loss": round(final_loss, 4),
     }
 

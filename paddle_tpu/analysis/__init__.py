@@ -48,6 +48,14 @@ from .findings import (  # noqa: F401
     Severity,
 )
 from .collectives import analyze_collectives, collective_axis  # noqa: F401
+from .cost import (  # noqa: F401
+    CostTable,
+    OpCost,
+    estimate_program,
+    family_of,
+    op_cost,
+    peak_flops,
+)
 from .shapes import analyze_shapes  # noqa: F401
 from .structural import analyze_structural  # noqa: F401
 from .verify import (  # noqa: F401

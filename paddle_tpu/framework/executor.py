@@ -37,7 +37,8 @@ from .scope import global_scope
 
 
 class _Compiled:
-    __slots__ = ("fn", "state_ro", "state_mut", "fetch_names", "nan_ops")
+    __slots__ = ("fn", "state_ro", "state_mut", "fetch_names", "nan_ops",
+                 "est", "recent_dts")
 
     def __init__(self, fn, state_ro, state_mut, fetch_names, nan_ops=None):
         self.fn = fn
@@ -47,6 +48,30 @@ class _Compiled:
         # ops list compiled with per-op NaN/Inf checks (FLAGS_check_nan_inf);
         # the extra trailing fetch indexes into this to name the offender
         self.nan_ops = nan_ops
+        # analytic cost estimate for this executable (perf.* telemetry):
+        # None = not yet computed, False = estimation failed (never retried)
+        self.est = None
+        # steady-state step latencies (compile-carrying runs excluded) —
+        # the window behind the live perf.mfu gauge
+        self.recent_dts = None
+
+
+class _PerfEstimate:
+    """Digest of a CostTable cached per executable for the per-run
+    perf.* updates (the full table is published once, to the
+    "perf.cost_table" observability table)."""
+
+    __slots__ = ("flops", "bytes", "peak", "family_shares")
+
+    def __init__(self, table):
+        self.flops = float(table.total_flops)
+        self.bytes = float(table.total_bytes)
+        self.peak = float(table.peak_flops)
+        total_lat = table.total_latency
+        self.family_shares = {
+            fam: (agg["latency"] / total_lat if total_lat else 0.0)
+            for fam, agg in table.by_family().items()
+        }
 
 
 def _analyze_block(block, feed_names, fetch_names):
@@ -92,9 +117,12 @@ class Executor:
 
         self.place = place if place is not None else default_place()
         self._cache = OrderedDict()
+        self._last_run = None  # (compiled, fresh_compile) of the last run
+        self._est_memo = {}  # cache key -> _PerfEstimate | False
 
     def close(self):
         self._cache.clear()
+        self._est_memo.clear()
 
     # ------------------------------------------------------------------
     def run(
@@ -106,14 +134,71 @@ class Executor:
         return_numpy=True,
         use_program_cache=True,
     ):
+        import time
+
         from .. import observability as _obs
 
         _obs.add("executor.run_steps")
-        with _obs.timed("executor.step_latency"), _obs.span("executor.step"):
-            return self._run_body(
-                program, feed, fetch_list, scope, return_numpy,
-                use_program_cache,
-            )
+        self._last_run = None
+        with _obs.span("executor.step"):
+            t0 = time.perf_counter()
+            try:
+                result = self._run_body(
+                    program, feed, fetch_list, scope, return_numpy,
+                    use_program_cache,
+                )
+            finally:
+                _obs.observe(
+                    "executor.step_latency", time.perf_counter() - t0
+                )
+        self._note_perf(time.perf_counter() - t0)
+        return result
+
+    @staticmethod
+    def _drop_perf_gauges(_obs):
+        for prefix in ("perf.mfu", "perf.step_seconds",
+                       "perf.family_time."):
+            _obs.drop_gauges(prefix)
+
+    def _note_perf(self, dt):
+        """Per-run perf.* telemetry from the analytic cost estimate: step
+        FLOP/byte counters always; the live MFU gauge only from
+        steady-state runs (a compile-carrying run would crater it)."""
+        from .. import observability as _obs
+
+        noted = self._last_run
+        self._last_run = None
+        if noted is None or not _obs.enabled():
+            return
+        compiled, fresh_compile = noted
+        est = compiled.est
+        if not est:
+            # this executable has no estimate: a previous executable's
+            # gauges must not read as live for it
+            self._drop_perf_gauges(_obs)
+            return
+        _obs.add("perf.step_flops", int(est.flops))
+        _obs.add("perf.step_bytes", int(est.bytes))
+        if fresh_compile or dt <= 0:
+            # compile-carrying run: no steady-state value for THIS
+            # executable yet, and the old gauges describe another one
+            self._drop_perf_gauges(_obs)
+            return
+        if compiled.recent_dts is None:
+            from collections import deque
+
+            compiled.recent_dts = deque(maxlen=32)
+        compiled.recent_dts.append(dt)
+        mean_dt = sum(compiled.recent_dts) / len(compiled.recent_dts)
+        _obs.set_gauge("perf.step_seconds", mean_dt)
+        if est.peak > 0 and est.flops > 0:
+            _obs.set_gauge("perf.mfu", est.flops / mean_dt / est.peak)
+        # attribute the MEASURED step time across op families by each
+        # family's share of the estimated roofline; drop first so families
+        # only present in a PREVIOUS executable don't survive as stale
+        _obs.drop_gauges("perf.family_time.")
+        for fam, share in est.family_shares.items():
+            _obs.set_gauge(f"perf.family_time.{fam}", share * mean_dt)
 
     def _run_body(
         self, program, feed, fetch_list, scope, return_numpy,
@@ -128,6 +213,7 @@ class Executor:
         (program, scope, block, feed_arrays, _feed_sig, fetch_names,
          key) = self._prepared(program, feed, fetch_list, scope)
         compiled = self._cache.get(key) if use_program_cache else None
+        fresh_compile = compiled is None
         if compiled is None:
             if use_program_cache:
                 _obs.add("executor.cache_misses")
@@ -145,6 +231,18 @@ class Executor:
         else:
             _obs.add("executor.cache_hits")
             self._cache.move_to_end(key)
+
+        if compiled.est is None and _obs.enabled():
+            # memo per cache key so use_program_cache=False callers don't
+            # re-walk the graph every step (fresh _Compiled each run)
+            est = self._est_memo.get(key)
+            if est is None:
+                est = self._estimate(program, feed_arrays)
+                if len(self._est_memo) >= 64:
+                    self._est_memo.pop(next(iter(self._est_memo)))
+                self._est_memo[key] = est
+            compiled.est = est
+        self._last_run = (compiled, fresh_compile)
 
         state_ro = {n: self._from_scope(scope, n, block) for n in compiled.state_ro}
         state_mut = {n: self._from_scope(scope, n, block) for n in compiled.state_mut}
@@ -211,6 +309,24 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
+    def _estimate(self, program, feed_arrays):
+        """Analytic cost digest for the executable about to run, pinned at
+        the actual feed shapes; publishes the full per-op table as the
+        "perf.cost_table" observability table. Returns False on failure so
+        the estimate is attempted once per executable, never per step."""
+        from .. import observability as _obs
+
+        try:
+            table = program.estimate(feed_shapes={
+                k: tuple(a.shape) for k, a in feed_arrays.items()
+            })
+            _obs.set_table("perf.cost_table", table.to_dict(top=50))
+            return _PerfEstimate(table)
+        except Exception:
+            _obs.add("perf.estimate_failures")
+            return False
+
+    # ------------------------------------------------------------------
     def flops(self, program=None, feed=None, fetch_list=None, scope=None):
         """XLA's static FLOP count for ONE step of `program` with this
         feed — the compiled executable's cost analysis (reference role:
@@ -241,8 +357,25 @@ class Executor:
         )
         ca = lowered.compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        return float((ca or {}).get("flops", 0.0))
+            ca = ca[0] if ca else None
+        if not ca or "flops" not in ca:
+            # "backend reports no cost data" is NOT "zero-FLOP program":
+            # callers deriving MFU from this must not read a silent 0.0
+            import warnings
+
+            from .. import observability as _obs
+            from ..errors import CostAnalysisUnavailableWarning
+
+            _obs.add("perf.cost_analysis_unavailable")
+            warnings.warn(
+                "XLA cost_analysis() returned no FLOP data for this "
+                "executable; falling back to 0.0 — use "
+                "Program.estimate() for an analytic count",
+                CostAnalysisUnavailableWarning,
+                stacklevel=2,
+            )
+            return 0.0
+        return float(ca.get("flops", 0.0))
 
     # ------------------------------------------------------------------
     def _prepared(self, program, feed, fetch_list, scope):

@@ -456,6 +456,22 @@ class Program:
         if nonce:
             self._rng_nonce = int(nonce)
 
+    def estimate(self, feed_shapes=None, peak_tflops=None, peak_gbps=None):
+        """Analytic per-op FLOPs / bytes / roofline-latency table for ONE
+        step of this program (analysis/cost.py): the observability half of
+        the IR cost model — ``bench.py`` derives MFU from it, the executor
+        feeds the live ``perf.*`` gauges with it, and the planned autotuner
+        consumes it as its objective. `feed_shapes` ({var: shape}) pins -1
+        batch dims; peaks default from ``PADDLE_TPU_PEAK_TFLOPS`` /
+        ``PADDLE_TPU_PEAK_GBPS`` (TPU v5e bf16). Pure graph walk over
+        declared Variable shapes — no tracing, no compilation."""
+        from ..analysis.cost import estimate_program
+
+        return estimate_program(
+            self, feed_shapes=feed_shapes, peak_tflops=peak_tflops,
+            peak_gbps=peak_gbps,
+        )
+
     @property
     def global_block(self):
         return self.blocks[0]

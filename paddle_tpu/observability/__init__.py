@@ -33,12 +33,15 @@ from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS,
     add,
     enabled,
+    drop_gauges,
     get_counters,
     get_gauges,
     get_histograms,
+    get_tables,
     observe,
     set_enabled,
     set_gauge,
+    set_table,
     timed,
 )
 from .spans import (  # noqa: F401

@@ -26,12 +26,16 @@ def _prom_name(name: str) -> str:
 def snapshot() -> dict:
     """Structured view of every metric: {"counters", "gauges",
     "histograms", "span_count"}."""
-    return {
+    snap = {
         "counters": metrics.get_counters(),
         "gauges": metrics.get_gauges(),
         "histograms": metrics.get_histograms(),
         "span_count": spans.span_count(),
     }
+    tables = metrics.get_tables()
+    if tables:  # only present when something published one (back-compat)
+        snap["tables"] = tables
+    return snap
 
 
 def dump(path: str, pretty: bool = True) -> str:

@@ -40,6 +40,10 @@ _lock = threading.Lock()
 _counters: dict[str, int] = {}
 _gauges: dict[str, float] = {}
 _histograms: dict[str, "_Histogram"] = {}
+# structured tables (plain-JSON dicts, last value wins): richer artifacts a
+# scalar cannot carry — e.g. the executor publishes the latest per-op cost
+# attribution as "perf.cost_table" (tools/stats_report.py --top-ops)
+_tables: dict[str, dict] = {}
 
 
 def enabled() -> bool:
@@ -116,6 +120,25 @@ def observe(name: str, value: float, buckets=None) -> None:
         h.observe(float(value))
 
 
+def drop_gauges(prefix: str) -> None:
+    """Remove every gauge whose name starts with `prefix`. For publishers
+    whose gauge SET varies with the source (e.g. the executor's
+    per-op-family ``perf.family_time.*``): dropping before re-publishing
+    keeps gauges from a previous executable from surviving as stale."""
+    with _lock:
+        for k in [k for k in _gauges if k.startswith(prefix)]:
+            del _gauges[k]
+
+
+def set_table(name: str, table: dict) -> None:
+    """Publish the structured table `name` (plain JSON types; last value
+    wins — snapshots carry it under "tables")."""
+    if not _enabled:
+        return
+    with _lock:
+        _tables[name] = table
+
+
 class _Timed:
     """Context manager AND decorator: wall time -> histogram `name`."""
 
@@ -165,8 +188,14 @@ def get_histograms() -> dict[str, dict]:
         return {k: h.to_dict() for k, h in _histograms.items()}
 
 
+def get_tables() -> dict[str, dict]:
+    with _lock:
+        return dict(_tables)
+
+
 def reset() -> None:
     with _lock:
         _counters.clear()
         _gauges.clear()
         _histograms.clear()
+        _tables.clear()

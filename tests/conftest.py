@@ -45,7 +45,7 @@ def _isolate_global_state():
     from paddle_tpu.resilience import faults as _faults
 
     saved_metrics = copy.deepcopy(
-        (_met._counters, _met._gauges, _met._histograms)
+        (_met._counters, _met._gauges, _met._histograms, _met._tables)
     )
     saved_enabled = _met._enabled
     saved_spans = list(_spans._spans)
@@ -61,7 +61,8 @@ def _isolate_global_state():
         yield
     finally:
         for store, saved in zip(
-            (_met._counters, _met._gauges, _met._histograms), saved_metrics
+            (_met._counters, _met._gauges, _met._histograms, _met._tables),
+            saved_metrics,
         ):
             store.clear()
             store.update(saved)

@@ -39,7 +39,7 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
-def _lint_one(name, strict, verbose):
+def _lint_one(name, strict, verbose, cost=False):
     import time
 
     from paddle_tpu.analysis import Severity, verify_program
@@ -63,6 +63,11 @@ def _lint_one(name, strict, verbose):
     shown = [f for f in report.findings if f.severity >= min_sev]
     for f in shown:
         print("    " + f.format())
+    if cost:
+        # the fourth analysis family: per-op FLOPs/bytes/roofline table
+        # (analysis/cost.py) at the model's graph-build shapes
+        for line in bm.main.estimate().format(top=10).splitlines():
+            print("    " + line)
     return not failing
 
 
@@ -114,6 +119,8 @@ def main(argv=None):
                     help="print INFO findings too")
     ap.add_argument("--broken-fixture", action="store_true",
                     help="lint the seeded broken program (must fail)")
+    ap.add_argument("--cost", action="store_true",
+                    help="print the Program.estimate() cost table per model")
     args = ap.parse_args(argv)
 
     if args.broken_fixture:
@@ -141,7 +148,7 @@ def main(argv=None):
         ap.error(f"unknown models {unknown}; have {sorted(MODEL_BUILDERS)}")
     ok = True
     for n in names:
-        ok = _lint_one(n, args.strict, args.verbose) and ok
+        ok = _lint_one(n, args.strict, args.verbose, cost=args.cost) and ok
     print("lint:", "PASS" if ok else "FAIL",
           f"({len(names)} model(s), strict={args.strict})")
     return 0 if ok else 2

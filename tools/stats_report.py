@@ -3,11 +3,15 @@
 
 Usage:
     python tools/stats_report.py SNAPSHOT.json [--require PREFIX ...]
+    python tools/stats_report.py SNAPSHOT.json --top-ops 15
 
 SNAPSHOT.json is the file written by `paddle_tpu.observability.dump(path)`
-(counters / gauges / histograms / span_count). `--require PREFIX` (repeatable)
-exits nonzero unless at least one metric name starts with PREFIX — the CI
-guard that instrumentation did not silently go dead.
+(counters / gauges / histograms / span_count / tables). `--require PREFIX`
+(repeatable) exits nonzero unless at least one metric name starts with
+PREFIX — the CI guard that instrumentation did not silently go dead.
+`--top-ops N` renders the top-N op sites of the "perf.cost_table" table
+the executor publishes (per-op FLOPs/bytes/roofline from
+`Program.estimate`); the default dump shows the table's totals.
 """
 
 from __future__ import annotations
@@ -27,11 +31,40 @@ def _sparkline(hist):
     return "".join(_BARS[round(c / peak * (len(_BARS) - 1))] for c in per)
 
 
-def render(snap):
+def _render_cost_table(table, top_ops, lines):
+    lines.append(
+        f"-- perf.cost_table: {table.get('total_flops', 0) / 1e9:.3f} "
+        f"GFLOP/step, {table.get('total_bytes', 0) / 1e6:.3f} MB moved, "
+        f"roofline >= {table.get('total_latency', 0) * 1e3:.3f} ms --"
+    )
+    fams = sorted(
+        (table.get("by_family") or {}).items(),
+        key=lambda kv: -kv[1].get("latency", 0),
+    )
+    for fam, agg in fams:
+        lines.append(
+            f"  {fam:<14} {agg.get('flops', 0) / 1e9:>10.3f} GFLOP "
+            f"{agg.get('bytes', 0) / 1e6:>10.3f} MB  ({agg.get('ops', 0)} "
+            "ops)"
+        )
+    if top_ops:
+        lines.append(f"-- top {top_ops} op sites by roofline latency --")
+        for e in (table.get("ops") or [])[:top_ops]:
+            lines.append(
+                f"  {e.get('op_type', '?'):<28} "
+                f"{e.get('flops', 0) / 1e9:>10.3f} GFLOP "
+                f"{e.get('bytes', 0) / 1e6:>9.3f} MB "
+                f"{e.get('latency', 0) * 1e6:>9.1f} us"
+                f"  b{e.get('block_idx', 0)}#{e.get('op_index', 0)}"
+            )
+
+
+def render(snap, top_ops=0):
     lines = []
     counters = snap.get("counters", {})
     gauges = snap.get("gauges", {})
     hists = snap.get("histograms", {})
+    tables = snap.get("tables", {})
     lines.append("==== paddle_tpu observability snapshot ====")
     if counters:
         lines.append(f"-- counters ({len(counters)}) --")
@@ -53,6 +86,8 @@ def render(snap):
                 f"  {name}: count={n} sum={h['sum']:.6g} mean={mean:.6g} "
                 f"min={h['min']} max={h['max']}  |{_sparkline(h)}|"
             )
+    if "perf.cost_table" in tables:
+        _render_cost_table(tables["perf.cost_table"], top_ops, lines)
     lines.append(f"span buffer: {snap.get('span_count', 0)} spans")
     if not (counters or gauges or hists):
         lines.append("(snapshot is empty — PADDLE_TPU_MONITOR=0, or nothing "
@@ -67,14 +102,19 @@ def main(argv=None):
         "--require", action="append", default=[], metavar="PREFIX",
         help="fail unless some metric name starts with PREFIX (repeatable)",
     )
+    ap.add_argument(
+        "--top-ops", type=int, default=0, metavar="N",
+        help="show the top-N op sites of the published perf.cost_table",
+    )
     args = ap.parse_args(argv)
     with open(args.snapshot) as f:
         snap = json.load(f)
-    print(render(snap))
+    print(render(snap, top_ops=args.top_ops))
     names = (
         list(snap.get("counters", {}))
         + list(snap.get("gauges", {}))
         + list(snap.get("histograms", {}))
+        + list(snap.get("tables", {}))
     )
     missing = [
         p for p in args.require if not any(n.startswith(p) for n in names)
