@@ -1,0 +1,432 @@
+"""Serving load generator: checkpoint -> frozen graph -> QPS.
+
+Drives the paddle_tpu.serving router with three traffic mixes and prints
+ONE JSON line (bench.py convention):
+
+  * ``bert_classify``  — tiny-BERT sequence classifier, closed-loop
+    concurrent clients over buckets (1, 2, 4, 8);
+  * ``resnet_classify`` — CIFAR-sized ResNet-18 softmax head, open-loop
+    Poisson arrivals (tests deadline-driven partial batches);
+  * ``gpt_generate``   — KV-cache generation endpoint (prefill + decode).
+
+Per mix: QPS, p50/p99 request latency (client-measured), batch-size
+histogram from the ``serving.bucket_runs.*`` counters, and the frozen
+graph's ``Program.estimate()`` roofline as the per-batch lower bound
+(estimate vs measured — the PR-7 cross-check; on CPU the v5e peaks make
+the ratio an overhead indicator, not a target).
+
+Two acceptance ratios ride along:
+
+  * ``batched_speedup``  — bucket-8 batch throughput vs 8 sequential
+    single-request dispatches on the same executable set (>= 3x CPU CI:
+    the arXiv:2301.13062 one-wide-program argument applied to serving);
+  * ``kv_decode_speedup`` — KV-cache generation vs full-context recompute
+    at context >= 256 (>= 5x: the O(1)-per-token decode path).
+
+``--smoke`` shrinks the run for CI; ``--dump PATH`` writes the
+observability snapshot for ``stats_report --require serving.``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _percentiles(lat):
+    lat = np.asarray(sorted(lat))
+    if not len(lat):
+        return {"p50_ms": None, "p99_ms": None}
+    return {
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+    }
+
+
+def _bucket_histogram(endpoint_name):
+    from paddle_tpu import observability
+
+    prefix = f"serving.bucket_runs.{endpoint_name}."
+    return {
+        k[len(prefix):]: v
+        for k, v in observability.get_counters().items()
+        if k.startswith(prefix)
+    }
+
+
+def _roofline(frozen, bucket, feed_builder):
+    """Program.estimate() at the largest bucket: analytic per-batch
+    latency lower bound for the frozen graph."""
+    try:
+        feed = feed_builder(bucket)
+        est = frozen.program.estimate(
+            feed_shapes={k: tuple(v.shape) for k, v in feed.items()}
+        )
+        return {
+            "est_batch_flops": float(est.total_flops),
+            "est_batch_ms": round(est.total_latency * 1e3, 4),
+        }
+    except Exception as e:  # estimate failures must not kill the bench
+        return {"est_error": str(e)[:120]}
+
+
+def _closed_loop(server, endpoint, feed_builder, n_clients, duration):
+    """N clients submit-wait-repeat; returns (latencies, n_done, wall)."""
+    lats, lock = [], threading.Lock()
+    stop = time.perf_counter() + duration
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        while time.perf_counter() < stop:
+            t0 = time.perf_counter()
+            fut = server.submit(endpoint, feed_builder(rng))
+            fut.result(timeout=60)
+            dt = time.perf_counter() - t0
+            with lock:
+                lats.append(dt)
+
+    t_start = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lats, len(lats), time.perf_counter() - t_start
+
+
+def _build_classifier_endpoint(kind, scope, seed=7):
+    """Build + 2-step-train + freeze a tiny classifier; returns
+    (frozen, sample_feed_builder, exe)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.framework.scope import scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        if kind == "bert":
+            from paddle_tpu.models.bert import BertConfig, bert_encoder
+
+            cfg = BertConfig.tiny()
+            s = 16
+            ids = fluid.data("ids", [-1, s], "int64")
+            types = fluid.data("types", [-1, s], "int64")
+            mask = fluid.data("mask", [-1, s], "float32")
+            seq = bert_encoder(ids, types, mask, cfg, is_test=False)
+            # [CLS]-style pooled head: first token's hidden state
+            pooled = layers.slice(seq, [1], [0], [1])
+            logits = layers.fc(pooled, 4)
+            prob = layers.softmax(logits)
+            lab = fluid.data("lab", [-1, 1], "int64")
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, lab)
+            )
+            feeds = ("ids", "types", "mask")
+
+            def build(rng_or_b):
+                if isinstance(rng_or_b, int):
+                    b = rng_or_b
+                    return {
+                        "ids": np.zeros((b, s), np.int64),
+                        "types": np.zeros((b, s), np.int64),
+                        "mask": np.ones((b, s), np.float32),
+                    }
+                rng = rng_or_b
+                return {
+                    "ids": rng.randint(0, cfg.vocab_size, s).astype(
+                        np.int64
+                    ),
+                    "types": np.zeros(s, np.int64),
+                    "mask": np.ones(s, np.float32),
+                }
+        else:
+            from paddle_tpu.models.resnet import resnet
+
+            img = fluid.data("image", [-1, 3, 32, 32], "float32")
+            logits = resnet(img, class_num=10, depth=18, is_test=False)
+            prob = layers.softmax(logits)
+            lab = fluid.data("lab", [-1, 1], "int64")
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, lab)
+            )
+            feeds = ("image",)
+
+            def build(rng_or_b):
+                if isinstance(rng_or_b, int):
+                    return {
+                        "image": np.zeros(
+                            (rng_or_b, 3, 32, 32), np.float32
+                        ),
+                    }
+                return {
+                    "image": rng_or_b.randn(3, 32, 32).astype(np.float32),
+                }
+        fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+    from paddle_tpu.serving import freeze_program
+
+    frozen = freeze_program(main, [prob], feed_names=feeds)
+    return frozen, build, exe
+
+
+def bench_classify_mix(name, kind, buckets, mode, load, duration,
+                       results):
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.serving import Server
+    from paddle_tpu.serving.router import EndpointConfig
+
+    scope = Scope()
+    frozen, build, exe = _build_classifier_endpoint(kind, scope)
+    server = Server()
+    server.add_endpoint(
+        name, None,
+        EndpointConfig(buckets=buckets, max_wait_ms=4.0, max_queue=4096),
+        frozen=frozen, executor=exe, scope=scope,
+    )
+    t0 = time.perf_counter()
+    server.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    if mode == "closed":
+        lats, n, wall = _closed_loop(server, name, build, load, duration)
+    else:
+        lats, n, wall = _poisson_loop(server, name, build, load, duration)
+    server.drain(timeout=30)
+    entry = {
+        "mix": name,
+        "mode": mode,
+        "load": load,
+        "requests": n,
+        "qps": round(n / wall, 2) if wall > 0 else None,
+        "warmup_s": round(warmup_s, 2),
+        "buckets": _bucket_histogram(name),
+        **_percentiles(lats),
+        **_roofline(frozen, buckets[-1], build),
+    }
+    results[name] = entry
+    return frozen, build, exe, scope, entry
+
+
+def _poisson_loop(server, endpoint, feed_builder, rate_qps, duration):
+    """Open-loop Poisson arrivals; latency = submit -> future resolve,
+    stamped by a done-callback at RESOLVE time (waiting and then reading
+    the wall clock would inflate early requests' latency to ~run
+    length)."""
+    rng = np.random.RandomState(1234)
+    lats, lock = [], threading.Lock()
+    futs = []
+    t_start = time.perf_counter()
+    stop = t_start + duration
+    next_t = t_start
+    while time.perf_counter() < stop:
+        next_t += rng.exponential(1.0 / rate_qps)
+        delay = next_t - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t0 = time.perf_counter()
+        fut = server.submit(endpoint, feed_builder(rng))
+
+        def _done(f, t0=t0):
+            dt = time.perf_counter() - t0
+            with lock:
+                lats.append(dt)
+
+        fut.add_done_callback(_done)
+        futs.append(fut)
+    for f in futs:
+        f.result(timeout=60)
+    wall = time.perf_counter() - t_start
+    return lats, len(futs), wall
+
+
+def bench_batched_vs_sequential(frozen, build, exe, scope, bucket=8,
+                                rounds=3, iters=10):
+    """Throughput of ONE bucket-N batch vs N sequential single-request
+    dispatches against the same warm executables."""
+    from paddle_tpu.framework.scope import scope_guard
+
+    fetch = list(frozen.fetch_names)
+    feed_b = build(bucket)
+    feed_1 = build(1)
+    with scope_guard(scope):
+        exe.run(frozen.program, feed=feed_b, fetch_list=fetch, scope=scope)
+        exe.run(frozen.program, feed=feed_1, fetch_list=fetch, scope=scope)
+        best_b = best_1 = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                exe.run(frozen.program, feed=feed_b, fetch_list=fetch,
+                        scope=scope)
+            best_b = min(best_b, (time.perf_counter() - t0) / iters)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                for _ in range(bucket):
+                    exe.run(frozen.program, feed=feed_1, fetch_list=fetch,
+                            scope=scope)
+            best_1 = min(best_1, (time.perf_counter() - t0) / iters)
+    qps_batched = bucket / best_b
+    qps_seq = bucket / best_1
+    return {
+        "bucket": bucket,
+        "batched_qps": round(qps_batched, 1),
+        "sequential_qps": round(qps_seq, 1),
+        "batched_speedup": round(qps_batched / qps_seq, 2),
+    }
+
+
+def bench_gpt_generate(smoke, results):
+    """KV-cache generation endpoint + the decode-vs-recompute ratio."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.serving import GPTGenerator, Server
+    from paddle_tpu.serving.generate import GPTGenerateRunner
+    from paddle_tpu.serving.router import EndpointConfig
+
+    # context >= 256 per the acceptance bar; 512 keeps the recompute
+    # baseline's O(S) cost well clear of decode dispatch overhead on the
+    # CPU CI leg (at 256 the ratio sits right at 5x and contention noise
+    # can dip it under)
+    context, new_tokens = (512, 32) if not smoke else (512, 24)
+    cfg = GPTConfig(
+        vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+        intermediate_size=256, max_position=context + new_tokens,
+        use_fused_attention=False,
+    )
+    gen = GPTGenerator(
+        cfg, batch=1, context_len=context, max_len=context + new_tokens
+    )
+    gen.init_params(seed=11)
+    rng = np.random.RandomState(0)
+    ctx = rng.randint(0, cfg.vocab_size, (1, context)).astype(np.int64)
+
+    # decode vs full-recompute, best-of-3 (tunneled-chip convention)
+    best_kv = best_full = float("inf")
+    gen.generate(ctx, new_tokens)
+    gen.generate_full_recompute(ctx, new_tokens)
+    for _ in range(3):
+        t0 = time.perf_counter()
+        kv_tokens = gen.generate(ctx, new_tokens)
+        best_kv = min(best_kv, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        full_tokens = gen.generate_full_recompute(ctx, new_tokens)
+        best_full = min(best_full, time.perf_counter() - t0)
+    parity = bool(np.array_equal(kv_tokens, full_tokens))
+
+    # the generate endpoint through the router (closed-loop, 2 clients)
+    server = Server()
+    runner = GPTGenerateRunner(gen, max_new_tokens=new_tokens)
+    server.add_endpoint(
+        "gpt_generate", runner,
+        EndpointConfig(buckets=(1,), max_wait_ms=1.0),
+    )
+    duration = 2.0 if smoke else 6.0
+
+    def build(rng):
+        return {
+            "context_ids": rng.randint(0, cfg.vocab_size, context).astype(
+                np.int64
+            )
+        }
+
+    lats, n, wall = _closed_loop(server, "gpt_generate", build, 2,
+                                 duration)
+    server.drain(timeout=30)
+    entry = {
+        "mix": "gpt_generate",
+        "mode": "closed",
+        "load": 2,
+        "context": context,
+        "new_tokens": new_tokens,
+        "requests": n,
+        "qps": round(n / wall, 3) if wall > 0 else None,
+        "decode_tok_s": round(new_tokens / best_kv, 1),
+        "recompute_tok_s": round(new_tokens / best_full, 1),
+        "kv_decode_speedup": round(best_full / best_kv, 2),
+        "kv_parity": parity,
+        **_percentiles(lats),
+    }
+    results["gpt_generate"] = entry
+    return entry
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized run (short durations, small context)")
+    ap.add_argument("--dump", default=None,
+                    help="write the observability snapshot JSON here")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="seconds of load per mix (default 2 smoke / 6)")
+    args = ap.parse_args(argv)
+    duration = args.duration or (2.0 if args.smoke else 6.0)
+
+    import jax
+
+    on_accel = jax.devices()[0].platform in ("tpu", "gpu")
+    results = {}
+
+    bert = bench_classify_mix(
+        "bert_classify", "bert", (1, 2, 4, 8), "closed", 8, duration,
+        results,
+    )
+    print(json.dumps(results["bert_classify"]), flush=True)
+    # batched-vs-sequential acceptance ratio on the BERT frozen graph
+    frozen, build, exe, scope, _ = bert
+    batched = bench_batched_vs_sequential(frozen, build, exe, scope)
+    print(json.dumps({"mix": "bert_classify", **batched}), flush=True)
+
+    # open-loop rate sized to ~60-70% of the CPU leg's service capacity so
+    # the latency numbers reflect batching behavior, not a saturated queue
+    bench_classify_mix(
+        "resnet_classify", "resnet", (1, 2, 4), "open",
+        40 if not args.smoke else 10, duration, results,
+    )
+    print(json.dumps(results["resnet_classify"]), flush=True)
+
+    gpt = bench_gpt_generate(args.smoke, results)
+    print(json.dumps(gpt), flush=True)
+
+    if args.dump:
+        from paddle_tpu import observability
+
+        observability.dump(args.dump)
+
+    summary = {
+        "metric": "serving_qps",
+        "value": results["bert_classify"]["qps"],
+        "unit": "req/s (bert_classify closed-loop)",
+        "on_accel": on_accel,
+        "mixes": {
+            k: {
+                f: v.get(f)
+                for f in ("qps", "p50_ms", "p99_ms", "requests")
+            }
+            for k, v in results.items()
+        },
+        "batched_speedup": batched["batched_speedup"],
+        "kv_decode_speedup": gpt["kv_decode_speedup"],
+        "kv_parity": gpt["kv_parity"],
+    }
+    print(json.dumps(summary), flush=True)
+    ok = (
+        batched["batched_speedup"] >= 3.0
+        and gpt["kv_decode_speedup"] >= 5.0
+        and gpt["kv_parity"]
+    )
+    if not ok:
+        print("serving acceptance ratios NOT met", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

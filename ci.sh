@@ -34,6 +34,70 @@ fi
 echo "== bench smoke =="
 python bench.py
 
+echo "== serving smoke (load gen + chaos ingest + drain) =="
+# short load-gen run over all three traffic mixes with a fault injected
+# on the request-ingestion seam (dataloader.fetch-style): the router's
+# retry policy must heal the two injected failures with zero dropped
+# requests, the serving.* stats must land in the snapshot, and the
+# acceptance ratios (batched >= 3x, KV decode >= 5x) gate the exit code
+SERVING_DIR=$(mktemp -d)
+PADDLE_TPU_FAULT_INJECT="serving.ingest:io:1.0:0:2" \
+python bench_serving.py --smoke --dump "$SERVING_DIR/serving_stats.json"
+python tools/stats_report.py "$SERVING_DIR/serving_stats.json" \
+    --require serving. --require executor.
+python - "$SERVING_DIR" <<'EOF'
+import json, sys
+snap = json.load(open(sys.argv[1] + "/serving_stats.json"))
+c = snap["counters"]
+assert c.get("resilience.faults_injected", 0) >= 2, c
+assert c.get("resilience.retries", 0) >= 2, (
+    "injected ingest faults were not retried", c)
+assert c.get("serving.requests_served", 0) > 0, c
+assert c.get("serving.batches", 0) > 0, c
+assert c.get("serving.warmup_runs", 0) > 0, c
+h = snap["histograms"]
+assert h["serving.request_latency"]["count"] > 0, h.keys()
+assert h["serving.batch_fill"]["count"] > 0, h.keys()
+print(f"serving chaos OK: {c['serving.requests_served']} requests served "
+      f"across {c['serving.batches']} batches, "
+      f"{c['resilience.retries']} ingest retries healed")
+EOF
+
+# SIGTERM during serving load: every admitted request completes, the
+# worker exits PREEMPTION_EXIT_CODE (75), serving.drained fires once
+JAX_PLATFORMS=cpu python tests/serving_drain_worker.py "$SERVING_DIR" \
+    > "$SERVING_DIR/drain.log" 2>&1 &
+SPID=$!
+for _ in $(seq 600); do
+    [ -f "$SERVING_DIR/ready" ] && break
+    kill -0 "$SPID" 2>/dev/null || { cat "$SERVING_DIR/drain.log"; exit 1; }
+    sleep 0.2
+done
+[ -f "$SERVING_DIR/ready" ] || { echo "serving worker never ready"; exit 1; }
+sleep 0.5  # let load build up before preempting
+kill -TERM "$SPID"
+rc=0; wait "$SPID" || rc=$?
+[ "$rc" -eq 75 ] || {
+    echo "expected serving drain exit 75, got $rc"
+    cat "$SERVING_DIR/drain.log"; exit 1
+}
+python - "$SERVING_DIR" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1] + "/result.json"))
+assert r["dropped"] == 0, r
+assert r["served"] == r["admitted"] > 0, r
+assert r["drained_counter"] == 1, r
+print(f"serving drain OK: {r['served']}/{r['admitted']} admitted requests "
+      "completed under SIGTERM, exit 75")
+EOF
+rm -rf "$SERVING_DIR"
+
+# the frozen-graph verifier must reject a freeze that left a training op
+if python tools/program_lint.py --broken-frozen-fixture > /dev/null 2>&1; then
+    echo "program_lint failed to reject the broken frozen fixture" >&2
+    exit 1
+fi
+
 echo "== observability smoke =="
 python - <<'EOF'
 import numpy as np

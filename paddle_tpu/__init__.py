@@ -65,6 +65,7 @@ from .dataset import DatasetFactory  # noqa: F401
 from . import native  # noqa: F401
 from . import crypto  # noqa: F401  (model-file encryption, framework/io/crypto)
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401  (freeze/router/KV-decode serving path)
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import tensor  # noqa: F401

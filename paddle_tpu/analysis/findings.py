@@ -36,6 +36,7 @@ DEAD_OP = "dead-op"
 UNREACHABLE_VAR = "unreachable-var"
 SHAPE_DESYNC = "shape-desync"
 DTYPE_DESYNC = "dtype-desync"
+TRAINING_OP_IN_INFERENCE = "training-op-in-inference"
 COLLECTIVE_DIVERGENCE = "collective-divergence"
 COLLECTIVE_BRANCH_DIVERGENCE = "collective-branch-divergence"
 UNKNOWN_MESH_AXIS = "unknown-mesh-axis"

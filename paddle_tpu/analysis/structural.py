@@ -29,6 +29,7 @@ from .findings import (
     DEAD_OP,
     MISSING_FEED,
     REDEFINITION,
+    TRAINING_OP_IN_INFERENCE,
     UNDECLARED_VAR,
     UNDECLARED_WRITE,
     UNKNOWN_OP,
@@ -38,6 +39,32 @@ from .findings import (
     Severity,
     finding_for_op,
 )
+
+# Op types that must never survive in a frozen inference program
+# (serving/freeze.py is the canonical producer of such programs; it marks
+# them with ``program._is_inference``). Parameter-update ops mutate
+# persistables, grad ops recompute backward work per request, and the AMP
+# loss-scaling automaton corrupts its state when stepped outside training.
+OPTIMIZER_UPDATE_OPS = frozenset({
+    "sgd", "momentum", "lars_momentum", "adam", "adamw", "lamb", "adagrad",
+    "decayed_adagrad", "adadelta", "rmsprop", "ftrl", "adamax", "dpsgd",
+})
+AMP_TRAINING_OPS = frozenset({
+    "amp_check_finite_and_scale", "check_finite_and_unscale",
+    "update_loss_scaling",
+})
+
+
+def is_training_only_op(op_type: str) -> bool:
+    """True for ops with no business in a frozen inference graph:
+    parameter updates, explicit grad kernels, the generic ``__vjp__``
+    backward replay, and the AMP loss-scale automaton."""
+    return (
+        op_type in OPTIMIZER_UPDATE_OPS
+        or op_type in AMP_TRAINING_OPS
+        or op_type == "__vjp__"
+        or op_type.endswith("_grad")
+    )
 
 # ops that are live regardless of dataflow (side effects / control
 # structure); their sub-blocks are not part of the global-block dataflow
@@ -61,6 +88,23 @@ def analyze_structural(program, feed_names=(), fetch_names=()):
     feed_names = set(feed_names or ())
     fetch_names = tuple(fetch_names or ())
     block = program.global_block
+
+    # --- training-only ops in frozen inference programs -------------------
+    # (only when the program is marked as an inference freeze — training
+    # graphs legitimately carry these ops)
+    if getattr(program, "_is_inference", False):
+        for blk in program.blocks:
+            for i, op in enumerate(blk.ops):
+                if is_training_only_op(op.type):
+                    findings.append(finding_for_op(
+                        Severity.ERROR, TRAINING_OP_IN_INFERENCE,
+                        f"training-only op {op.type!r} survived a freeze "
+                        "into an inference program — it would mutate "
+                        "parameters/loss-scale state or recompute backward "
+                        "work per request; re-freeze from the training "
+                        "graph (serving.freeze_program)",
+                        op=op, op_index=i, block_idx=blk.idx,
+                    ))
 
     # --- unknown ops + undeclared reads/writes, every block ---------------
     for blk in program.blocks:

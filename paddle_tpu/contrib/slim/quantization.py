@@ -389,63 +389,75 @@ class PostTrainingQuantization:
         abs-max at apply time (they are constants at inference, so
         data-derived == calibrated). Returns the number of quant-dequant
         ops inserted; pair with io.save_inference_model to export."""
-        def norm_scale(s):
-            # min_max returns (min, max); scalar algos return a float
-            if isinstance(s, (tuple, list)):
-                s = max(abs(s[0]), abs(s[1]))
-            return float(s)
-
-        blk = program.global_block
-        # ONE shared zero var feeds every qdq op's unused accum/state
-        # (read-only in is_test mode)
-        zero_n = unique_name.generate("ptq_zero")
-        blk.create_var(name=zero_n, shape=(1,), dtype="float32")
-        blk.append_op(
-            "fill_constant", {}, {"Out": [zero_n]},
-            {"shape": [1], "dtype": "float32", "value": 0.0}, index=0,
+        return bake_ptq_scales(
+            program, scales, quantizable_ops=quantizable_ops,
+            activation_bits=activation_bits, weight_bits=weight_bits,
         )
 
-        def insert(blk, i, op, n, v, is_weight):
-            if not is_weight:
-                sval = norm_scale(scales[n]) if n in scales else 0.0
-                if sval <= 0.0:
-                    # uncalibrated or degenerate (all-zero activation):
-                    # a 0 InScale would divide to NaN at inference — skip
-                    return None, 0
-            qname = unique_name.generate(n + ".ptq_quantized")
-            blk.create_var(name=qname, shape=v.shape, dtype=v.dtype)
-            oscale = unique_name.generate(n + ".ptq_scale_out")
-            blk.create_var(name=oscale, shape=(1,), dtype="float32")
-            if is_weight:
-                blk.append_op(
-                    "fake_channel_wise_quantize_dequantize_abs_max",
-                    {"X": [n]},
-                    {"Out": [qname], "OutScale": [oscale]},
-                    {"bit_length": weight_bits,
-                     "quant_axis": _weight_quant_axis(op.type, v)},
-                    index=i,
-                )
-                return qname, 1
-            sn = unique_name.generate(n + ".ptq_in_scale")
-            acc_out = unique_name.generate(n + ".ptq_acc_out")
-            st_out = unique_name.generate(n + ".ptq_st_out")
-            for aux_n in (sn, acc_out, st_out):
-                blk.create_var(name=aux_n, shape=(1,), dtype="float32")
+
+def bake_ptq_scales(program, scales, quantizable_ops=QUANTIZABLE_OPS,
+                    activation_bits=8, weight_bits=8):
+    """Module-level scale baking (the body of
+    :meth:`PostTrainingQuantization.apply`, shared with
+    ``serving.freeze_program(int8_scales=...)`` — freezing a served graph
+    must not require constructing a calibrator)."""
+    def norm_scale(s):
+        # min_max returns (min, max); scalar algos return a float
+        if isinstance(s, (tuple, list)):
+            s = max(abs(s[0]), abs(s[1]))
+        return float(s)
+
+    blk = program.global_block
+    # ONE shared zero var feeds every qdq op's unused accum/state
+    # (read-only in is_test mode)
+    zero_n = unique_name.generate("ptq_zero")
+    blk.create_var(name=zero_n, shape=(1,), dtype="float32")
+    blk.append_op(
+        "fill_constant", {}, {"Out": [zero_n]},
+        {"shape": [1], "dtype": "float32", "value": 0.0}, index=0,
+    )
+
+    def insert(blk, i, op, n, v, is_weight):
+        if not is_weight:
+            sval = norm_scale(scales[n]) if n in scales else 0.0
+            if sval <= 0.0:
+                # uncalibrated or degenerate (all-zero activation):
+                # a 0 InScale would divide to NaN at inference — skip
+                return None, 0
+        qname = unique_name.generate(n + ".ptq_quantized")
+        blk.create_var(name=qname, shape=v.shape, dtype=v.dtype)
+        oscale = unique_name.generate(n + ".ptq_scale_out")
+        blk.create_var(name=oscale, shape=(1,), dtype="float32")
+        if is_weight:
             blk.append_op(
-                "fill_constant", {}, {"Out": [sn]},
-                {"shape": [1], "dtype": "float32", "value": sval},
+                "fake_channel_wise_quantize_dequantize_abs_max",
+                {"X": [n]},
+                {"Out": [qname], "OutScale": [oscale]},
+                {"bit_length": weight_bits,
+                 "quant_axis": _weight_quant_axis(op.type, v)},
                 index=i,
             )
-            blk.append_op(
-                "fake_quantize_dequantize_moving_average_abs_max",
-                {"X": [n], "InScale": [sn], "InAccum": [zero_n],
-                 "InState": [zero_n]},
-                {"Out": [qname], "OutScale": [oscale],
-                 "OutAccum": [acc_out], "OutState": [st_out]},
-                {"bit_length": activation_bits, "is_test": True},
-                index=i + 1,
-            )
-            return qname, 2
+            return qname, 1
+        sn = unique_name.generate(n + ".ptq_in_scale")
+        acc_out = unique_name.generate(n + ".ptq_acc_out")
+        st_out = unique_name.generate(n + ".ptq_st_out")
+        for aux_n in (sn, acc_out, st_out):
+            blk.create_var(name=aux_n, shape=(1,), dtype="float32")
+        blk.append_op(
+            "fill_constant", {}, {"Out": [sn]},
+            {"shape": [1], "dtype": "float32", "value": sval},
+            index=i,
+        )
+        blk.append_op(
+            "fake_quantize_dequantize_moving_average_abs_max",
+            {"X": [n], "InScale": [sn], "InAccum": [zero_n],
+             "InState": [zero_n]},
+            {"Out": [qname], "OutScale": [oscale],
+             "OutAccum": [acc_out], "OutState": [st_out]},
+            {"bit_length": activation_bits, "is_test": True},
+            index=i + 1,
+        )
+        return qname, 2
 
-        return _rewrite_quantizable_inputs(program, quantizable_ops,
-                                           insert)
+    return _rewrite_quantizable_inputs(program, quantizable_ops,
+                                       insert)

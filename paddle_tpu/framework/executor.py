@@ -623,6 +623,13 @@ class Executor:
         else:
             mesh_axes = ()
 
+        # frozen inference programs (serving.freeze_program /
+        # load_inference_model marks them _is_inference) trace in test
+        # mode: even an op that missed its is_test attr flip must not run
+        # train-only behavior (dropout masks, batch-norm stat updates)
+        # while serving requests
+        is_test = bool(getattr(program, "_is_inference", False))
+
         def traced(feeds, smut, sro, step_key):
             env = {}
             env.update(sro)
@@ -630,7 +637,7 @@ class Executor:
             env.update(feeds)
             axis_sizes = dict(mesh.shape) if mesh is not None else {}
             ctx = EmitContext(
-                step_key=step_key, is_test=False, mesh_axes=mesh_axes,
+                step_key=step_key, is_test=is_test, mesh_axes=mesh_axes,
                 axis_sizes=axis_sizes, program=program,
             )
             nan_flags = []

@@ -368,6 +368,7 @@ def save_inference_model(
         for v in target_vars
     ]
     pruned = prune(test_prog, targets, feeds=feeded_var_names)
+    pruned._is_inference = True
     fault_point("io.save")
     os.makedirs(dirname, exist_ok=True)
     meta = {
@@ -409,4 +410,9 @@ def load_inference_model(dirname, executor=None, model_filename=None,
     arrays = _load_npz_verified(path)
     for name, arr in arrays.items():
         scope.set_var(name, jnp.asarray(arr))
-    return meta["program"], meta["feed_names"], meta["fetch_names"]
+    program = meta["program"]
+    # a loaded inference model is a frozen graph: the executor traces it
+    # in test mode and the static verifier rejects surviving training ops
+    # (serving freeze contract; older exports predate the flag)
+    program._is_inference = True
+    return program, meta["feed_names"], meta["fetch_names"]

@@ -145,6 +145,15 @@ def gpt_decoder(input_ids, cfg, is_test=False):
     return _ln(x, "gpt_lnf")
 
 
+def _lm_head(hidden, cfg):
+    """The (shared-name) vocab projection every GPT graph variant uses —
+    one definition so the `lm_head_w` checkpoint contract cannot drift."""
+    return layers.fc(
+        hidden, cfg.vocab_size, num_flatten_dims=2, bias_attr=False,
+        param_attr=ParamAttr(name="lm_head_w", initializer=_init(cfg)),
+    )
+
+
 def gpt_lm_loss(input_ids, cfg, is_test=False, labels=None):
     """Next-token LM loss; labels default to input_ids shifted left (the
     final position predicts nothing and is dropped)."""
@@ -155,10 +164,7 @@ def gpt_lm_loss(input_ids, cfg, is_test=False, labels=None):
     # slicing before it is a [B, S, H] copy and the head matmul computes
     # only the s-1 predicted positions
     pred_h = layers.slice(hidden, [1], [0], [s - 1])
-    pred = layers.fc(
-        pred_h, cfg.vocab_size, num_flatten_dims=2, bias_attr=False,
-        param_attr=ParamAttr(name="lm_head_w", initializer=_init(cfg)),
-    )
+    pred = _lm_head(pred_h, cfg)
     if labels is None:
         tgt = layers.slice(input_ids, [1], [1], [s])
     else:
@@ -168,6 +174,151 @@ def gpt_lm_loss(input_ids, cfg, is_test=False, labels=None):
         layers.reshape(tgt, [b * (s - 1), 1]),
     )
     return layers.mean(loss)
+
+
+def gpt_logits(input_ids, cfg, is_test=True):
+    """Full-context logits [B, S, V] — the serving/full-recompute head
+    (no label shift, no loss): every position's next-token distribution."""
+    hidden = gpt_decoder(input_ids, cfg, is_test=is_test)
+    return _lm_head(hidden, cfg)
+
+
+# --- KV-cache serving graphs (prefill + single-token decode) ---------------
+#
+# Generation through the training graph re-runs the whole context every
+# token (O(S) recompute per emitted token). The serving split keeps each
+# layer's K/V rows in persistable scope vars shared BETWEEN two programs:
+# a prefill program that embeds the full context once and fills the cache,
+# and a single-token decode program that appends one K/V row and attends
+# over the cache — O(1) recompute per token. Parameter names match
+# gpt_decoder/gpt_logits exactly, so a trained checkpoint loads into
+# either graph unchanged (serving/generate.py drives the pair).
+
+
+def gpt_cache_names(cfg):
+    """The persistable cache var names both serving programs share."""
+    out = []
+    for i in range(cfg.num_layers):
+        out += [f"gpt_l{i}_cache_k", f"gpt_l{i}_cache_v"]
+    return out
+
+
+def _cache_var(name, batch, max_len, hidden):
+    from ..framework.program import default_main_program
+
+    blk = default_main_program().global_block
+    if blk.has_var(name):
+        return blk.var(name)
+    return blk.create_var(
+        name=name, shape=(batch, max_len, hidden), dtype="float32",
+        persistable=True,
+    )
+
+
+def _cached_decoder_layer(x, cfg, prefix, write_pos, attend_pos, max_len):
+    """Pre-LN decoder layer routed through the layer's KV cache: write this
+    call's K/V rows at `write_pos`, attend Q over the cache up to
+    `attend_pos` (inclusive). Dropout sites keep their test-mode
+    ``downgrade_in_infer`` (1 - p) scaling so outputs match the training
+    graph's ``is_test`` numerics (the freeze-parity contract)."""
+    from ..framework.program import default_main_program
+    from ..layers.tensor import _simple
+
+    b, t, h = x.shape
+    nh, dh = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    a = _ln(x, f"{prefix}_ln1")
+    qkv = _dense(a, 3 * h, f"{prefix}_attn_qkv", cfg)
+    q = layers.slice(qkv, [2], [0], [h])
+    k = layers.slice(qkv, [2], [h], [2 * h])
+    v = layers.slice(qkv, [2], [2 * h], [3 * h])
+    ck = _cache_var(f"{prefix}_cache_k", b, max_len, h)
+    cv = _cache_var(f"{prefix}_cache_v", b, max_len, h)
+    blk = default_main_program().global_block
+    for cache, rows in ((ck, k), (cv, v)):
+        blk.append_op(
+            "kv_cache_write",
+            {"Cache": [cache.name], "X": [rows.name],
+             "Pos": [write_pos.name]},
+            {"Out": [cache.name]},
+        )
+    ctxv = _simple(
+        "kv_cache_attention",
+        {"Q": [q], "CacheK": [ck], "CacheV": [cv], "Pos": [attend_pos]},
+        {"num_heads": nh, "scale": 1.0 / math.sqrt(dh),
+         "prob_scale": 1.0 - cfg.attention_dropout},
+    )
+    attn = _dense(ctxv, h, f"{prefix}_attn_out", cfg)
+    x = x + layers.dropout(attn, cfg.hidden_dropout, is_test=True)
+    m = _ln(x, f"{prefix}_ln2")
+    m = _dense(m, cfg.intermediate_size, f"{prefix}_mlp_in", cfg)
+    m = layers.gelu(m, approximate=True)
+    m = _dense(m, cfg.hidden_size, f"{prefix}_mlp_out", cfg)
+    return x + layers.dropout(m, cfg.hidden_dropout, is_test=True)
+
+
+def gpt_prefill(context_ids, cfg, max_len):
+    """Prefill graph body: embed the full [B, S] context, fill every
+    layer's KV cache rows 0..S-1, and return the LAST position's
+    next-token logits [B, 1, V]. `max_len` bounds the cache (must cover
+    context + generated tokens; <= cfg.max_position)."""
+    b, s = context_ids.shape
+    if max_len > cfg.max_position:
+        from ..errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"max_len {max_len} exceeds cfg.max_position {cfg.max_position}"
+        )
+    tok = layers.embedding(
+        context_ids, size=[cfg.vocab_size, cfg.hidden_size],
+        param_attr=ParamAttr(name="wte", initializer=_init(cfg)),
+    )
+    pos_ids = layers.reshape(layers.range(0, s, 1, "int64"), [1, s])
+    pos = layers.embedding(
+        pos_ids, size=[cfg.max_position, cfg.hidden_size],
+        param_attr=ParamAttr(name="wpe", initializer=_init(cfg)),
+    )
+    x = layers.dropout(tok + pos, cfg.hidden_dropout, is_test=True)
+    write_pos = layers.fill_constant([1], "int32", 0)
+    attend_pos = layers.fill_constant([1], "int32", s - 1)
+    for i in range(cfg.num_layers):
+        x = _cached_decoder_layer(
+            x, cfg, f"gpt_l{i}", write_pos, attend_pos, max_len
+        )
+    x = _ln(x, "gpt_lnf")
+    last_h = layers.slice(x, [1], [s - 1], [s])
+    return _lm_head(last_h, cfg)
+
+
+def gpt_decode_step(token_ids, pos_ids, cfg, max_len):
+    """Single-token decode graph body: embed the [B, 1] token at position
+    `pos_ids` ([1, 1] int64 feed), append its K/V rows to every layer's
+    cache at that position, attend over the cache, and return next-token
+    logits [B, 1, V]. Run repeatedly with the SAME shapes — one compiled
+    executable serves the whole generation."""
+    b = token_ids.shape[0]
+    # [B, 1] ids hit the v1 lookup_table (trailing-1 squeeze): restore the
+    # [B, T=1, H] layout the layer stack expects
+    tok = layers.reshape(
+        layers.embedding(
+            token_ids, size=[cfg.vocab_size, cfg.hidden_size],
+            param_attr=ParamAttr(name="wte", initializer=_init(cfg)),
+        ),
+        [b, 1, cfg.hidden_size],
+    )
+    pos = layers.reshape(
+        layers.embedding(
+            pos_ids, size=[cfg.max_position, cfg.hidden_size],
+            param_attr=ParamAttr(name="wpe", initializer=_init(cfg)),
+        ),
+        [1, 1, cfg.hidden_size],
+    )
+    x = layers.dropout(tok + pos, cfg.hidden_dropout, is_test=True)
+    for i in range(cfg.num_layers):
+        x = _cached_decoder_layer(
+            x, cfg, f"gpt_l{i}", pos_ids, pos_ids, max_len
+        )
+    x = _ln(x, "gpt_lnf")
+    return _lm_head(x, cfg)
 
 
 def gpt_tp_shardings(cfg, axis="mp"):

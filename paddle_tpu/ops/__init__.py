@@ -17,6 +17,7 @@ from . import (  # noqa: F401
     detection,
     detection_ext,
     fused,
+    kv_cache,
     loss_ext,
     math,
     math_ext,

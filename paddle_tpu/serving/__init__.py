@@ -1,0 +1,33 @@
+"""Inference serving subsystem: checkpoint -> frozen graph -> QPS.
+
+The training stack (PRs 1-7) ends at a checkpoint; this package is the
+path from that checkpoint to traffic (ROADMAP item 1):
+
+* :mod:`freeze` — ``freeze_program(program, fetch_list)`` prunes
+  loss/optimizer/backward ops into a pure inference Program (optional
+  INT8 leg baking slim's calibrated PTQ scales into the frozen graph).
+* :mod:`router` — ``Server``/``Endpoint``: a request router with
+  continuous batching over bucketed shapes. Requests land in per-endpoint
+  queues; a scheduler thread forms batches under a max-wait deadline,
+  pads to the nearest compiled bucket (so the executor's per-(program,
+  feed-shapes, fetch-set) executable LRU amortizes compiles), and
+  resolves per-request futures.
+* :mod:`generate` — ``GPTGenerator``: the KV-cache decode path (prefill
+  + single-token decode programs sharing cache persistables in scope;
+  O(1) recompute per generated token).
+
+Lifecycle: ``serving.*`` counters/gauges/histograms ride the PR-1
+observability registry; ``Server.drain()`` / SIGTERM ride the PR-3
+preemption contract (stop admitting, flush in-flight batches, exit 75).
+"""
+
+from __future__ import annotations
+
+from .freeze import FrozenModel, freeze_program, load_frozen  # noqa: F401
+from .generate import GPTGenerator  # noqa: F401
+from .router import (  # noqa: F401
+    Endpoint,
+    EndpointConfig,
+    Server,
+    install_preemption_handler,
+)

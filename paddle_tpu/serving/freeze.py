@@ -1,0 +1,188 @@
+"""Graph freezing: training Program -> pure inference Program.
+
+``freeze_program`` is the serving analogue of the reference's
+``load_inference_model`` pruning (PAPER.md: the fluid predictor pipeline):
+clone the graph in test mode, backward-slice it to the requested fetches
+(which drops backward/optimizer/loss-scale ops — they feed no fetch), and
+*verify* that nothing training-only survived (the
+``training-op-in-inference`` structural finding; strict verify refuses to
+compile a bad freeze). The frozen program is marked ``_is_inference`` so
+the Executor traces it in test mode and the static verifier holds it to
+the inference contract.
+
+INT8 leg: ``int8_scales=`` bakes slim's calibrated PTQ activation scales
+into the frozen graph through the same ``contrib/slim/quantization.py``
+walker QAT uses (weights quantize channel-wise at apply time), so the
+served graph carries its quant-dequant chain with zero training
+leftovers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FrozenModel:
+    """A servable graph: the frozen Program plus its feed/fetch contract."""
+
+    program: object
+    feed_names: tuple
+    fetch_names: tuple
+    # set when the INT8 leg baked calibrated scales into the graph
+    int8: bool = False
+    meta: dict = field(default_factory=dict)
+
+    def save(self, dirname, scope=None):
+        """Export with ``io.save_inference_model`` semantics (program +
+        CRC-manifested params) for a later ``load_frozen``."""
+        from .. import io as _io
+        from ..framework.scope import global_scope, scope_guard
+
+        with scope_guard(scope or global_scope()):
+            return _io.save_inference_model(
+                dirname, list(self.feed_names),
+                [self.program.global_block.var(n) for n in self.fetch_names],
+                main_program=self.program,
+            )
+
+
+def _referenced_names(program):
+    names = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            names.update(op.input_names())
+            names.update(op.output_names())
+    return names
+
+
+def _strip_unused_vars(program, keep_names=(), referenced=None):
+    """Drop Variable metadata nothing references after the prune: frozen
+    graphs travel (pickled into model dirs, shipped to servers), and a
+    training graph's optimizer-state/grad var table is dead weight there.
+    `keep_names` (the feed contract) always survives; pass `referenced`
+    to reuse an already-computed name walk."""
+    if referenced is None:
+        referenced = _referenced_names(program)
+    keep = referenced | set(keep_names)
+    removed = 0
+    for blk in program.blocks:
+        for name in [n for n in blk.vars if n not in keep]:
+            del blk.vars[name]
+            removed += 1
+    return removed
+
+
+def freeze_program(program, fetch_list, feed_names=(), int8_scales=None,
+                   quantizable_ops=None, verify=True):
+    """Freeze `program` to the pure inference subgraph producing
+    `fetch_list`.
+
+    Returns a :class:`FrozenModel`. The frozen Program:
+
+    * runs in test mode (``clone(for_test=True)`` flipped is_test ops;
+      ``_is_inference`` makes the Executor trace with ``is_test=True``);
+    * contains only ops on the feed->fetch path (``io.prune`` backward
+      slice — backward ``__vjp__``/grad ops, optimizer updates, and the
+      AMP loss-scale automaton all feed no fetch, so they fall away);
+    * passes the structural verifier's ``training-op-in-inference``
+      check (raises ``ProgramVerifyError`` if a training op survived —
+      e.g. a fetch that reaches through an optimizer output).
+
+    `int8_scales` ({var_name: calibrated scale}) routes quantizable-op
+    activations through fixed-scale quant-dequant ops and weights through
+    channel-wise abs-max quant-dequant (slim's PTQ bake), producing the
+    INT8-annotated serving graph.
+    """
+    from .. import observability as _obs
+    from ..io import prune
+
+    fetch_list = list(fetch_list)
+    test_prog = program.clone(for_test=True)
+    fetch_names = [
+        v.name if hasattr(v, "name") else str(v) for v in fetch_list
+    ]
+    targets = [test_prog.global_block.var(n) for n in fetch_names]
+    explicit_feeds = tuple(feed_names)
+    all_data = tuple(
+        v.name for v in test_prog.list_vars() if v.is_data
+    )
+    n_before = sum(len(b.ops) for b in test_prog.blocks)
+    frozen = prune(test_prog, targets, feeds=explicit_feeds or all_data)
+    frozen._is_inference = True
+    referenced = None
+    if explicit_feeds:
+        feed_names = explicit_feeds
+    else:
+        # the default feed contract is the data vars the PRUNED graph
+        # actually reads — a training graph's label inputs feed only the
+        # loss and must not survive into the serving contract (a router
+        # request would need a label array per submit)
+        referenced = _referenced_names(frozen)
+        feed_names = tuple(n for n in all_data if n in referenced)
+
+    if int8_scales is not None:
+        from ..contrib.slim.quantization import (QUANTIZABLE_OPS,
+                                                 bake_ptq_scales)
+
+        n_qdq = bake_ptq_scales(
+            frozen, int8_scales,
+            quantizable_ops=quantizable_ops or QUANTIZABLE_OPS,
+        )
+        _obs.add("serving.freeze_int8_qdq_ops", n_qdq)
+        referenced = None  # the bake added qdq ops/vars: re-walk
+
+    removed_vars = _strip_unused_vars(
+        frozen, keep_names=feed_names, referenced=referenced
+    )
+    frozen._bump()
+    n_after = sum(len(b.ops) for b in frozen.blocks)
+    _obs.add("serving.programs_frozen")
+    _obs.add("serving.freeze_ops_pruned", max(0, n_before - n_after))
+
+    if verify:
+        from ..analysis import verify_program
+        from ..analysis.findings import TRAINING_OP_IN_INFERENCE
+        from ..errors import ProgramVerifyError
+
+        report = verify_program(
+            frozen, feed_names, fetch_names,
+            families=("structural",),
+        )
+        survivors = report.by_category(TRAINING_OP_IN_INFERENCE)
+        if survivors:
+            raise ProgramVerifyError(
+                "freeze_program left training-only ops in the inference "
+                "graph (a fetch reaches through training state?):\n"
+                + "\n".join("  " + f.format() for f in survivors),
+                findings=report.findings,
+                op=survivors[0].op_type,
+            )
+    return FrozenModel(
+        program=frozen,
+        feed_names=tuple(feed_names),
+        fetch_names=tuple(fetch_names),
+        int8=int8_scales is not None,
+        meta={
+            "ops_pruned": max(0, n_before - n_after),
+            "vars_stripped": removed_vars,
+        },
+    )
+
+
+def load_frozen(dirname, scope=None, executor=None):
+    """Load a ``FrozenModel.save`` / ``io.save_inference_model`` export
+    as a servable :class:`FrozenModel`."""
+    from .. import io as _io
+    from ..framework.scope import global_scope, scope_guard
+
+    with scope_guard(scope or global_scope()):
+        # load_inference_model marks the program _is_inference itself
+        program, feed_names, fetch_names = _io.load_inference_model(
+            dirname, executor
+        )
+    return FrozenModel(
+        program=program,
+        feed_names=tuple(feed_names),
+        fetch_names=tuple(fetch_names),
+    )

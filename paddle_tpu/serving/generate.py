@@ -1,0 +1,258 @@
+"""KV-cache GPT generation: prefill + single-token decode programs.
+
+``GPTGenerator`` owns the two programs ``models/gpt.py`` splits the
+decoder into and the Scope their cache persistables share:
+
+* prefill — embed the [B, S] context ONCE, fill every layer's
+  ``gpt_l{i}_cache_{k,v}`` persistable rows 0..S-1, emit the last
+  position's logits;
+* decode — embed ONE token at a runtime position, append its K/V rows to
+  the caches (in-place: the Executor donates mutated persistables, so the
+  update is an HBM dynamic-update-slice), attend over the cache, emit
+  next-token logits.
+
+Generation is O(1) recompute per token instead of O(S): both programs
+compile exactly once (shapes never change across steps), so a T-token
+generation is 1 prefill dispatch + T-1 decode dispatches against warm
+executables. ``generate_full_recompute`` keeps the naive re-run-the-
+whole-context baseline alive for parity tests and the bench_serving
+speedup measurement.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..errors import InvalidArgumentError
+
+
+class GPTGenerator:
+    """Checkpoint -> tokens through the KV-cache decode path.
+
+    Shapes are fixed at construction (the serving bucket contract):
+    `batch` concurrent sequences, `context_len` prompt tokens, caches
+    sized `max_len`. ``generate`` emits up to
+    ``max_len - context_len`` tokens.
+    """
+
+    def __init__(self, cfg, batch, context_len, max_len, scope=None,
+                 executor=None):
+        import paddle_tpu as fluid
+        from ..framework.scope import Scope, scope_guard
+        from ..models.gpt import gpt_decode_step, gpt_prefill
+
+        if context_len >= max_len:
+            raise InvalidArgumentError(
+                f"context_len {context_len} must leave room to generate "
+                f"(max_len {max_len})"
+            )
+        self.cfg = cfg
+        self.batch = int(batch)
+        self.context_len = int(context_len)
+        self.max_len = int(max_len)
+        self.scope = scope or Scope()
+        self.executor = executor or fluid.Executor()
+
+        self.prefill_prog = fluid.Program()
+        self.startup_prog = fluid.Program()
+        with fluid.program_guard(self.prefill_prog, self.startup_prog):
+            ids = fluid.data("context_ids", [batch, context_len], "int64")
+            logits = gpt_prefill(ids, cfg, max_len)
+        self._prefill_fetch = [logits.name]
+
+        self.decode_prog = fluid.Program()
+        decode_startup = fluid.Program()  # same init ops; never run
+        with fluid.program_guard(self.decode_prog, decode_startup):
+            tok = fluid.data("token_ids", [batch, 1], "int64")
+            pos = fluid.data("pos_ids", [1, 1], "int64")
+            dlogits = gpt_decode_step(tok, pos, cfg, max_len)
+        self._decode_fetch = [dlogits.name]
+
+        # both are pure inference graphs: mark them so the Executor traces
+        # in test mode and the verifier holds the inference contract
+        self.prefill_prog._is_inference = True
+        self.decode_prog._is_inference = True
+        self._scope_guard = scope_guard
+
+    def _param_vars(self):
+        from ..models.gpt import gpt_cache_names
+
+        caches = set(gpt_cache_names(self.cfg))
+        return [
+            v for v in self.prefill_prog.list_vars()
+            if v.persistable and v.name not in caches
+        ]
+
+    # -- parameters --------------------------------------------------------
+    def init_params(self, seed=0):
+        """Random-init parameters (bench/test path; production loads a
+        checkpoint). Runs the prefill startup program once."""
+        self.startup_prog.random_seed = seed
+        self.prefill_prog.random_seed = seed
+        self.decode_prog.random_seed = seed
+        with self._scope_guard(self.scope):
+            self.executor.run(self.startup_prog, scope=self.scope)
+        self.reset()
+
+    def load_params(self, path):
+        """Load trained GPT parameters (``io.save`` format) into the
+        shared scope — cache vars excluded (they are runtime state, not
+        checkpoint content)."""
+        from .. import io as _io
+
+        with self._scope_guard(self.scope):
+            _io.load(self.prefill_prog, path, var_list=self._param_vars())
+        self.reset()
+
+    def save_params(self, path):
+        from .. import io as _io
+
+        with self._scope_guard(self.scope):
+            return _io.save(self.prefill_prog, path)
+
+    def reset(self):
+        """Zero the KV caches (fresh generation state)."""
+        import jax.numpy as jnp
+
+        from ..models.gpt import gpt_cache_names
+
+        shape = (self.batch, self.max_len, self.cfg.hidden_size)
+        for name in gpt_cache_names(self.cfg):
+            self.scope.set_var(name, jnp.zeros(shape, jnp.float32))
+
+    # -- generation --------------------------------------------------------
+    def generate(self, context_ids, max_new_tokens, greedy=True):
+        """Generate `max_new_tokens` per sequence; returns [B, T] int64.
+
+        Greedy decoding (argmax) — the deterministic contract the parity
+        tests rely on; sampling policies plug in at the caller by reading
+        logits instead."""
+        from .. import observability as _obs
+
+        if not greedy:
+            raise InvalidArgumentError(
+                "only greedy decoding is implemented; sample from the "
+                "logits fetch at the caller for other policies"
+            )
+        if int(max_new_tokens) < 1:
+            raise InvalidArgumentError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        ids = np.asarray(context_ids)
+        if ids.shape != (self.batch, self.context_len):
+            raise InvalidArgumentError(
+                f"context_ids must be [{self.batch}, {self.context_len}], "
+                f"got {ids.shape}"
+            )
+        t_total = self.context_len + int(max_new_tokens)
+        if t_total > self.max_len:
+            raise InvalidArgumentError(
+                f"context {self.context_len} + {max_new_tokens} new tokens "
+                f"exceeds max_len {self.max_len}"
+            )
+        self.reset()
+        with self._scope_guard(self.scope):
+            (logits,) = self.executor.run(
+                self.prefill_prog, feed={"context_ids": ids},
+                fetch_list=self._prefill_fetch, scope=self.scope,
+            )
+            _obs.add("serving.prefill_steps")
+            out = np.zeros((self.batch, max_new_tokens), np.int64)
+            nxt = np.argmax(np.asarray(logits)[:, -1, :], axis=-1)
+            out[:, 0] = nxt
+            for t in range(1, max_new_tokens):
+                pos = self.context_len + t - 1  # position of the fed token
+                (logits,) = self.executor.run(
+                    self.decode_prog,
+                    feed={
+                        "token_ids": nxt[:, None].astype(np.int64),
+                        "pos_ids": np.array([[pos]], np.int64),
+                    },
+                    fetch_list=self._decode_fetch, scope=self.scope,
+                )
+                nxt = np.argmax(np.asarray(logits)[:, -1, :], axis=-1)
+                out[:, t] = nxt
+            _obs.add("serving.decode_steps", max(0, max_new_tokens - 1))
+        return out
+
+    def generate_full_recompute(self, context_ids, max_new_tokens):
+        """The naive baseline: re-run the FULL context through a plain
+        ``gpt_logits`` graph for every emitted token (one fixed padded
+        shape, so it too compiles once — the comparison isolates
+        recompute cost, not compile count)."""
+        import paddle_tpu as fluid
+        from ..models.gpt import gpt_logits
+
+        ids = np.asarray(context_ids)
+        if int(max_new_tokens) < 1:
+            raise InvalidArgumentError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        t_total = self.context_len + int(max_new_tokens)
+        if t_total > self.max_len:
+            raise InvalidArgumentError(
+                f"context {self.context_len} + {max_new_tokens} new tokens "
+                f"exceeds max_len {self.max_len}"
+            )
+        prog = getattr(self, "_recompute_prog", None)
+        if prog is None or self._recompute_len != t_total:
+            cfg = self.cfg
+            prog = fluid.Program()
+            startup = fluid.Program()  # params come from the shared scope
+            with fluid.program_guard(prog, startup):
+                full = fluid.data("full_ids", [self.batch, t_total],
+                                  "int64")
+                logits = gpt_logits(full, cfg, is_test=True)
+            prog._is_inference = True
+            self._recompute_prog = prog
+            self._recompute_len = t_total
+            self._recompute_fetch = [logits.name]
+        prog = self._recompute_prog
+        padded = np.zeros((self.batch, t_total), np.int64)
+        padded[:, : self.context_len] = ids
+        out = np.zeros((self.batch, max_new_tokens), np.int64)
+        cur = self.context_len
+        with self._scope_guard(self.scope):
+            for t in range(max_new_tokens):
+                (logits,) = self.executor.run(
+                    prog, feed={"full_ids": padded},
+                    fetch_list=self._recompute_fetch, scope=self.scope,
+                )
+                nxt = np.argmax(np.asarray(logits)[:, cur - 1, :], axis=-1)
+                out[:, t] = nxt
+                if cur < t_total:
+                    padded[:, cur] = nxt
+                cur += 1
+        return out
+
+
+class GPTGenerateRunner:
+    """Router runner wrapping a GPTGenerator: a "generate" endpoint whose
+    batched dispatch is one prefill + T decode steps. The endpoint bucket
+    must equal the generator's batch (cache shapes are static)."""
+
+    def __init__(self, generator, max_new_tokens):
+        self.generator = generator
+        self.max_new_tokens = int(max_new_tokens)
+        self.feed_names = ("context_ids",)
+
+    def validate_config(self, config):
+        """Endpoint hook: cache shapes are static, so every configured
+        bucket must equal the generator's batch exactly."""
+        bad = [b for b in config.buckets if b != self.generator.batch]
+        if bad:
+            raise InvalidArgumentError(
+                f"GPT generate endpoint buckets {config.buckets} must all "
+                f"equal the generator batch {self.generator.batch} (cache "
+                "shapes are compiled static)"
+            )
+
+    def sample_spec(self, name):
+        return (self.generator.context_len,), "int64"
+
+    def run(self, feed):
+        return [
+            self.generator.generate(
+                feed["context_ids"], self.max_new_tokens
+            )
+        ]

@@ -1,0 +1,418 @@
+"""Request router with continuous/dynamic batching over bucketed shapes.
+
+One request = one sample (feed arrays WITHOUT the leading batch axis).
+Requests are admitted into a per-endpoint queue; a scheduler thread forms
+batches continuously: it waits until either enough requests queue to fill
+the largest bucket or the OLDEST queued request hits the max-wait
+deadline, then pads the batch up to the nearest configured bucket and
+runs it as ONE program dispatch. Because every batch lands on a bucket
+shape with the endpoint's exact fetch set, the executor's
+per-(program, feed-shapes, fetch-set) executable LRU serves every request
+after warmup with zero compiles — the serving analogue of the PR-6
+"one wide program" argument (arXiv:2301.13062: many small per-request
+programs lose badly to one bucketed one).
+
+Lifecycle: ``Server.drain()`` stops admission, flushes every in-flight
+batch, and stops the scheduler threads; :func:`install_preemption_handler`
+rides the PR-3 SIGTERM/exit-75 contract (drain, then exit
+``PREEMPTION_EXIT_CODE`` — the launcher treats it as a clean preemption).
+
+Observability (PR-1 registry): ``serving.requests`` / ``.rejected`` /
+``.requests_served`` / ``.request_errors`` counters,
+``serving.queue_depth`` gauge, ``serving.batches`` counter,
+``serving.batch_fill`` + ``serving.padding_waste`` histograms,
+``serving.request_latency`` + ``serving.batch_latency`` histograms (p50/
+p99 come out of the bucket counts), ``serving.drained`` counter.
+
+Fault seam: request ingestion passes ``fault_point("serving.ingest")``
+under a retry policy — the dataloader.fetch-style chaos seam for the CI
+serving smoke.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..errors import InvalidArgumentError, PreconditionNotMetError
+
+# batch-fill / padding-waste are ratios in [0, 1]; latency histograms use
+# the registry's default latency edges
+_RATIO_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+class ServerDrainingError(PreconditionNotMetError):
+    """Admission refused: the server is draining (SIGTERM) or stopped."""
+
+
+class EndpointConfig:
+    """Batching knobs for one endpoint.
+
+    * ``buckets`` — allowed batch sizes, ascending; a formed batch pads up
+      to the smallest bucket that fits (largest bucket caps batch size).
+    * ``max_wait_ms`` — how long the OLDEST queued request may wait for
+      co-batching before the scheduler dispatches a partial batch.
+    * ``max_queue`` — admission bound; beyond it submits are rejected
+      (``serving.rejected``) so an overloaded server degrades by shedding
+      instead of growing an unbounded queue.
+    """
+
+    def __init__(self, buckets=(1, 2, 4, 8), max_wait_ms=5.0,
+                 max_queue=1024):
+        sizes = sorted(int(b) for b in buckets)
+        if not sizes or sizes[0] <= 0:
+            raise InvalidArgumentError(
+                f"endpoint buckets must be positive, got {sizes}"
+            )
+        self.buckets = tuple(sizes)
+        self.max_wait = float(max_wait_ms) / 1000.0
+        self.max_queue = int(max_queue)
+
+
+class _Request:
+    __slots__ = ("feeds", "future", "t_enqueue")
+
+    def __init__(self, feeds):
+        self.feeds = feeds
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class FrozenRunner:
+    """Default runner: a FrozenModel executed through an Executor/Scope.
+
+    Feed variables must be declared batch-leading (shape[0] == -1 or the
+    sample rank excludes the batch axis); fetches must be per-sample
+    tensors with the batch leading, the same contract as
+    ``AnalysisConfig.set_batch_buckets``.
+    """
+
+    def __init__(self, frozen, executor=None, scope=None):
+        from ..framework.executor import Executor
+        from ..framework.scope import global_scope
+
+        self.frozen = frozen
+        self.executor = executor or Executor()
+        self.scope = scope or global_scope()
+        self.feed_names = tuple(frozen.feed_names)
+        self.fetch_names = tuple(frozen.fetch_names)
+        self._sample_specs = {}
+        blk = frozen.program.global_block
+        for n in self.feed_names:
+            v = blk.var(n)
+            shape = tuple(v.shape or ())
+            if not shape or shape[0] not in (-1, None):
+                raise InvalidArgumentError(
+                    f"serving feed {n!r} must be declared batch-leading "
+                    f"(-1 first dim), got {shape}"
+                )
+            self._sample_specs[n] = (tuple(shape[1:]), v.dtype)
+
+    def sample_spec(self, name):
+        """(per-sample shape, dtype) for feed `name`."""
+        return self._sample_specs[name]
+
+    def run(self, feed):
+        """Run one padded bucket batch; returns batch-leading outputs."""
+        return self.executor.run(
+            self.frozen.program, feed=feed,
+            fetch_list=list(self.fetch_names), scope=self.scope,
+        )
+
+
+class Endpoint:
+    """One servable model: queue + scheduler thread + bucketed dispatch."""
+
+    def __init__(self, name, runner, config=None):
+        from .. import observability as _obs
+        from ..resilience.retry import retry
+
+        self.name = name
+        self.runner = runner
+        self.config = config or EndpointConfig()
+        # runners with static shape constraints (e.g. the GPT generator's
+        # compiled cache batch) veto incompatible bucket configs up front
+        validate = getattr(runner, "validate_config", None)
+        if validate is not None:
+            validate(self.config)
+        self._queue = deque()
+        self._cond = threading.Condition()
+        # serializes runner.run between the scheduler thread and warmup():
+        # stateful runners (the GPT generator's shared KV-cache scope)
+        # must never see two interleaved dispatches
+        self._run_lock = threading.Lock()
+        self._draining = False
+        self._stopped = False
+        self._obs = _obs
+        self._ingest_retry = retry(
+            max_attempts=3, base_delay=0.005, max_delay=0.1,
+            name="serving.ingest",
+        )
+        self._thread = threading.Thread(
+            target=self._schedule_loop, daemon=True,
+            name=f"serving-{name}",
+        )
+        self._thread.start()
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, feeds):
+        """Admit one single-sample request; returns its Future."""
+        try:
+            return self._ingest_retry.call(self._ingest, feeds)
+        except ServerDrainingError:
+            self._obs.add("serving.rejected")
+            self._obs.add(f"serving.rejected.{self.name}")
+            raise
+
+    def _ingest(self, feeds):
+        from ..resilience.faults import fault_point
+
+        # the chaos seam (dataloader.fetch analogue): an armed fault
+        # raises HERE, before any state mutation, so the retry re-admits
+        # the identical request with no double-enqueue hazard
+        fault_point("serving.ingest")
+        feeds = {
+            n: np.asarray(feeds[n]) for n in self.runner.feed_names
+        }
+        req = _Request(feeds)
+        with self._cond:
+            if self._draining or self._stopped:
+                raise ServerDrainingError(
+                    f"endpoint {self.name!r} is draining; request refused"
+                )
+            if len(self._queue) >= self.config.max_queue:
+                self._obs.add("serving.rejected")
+                self._obs.add(f"serving.rejected.{self.name}")
+                raise PreconditionNotMetError(
+                    f"endpoint {self.name!r} queue full "
+                    f"({self.config.max_queue}); shed load or add capacity"
+                )
+            self._queue.append(req)
+            self._obs.set_gauge(
+                f"serving.queue_depth.{self.name}", len(self._queue)
+            )
+            self._cond.notify_all()
+        self._obs.add("serving.requests")
+        self._obs.add(f"serving.requests.{self.name}")
+        return req.future
+
+    # -- scheduling --------------------------------------------------------
+    def _schedule_loop(self):
+        max_bucket = self.config.buckets[-1]
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopped:
+                    self._cond.wait(0.05)
+                if self._stopped and not self._queue:
+                    return
+                # continuous batching: admit late arrivals until the
+                # largest bucket fills or the oldest request's deadline
+                # expires (draining flushes immediately)
+                deadline = self._queue[0].t_enqueue + self.config.max_wait
+                while (len(self._queue) < max_bucket
+                       and not self._draining and not self._stopped):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                n = min(len(self._queue), max_bucket)
+                batch = [self._queue.popleft() for _ in range(n)]
+                self._obs.set_gauge(
+                    f"serving.queue_depth.{self.name}", len(self._queue)
+                )
+            if batch:
+                self._run_batch(batch)
+
+    def _bucket_for(self, n):
+        for b in self.config.buckets:
+            if b >= n:
+                return b
+        return self.config.buckets[-1]
+
+    def _run_batch(self, batch):
+        t0 = time.perf_counter()
+        n = len(batch)
+        bucket = self._bucket_for(n)
+        try:
+            feed = {}
+            for name in self.runner.feed_names:
+                rows = np.stack([r.feeds[name] for r in batch])
+                if n < bucket:
+                    pad = np.zeros(
+                        (bucket - n,) + rows.shape[1:], rows.dtype
+                    )
+                    rows = np.concatenate([rows, pad], axis=0)
+                feed[name] = rows
+            with self._run_lock:
+                outs = [np.asarray(o) for o in self.runner.run(feed)]
+        except Exception as exc:
+            self._obs.add("serving.request_errors", n)
+            for r in batch:
+                r.future.set_exception(exc)
+            return
+        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        self._obs.add("serving.batches")
+        self._obs.add(f"serving.batches.{self.name}")
+        self._obs.add(f"serving.bucket_runs.{self.name}.{bucket}")
+        self._obs.observe("serving.batch_latency", dt)
+        self._obs.observe(
+            "serving.batch_fill", n / bucket, buckets=_RATIO_BUCKETS
+        )
+        self._obs.observe(
+            "serving.padding_waste", (bucket - n) / bucket,
+            buckets=_RATIO_BUCKETS,
+        )
+        self._obs.add("serving.padded_rows", bucket - n)
+        for i, r in enumerate(batch):
+            r.future.set_result([o[i] for o in outs])
+            lat = now - r.t_enqueue
+            self._obs.observe("serving.request_latency", lat)
+            self._obs.observe(f"serving.request_latency.{self.name}", lat)
+        self._obs.add("serving.requests_served", n)
+
+    # -- warmup ------------------------------------------------------------
+    def warmup(self):
+        """Compile the EXACT (bucket-shape, fetch-set) executables serving
+        will dispatch: one zero-feed run per bucket through the same
+        ``runner.run`` entry the scheduler uses. The executor's executable
+        cache (and its flops/estimate digests) key on the fetch set, so a
+        warmup with a different fetch list — or a different batch shape —
+        would leave every real bucket cold and push the first compile into
+        a user-visible request latency (the PR-6 bench warmup lesson)."""
+        from ..core.dtypes import to_numpy_dtype
+
+        for b in self.config.buckets:
+            feed = {}
+            for name in self.runner.feed_names:
+                shape, dtype = self.runner.sample_spec(name)
+                feed[name] = np.zeros((b,) + shape, to_numpy_dtype(dtype))
+            with self._run_lock:
+                self.runner.run(feed)
+            self._obs.add("serving.warmup_runs")
+        return len(self.config.buckets)
+
+    # -- lifecycle ---------------------------------------------------------
+    def pending(self):
+        with self._cond:
+            return len(self._queue)
+
+    def drain(self, timeout=None):
+        """Stop admitting, flush the queue through the scheduler, stop the
+        thread. Returns True when everything in flight completed."""
+        with self._cond:
+            self._draining = True
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        return not self._thread.is_alive() and not self._queue
+
+
+class Server:
+    """A set of endpoints behind one admission/drain lifecycle."""
+
+    def __init__(self):
+        self._endpoints = {}
+        self._draining = False
+        self._drained = threading.Event()
+        self._lock = threading.Lock()
+
+    def add_endpoint(self, name, runner, config=None, frozen=None,
+                     executor=None, scope=None):
+        """Register (and start) an endpoint. Pass a ``runner`` with the
+        FrozenRunner interface, or ``frozen=`` to wrap a FrozenModel."""
+        if frozen is not None:
+            runner = FrozenRunner(frozen, executor=executor, scope=scope)
+        if runner is None:
+            raise InvalidArgumentError(
+                "add_endpoint needs runner= or frozen="
+            )
+        with self._lock:
+            if self._draining:
+                raise ServerDrainingError("server is draining")
+            if name in self._endpoints:
+                raise InvalidArgumentError(
+                    f"endpoint {name!r} already registered"
+                )
+            ep = Endpoint(name, runner, config)
+            self._endpoints[name] = ep
+        return ep
+
+    def __getitem__(self, name):
+        return self._endpoints[name]
+
+    def endpoints(self):
+        return dict(self._endpoints)
+
+    def submit(self, endpoint, feeds):
+        if self._draining:
+            from .. import observability as _obs
+
+            _obs.add("serving.rejected")
+            raise ServerDrainingError("server is draining")
+        return self._endpoints[endpoint].submit(feeds)
+
+    def warmup(self):
+        """Warm every endpoint's bucket executables; returns total runs."""
+        return sum(ep.warmup() for ep in self._endpoints.values())
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def drain(self, timeout=None):
+        """Graceful shutdown: stop admission, complete every admitted
+        request, stop scheduler threads, then bump ``serving.drained``.
+        Idempotent; returns True when fully drained."""
+        from .. import observability as _obs
+
+        with self._lock:
+            first = not self._draining
+            self._draining = True
+            eps = list(self._endpoints.values())
+        ok = True
+        for ep in eps:
+            ok = ep.drain(timeout) and ok
+        if first:
+            _obs.add("serving.drained")
+            _obs.set_gauge("serving.draining", 1.0)
+        if ok:
+            self._drained.set()
+        return ok
+
+    def wait_drained(self, timeout=None):
+        return self._drained.wait(timeout)
+
+
+def install_preemption_handler(server, exit_on_drain=True, timeout=None):
+    """SIGTERM -> drain -> exit ``PREEMPTION_EXIT_CODE`` (75), riding the
+    PR-3 preemption contract: the launcher treats 75 as a clean preempt
+    (no restart-budget burn). The signal handler only spawns the drain
+    thread (handlers must stay tiny); with ``exit_on_drain=False`` the
+    caller observes ``server.wait_drained()`` instead — the in-process
+    test shape."""
+    import os
+    import signal
+
+    from ..resilience.health import PREEMPTION_EXIT_CODE
+
+    def _drain_then_exit():
+        server.drain(timeout)
+        if exit_on_drain:
+            # handlers/threads cannot sys.exit the main thread; preemption
+            # wants no further cleanup anyway (checkpointless server)
+            os._exit(PREEMPTION_EXIT_CODE)
+
+    def _on_sigterm(signum, frame):
+        threading.Thread(
+            target=_drain_then_exit, daemon=True,
+            name="serving-drain",
+        ).start()
+
+    old = signal.getsignal(signal.SIGTERM)
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    return old

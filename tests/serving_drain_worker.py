@@ -1,0 +1,113 @@
+"""Serving preemption worker: serve load until SIGTERM, drain, exit 75.
+
+Driven by tests/test_serving.py::test_drain_worker_exits_75 and the ci.sh
+serving smoke: the parent SIGTERMs this process mid-load and asserts
+
+* exit code == PREEMPTION_EXIT_CODE (75, the PR-3 preemption contract),
+* every admitted request completed (result.json: dropped == 0),
+* the ``serving.drained`` counter fired exactly once.
+
+Usage: python tests/serving_drain_worker.py OUT_DIR
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import layers, observability  # noqa: E402
+from paddle_tpu.framework.scope import Scope, scope_guard  # noqa: E402
+from paddle_tpu.resilience.health import PREEMPTION_EXIT_CODE  # noqa: E402
+from paddle_tpu.serving import (  # noqa: E402
+    Server,
+    freeze_program,
+    install_preemption_handler,
+)
+from paddle_tpu.serving.router import (  # noqa: E402
+    EndpointConfig,
+    ServerDrainingError,
+)
+
+
+def main():
+    out_dir = sys.argv[1]
+    scope = Scope()
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.data("x", [-1, 16])
+        lab = fluid.data("lab", [-1, 1], "int64")
+        logits = layers.fc(layers.fc(x, 32, act="relu"), 4)
+        prob = layers.softmax(logits)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, lab))
+        fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+    frozen = freeze_program(main_prog, [prob], feed_names=("x",))
+
+    server = Server()
+    server.add_endpoint(
+        "clf", None, EndpointConfig(buckets=(1, 2, 4, 8), max_wait_ms=5.0),
+        frozen=frozen, executor=exe, scope=scope,
+    )
+    server.warmup()
+    install_preemption_handler(server, exit_on_drain=False)
+
+    # signal readiness only after warmup: the parent's SIGTERM must land
+    # during steady-state load, not during compiles
+    with open(os.path.join(out_dir, "ready"), "w") as f:
+        f.write("1")
+
+    rng = np.random.RandomState(0)
+    futures = []
+    while not server.draining:
+        try:
+            futures.append(
+                server.submit(
+                    "clf", {"x": rng.randn(16).astype(np.float32)}
+                )
+            )
+        except ServerDrainingError:
+            break
+        except Exception:
+            # queue-full shedding under the tight submit loop: back off
+            import time as _time
+
+            _time.sleep(0.005)
+            continue
+    if not server.wait_drained(timeout=60):
+        print("drain never completed", file=sys.stderr)
+        sys.exit(1)
+
+    served = dropped = 0
+    for f in futures:
+        try:
+            f.result(timeout=5)
+            served += 1
+        except Exception:
+            dropped += 1
+    counters = observability.get_counters()
+    with open(os.path.join(out_dir, "result.json"), "w") as f:
+        json.dump({
+            "admitted": len(futures),
+            "served": served,
+            "dropped": dropped,
+            "drained_counter": counters.get("serving.drained", 0),
+            "requests_served": counters.get("serving.requests_served", 0),
+        }, f)
+    sys.exit(PREEMPTION_EXIT_CODE)
+
+
+if __name__ == "__main__":
+    main()

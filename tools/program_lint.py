@@ -107,6 +107,36 @@ def _broken_fixture():
     return main, ("x",), (loss.name,)
 
 
+def _broken_frozen_fixture():
+    """A "frozen" inference program with a surviving optimizer op: the
+    ``training-op-in-inference`` structural finding must reject it (the
+    serving freeze regression fixture)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4])
+        pred = layers.fc(x, 2)
+        prob = layers.softmax(pred)
+    blk = main.global_block
+    # a leftover sgd update (as if prune missed it): params mutate while
+    # serving — the exact defect the finding exists to catch
+    w = blk.all_parameters()[0]
+    blk.create_var(name="lr0", shape=[1], dtype="float32")
+    blk.append_op(
+        "fill_constant", {}, {"Out": ["lr0"]},
+        {"shape": [1], "dtype": "float32", "value": 0.1},
+    )
+    blk.append_op(
+        "sgd",
+        {"Param": [w.name], "Grad": [w.name], "LearningRate": ["lr0"]},
+        {"ParamOut": [w.name]},
+    )
+    main._is_inference = True
+    return main, ("x",), (prob.name,)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--all-models", action="store_true",
@@ -119,14 +149,20 @@ def main(argv=None):
                     help="print INFO findings too")
     ap.add_argument("--broken-fixture", action="store_true",
                     help="lint the seeded broken program (must fail)")
+    ap.add_argument("--broken-frozen-fixture", action="store_true",
+                    help="lint a frozen program with a surviving "
+                         "training op (must fail)")
     ap.add_argument("--cost", action="store_true",
                     help="print the Program.estimate() cost table per model")
     args = ap.parse_args(argv)
 
-    if args.broken_fixture:
+    if args.broken_fixture or args.broken_frozen_fixture:
         from paddle_tpu.analysis import verify_program
 
-        program, feeds, fetches = _broken_fixture()
+        program, feeds, fetches = (
+            _broken_frozen_fixture() if args.broken_frozen_fixture
+            else _broken_fixture()
+        )
         report = verify_program(program, feeds, fetches)
         for f in report.findings:
             print("    " + f.format())
