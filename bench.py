@@ -790,6 +790,37 @@ def bench_mask_rcnn(on_accel):
     }
 
 
+def bench_dp_sharding(on_accel):
+    """ZeRO weight-update sharding + quantized collectives on the dp=8
+    virtual mesh (tools/bench_dp_sharding.py in a pinned CPU child
+    process — a payload/memory leg, not a throughput leg): collective
+    wire bytes vs the allreduce baseline, optimizer-state bytes/rank,
+    and loss parity. Gates: >=40% int8 payload reduction, state/rank
+    ~1/8, fp32 parity."""
+    import os
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "bench_dp_sharding.py")],
+        capture_output=True, text=True, timeout=1200,
+    )
+    line = (proc.stdout or "").strip().splitlines()
+    if proc.returncode != 0 or not line:
+        raise RuntimeError(
+            f"bench_dp_sharding failed (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}"
+        )
+    m = json.loads(line[-1])
+    return {
+        **m,
+        "metric": "dp_sharding_payload_reduction",
+        "value": m["int8_payload_reduction"],
+        "unit": "fraction_of_allreduce_wire_bytes_saved",
+    }
+
+
 def main():
     import jax
 
@@ -802,6 +833,7 @@ def main():
         ("gpt_longctx", lambda: bench_gpt_longctx(on_accel, 2048, 4)),
         ("deepfm", lambda: bench_deepfm(on_accel)),
         ("mask_rcnn", lambda: bench_mask_rcnn(on_accel)),
+        ("dp_sharding", lambda: bench_dp_sharding(on_accel)),
     ]
     if on_accel:
         legs += [

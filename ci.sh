@@ -34,6 +34,25 @@ fi
 echo "== bench smoke =="
 python bench.py
 
+echo "== multichip dryrun: dp weight-update sharding + quantized collectives =="
+# allreduce vs ZeRO-sharded vs int8-quantized on the dp=8 virtual mesh:
+# the tool self-gates (>=40% int8 payload reduction, optimizer-state
+# bytes/rank ~1/8, fp32 loss parity) and its snapshot must carry the new
+# per-kind/precision payload counters + sharding gauges
+DPS_DIR=$(mktemp -d)
+# --steps 2: the gates are trace-time byte accounting + parity, so the
+# short run gates identically (bench.py's dp_sharding leg already ran the
+# full-length leg above)
+python tools/bench_dp_sharding.py --steps 2 \
+    --dump "$DPS_DIR/dp_sharding_stats.json"
+python tools/stats_report.py "$DPS_DIR/dp_sharding_stats.json" \
+    --require collective.reduce_scatter --require collective.all_gather \
+    --require collective.bytes.reduce_scatter_int8 \
+    --require collective.bytes.all_gather_int8 \
+    --require collective.bytes.reduce_scatter_fp32 \
+    --require collective.zero_
+rm -rf "$DPS_DIR"
+
 echo "== serving smoke (load gen + chaos ingest + drain) =="
 # short load-gen run over all three traffic mixes with a fault injected
 # on the request-ingestion seam (dataloader.fetch-style): the router's
@@ -360,6 +379,9 @@ echo "== exact-resume chaos stage: 2-rank SIGKILL mid-epoch + elastic resume =="
 # consumed twice, the resume counters fired, and a v1 (epoch-only)
 # checkpoint still loads
 python tools/resume_audit.py
+# ...and again with dp-sharded optimizer state (Momentum velocity shards
+# under the ZeRO weight-update transpile): kill/resume must stay bitwise
+python tools/resume_audit.py --sharded
 
 echo "== driver entry points =="
 python __graft_entry__.py

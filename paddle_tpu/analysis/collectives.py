@@ -57,6 +57,9 @@ ATTR_AXIS_OPS = {
     "alltoall": "dp",
     "collective_permute": "dp",
     "barrier": "dp",
+    "c_allreduce_any": "dp",
+    "zero_reduce_scatter": "dp",
+    "zero_all_gather": "dp",
     "dgc_momentum_step": "dp",
     "distributed_lookup_table": "ps",
     "moe_ffn": "ep",
@@ -77,11 +80,29 @@ _BODY_ATTRS = ("sub_block",)  # while / scan_block / bounded_while
 MAX_RANK_COMBOS = 128
 
 
+# sharded-weight-update collectives whose WIRE FORMAT is part of the site
+# kind: an int8-quantized reduce-scatter on one rank paired with a
+# full-precision one on another is a payload-size mismatch — the exchange
+# deadlocks (or corrupts) exactly like a kind mismatch, so the lint must
+# distinguish the quantized variants
+_QUANT_KIND_OPS = frozenset({"zero_reduce_scatter", "zero_all_gather"})
+
+
+def _site_kind(op, t):
+    if t in _QUANT_KIND_OPS:
+        quant = op.attr("quant", "none")
+        if quant and quant != "none":
+            return f"{t}:{quant}"
+    return t
+
+
 def collective_axis(op):
-    """(axis_name, kind) if `op` is collective-bearing, else (None, None)."""
+    """(axis_name, kind) if `op` is collective-bearing, else (None, None).
+    For quantized sharded-update collectives the kind carries the wire
+    format (e.g. ``zero_reduce_scatter:int8``)."""
     t = op.type
     if t in ATTR_AXIS_OPS:
-        return op.attr("axis_name", ATTR_AXIS_OPS[t]), t
+        return op.attr("axis_name", ATTR_AXIS_OPS[t]), _site_kind(op, t)
     if t in FIXED_AXIS_OPS:
         return FIXED_AXIS_OPS[t], t
     if t in _PIPELINE_OPS:

@@ -180,7 +180,20 @@ _COLLECTIVE_FACTORS = {
     "c_broadcast": lambda n: 1.0,
     "collective_permute": lambda n: 1.0,
     "barrier": lambda n: 0.0,
+    # sharded weight update (ZeRO): reduce-scatter and all-gather each move
+    # (n-1)/n of the payload; found-inf any-reduce is a [1]-element
+    # allreduce
+    "zero_reduce_scatter": lambda n: float(n - 1) / n,
+    "zero_all_gather": lambda n: float(n - 1) / n,
+    "c_allreduce_any": lambda n: 2.0 * (n - 1) / n,
 }
+
+#: int8 block quantization (ops/collective.py): effective bytes per
+#: payload element = 1 int8 + one fp32 scale per `quant_block` elements.
+def _quant_elem_bytes(quant, block, fp_itemsize):
+    if quant and quant != "none":
+        return 1.0 + 4.0 / max(int(block or 256), 1)
+    return float(fp_itemsize)
 
 
 def family_of(op_type: str) -> str:
@@ -533,6 +546,18 @@ def _collective_cost(op, ins, outs, axis_sizes):
     if n <= 1:
         return 0.0, 0.0  # unbound axis: the emitter degrades to identity
     factor = _COLLECTIVE_FACTORS.get(op.type, lambda n: 1.0)(n)
+    if op.type in ("zero_reduce_scatter", "zero_all_gather"):
+        # the wire payload is the PADDED flat vector at the (possibly
+        # quantized) element size, not the declared input tensor:
+        # pad_len * (1B + 4B/quant_block) int8, pad_len * itemsize fp
+        pad = int(op.attr("pad_len") or _nelem(payload))
+        elem = _quant_elem_bytes(
+            op.attr("quant", "none"), op.attr("quant_block", 256),
+            payload[1] if payload else 4,
+        )
+        # reduce-scatter sums n contributions per received element
+        flops = float(pad) if op.type == "zero_reduce_scatter" else 0.0
+        return flops, pad * elem * factor
     flops = float(_nelem(payload)) if "allreduce" in op.type else 0.0
     return flops, nbytes * factor
 

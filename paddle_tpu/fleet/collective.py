@@ -47,6 +47,16 @@ class DistributedStrategy:
         self.amp_loss_scaling = 2.0**15
         self.mesh_axes = None  # {axis: size}; default all-dp
         self.sharding = {}  # extra var->spec annotations (TP etc.)
+        # ZeRO-style cross-replica weight-update sharding
+        # (arXiv:2004.13336): per-grad allreduce becomes reduce-scatter +
+        # shard-local optimizer update + param all-gather; optimizer state
+        # is 1/dp per rank (parallel/transpiler.py ShardedWeightUpdate)
+        self.shard_weight_update = False
+        # opt-in block-quantized collectives for the sharded update
+        # (arXiv:2506.17615 EQuARX): None/"none" = full precision wire,
+        # "int8" = int8 blocks with per-block fp32 scales, fp32 accumulate
+        self.collective_quant = None
+        self.collective_quant_block = 256
 
 
 _CHECKPOINT_PREFIX = "__paddle_checkpoint__"
@@ -64,6 +74,59 @@ TRAIN_STATUS_VERSION = 2
 
 def _rank_dir_name(rank):
     return f"{_RANK_PREFIX}{int(rank)}"
+
+
+#: npz-key marker for a process-local dim-0 slice of a cross-process-
+#: sharded persistable: "<var name>@@off<global dim0 start>"
+_SLICE_MARK = "@@off"
+
+
+def _local_dim0_slices(name, value):
+    """{key: np.ndarray} for every dim-0 slice of `value` addressable from
+    this process (deduped: replicated-over-submesh shards repeat). Only
+    dim-0 sharding is expressible in the ``@@off<start>`` key layout —
+    anything else (e.g. a TP column-parallel persistable) would collapse
+    distinct shards onto one key and silently drop data, so it refuses."""
+    import numpy as np
+
+    out = {}
+    for sh in value.addressable_shards:
+        idx = tuple(sh.index)
+        for d, s in enumerate(idx[1:], start=1):
+            if isinstance(s, slice) and not (
+                s.start in (None, 0)
+                and s.stop in (None, int(value.shape[d]))
+            ):
+                raise ValueError(
+                    f"local_vars persistable {name!r} is sharded over "
+                    f"dim {d}; per-rank checkpoint slices support dim-0 "
+                    "sharding only (the ZeRO flat-state layout)"
+                )
+        start = 0
+        if idx and isinstance(idx[0], slice):
+            start = int(idx[0].start or 0)
+        out[f"{name}{_SLICE_MARK}{start}"] = np.asarray(sh.data)
+    return out
+
+
+def _overlay_slice(scope, key, arr):
+    """Write a persisted dim-0 slice back over the startup-initialized
+    full-shape value (the inverse of :func:`_local_dim0_slices`); the
+    SPMD staging then slices each rank's part out again, so the untouched
+    remainder is never read. Returns False when the base value is absent
+    or itself not materializable host-side."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    name, off = key.rsplit(_SLICE_MARK, 1)
+    base = scope.find_var(name)
+    if base is None or not getattr(base, "is_fully_addressable", True):
+        return False
+    full = np.asarray(base).copy()
+    start = int(off)
+    full[start:start + arr.shape[0]] = arr
+    scope.set_var(name, jnp.asarray(full))
+    return True
 
 
 def _dir_numbers(dirs):
@@ -224,8 +287,16 @@ class Fleet:
             for v in local_vars:
                 name = v if isinstance(v, str) else v.name
                 value = scope.find_var(name)
-                if value is not None:
+                if value is None:
+                    continue
+                if getattr(value, "is_fully_addressable", True):
                     arrays[name] = np.asarray(value)
+                else:
+                    # cross-process-sharded state (ZeRO optimizer shards):
+                    # persist only the dim-0 slices THIS process holds,
+                    # keyed by their global offset; load overlays them
+                    # back into the startup-initialized full value
+                    arrays.update(_local_dim0_slices(name, value))
             payload = os.path.join(shard, "__params__.npz")
             _io._atomic_write(payload, lambda f: np.savez(f, **arrays))
             _io._write_manifest(
@@ -396,7 +467,16 @@ class Fleet:
             if fs.is_exist(ckpt):
                 fs.delete(tmp)
                 return
-            _io.save_persistables(executor, local, main_program)
+            # local_vars travel in the per-rank shards, not the
+            # replicated payload (on a cross-process mesh this process
+            # could not materialize them anyway)
+            _io.save_persistables(
+                executor, local, main_program,
+                exclude=[
+                    v if isinstance(v, str) else v.name
+                    for v in (local_vars or ())
+                ],
+            )
             with open(os.path.join(local, _TRAIN_STATUS_FILE), "w") as f:
                 json.dump(train_status.to_dict(), f)
             commit = self._commit_record(train_status, no, per_rank)
@@ -599,11 +679,16 @@ class Fleet:
                     )
         payload = os.path.join(shard, "__params__.npz")
         if os.path.exists(payload):
+            from .. import observability as _obs
             from ..framework.scope import global_scope
 
             arrays = _io._load_npz_verified(payload)
             scope = global_scope()
             for name, arr in arrays.items():
+                if _SLICE_MARK in name:
+                    if not _overlay_slice(scope, name, arr):
+                        _obs.add("resilience.shard_overlay_skipped")
+                    continue
                 scope.set_var(name, jnp.asarray(arr))
         status_file = os.path.join(shard, _TRAIN_STATUS_FILE)
         if os.path.exists(status_file):
@@ -875,6 +960,30 @@ class CollectiveOptimizer:
     def apply_gradients(self, params_grads):
         return self._inner.apply_gradients(params_grads)
 
+    def _check_shardable(self):
+        """shard_weight_update preconditions: grad clipping and
+        regularization both read FULL-tensor gradients after the
+        reduce-scatter would land (a shard-local norm silently changes
+        the math), so they refuse to compose until a sharded global-norm
+        path exists."""
+        opt = self._inner
+        seen = set()
+        while opt is not None and id(opt) not in seen:
+            seen.add(id(opt))
+            if getattr(opt, "_grad_clip", None) is not None:
+                raise NotImplementedError(
+                    "shard_weight_update does not compose with grad_clip "
+                    "yet: clipping norms are full-tensor reductions"
+                )
+            if getattr(opt, "regularization", None) is not None:
+                raise NotImplementedError(
+                    "shard_weight_update does not compose with "
+                    "regularization yet (weight decay via AdamW is fine)"
+                )
+            opt = getattr(opt, "_inner", None) or getattr(
+                opt, "inner_optimizer", None
+            )
+
     def minimize(
         self, loss, startup_program=None, parameter_list=None, no_grad_set=None
     ):
@@ -909,7 +1018,24 @@ class CollectiveOptimizer:
                 )
             # no dp axis in the mesh -> pure model parallel, no grad allreduce
             dp = mesh.shape.get(DATA_AXIS, 1)
-            if dp > 1:
+            sharded = bool(strategy.shard_weight_update) and dp > 1
+            quant = strategy.collective_quant
+            if quant not in (None, "", "none", "int8"):
+                raise ValueError(
+                    f"DistributedStrategy.collective_quant={quant!r} is "
+                    "unknown; supported: None | 'int8'"
+                )
+            if quant in ("int8",) and not strategy.shard_weight_update:
+                # a silently ignored knob would let users believe they
+                # bought the 4x wire reduction
+                raise ValueError(
+                    "DistributedStrategy.collective_quant requires "
+                    "shard_weight_update=True: quantized payloads exist "
+                    "only on the reduce-scatter/all-gather path"
+                )
+            if sharded:
+                self._check_shardable()
+            if dp > 1 and not sharded:
                 GradAllReduce(dp).transpile(main, params_grads)
                 from .. import observability as _obs
 
@@ -917,6 +1043,20 @@ class CollectiveOptimizer:
                          len(params_grads))
                 _obs.set_gauge("collective.dp_degree", dp)
             ops = inner.apply_gradients(params_grads)
+            if sharded:
+                # the update ops exist now: rewrite them onto 1/dp shards
+                # (reduce-scatter grads, shard-local update, param
+                # all-gather) — the ZeRO transpile
+                from ..parallel.transpiler import ShardedWeightUpdate
+
+                ShardedWeightUpdate(
+                    dp,
+                    quant=strategy.collective_quant,
+                    quant_block=strategy.collective_quant_block,
+                ).transpile(main, startup, params_grads)
+                from .. import observability as _obs
+
+                _obs.set_gauge("collective.dp_degree", dp)
             if dp > 1:
                 # fetched metrics (loss) are shard-local means; average them
                 # across dp so exe.run returns the global-batch value (the

@@ -189,15 +189,62 @@ def verify_checkpoint_dir(dirname, filename=None):
     _load_npz_verified(path)
 
 
-def _collect(program, scope, predicate):
+def _collect(program, scope, predicate, exclude=frozenset()):
     out = {}
+    skipped = []
     for var in program.list_vars():
-        if not predicate(var):
+        if not predicate(var) or var.name in exclude:
             continue
         val = scope.find_var(var.name)
-        if val is not None:
-            out[var.name] = np.asarray(val)
+        if val is None:
+            continue
+        if not _is_fully_addressable(val):
+            # multi-process array: a REPLICATED value is recoverable from
+            # the local replica; a genuinely cross-process-sharded value
+            # (ZeRO weight-update state) cannot be materialized here and
+            # must travel via Fleet.save_check_point(local_vars=...)
+            rep = _local_full_replica(val)
+            if rep is not None:
+                out[var.name] = rep
+            else:
+                skipped.append(var.name)
+            continue
+        out[var.name] = np.asarray(val)
+    if skipped:
+        import warnings
+
+        from . import observability as _obs
+
+        _obs.add("io.nonaddressable_vars_skipped", len(skipped))
+        warnings.warn(
+            f"save skipped {len(skipped)} cross-process-sharded "
+            f"persistable(s) {skipped[:5]}{'...' if len(skipped) > 5 else ''}"
+            " this process cannot materialize; pass them as local_vars= to "
+            "Fleet.save_check_point so each rank persists its own slice — "
+            "otherwise they will NOT be restored on resume",
+            stacklevel=3,
+        )
     return out
+
+
+def _is_fully_addressable(val):
+    """Whether this process holds every shard of `val` (plain numpy and
+    single-process jax arrays: yes; multi-host-sharded jax arrays: no)."""
+    return bool(getattr(val, "is_fully_addressable", True))
+
+
+def _local_full_replica(val):
+    """np.ndarray of `val` if some addressable shard spans the WHOLE
+    array (i.e. the value is replicated over the processes this one can
+    see), else None."""
+    for sh in val.addressable_shards:
+        if all(
+            isinstance(s, slice)
+            and s.start in (None, 0) and s.stop in (None, int(dim))
+            for s, dim in zip(sh.index, val.shape)
+        ):
+            return np.asarray(sh.data)
+    return None
 
 
 def _is_persistable(v):
@@ -212,15 +259,22 @@ def save_params(executor, dirname, main_program=None, filename=None):
     _save_vars(dirname, main_program, _is_parameter, filename)
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
-    _save_vars(dirname, main_program, _is_persistable, filename)
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      exclude=None):
+    """`exclude`: var names to leave out of the payload — the per-rank
+    checkpoint machinery passes its `local_vars` here so state that each
+    rank persists in its own shard is not duplicated (or warned about)
+    in the replicated payload."""
+    _save_vars(dirname, main_program, _is_persistable, filename,
+               exclude=exclude)
 
 
-def _save_vars(dirname, main_program, predicate, filename):
+def _save_vars(dirname, main_program, predicate, filename, exclude=None):
     fault_point("io.save")
     program = main_program or default_main_program()
     scope = global_scope()
-    arrays = _collect(program, scope, predicate)
+    arrays = _collect(program, scope, predicate,
+                      exclude=frozenset(exclude or ()))
     os.makedirs(dirname, exist_ok=True)
     path = os.path.join(dirname, filename or "__params__.npz")
     _atomic_write(path, lambda f: np.savez(f, **arrays))
@@ -239,6 +293,7 @@ def _load_vars(dirname, main_program, filename):
     import jax.numpy as jnp
 
     fault_point("io.load")
+    program = main_program or default_main_program()
     scope = global_scope()
     path = os.path.join(dirname, filename or "__params__.npz")
     # verify the WHOLE payload before the first scope write: a corrupt
@@ -246,6 +301,49 @@ def _load_vars(dirname, main_program, filename):
     arrays = _load_npz_verified(path)
     for name, arr in arrays.items():
         scope.set_var(name, jnp.asarray(arr))
+    _rederive_zero_shards(program, scope, set(arrays))
+
+
+def _rederive_zero_shards(program, scope, loaded_names):
+    """Warm-start bridge for the sharded weight update: when a value is
+    loaded from a NON-sharded layout (plain params, or a replicated-era
+    checkpoint's full moments) but the program's update runs on a
+    ``<name>@ZERO_SHARD`` flat master (parallel/transpiler.py), the shard
+    still holds its startup init — the first ``zero_all_gather`` would
+    silently revert the loaded weights. Re-derive such shards from the
+    freshly loaded value. A shard that was itself in the payload (saved
+    from a sharded run) is authoritative and left alone."""
+    import jax.numpy as jnp
+
+    shards_of = {
+        v._zero_shard_of: (sname, v)
+        for sname, v in program.global_block.vars.items()
+        if getattr(v, "_zero_shard_of", None) is not None
+    }
+    rederived = 0
+    for name in loaded_names & set(shards_of):
+        sname, v = shards_of[name]
+        if sname in loaded_names:
+            continue
+        loaded = scope.find_var(name)
+        if loaded is None or not _is_fully_addressable(loaded):
+            continue
+        full = np.asarray(loaded).reshape(-1)
+        pad = int(v.shape[0])
+        flat = np.zeros(pad, dtype=full.dtype)
+        flat[: full.size] = full
+        scope.set_var(sname, jnp.asarray(flat))
+        rederived += 1
+        if not program.global_block.has_var(name):
+            # a full-size accumulator from a replicated-era checkpoint:
+            # its program var was deleted by the sharded transpile, so
+            # after the copy into the shard nothing ever reads it — drop
+            # it instead of stranding 2x-params of host memory
+            scope.erase(name)
+    if rederived:
+        from . import observability as _obs
+
+        _obs.add("collective.zero_shards_rederived", rederived)
 
 
 def save(program, model_path):

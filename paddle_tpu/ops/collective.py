@@ -157,6 +157,176 @@ def _collective_permute(ctx, op, ins):
     return {"Out": [lax.ppermute(x, ax, perm)]}
 
 
+@register_op(
+    "c_allreduce_any", inputs=["X"], outputs=["Out"], differentiable=False
+)
+def _c_allreduce_any(ctx, op, ins):
+    """Cross-rank logical OR (max over int cast) — the AMP FoundInfinite
+    reduction of the sharded weight update: after a reduce-scatter each
+    rank checks finiteness of only ITS 1/N grad shard, so the loss-scale
+    automaton must see "any rank saw a non-finite" or the ranks' scales
+    silently diverge (the ZeRO analog of the reference's nccl allreduce
+    on found_inf)."""
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    _record("c_allreduce_any", x, ax)
+    if ax is None:
+        return {"Out": [x]}
+    return {"Out": [lax.pmax(x.astype(jnp.int32), ax).astype(x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-style weight-update sharding collectives (arXiv:2004.13336) with an
+# opt-in EQuARX-style block-quantized wire format (arXiv:2506.17615).
+#
+# Data layout contract (parallel/transpiler.py ShardedWeightUpdate is the
+# only producer): gradients/optimizer state travel as FLAT [pad_len]
+# vectors, pad_len a multiple of nranks (and of quant_block when
+# quantized); the dp-sharded state vars are declared at global [pad_len]
+# with spec ("dp",) so each rank's shard_map body sees its [pad_len/n]
+# shard. Outside a mesh both ops degrade to the identity pipeline
+# (flatten+pad / unpad+reshape), which is also the single-chip math.
+# ---------------------------------------------------------------------------
+
+
+def _quant_precision(quant, dtype):
+    if quant and quant != "none":
+        return quant
+    return {"float32": "fp32", "bfloat16": "bf16", "float16": "fp16",
+            "float64": "fp64"}.get(str(jnp.dtype(dtype)), str(dtype))
+
+
+def _record_zero(kind, op, payload_elems, dtype, ax, n):
+    """Count a sharded-update collective and its estimated ring WIRE bytes
+    (payload x (n-1)/n, plus per-block scale overhead when quantized) by
+    kind and precision: collective.bytes.reduce_scatter_int8 etc. Trace-
+    time granularity, like _record (once per compiled collective site)."""
+    if ax is None:
+        return
+    from .. import observability as _obs
+    from ..resilience.faults import fault_point
+
+    fault_point("collective.dispatch")
+    quant = op.attr("quant", "none")
+    block = int(op.attr("quant_block", 256) or 256)
+    if quant and quant != "none":
+        payload = payload_elems * 1.0 + (payload_elems / block) * 4.0
+        precision = quant
+    else:
+        payload = float(payload_elems) * jnp.dtype(dtype).itemsize
+        precision = _quant_precision(None, dtype)
+    wire = int(payload * (n - 1) / n) if n > 1 else 0
+    _obs.add(f"collective.{kind}")
+    _obs.add(f"collective.bytes.{kind}_{precision}", wire)
+
+
+def _block_quantize(x, block):
+    """int8-quantize `x` (fp, last dim a multiple of `block`) in blocks
+    with per-block fp32 abs-max scales. Returns (q int8 same shape,
+    scales fp32 [..., nblocks])."""
+    xb = x.reshape(x.shape[:-1] + (x.shape[-1] // block, block))
+    xb = xb.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xb), axis=-1) / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(xb / safe[..., None]), -127, 127).astype(jnp.int8)
+    return q.reshape(x.shape), safe
+
+
+def _block_dequantize(q, scales, block):
+    """fp32 dequantization of :func:`_block_quantize` output."""
+    qb = q.reshape(q.shape[:-1] + (q.shape[-1] // block, block))
+    return (qb.astype(jnp.float32) * scales[..., None]).reshape(q.shape)
+
+
+@register_op(
+    "zero_reduce_scatter", inputs=["X"], outputs=["Out"],
+    differentiable=False,
+)
+def _zero_reduce_scatter(ctx, op, ins):
+    """Flatten + optional scale + pad a gradient to [pad_len], then
+    reduce-scatter it over `axis_name`: each rank ends with the globally
+    summed [pad_len/n] shard it will update. quant="int8" swaps the
+    fp-wire psum_scatter for block-quantized all_to_all + fp32-accumulated
+    local sum (EQuARX: quantize per hop, accumulate full precision)."""
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    pad_len = int(op.attr("pad_len"))
+    scale = op.attr("scale", None)
+    quant = op.attr("quant", "none") or "none"
+    block = int(op.attr("quant_block", 256) or 256)
+    flat = x.reshape(-1)
+    if scale is not None:
+        flat = flat * jnp.asarray(scale, flat.dtype)
+    if pad_len > flat.shape[0]:
+        flat = jnp.pad(flat, (0, pad_len - flat.shape[0]))
+    n = int(ctx.axis_sizes.get(ax, 1)) if ax is not None else 1
+    _record_zero("reduce_scatter", op, pad_len, flat.dtype, ax, n)
+    if ax is None:
+        return {"Out": [flat]}
+    if quant == "none":
+        return {"Out": [
+            lax.psum_scatter(flat, ax, scatter_dimension=0, tiled=True)
+        ]}
+    # int8 path: quantize each destination rank's shard in blocks, exchange
+    # int8 payload + fp32 per-block scales, dequantize and SUM IN FP32
+    shards = flat.reshape(n, pad_len // n)
+    q, scales = _block_quantize(shards, block)
+    q = lax.all_to_all(q, ax, split_axis=0, concat_axis=0, tiled=False)
+    scales = lax.all_to_all(
+        scales, ax, split_axis=0, concat_axis=0, tiled=False
+    )
+    acc = jnp.sum(_block_dequantize(q, scales, block), axis=0)
+    return {"Out": [acc.astype(x.dtype)]}
+
+
+@register_op(
+    "zero_all_gather", inputs=["X"], outputs=["Out"], differentiable=False
+)
+def _zero_all_gather(ctx, op, ins):
+    """All-gather a rank's updated [pad_len/n] parameter shard back to the
+    full parameter: concatenate shards, drop padding, reshape to `shape`.
+    quant="int8" ships the shards block-quantized (the EQuARX trade: the
+    replicated working copy is transport-quantized; the rank's own master
+    shard keeps full precision)."""
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    shape = tuple(int(d) for d in op.attr("shape"))
+    pad_len = int(op.attr("pad_len"))
+    numel = 1
+    for d in shape:
+        numel *= d
+    quant = op.attr("quant", "none") or "none"
+    block = int(op.attr("quant_block", 256) or 256)
+    n = int(ctx.axis_sizes.get(ax, 1)) if ax is not None else 1
+    _record_zero("all_gather", op, pad_len, x.dtype, ax, n)
+    if ax is None:
+        full = x
+    elif quant == "none":
+        full = lax.all_gather(x, ax, tiled=True)
+    else:
+        q, scales = _block_quantize(x, block)
+        q = lax.all_gather(q, ax, tiled=True)
+        scales = lax.all_gather(scales, ax, tiled=True)
+        full = _block_dequantize(q, scales, block).astype(x.dtype)
+    return {"Out": [full[:numel].reshape(shape)]}
+
+
+@register_op(
+    "zero_pad_flatten", inputs=["X"], outputs=["Out"], differentiable=False
+)
+def _zero_pad_flatten(ctx, op, ins):
+    """Startup-side init of a sharded-update state var: flatten X and
+    zero-pad to [pad_len] (the global flat layout zero_reduce_scatter /
+    zero_all_gather exchange). Runs meshless in the startup program; the
+    executor's SPMD staging slices each rank's shard out afterwards."""
+    x = ins["X"][0]
+    pad_len = int(op.attr("pad_len"))
+    flat = x.reshape(-1)
+    if pad_len > flat.shape[0]:
+        flat = jnp.pad(flat, (0, pad_len - flat.shape[0]))
+    return {"Out": [flat]}
+
+
 @register_op("c_identity", inputs=["X"], outputs=["Out"])
 def _c_identity(ctx, op, ins):
     return {"Out": [ins["X"][0]]}

@@ -77,6 +77,7 @@ class Optimizer:
         key = (name, param.name)
         if key in self._accumulators:
             return self._accumulators[key]
+        param_shaped = shape is None
         shape = list(shape if shape is not None else param.shape)
         dtype = dtype or "float32"
         vname = unique_name.generate(f"{param.name}_{name}")
@@ -87,6 +88,10 @@ class Optimizer:
         # tag for sharding bookkeeping: parallel/sparse.shard_sparse_tables
         # row-shards exactly the accumulators of sharded tables
         v._accum_of = param.name
+        # elementwise (param-shaped) state shards 1/N under the ZeRO
+        # weight-update transpile; explicitly-shaped state (beta-pow
+        # scalars) is broadcast into the update and must stay replicated
+        v._accum_elementwise = param_shaped
         startup.create_parameter(vname, shape, dtype, trainable=False)
         Constant(fill_value)(startup, vname, shape, dtype)
         self._accumulators[key] = v

@@ -18,7 +18,11 @@ from .mesh import (  # noqa: F401
     spec,
 )
 from .spmd import device_put_sharded, shard_program, spec_for  # noqa: F401
-from .transpiler import GradAllReduce, LocalSGD  # noqa: F401
+from .transpiler import (  # noqa: F401
+    GradAllReduce,
+    LocalSGD,
+    ShardedWeightUpdate,
+)
 from .pipeline import PipelineOptimizer  # noqa: F401  (registers pipeline_block)
 from .pipeline_uniform import (  # noqa: F401  (registers pipeline_uniform)
     append_outside_grad_allreduce,

@@ -36,6 +36,20 @@ KILL_STEP = 11  # mid epoch 1, one step past the step-10 checkpoint
 
 
 def main(out_dir, kill_rank=-1):
+    # PADDLE_TPU_RESUME_SHARDED=1 (tools/resume_audit.py --sharded):
+    # train with Momentum + the ZeRO weight-update transpile over a
+    # per-process dp=2 virtual mesh, so every checkpointed local_vars
+    # shard carries genuinely dp-sharded optimizer state — the
+    # exact-resume machinery must restore it bitwise. The device count
+    # must be forced BEFORE jax initializes.
+    sharded = os.environ.get("PADDLE_TPU_RESUME_SHARDED") == "1"
+    if sharded:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+
     import paddle_tpu as fluid
     from paddle_tpu import layers, observability
     from paddle_tpu.dataloader.dataset import Dataset
@@ -61,8 +75,28 @@ def main(out_dir, kill_rank=-1):
     y = fluid.data("y", [-1, 1])
     pred = layers.fc(x, 1)
     loss = layers.mean(layers.square_error_cost(pred, y))
-    fluid.optimizer.SGD(0.05).minimize(loss)
+    opt = (fluid.optimizer.Momentum(0.05, 0.9) if sharded
+           else fluid.optimizer.SGD(0.05))
+    _, pg = opt.minimize(loss)
     main_prog = fluid.default_main_program()
+    if sharded:
+        import jax
+
+        from paddle_tpu.parallel import make_mesh, shard_program
+        from paddle_tpu.parallel.transpiler import ShardedWeightUpdate
+
+        ShardedWeightUpdate(2).transpile(
+            main_prog, fluid.default_startup_program(), pg
+        )
+        blk = main_prog.global_block
+        blk.append_op("scale", {"X": [loss.name]}, {"Out": [loss.name]},
+                      {"scale": 0.5, "bias": 0.0})
+        blk.append_op("c_allreduce_sum", {"X": [loss.name]},
+                      {"Out": [loss.name]}, {"axis_name": "dp"})
+        shard_program(
+            main_prog, make_mesh({"dp": 2}, jax.devices()[:2]),
+            {"x": ("dp",), "y": ("dp",)},
+        )
     main_prog.random_seed = fluid.default_startup_program().random_seed = 7
     exe = fluid.Executor()
     exe.run(fluid.default_startup_program())

@@ -1,0 +1,121 @@
+"""Worker for the sharded-weight-update golden equivalence test
+(tests/test_zero_sharding.py): a real 2-process gloo run — the MULTICHIP
+dryrun path — training one tiny MLP three ways:
+
+  baseline      per-grad c_allreduce_sum (GradAllReduce transpile)
+  sharded       ZeRO reduce-scatter + 1/N shard update + all-gather, fp32
+  sharded_int8  same, with int8 block-quantized collective payloads
+
+The dp=2 mesh spans BOTH processes (one device from each), each process
+feeds its half of the global batch (the make_array_from_process_local_data
+convention), and the loss fetch is the dp-allreduced global mean — so the
+recorded loss trajectory and final weights are directly comparable across
+modes. Each rank writes result_<rank>.json (losses + observability
+counters/gauges) and params_<rank>.npz (trainable weights).
+
+argv: mode out_dir
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability
+from paddle_tpu.fleet import collective as fleet_mod
+from paddle_tpu.framework import unique_name
+from paddle_tpu.parallel import make_mesh, shard_program
+from paddle_tpu.parallel.transpiler import GradAllReduce, ShardedWeightUpdate
+
+B, D, H, STEPS = 8, 16, 32, 6
+
+
+def pick_devices(per_proc):
+    import jax
+
+    by_proc = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, []).append(d)
+    assert len(by_proc) == 2, f"expected 2 processes, saw {sorted(by_proc)}"
+    devs = []
+    for p in sorted(by_proc):
+        devs.extend(sorted(by_proc[p], key=lambda d: d.id)[:per_proc])
+    return devs
+
+
+def main():
+    mode, out_dir = sys.argv[1], sys.argv[2]
+    fleet = fleet_mod.fleet
+    fleet.init()  # jax.distributed rendezvous
+    rank = fleet.worker_index()
+    half = B // 2
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 7
+    scope = fluid.framework.scope.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        x = fluid.data("x", [B, D])
+        y = fluid.data("y", [B, 1])
+        h = layers.fc(x, H, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        _, pg = fluid.optimizer.Adam(0.01).minimize(loss, startup)
+        blk = main_prog.global_block
+        if mode == "baseline":
+            GradAllReduce(2).transpile(main_prog, pg)
+        else:
+            ShardedWeightUpdate(
+                2, quant="int8" if mode == "sharded_int8" else None
+            ).transpile(main_prog, startup, pg)
+        blk.append_op("scale", {"X": [loss.name]}, {"Out": [loss.name]},
+                      {"scale": 0.5, "bias": 0.0})
+        blk.append_op("c_allreduce_sum", {"X": [loss.name]},
+                      {"Out": [loss.name]}, {"axis_name": "dp"})
+        shard_program(
+            main_prog, make_mesh({"dp": 2}, pick_devices(1)),
+            {"x": ("dp",), "y": ("dp",)},
+        )
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        losses = []
+        for i in range(STEPS):
+            rng = np.random.RandomState(100 + i)
+            xv = rng.randn(B, D).astype(np.float32)
+            yv = rng.randn(B, 1).astype(np.float32)
+            lo = rank * half
+            (lv,) = exe.run(
+                main_prog,
+                feed={"x": xv[lo:lo + half], "y": yv[lo:lo + half]},
+                fetch_list=[loss], scope=scope, return_numpy=False,
+            )
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        params = {}
+        for v in main_prog.all_parameters():
+            if not getattr(v, "trainable", False):
+                continue
+            val = scope.find_var(v.name)
+            if val is None:
+                continue
+            if getattr(val, "is_fully_addressable", True):
+                params[v.name] = np.asarray(val)
+            else:
+                # replicated across the 2-process mesh: this process's
+                # local replica IS the full value
+                params[v.name] = np.asarray(val.addressable_shards[0].data)
+
+    snap = observability.snapshot()
+    with open(os.path.join(out_dir, f"result_{rank}.json"), "w") as f:
+        json.dump({
+            "mode": mode,
+            "losses": losses,
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+        }, f)
+    np.savez(os.path.join(out_dir, f"params_{rank}.npz"), **params)
+
+
+if __name__ == "__main__":
+    main()

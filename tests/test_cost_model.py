@@ -173,6 +173,89 @@ class TestOpGoldens:
         )
         assert plain == 2 * 16 * 4
 
+    def test_zero_sharded_collective_wire_bytes(self):
+        """Sharded weight-update collectives: wire bytes follow the PADDED
+        flat payload at the quantized element size, with reduce-scatter
+        and all-gather each moving (n-1)/n of it."""
+        n, pad, block = 4, 4096, 256
+        grad = _f32((60, 64))  # 3840 elements, padded to 4096
+        rs = OpView("zero_reduce_scatter", {
+            "axis_name": "dp", "pad_len": pad, "quant": "none",
+            "quant_block": block, "scale": 0.25,
+        })
+        flops, wire = op_cost(rs, {"X": [grad]}, {}, axis_sizes={"dp": n})
+        assert wire == pytest.approx(pad * 4 * (n - 1) / n)
+        assert flops == pad  # n contributions summed per element
+        # int8 blocks: 1 byte/elem + 4-byte fp32 scale per block
+        rs_q = OpView("zero_reduce_scatter", {
+            "axis_name": "dp", "pad_len": pad, "quant": "int8",
+            "quant_block": block,
+        })
+        _, wire_q = op_cost(rs_q, {"X": [grad]}, {}, axis_sizes={"dp": n})
+        assert wire_q == pytest.approx(
+            pad * (1 + 4 / block) * (n - 1) / n
+        )
+        assert wire_q < 0.3 * wire  # the >=40% payload-reduction headline
+        ag = OpView("zero_all_gather", {
+            "axis_name": "dp", "pad_len": pad, "quant": "none",
+            "shape": [60, 64],
+        })
+        shard = _f32((pad,))
+        ag_flops, ag_wire = op_cost(
+            ag, {"X": [shard]}, {}, axis_sizes={"dp": n}
+        )
+        assert ag_flops == 0.0
+        assert ag_wire == pytest.approx(pad * 4 * (n - 1) / n)
+        # unbound axis: identity degrade, no wire traffic
+        assert op_cost(rs, {"X": [grad]}, {}, axis_sizes={}) == (0.0, 0.0)
+        # found-inf any-reduce is a [1]-element allreduce
+        anyop = OpView("c_allreduce_any", {"axis_name": "dp"})
+        _, any_wire = op_cost(
+            anyop, {"X": [((1,), 1)]}, {}, axis_sizes={"dp": n}
+        )
+        assert any_wire == pytest.approx(1 * 2 * (n - 1) / n)
+
+    def test_zero_collectives_in_program_estimate(self, fresh):
+        """A ShardedWeightUpdate-transpiled program's estimate carries the
+        new collective sites with quantized wire bytes smaller than the
+        fp32 build's."""
+        import jax
+
+        from paddle_tpu.parallel import make_mesh, shard_program
+        from paddle_tpu.parallel.transpiler import ShardedWeightUpdate
+
+        def build(quant):
+            main, startup = fluid.Program(), fluid.Program()
+            scope = Scope()
+            with fluid.program_guard(main, startup), \
+                    fluid.scope_guard(scope), unique_name.guard():
+                # a 512x64 weight: big enough that int8 padding overhead
+                # cannot mask the 4x element shrink
+                x = fluid.data("x", [8, 512])
+                loss = layers.mean(layers.square(layers.fc(x, 64)))
+                _, pg = fluid.optimizer.Adam(0.01).minimize(loss, startup)
+                ShardedWeightUpdate(2, quant=quant).transpile(
+                    main, startup, pg
+                )
+                shard_program(
+                    main, make_mesh({"dp": 2}, jax.devices()[:2]),
+                    {"x": ("dp",)},
+                )
+            return main.estimate(feed_shapes={"x": (8, 512)})
+
+        est_fp = build(None)
+        est_q = build("int8")
+        kinds_fp = {e.op_type for e in est_fp.ops}
+        assert {"zero_reduce_scatter", "zero_all_gather"} <= kinds_fp
+
+        def coll_bytes(est):
+            return sum(
+                e.bytes for e in est.ops
+                if e.op_type in ("zero_reduce_scatter", "zero_all_gather")
+            )
+
+        assert coll_bytes(est_q) < 0.6 * coll_bytes(est_fp)
+
     def test_family_of(self):
         assert family_of("matmul") == "matmul"
         assert family_of("conv2d") == "conv"

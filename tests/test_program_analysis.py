@@ -338,6 +338,121 @@ def test_meshless_program_skips_collective_analysis(fresh):
 
 
 # ---------------------------------------------------------------------------
+# sharded-weight-update collective kinds (zero_reduce_scatter /
+# zero_all_gather, quantized variants, c_allreduce_any): one broken
+# fixture per kind — a stage-divergent site of each must be an ERROR
+# ---------------------------------------------------------------------------
+
+
+def _poison_pipeline_with(op_type, attrs, out_shape):
+    """A 2-stage pipeline whose stage-0 block gains one `op_type` site the
+    other stage never issues — the canonical rank-divergence fixture."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [8, 4])
+        with fluid.device_guard("pipeline:0"):
+            h = layers.fc(x, 4)
+        with fluid.device_guard("pipeline:1"):
+            loss = layers.mean(layers.fc(h, 4))
+        main._pipeline = {"num_microbatches": 2, "axis_name": "pp"}
+        _, pipe_op = slice_program_into_stages(main, loss)
+    stage = main.blocks[pipe_op.attr("stage_blocks")[0]]
+    stage.create_var(name="zout", shape=out_shape, dtype="float32")
+    stage.append_op(op_type, {"X": [h.name]}, {"Out": ["zout"]}, attrs)
+    shard_program(main, make_mesh({"dp": 4, "pp": 2}), {"x": ("dp",)})
+    return main, loss
+
+
+@pytest.mark.parametrize("op_type,attrs,out_shape,kind", [
+    ("zero_reduce_scatter",
+     {"axis_name": "dp", "pad_len": 32, "quant": "none"}, [32],
+     "zero_reduce_scatter"),
+    ("zero_all_gather",
+     {"axis_name": "dp", "pad_len": 32, "shape": [8, 4], "quant": "none"},
+     [8, 4], "zero_all_gather"),
+    ("zero_reduce_scatter",
+     {"axis_name": "dp", "pad_len": 1024, "quant": "int8",
+      "quant_block": 256}, [1024],
+     "zero_reduce_scatter:int8"),
+    ("zero_all_gather",
+     {"axis_name": "dp", "pad_len": 1024, "shape": [8, 4], "quant": "int8",
+      "quant_block": 256}, [8, 4],
+     "zero_all_gather:int8"),
+    ("c_allreduce_any", {"axis_name": "dp"}, [8, 4], "c_allreduce_any"),
+])
+def test_divergent_sharded_update_site_detected(fresh, op_type, attrs,
+                                                out_shape, kind):
+    main, loss = _poison_pipeline_with(op_type, attrs, out_shape)
+    rep = verify_program(main, ("x",), (loss.name,),
+                         families=("collectives",))
+    findings = rep.by_category(COLLECTIVE_DIVERGENCE)
+    assert findings, f"{kind}: stage-divergent site not flagged"
+    f = findings[0]
+    assert f.severity == Severity.ERROR
+    assert f.op_type == op_type
+    assert kind in f.message
+
+
+def test_quantized_wire_format_is_part_of_the_site_kind(fresh):
+    """An int8-quantized reduce-scatter on one cond branch against a
+    full-precision one on the other is a payload mismatch, not a match:
+    the branch-divergence lint must see two DIFFERENT kinds."""
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("x", [8, 4])
+    cond_v = fluid.data("c", [1], "bool")
+    branches = []
+    for quant in ("none", "int8"):
+        b = main.create_block()
+        main.rollback()
+        b.create_var(name=f"zs_{quant}", shape=[1024], dtype="float32")
+        b.append_op(
+            "zero_reduce_scatter", {"X": ["x"]}, {"Out": [f"zs_{quant}"]},
+            {"axis_name": "dp", "pad_len": 1024, "quant": quant,
+             "quant_block": 256},
+        )
+        branches.append(b)
+    blk.create_var(name="out", shape=[8, 4], dtype="float32")
+    blk.append_op(
+        "cond", {"Cond": [cond_v.name], "TrueIn": ["x"], "FalseIn": ["x"]},
+        {"Out": ["out"]},
+        {"true_block": branches[0].idx, "false_block": branches[1].idx,
+         "true_out_names": ["x"], "false_out_names": ["x"]},
+    )
+    shard_program(main, make_mesh({"dp": 8}))
+    rep = verify_program(main, ("x", "c"), ("out",),
+                         families=("collectives",))
+    (f,) = rep.by_category(COLLECTIVE_BRANCH_DIVERGENCE)
+    assert "zero_reduce_scatter:int8" in f.message
+    assert "zero_reduce_scatter@dp" in f.message
+
+
+def test_sharded_weight_update_program_is_error_clean(fresh):
+    """The real ShardedWeightUpdate transpile (AMP + int8) must come out of
+    the full verifier with zero ERROR findings — the lint understands the
+    new collective pattern end to end."""
+    from paddle_tpu.contrib import mixed_precision as mp
+    from paddle_tpu.parallel.transpiler import ShardedWeightUpdate
+
+    main, startup, _ = fresh
+    x = fluid.data("x", [8, 16])
+    y = fluid.data("y", [8, 1])
+    loss = layers.mean(layers.square_error_cost(
+        layers.fc(layers.fc(x, 32, act="relu"), 1), y
+    ))
+    opt = mp.decorate(fluid.optimizer.Adam(0.01), dest_dtype="bfloat16")
+    _, pg = opt.minimize(loss, startup)
+    ShardedWeightUpdate(2, quant="int8").transpile(main, startup, pg)
+    import jax
+
+    shard_program(main, make_mesh({"dp": 2}, jax.devices()[:2]),
+                  {"x": ("dp",), "y": ("dp",)})
+    rep = verify_program(main, ("x", "y"), (loss.name,))
+    errors = [f for f in rep.findings if f.severity == Severity.ERROR]
+    assert not errors, [f.format() for f in errors]
+
+
+# ---------------------------------------------------------------------------
 # executor wiring: strict rejects, warn warns, off is silent
 # ---------------------------------------------------------------------------
 

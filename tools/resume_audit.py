@@ -41,7 +41,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "dist_resume_worker.py")
 
 
-def run_pod(out_dir, kill, started_port):
+def run_pod(out_dir, kill, started_port, sharded=False):
     os.makedirs(out_dir, exist_ok=True)
     cmd = [
         sys.executable, "-m", "paddle_tpu.distributed.launch",
@@ -55,6 +55,8 @@ def run_pod(out_dir, kill, started_port):
     cmd += [WORKER, out_dir] + (["1"] if kill else [])
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if sharded:
+        env["PADDLE_TPU_RESUME_SHARDED"] = "1"
     proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
     if proc.returncode != 0:
         for rank in (0, 1):
@@ -177,33 +179,70 @@ def audit_v1_compat(work_dir):
           "v2 fields")
 
 
+def assert_sharded_state_audited(out_dir, nranks=2):
+    """The sharded leg proved something only if the checkpointed/final
+    state really contains dp-sharded optimizer shards (Momentum velocity
+    shards under the @ZERO_SHARD layout) and the full-size velocities are
+    gone."""
+    for rank in range(nranks):
+        z = np.load(os.path.join(out_dir, f"final_rank{rank}.npz"))
+        shard_vars = [n for n in z.files if n.endswith("@ZERO_SHARD")]
+        assert any("velocity" in n for n in shard_vars), (
+            f"rank {rank}: no sharded optimizer state in the audited "
+            f"final weights ({z.files})"
+        )
+        full = [
+            n for n in z.files
+            if "velocity" in n and not n.endswith("@ZERO_SHARD")
+        ]
+        assert not full, (
+            f"rank {rank}: full-size optimizer state survived the "
+            f"sharded transpile: {full}"
+        )
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser("resume_audit")
     ap.add_argument("--out", default=None,
                     help="work dir (default: a fresh temp dir)")
     ap.add_argument("--keep", action="store_true",
                     help="keep the work dir for inspection")
+    ap.add_argument("--sharded", action="store_true",
+                    help="train with the ZeRO sharded weight update "
+                         "(Momentum over a dp=2 virtual mesh) so the "
+                         "audit covers dp-sharded optimizer state")
     args = ap.parse_args(argv)
     work = args.out or tempfile.mkdtemp(prefix="paddle_tpu_resume_audit_")
     os.makedirs(work, exist_ok=True)
     sys.path.insert(0, REPO)
+    label = "sharded " if args.sharded else ""
+    ports = (6470, 6490) if args.sharded else (6370, 6390)
     try:
         control, kill = os.path.join(work, "control"), os.path.join(work, "kill")
-        print("== resume audit: control run (uninterrupted) ==")
-        run_pod(control, kill=False, started_port=6370)
-        print("== resume audit: kill run (SIGKILL rank 1 mid-epoch, "
-              "elastic resume) ==")
-        run_pod(kill, kill=True, started_port=6390)
+        print(f"== resume audit: {label}control run (uninterrupted) ==")
+        run_pod(control, kill=False, started_port=ports[0],
+                sharded=args.sharded)
+        print(f"== resume audit: {label}kill run (SIGKILL rank 1 "
+              "mid-epoch, elastic resume) ==")
+        run_pod(kill, kill=True, started_port=ports[1],
+                sharded=args.sharded)
 
         assert_resume_fired(kill)
         audit_logs(kill)
         audit_logs(control)
         assert_bitwise_equal(control, kill)
-        audit_v1_compat(work)
-        print("resume audit OK: SIGKILL+elastic-resume run is bitwise "
-              "identical to the uninterrupted run (weights + "
-              "consumed-example logs), no example skipped or repeated, "
-              "resume counters fired, v1 checkpoint loads")
+        if args.sharded:
+            assert_sharded_state_audited(control)
+            assert_sharded_state_audited(kill)
+            print("resume audit OK (sharded): SIGKILL+elastic-resume with "
+                  "dp-sharded optimizer state is bitwise identical to the "
+                  "uninterrupted run — velocity shards included")
+        else:
+            audit_v1_compat(work)
+            print("resume audit OK: SIGKILL+elastic-resume run is bitwise "
+                  "identical to the uninterrupted run (weights + "
+                  "consumed-example logs), no example skipped or repeated, "
+                  "resume counters fired, v1 checkpoint loads")
         return 0
     finally:
         if not args.keep and args.out is None:

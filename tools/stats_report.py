@@ -86,6 +86,27 @@ def render(snap, top_ops=0):
                 f"  {name}: count={n} sum={h['sum']:.6g} mean={mean:.6g} "
                 f"min={h['min']} max={h['max']}  |{_sparkline(h)}|"
             )
+    # two byte-counter generations share the table: the sharded-update
+    # kinds record estimated ring WIRE bytes under
+    # collective.bytes.<kind>_<precision>; the classic emitters record
+    # raw per-shard PAYLOAD bytes under collective.<kind>.bytes — both
+    # belong in one view or an allreduce leg reads as zero traffic
+    payload = {
+        n[len("collective.bytes."):] + " (wire)": c
+        for n, c in counters.items() if n.startswith("collective.bytes.")
+    }
+    payload.update({
+        n[len("collective."):-len(".bytes")] + " (payload)": c
+        for n, c in counters.items()
+        if n.startswith("collective.") and n.endswith(".bytes")
+    })
+    if payload:
+        lines.append("-- collective bytes by kind --")
+        width = max(len(n) for n in payload)
+        for name in sorted(payload):
+            lines.append(
+                f"  {name:<{width}}  {payload[name] / 1e6:>10.3f} MB"
+            )
     if "perf.cost_table" in tables:
         _render_cost_table(tables["perf.cost_table"], top_ops, lines)
     lines.append(f"span buffer: {snap.get('span_count', 0)} spans")
