@@ -47,6 +47,9 @@ ROUND3_GPT2048_TOK_S = 50787.0
 # r5 Mask R-CNN: AMP bf16 + dynamic loss scaling, 4x1-image unroll
 # (BASELINE.md r5 table) — denominator for the r6 batched leg
 ROUND5_MASK_RCNN_IMG_S = 20.99
+# r5 DeepFM: per-slot gather path, b=4096 criteo shape (BENCH_r05 deepfm
+# leg) — denominator for the r11 fused-embedding leg (acceptance >= 5x)
+ROUND5_DEEPFM_EX_S = 266671.4
 
 
 def _amp(opt):
@@ -529,6 +532,187 @@ def bench_deepfm(on_accel):
     }
 
 
+def bench_deepfm_fused(on_accel):
+    """CTR path through the PR-11 embedding engine: the per-slot reference
+    layout (2F gather dispatch sites) coalesced into ONE fused_lookup_table
+    per table width, batch-dedup on, async prefetch staging the next
+    batch's rows. Self-gating structural proxies on the CPU leg (one fused
+    gather for all slots, dedup active, prefetch overlap recorded); the
+    accel leg reports examples/s against the r5 per-slot denominator
+    (acceptance: >= 5x)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as _obs
+    from paddle_tpu.embedding import EmbeddingEngine, Prefetcher, fuse_lookups
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.models.deepfm import DeepFMConfig, deepfm
+    from paddle_tpu.optimizer import Adam
+
+    cfg = DeepFMConfig.criteo() if on_accel else DeepFMConfig(
+        vocab_size=4096, num_fields=8, embed_dim=8, mlp_sizes=(16,),
+        dense_dim=4,
+    )
+    b = 4096 if on_accel else 64
+    rng = np.random.RandomState(0)
+
+    def make_batches(k):
+        out = []
+        for _ in range(k):
+            # power-law ids: the skew that makes the hot tier and dedup
+            # meaningful (criteo id frequency is heavy-tailed)
+            idv = (cfg.vocab_size * rng.power(0.35, (b, cfg.num_fields)))
+            out.append({
+                "feat": jnp.asarray(idv.astype("int64")),
+                "dense": jnp.asarray(
+                    rng.rand(b, cfg.dense_dim).astype("float32")
+                ),
+                "label": jnp.asarray(
+                    (rng.rand(b, 1) < 0.3).astype("float32")
+                ),
+            })
+        return out
+
+    def build(fused):
+        main_prog, startup = fluid.Program(), fluid.Program()
+        main_prog.random_seed = startup.random_seed = 1
+        scope = Scope()
+        with fluid.program_guard(main_prog, startup):
+            feat = fluid.data("feat", [b, cfg.num_fields], "int64")
+            dense = fluid.data("dense", [b, cfg.dense_dim], "float32")
+            label = fluid.data("label", [b, 1], "float32")
+            loss, _pred = deepfm(feat, label, cfg, dense_input=dense,
+                                 per_slot=True)
+            if fused:
+                fuse_lookups(main_prog)
+            Adam(1e-3).minimize(loss, startup)
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        return main_prog, scope, exe, loss
+
+    def lookup_sites(prog):
+        singles = sum(1 for op in prog.global_block.ops
+                      if op.type == "distributed_lookup_table")
+        fused = sum(1 for op in prog.global_block.ops
+                    if op.type == "fused_lookup_table")
+        return singles, fused
+
+    batches = make_batches(4)
+    n_steps = 20 if on_accel else 6
+    rounds = 3 if on_accel else 1
+
+    # per-slot unfused baseline (the r5 shape, measured in-run on CPU so
+    # the structural comparison is like-for-like on this host)
+    base_prog, base_scope, base_exe, base_loss = build(fused=False)
+    base_singles, _ = lookup_sites(base_prog)
+    for i in range(2):
+        base_exe.run(base_prog, feed=batches[i % 4], fetch_list=[base_loss],
+                     scope=base_scope)
+    base_dt, _, _ = _timed_loop(
+        base_exe, base_prog, base_scope, batches, base_loss, n_steps, rounds
+    )
+    base_ex_s = n_steps * b / base_dt
+
+    # fused leg
+    main_prog, scope, exe, loss = build(fused=True)
+    singles_left, fused_sites = lookup_sites(main_prog)
+    for i in range(3):
+        exe.run(main_prog, feed=batches[i % 4], fetch_list=[loss],
+                scope=scope)
+    dt, dts, final_loss = _timed_loop(
+        exe, main_prog, scope, batches, loss, n_steps, rounds
+    )
+    ex_s = n_steps * b / dt
+    est_flops, flops_model = _estimated_step_flops(main_prog, batches[0])
+    mfu = _mfu_fields(est_flops, dt, n_steps, on_accel)
+
+    # dedup ratio on the actual batches (host-side truth)
+    ratios = [
+        len(np.unique(np.asarray(f["feat"]))) / np.asarray(f["feat"]).size
+        for f in batches
+    ]
+
+    # short cached+prefetched segment: the hot tier holds half the vocab,
+    # the prefetcher stages cold rows behind compute — structural proxy
+    # that the engine composes (hit-rate + overlap metrics land)
+    cache_prog, cache_startup = fluid.Program(), fluid.Program()
+    cache_prog.random_seed = cache_startup.random_seed = 1
+    cache_scope = Scope()
+    with fluid.program_guard(cache_prog, cache_startup):
+        feat = fluid.data("feat", [b, cfg.num_fields], "int64")
+        dense = fluid.data("dense", [b, cfg.dense_dim], "float32")
+        label = fluid.data("label", [b, 1], "float32")
+        closs, _ = deepfm(feat, label, cfg, dense_input=dense,
+                          per_slot=True)
+        fuse_lookups(cache_prog)
+        engine = EmbeddingEngine(
+            cache_prog, cache_startup,
+            hot_rows=max(b * cfg.num_fields, cfg.vocab_size // 2),
+        )
+        Adam(1e-3).minimize(closs, cache_startup)
+    cache_exe = fluid.Executor()
+    cache_exe.run(cache_startup, scope=cache_scope)
+    engine.attach(cache_scope)
+    feed_stream = [
+        {k: np.asarray(v) for k, v in batches[i % 4].items()}
+        for i in range(8 if not on_accel else 16)
+    ]
+    for f in Prefetcher(engine, feed_stream, cache_scope):
+        cache_exe.run(cache_prog, feed=f, fetch_list=[closs],
+                      scope=cache_scope)
+    gauges = _obs.get_gauges()
+    hists = _obs.get_histograms()
+    hit_rate = next(
+        (v for k, v in gauges.items()
+         if k.startswith("embedding.hot_hit_rate.")), None
+    )
+    overlap = hists.get("embedding.prefetch_overlap", {})
+    overlap_mean = (
+        overlap["sum"] / overlap["count"] if overlap.get("count") else None
+    )
+
+    gates = {
+        "one_fused_gather_per_width": fused_sites == 2 and singles_left <= 1,
+        "lookup_sites_before": base_singles,
+        "lookup_sites_after": fused_sites + singles_left,
+        "dedup_active": all(r < 1.0 for r in ratios),
+        "dedup_unique_ratio": round(float(np.mean(ratios)), 4),
+        "prefetch_overlap_recorded": bool(overlap.get("count")),
+        "prefetch_overlap_mean": (
+            round(overlap_mean, 3) if overlap_mean is not None else None
+        ),
+        "hot_hit_rate": round(hit_rate, 3) if hit_rate is not None else None,
+    }
+    structural_ok = (
+        gates["one_fused_gather_per_width"]
+        and gates["dedup_active"]
+        and gates["prefetch_overlap_recorded"]
+    )
+    if not structural_ok:
+        raise RuntimeError(f"deepfm_fused structural gates failed: {gates}")
+    return {
+        "metric": "deepfm_fused_criteo_train_examples_per_sec" if on_accel
+        else "deepfm_fused_tiny_train_examples_per_sec_cpu",
+        "value": round(ex_s, 1),
+        "unit": "examples/s",
+        # r5 denominator: 266,671 ex/s (BENCH_r05 deepfm leg, per-slot
+        # gather path on the tunneled v5e) — acceptance >= 5x on accel
+        "vs_baseline": (
+            round(ex_s / ROUND5_DEEPFM_EX_S, 3) if on_accel else None
+        ),
+        "vs_per_slot_in_run": round(ex_s / base_ex_s, 3),
+        "per_slot_examples_per_sec": round(base_ex_s, 1),
+        "config": {"batch": b, "fields": cfg.num_fields,
+                   "vocab": cfg.vocab_size, "mlp": list(cfg.mlp_sizes),
+                   "layout": "per_slot->fused", "dedup": True},
+        "samples": _samples(n_steps * b, dts),
+        **mfu,
+        "flops_model": flops_model,
+        "gates": gates,
+        "final_loss": round(final_loss, 4),
+    }
+
+
 def bench_mask_rcnn_legacy(on_accel):
     """LEGACY Mask R-CNN leg (r5 configuration, kept for like-for-like
     comparison under PADDLE_TPU_BATCHED_DETECTION=0): AMP bf16 + dynamic
@@ -832,6 +1016,7 @@ def main():
         ("yolov3", lambda: bench_yolov3(on_accel)),
         ("gpt_longctx", lambda: bench_gpt_longctx(on_accel, 2048, 4)),
         ("deepfm", lambda: bench_deepfm(on_accel)),
+        ("deepfm_fused", lambda: bench_deepfm_fused(on_accel)),
         ("mask_rcnn", lambda: bench_mask_rcnn(on_accel)),
         ("dp_sharding", lambda: bench_dp_sharding(on_accel)),
     ]

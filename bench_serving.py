@@ -284,6 +284,83 @@ def bench_batched_vs_sequential(frozen, build, exe, scope, bucket=8,
     }
 
 
+def bench_ctr_rank(smoke, duration, results):
+    """Recommendation traffic mix (PR 11): a DeepFM CTR ranker served
+    through the continuous-batching router — per-slot sparse lookups fused
+    into one ``fused_lookup_table`` per table width by the embedding
+    engine, frozen, and dispatched per bucket. Records the FIRST
+    served-embedding QPS baseline (no ratio gate yet: the number exists so
+    the next round has a denominator)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.embedding import fuse_lookups
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.models.deepfm import DeepFMConfig, deepfm
+    from paddle_tpu.serving import Server, freeze_program
+    from paddle_tpu.serving.router import EndpointConfig
+
+    cfg = DeepFMConfig(
+        vocab_size=4096, num_fields=13, embed_dim=16, mlp_sizes=(64, 32),
+    )
+    scope = Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("feat_ids", [-1, cfg.num_fields], "int64")
+        label = fluid.data("label", [-1, 1], "float32")
+        loss, prob = deepfm(ids, label, cfg, per_slot=True)
+        fused = fuse_lookups(main)
+        fluid.optimizer.Adam(1e-3).minimize(loss, startup)
+    assert fused == 2, f"expected 2 fused lookup sites, got {fused}"
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+    frozen = freeze_program(main, [prob], feed_names=("feat_ids",))
+    fused_frozen = sum(
+        1 for op in frozen.program.global_block.ops
+        if op.type == "fused_lookup_table"
+    )
+
+    server = Server()
+    server.add_endpoint(
+        "ctr_rank", None,
+        EndpointConfig(buckets=(1, 2, 4, 8), max_wait_ms=4.0,
+                       max_queue=4096),
+        frozen=frozen, executor=exe, scope=scope,
+    )
+    server.warmup()
+
+    def build(rng_or_b):
+        if isinstance(rng_or_b, int):
+            return {
+                "feat_ids": np.zeros(
+                    (rng_or_b, cfg.num_fields), np.int64
+                ),
+            }
+        # power-law ids: the heavy-tailed CTR id distribution
+        return {
+            "feat_ids": (
+                cfg.vocab_size * rng_or_b.power(0.35, cfg.num_fields)
+            ).astype(np.int64),
+        }
+
+    lats, n, wall = _closed_loop(server, "ctr_rank", build, 8, duration)
+    server.drain(timeout=30)
+    entry = {
+        "mix": "ctr_rank",
+        "mode": "closed",
+        "load": 8,
+        "requests": n,
+        "qps": round(n / wall, 2) if wall > 0 else None,
+        "fused_lookup_sites_frozen": fused_frozen,
+        "buckets": _bucket_histogram("ctr_rank"),
+        **_percentiles(lats),
+        **_roofline(frozen, 8, build),
+        "baseline_note": "first served-embedding QPS baseline (r11)",
+    }
+    results["ctr_rank"] = entry
+    return entry
+
+
 def bench_gpt_generate(smoke, results):
     """KV-cache generation endpoint + the decode-vs-recompute ratio."""
     from paddle_tpu.models.gpt import GPTConfig
@@ -392,6 +469,11 @@ def main(argv=None):
     )
     print(json.dumps(results["resnet_classify"]), flush=True)
 
+    # recommendation mix: fused-embedding DeepFM ranker (PR 11) — records
+    # the first served-embedding QPS baseline
+    ctr = bench_ctr_rank(args.smoke, duration, results)
+    print(json.dumps(ctr), flush=True)
+
     gpt = bench_gpt_generate(args.smoke, results)
     print(json.dumps(gpt), flush=True)
 
@@ -415,12 +497,15 @@ def main(argv=None):
         "batched_speedup": batched["batched_speedup"],
         "kv_decode_speedup": gpt["kv_decode_speedup"],
         "kv_parity": gpt["kv_parity"],
+        "served_embedding_qps": ctr["qps"],
     }
     print(json.dumps(summary), flush=True)
     ok = (
         batched["batched_speedup"] >= 3.0
         and gpt["kv_decode_speedup"] >= 5.0
         and gpt["kv_parity"]
+        and (ctr["qps"] or 0) > 0
+        and ctr["fused_lookup_sites_frozen"] == 2
     )
     if not ok:
         print("serving acceptance ratios NOT met", file=sys.stderr)

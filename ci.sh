@@ -53,6 +53,24 @@ python tools/stats_report.py "$DPS_DIR/dp_sharding_stats.json" \
     --require collective.zero_
 rm -rf "$DPS_DIR"
 
+echo "== embedding engine smoke: fused lookup + cache tier + prefetch =="
+# fused-vs-per-slot op reduction, batch dedup, hot-tier capacity beyond
+# the device-resident rows (cold host path, eviction+write-back), async
+# prefetch overlap, and BITWISE cache-vs-full-table parity — the tool
+# self-gates and its snapshot must carry the embedding.* telemetry
+EMBED_DIR=$(mktemp -d)
+python tools/bench_embedding.py --smoke \
+    --dump "$EMBED_DIR/embedding_stats.json"
+python tools/stats_report.py "$EMBED_DIR/embedding_stats.json" \
+    --require embedding.cache_ --require embedding.hot_hit_rate \
+    --require embedding.prefetch_overlap \
+    --require embedding.unique_ids_per_batch \
+    --require embedding.host_fetch_latency
+rm -rf "$EMBED_DIR"
+# checkpoints carrying cached (host-cold/device-hot) and ps-sharded
+# tables must resume bitwise (Momentum state tiers included)
+python tools/resume_audit.py --embedding
+
 echo "== serving smoke (load gen + chaos ingest + drain) =="
 # short load-gen run over all three traffic mixes with a fault injected
 # on the request-ingestion seam (dataloader.fetch-style): the router's
@@ -122,6 +140,8 @@ python - <<'EOF'
 import numpy as np
 import paddle_tpu as fluid
 from paddle_tpu import layers, observability
+from paddle_tpu.embedding import EmbeddingEngine, fuse_lookups
+from paddle_tpu.framework.scope import Scope, scope_guard
 from paddle_tpu.ops.detection_stats import record_roi_stats
 
 main, startup = fluid.Program(), fluid.Program()
@@ -141,11 +161,35 @@ exe.run(main, feed={"x": np.ones((4, 4), "float32"),
                     "rois": rb}, fetch_list=[y, pooled])
 # host-side padding-waste gauge + rois-per-image histogram
 record_roi_stats(np.array([2, 3]), cap=3)
+
+# one fused + hot-tier-cached lookup -> embedding.* counters, hit-rate
+# gauge, unique-ids/dedup/host-fetch histograms
+emain, estartup = fluid.Program(), fluid.Program()
+escope = Scope()
+with fluid.program_guard(emain, estartup):
+    ids = fluid.data("ids", [8, 2], "int64")
+    parts = [
+        layers.sparse_embedding(
+            layers.slice(ids, [1], [i], [i + 1]), [64, 4],
+            param_attr=fluid.ParamAttr(name="obs_table"),
+        )
+        for i in range(2)
+    ]
+    assert fuse_lookups(emain) == 1
+    engine = EmbeddingEngine(emain, estartup, hot_rows=32)
+    out = layers.concat([layers.reshape(p, [8, 1, 4]) for p in parts], 1)
+with scope_guard(escope):
+    exe.run(estartup, scope=escope)
+    engine.attach(escope)
+    feed = engine.prepare_feed(
+        {"ids": np.arange(16).reshape(8, 2).astype("int64")}, escope)
+    exe.run(emain, feed=feed, fetch_list=[out], scope=escope)
+
 observability.dump("/tmp/paddle_tpu_obs_snapshot.json")
 EOF
 python tools/stats_report.py /tmp/paddle_tpu_obs_snapshot.json \
     --require executor. --require analysis. --require detection. \
-    --require perf. --top-ops 5
+    --require perf. --require embedding. --top-ops 5
 
 echo "== perf report (IR cost model vs XLA over the zoo) =="
 # every zoo model's Program.estimate() must stay within 25% of XLA's own

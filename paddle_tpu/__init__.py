@@ -66,6 +66,7 @@ from . import native  # noqa: F401
 from . import crypto  # noqa: F401  (model-file encryption, framework/io/crypto)
 from . import inference  # noqa: F401
 from . import serving  # noqa: F401  (freeze/router/KV-decode serving path)
+from . import embedding  # noqa: F401  (fused/cached/sharded sparse tables)
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import tensor  # noqa: F401

@@ -62,6 +62,7 @@ ATTR_AXIS_OPS = {
     "zero_all_gather": "dp",
     "dgc_momentum_step": "dp",
     "distributed_lookup_table": "ps",
+    "fused_lookup_table": "ps",
     "moe_ffn": "ep",
     "ring_attention": "sp",
     "ulysses_attention": "sp",
@@ -80,20 +81,32 @@ _BODY_ATTRS = ("sub_block",)  # while / scan_block / bounded_while
 MAX_RANK_COMBOS = 128
 
 
-# sharded-weight-update collectives whose WIRE FORMAT is part of the site
-# kind: an int8-quantized reduce-scatter on one rank paired with a
-# full-precision one on another is a payload-size mismatch — the exchange
-# deadlocks (or corrupts) exactly like a kind mismatch, so the lint must
-# distinguish the quantized variants
-_QUANT_KIND_OPS = frozenset({"zero_reduce_scatter", "zero_all_gather"})
+# collectives whose WIRE FORMAT is part of the site kind: an int8-quantized
+# exchange on one rank paired with a full-precision one on another is a
+# payload-size mismatch — the exchange deadlocks (or corrupts) exactly like
+# a kind mismatch, so the lint must distinguish the quantized variants.
+# The embedding lookups joined in PR 11: their backward row-gradient
+# exchange (all_to_all + all_gather when quantized, psum otherwise) runs a
+# different collective SEQUENCE per wire format, and the column partition
+# runs an all-gather instead of a psum — both are part of the site kind.
+_QUANT_KIND_OPS = frozenset({
+    "zero_reduce_scatter", "zero_all_gather",
+    "distributed_lookup_table", "fused_lookup_table",
+})
+_LOOKUP_KIND_OPS = frozenset({
+    "distributed_lookup_table", "fused_lookup_table",
+})
 
 
 def _site_kind(op, t):
+    kind = t
+    if t in _LOOKUP_KIND_OPS and op.attr("partition", "row") == "col":
+        kind = f"{t}:col"
     if t in _QUANT_KIND_OPS:
         quant = op.attr("quant", "none")
         if quant and quant != "none":
-            return f"{t}:{quant}"
-    return t
+            return f"{kind}:{quant}"
+    return kind
 
 
 def collective_axis(op):

@@ -103,9 +103,15 @@ NORM_OPS = frozenset({
 })
 EMBED_OPS = frozenset({
     "lookup_table", "lookup_table_v2", "lookup_table_dequant",
-    "lookup_sparse_table", "distributed_lookup_table", "gather",
+    "lookup_sparse_table", "distributed_lookup_table",
+    "fused_lookup_table", "gather",
     "gather_nd", "index_select", "index_sample", "take_along_axis",
     "scatter", "scatter_nd_add", "shuffle_batch", "pyramid_hash",
+})
+# the engine's lookup ops get dedicated closed forms (unique-row gather
+# bytes forward, segment-sum scatter backward, quantized exchange wire)
+SPARSE_LOOKUP_OPS = frozenset({
+    "distributed_lookup_table", "fused_lookup_table",
 })
 OPTIMIZER_OPS = {
     # op type -> flops per Param element (rough update-rule arithmetic)
@@ -532,6 +538,89 @@ def _flops_optimizer(op, ins, outs):
     return OPTIMIZER_OPS.get(op.type, 4.0) * _nelem(p)
 
 
+def _lookup_exchange_axis(op, axis_sizes):
+    ax = op.attr("axis_name", "ps")
+    n = int(axis_sizes.get(ax, 1))
+    return n if n > 1 else 1
+
+
+def _lookup_wire_elem_bytes(op, itemsize):
+    return _quant_elem_bytes(
+        op.attr("quant", "none"), op.attr("quant_block", 256), itemsize
+    )
+
+
+def _lookup_cost(op, ins, outs, axis_sizes):
+    """Forward closed form for the engine's lookup ops
+    (distributed_lookup_table / fused_lookup_table): ids read + output
+    write + the UNIQUE-row gather — batch dedup means at most
+    min(total ids, total table rows) rows actually stream from the table —
+    plus the row-assembly exchange wire when the table is mesh-partitioned
+    (psum of the masked [ids, D] rows ~ allreduce factor; the col
+    partition's all-gather moves (n-1)/n of the assembled rows)."""
+    ids_bytes = sum(
+        _nbytes(v) for v in ins.get("Ids", ()) if v is not None
+    )
+    out_bytes = sum(
+        _nbytes(v) for v in outs.get("Out", ()) if v is not None
+    )
+    tables = [v for v in ins.get("W", ()) if v is not None]
+    table_rows = sum(v[0][0] for v in tables if v[0])
+    dim = tables[0][0][-1] if tables and tables[0][0] else 1
+    itemsize = tables[0][1] if tables else 4
+    total_ids = sum(
+        _nelem(v) for v in ins.get("Ids", ()) if v is not None
+    )
+    unique_rows = min(total_ids, table_rows) if table_rows else total_ids
+    gather_bytes = (
+        unique_rows * dim * itemsize
+        if bool(op.attr("dedup", True)) else out_bytes
+    )
+    nbytes = ids_bytes + out_bytes + gather_bytes
+    n = _lookup_exchange_axis(op, axis_sizes)
+    if n > 1:
+        row_payload = float(total_ids * dim)
+        if op.attr("partition", "row") == "col":
+            nbytes += row_payload * itemsize * (n - 1) / n
+        else:
+            # forward psum of the masked rows: allreduce ring factor at
+            # full precision (quantization applies to the BACKWARD grad
+            # exchange only; see _lookup_grad_cost)
+            nbytes += row_payload * itemsize * 2.0 * (n - 1) / n
+    return 0.0, nbytes
+
+
+def _lookup_grad_cost(fwd_op, fwd_ins, fwd_outs, axis_sizes):
+    """Backward closed form: ONE segment-sum scatter per table — each
+    gathered row's cotangent is read once and accumulated into its unique
+    row (flops ~= out grad elems), moving grad-rows in and unique table
+    rows out — plus the id->owner grad all-to-all + all-gather at the
+    (possibly int8 block-quantized) wire element size when row-sharded."""
+    out_bytes = sum(
+        _nbytes(v) for v in fwd_outs.get("Out", ()) if v is not None
+    )
+    out_elems = sum(
+        _nelem(v) for v in fwd_outs.get("Out", ()) if v is not None
+    )
+    tables = [v for v in fwd_ins.get("W", ()) if v is not None]
+    table_rows = sum(v[0][0] for v in tables if v[0])
+    dim = tables[0][0][-1] if tables and tables[0][0] else 1
+    itemsize = tables[0][1] if tables else 4
+    total_ids = sum(
+        _nelem(v) for v in fwd_ins.get("Ids", ()) if v is not None
+    )
+    unique_rows = min(total_ids, table_rows) if table_rows else total_ids
+    nbytes = 2.0 * out_bytes + unique_rows * dim * itemsize
+    flops = float(out_elems)
+    n = _lookup_exchange_axis(fwd_op, axis_sizes)
+    if n > 1 and fwd_op.attr("partition", "row") != "col":
+        elem = _lookup_wire_elem_bytes(fwd_op, itemsize)
+        # reduce-scatter (all_to_all) + all-gather legs over the grad rows
+        nbytes += float(total_ids * dim) * elem * 2.0 * (n - 1) / n
+        flops += float(total_ids * dim)  # fp32 accumulation of the shards
+    return flops, nbytes
+
+
 def _collective_cost(op, ins, outs, axis_sizes):
     """(flops, wire_bytes) for a collective op given bound axis sizes."""
     from .collectives import collective_axis
@@ -571,6 +660,8 @@ def op_cost(op, in_specs, out_specs, axis_sizes=None):
     generic_bytes = _all_bytes(in_specs, out_specs)
     if t in _COLLECTIVE_FACTORS:
         return _collective_cost(op, in_specs, out_specs, axis_sizes or {})
+    if t in SPARSE_LOOKUP_OPS:
+        return _lookup_cost(op, in_specs, out_specs, axis_sizes or {})
     if t in MATMUL_OPS:
         return _flops_matmul(op, in_specs, out_specs), generic_bytes
     if t in CONV_OPS:
@@ -757,6 +848,15 @@ class _Estimator:
         if fwd_type == "recompute_segment":
             self._visit_recompute(fwd_op, block, op_index, count, 0,
                                   grad=True)
+            return
+        if fwd_type in SPARSE_LOOKUP_OPS:
+            # one segment-sum scatter per table + the (possibly quantized)
+            # grad exchange — NOT 2x the forward gather
+            flops, nbytes = _lookup_grad_cost(
+                fwd_op, fwd_ins, fwd_outs, self.axis_sizes
+            )
+            self._record(op, f"{fwd_type}_grad", flops, nbytes, count,
+                         block.idx, op_index)
             return
         flops, nbytes = op_cost(fwd_op, fwd_ins, fwd_outs, self.axis_sizes)
         # each WANTED input grad of a contraction is one forward-sized

@@ -1,0 +1,29 @@
+"""TPU-native embedding engine (PR 11) — the recommendation workhorse.
+
+The reference's large-scale sparse stack (PAPER.md L3/L8: FleetWrapper /
+pslib, distributed lookup_table, BoxPS caches) re-architected for the XLA
+compilation model, in four pieces:
+
+* :func:`fuse_lookups` (engine.py) — same-width ``sparse_embedding``
+  lookups coalesce into ONE ``fused_lookup_table`` op over a concatenated
+  id space: batch-unique ids dedup once, one gather serves every slot,
+  backward is one segment-sum scatter per table (DeepFM: 26+1 gather
+  dispatches -> 2).
+* sharded tables (parallel/sparse.py) — row- or column-partition over a
+  mesh axis, with an opt-in PR-9 int8 block-quant wire for the embedding
+  gradient exchange (``parallel.quantize_embedding_grads``).
+* :class:`CachedTable` tiers (cache.py) — a frequency-tracked hot-rows
+  tier resident on device with a host-memory cold path, so ``vocab_size``
+  can exceed one device's HBM; eviction by access count, write-back of
+  trained rows + optimizer state.
+* :class:`Prefetcher` (prefetch.py) — the next batch's ids are extracted
+  and their cold rows staged host-side while the current step computes.
+
+Telemetry lands under ``embedding.*`` (hit-rate gauges, host-fetch /
+prefetch-overlap / unique-ids histograms); README §Embedding engine has
+the knobs and the capacity math.
+"""
+
+from .cache import CachedGroup  # noqa: F401
+from .engine import EmbeddingEngine, fuse_lookups  # noqa: F401
+from .prefetch import Prefetcher  # noqa: F401

@@ -418,12 +418,18 @@ def accuracy(input, label, k=1):
 
 def sparse_embedding(
     input, size, param_attr=None, dtype="float32", axis="ps",
-    pad_to_multiple=8, is_sparse=True,
+    pad_to_multiple=8, is_sparse=True, dedup=True,
 ):
-    """Row-sharded (huge) embedding lookup — the PS-table capability
+    """Mesh-sharded (huge) embedding lookup — the PS-table capability
     (reference distributed_lookup_table_op.cc / fluid sparse embedding).
     `size=[vocab, dim]`; vocab is padded up so any mesh axis size dividing
-    `pad_to_multiple` shards evenly. See ops/sparse.py + parallel/sparse.py.
+    `pad_to_multiple` shards evenly. `dedup` (default on) batch-uniques the
+    ids before the gather so repeated ids read their row once and the
+    backward is one segment-sum scatter. Same-width lookups coalesce into
+    one ``fused_lookup_table`` under ``embedding.fuse_lookups``; row/col
+    partition and the quantized grad exchange are selected by
+    ``parallel.shard_sparse_tables`` / ``parallel.quantize_embedding_grads``.
+    See ops/sparse.py + parallel/sparse.py + paddle_tpu/embedding/.
     """
     vocab, dim = size
     padded = ((vocab + pad_to_multiple - 1) // pad_to_multiple) * pad_to_multiple
@@ -433,7 +439,7 @@ def sparse_embedding(
     )
     return helper.create_and_append(
         {"Ids": [input], "W": [w]},
-        {"axis_name": axis},
+        {"axis_name": axis, "dedup": bool(dedup)},
         op_type="distributed_lookup_table",
     )
 

@@ -33,7 +33,8 @@ class DeepFMConfig:
                    mlp_sizes=(400, 400, 400), dense_dim=13)
 
 
-def deepfm(feat_ids, label, cfg, axis="ps", dense_input=None):
+def deepfm(feat_ids, label, cfg, axis="ps", dense_input=None,
+           per_slot=False):
     """feat_ids: [B, F] int64 global feature ids; label: [B, 1] float32;
     dense_input: optional [B, dense_dim] float32 continuous features.
     Returns (avg_logloss, predict).
@@ -41,21 +42,50 @@ def deepfm(feat_ids, label, cfg, axis="ps", dense_input=None):
     The wide half is the FM itself — first-order sparse weights plus the
     factorized second-order term, which IS all pairwise feature crosses
     (sum_{i<j} <v_i, v_j> x_i x_j) without materializing the cross matrix;
-    dense features get a linear wide term and join the deep tower input."""
+    dense features get a linear wide term and join the deep tower input.
+
+    ``per_slot=True`` builds the reference CTR layout (PaddleRec DeepFM:
+    one embedding gather PER SPARSE SLOT against the shared global-id
+    tables) — 2F+ lookup dispatch sites instead of 2, which is exactly the
+    shape ``embedding.fuse_lookups`` coalesces back into one
+    ``fused_lookup_table`` per table width. Numerically identical to the
+    default layout."""
     b, f = feat_ids.shape
 
-    # first-order: sharded [V, 1] table
-    w1 = layers.sparse_embedding(
-        feat_ids, [cfg.vocab_size, 1],
-        param_attr=ParamAttr(name="deepfm_w1"), axis=axis,
-    )  # [B, F, 1]
+    if per_slot:
+        # gather phase first (2F lookup sites, nothing reads them yet —
+        # the layout fuse_lookups coalesces into one op per table width),
+        # assembly phase after
+        w1_raw, emb_raw = [], []
+        for i in range(f):
+            slot_ids = layers.slice(feat_ids, [1], [i], [i + 1])  # [B, 1]
+            w1_raw.append(layers.sparse_embedding(
+                slot_ids, [cfg.vocab_size, 1],
+                param_attr=ParamAttr(name="deepfm_w1"), axis=axis,
+            ))  # [B, 1]
+            emb_raw.append(layers.sparse_embedding(
+                slot_ids, [cfg.vocab_size, cfg.embed_dim],
+                param_attr=ParamAttr(name="deepfm_emb"), axis=axis,
+            ))  # [B, D]
+        w1 = layers.concat(
+            [layers.reshape(v, [b, 1, 1]) for v in w1_raw], axis=1
+        )  # [B, F, 1]
+        emb = layers.concat(
+            [layers.reshape(v, [b, 1, cfg.embed_dim]) for v in emb_raw],
+            axis=1,
+        )  # [B, F, D]
+    else:
+        # first-order: sharded [V, 1] table
+        w1 = layers.sparse_embedding(
+            feat_ids, [cfg.vocab_size, 1],
+            param_attr=ParamAttr(name="deepfm_w1"), axis=axis,
+        )  # [B, F, 1]
+        # factor embeddings: sharded [V, D] table
+        emb = layers.sparse_embedding(
+            feat_ids, [cfg.vocab_size, cfg.embed_dim],
+            param_attr=ParamAttr(name="deepfm_emb"), axis=axis,
+        )  # [B, F, D]
     first = layers.reduce_sum(layers.reshape(w1, [b, f]), 1, keep_dim=True)
-
-    # factor embeddings: sharded [V, D] table
-    emb = layers.sparse_embedding(
-        feat_ids, [cfg.vocab_size, cfg.embed_dim],
-        param_attr=ParamAttr(name="deepfm_emb"), axis=axis,
-    )  # [B, F, D]
 
     # FM second order: 0.5 * sum_d((sum_f v)^2 - sum_f v^2)
     sum_f = layers.reduce_sum(emb, 1)  # [B, D]

@@ -128,6 +128,35 @@ def build_deepfm():
     )
 
 
+def build_deepfm_fused():
+    """The PR-11 embedding-engine layout: per-slot lookups (the reference
+    CTR shape, 2F gather sites) coalesced by ``embedding.fuse_lookups``
+    into one ``fused_lookup_table`` per table width, with the tables
+    row-sharded over the "ps" axis — the graph the fused bench leg and the
+    serving recommendation mix dispatch."""
+    import paddle_tpu as fluid
+    from ..embedding import fuse_lookups
+    from ..parallel.sparse import shard_sparse_tables
+    from .deepfm import DeepFMConfig, deepfm
+
+    cfg = DeepFMConfig(
+        vocab_size=512, num_fields=6, embed_dim=8, mlp_sizes=(16,)
+    )
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("feat_ids", [8, cfg.num_fields], "int64")
+        label = fluid.data("label", [8, 1], "float32")
+        loss, predict = deepfm(ids, label, cfg, per_slot=True)
+        fuse_lookups(main)
+        fluid.optimizer.Adam(1e-2).minimize(loss, startup)
+        shard_sparse_tables(main)
+    return BuiltModel(
+        "deepfm_fused", main, startup, ("feat_ids", "label"),
+        (loss.name, predict.name),
+        mesh_axes={"ps": 8},
+    )
+
+
 def build_mask_rcnn():
     import paddle_tpu as fluid
     from . import mask_rcnn
@@ -209,6 +238,7 @@ MODEL_BUILDERS = {
     "gpt": build_gpt,
     "yolov3": build_yolov3,
     "deepfm": build_deepfm,
+    "deepfm_fused": build_deepfm_fused,
     "mask_rcnn": build_mask_rcnn,
     "mask_rcnn_batched": build_mask_rcnn_batched,
     "bert_3d": build_bert_3d,

@@ -8,48 +8,260 @@ gradients flow back as send ops into per-shard optimize blocks
 one device) lived entirely in that RPC machinery (plus pslib/BoxPS caches,
 fleet_wrapper.h:86).
 
-TPU-native re-design: the table is ROW-SHARDED over a mesh axis ("ps") and
-stays resident in device HBM; a lookup is one fused gather + masked-select +
-psum over ICI — no RPC, no host round-trip, and the backward pass
-(scatter-add of row gradients into the owning shard) falls out of the
-generic __vjp__ machinery instead of a hand-written send/optimize-block
-protocol. Block sharding: row r lives on shard r // (vocab/N).
+TPU-native re-design (PR 11 embedding engine):
 
-Under no mesh (or the axis absent) the op degrades to a plain local gather,
-matching the reference's non-distributed lookup_table fallback.
+* ``distributed_lookup_table`` — one table, row- or column-sharded over a
+  mesh axis ("ps"). Row sharding: the table stays resident in device HBM as
+  [V/n, D] shards; a lookup is batch-dedup (unique) -> fused gather ->
+  masked-select -> psum over ICI -> scatter-back by the unique inverse. The
+  backward (scatter-add of row gradients into the owning shard) falls out of
+  the generic __vjp__ machinery — the vjp of gather-by-unique-inverse IS the
+  segment-sum the reference hand-wrote in its send/optimize-block protocol.
+  Column sharding ([V, D/n] shards): local gather of every row's column
+  slice + an all-gather over the feature dim.
+
+* ``fused_lookup_table`` — N same-width lookups coalesced into ONE op
+  (``embedding.fuse_lookups`` builds these): the id tensors concatenate into
+  a single key space (table t's id i -> table_offset_t + i), batch-unique
+  ids dedup ONCE across every slot, one gather against the row-concatenated
+  tables serves all N lookups, and the unique inverse scatters each slot's
+  rows back. DeepFM's 26 sparse slots become one gather instead of 26+1
+  dispatch sites. Backward: one segment-sum scatter per table (vjp of
+  concat splits the row grads back to their tables).
+
+* opt-in quantized gradient exchange (``quant="int8"``): the row-sharded
+  backward's psum of [ids, D] row cotangents is replaced by the PR-9 EQuARX
+  wire format (ops/collective.py ``_block_quantize``) — int8 blocks + fp32
+  per-block abs-max scales, all_to_all reduce-scatter with fp32
+  accumulation, int8 all-gather back. ``quant="none"`` (default) keeps the
+  plain psum and is BITWISE identical to the pre-engine path.
+
+Under no mesh (or the axis absent) every path degrades to a plain local
+gather, matching the reference's non-distributed lookup_table fallback.
+Batch dedup is on by default (``dedup`` attr): repeated ids in a batch
+gather their row once, and the backward becomes a true segment-sum instead
+of N colliding scatter-adds.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..framework.registry import register_op
 
 
-@register_op("distributed_lookup_table", inputs=["Ids", "W"], outputs=["Out"])
-def _distributed_lookup_table(ctx, op, ins):
-    ids = ins["Ids"][0]
-    w = ins["W"][0]  # local row-shard under shard_map; full table otherwise
-    axis = op.attr("axis_name", "ps")
+def _squeeze_ids(ids):
+    """fluid id layout: a trailing [.., 1] dim is squeezed (ids [B, 1]
+    gather to [B, D], not [B, 1, D])."""
     if ids.ndim and ids.shape[-1] == 1:
         ids = ids.reshape(ids.shape[:-1])
-    ids = ids.astype(jnp.int32)
-    if axis not in ctx.mesh_axes:
-        return {"Out": [w[ids]]}
-    n = ctx.axis_sizes[axis]
-    k = lax.axis_index(axis)
-    rows_local = w.shape[0]  # the local row-shard (global_rows // n)
-    local = ids - k * rows_local
-    owned = jnp.logical_and(local >= 0, local < rows_local)
-    safe = jnp.clip(local, 0, rows_local - 1)
-    vals = jnp.where(owned[..., None], w[safe], 0)
-    # each row is owned by exactly one shard: the psum assembles the full
-    # batch of embeddings on every device (ICI all-reduce of [B..., D]).
-    out = lax.psum(vals, axis)
-    # psum transposes to psum under shard_map: the N replicated downstream
-    # losses each seed a unit cotangent, which would scatter N-times-too-
-    # large row gradients into the owning shard. Rescale the GRADIENT only
-    # (value unchanged) — same correction as pipeline_block's loss psum.
-    out = out / n + lax.stop_gradient(out * (n - 1) / n)
+    return ids.astype(jnp.int32)
+
+
+def _quant_psum_rows(ax, n, qblock):
+    """psum whose BACKWARD ships the cotangent in the PR-9 int8 block-quant
+    wire format: quantize -> all_to_all reduce-scatter -> fp32 accumulate ->
+    int8 all-gather (exactly zero_reduce_scatter + zero_all_gather's wire,
+    applied to the embedding row-gradient exchange). The forward psum is
+    exact: each unique row is owned by one shard, every other contribution
+    is a true zero."""
+    from .collective import _block_dequantize, _block_quantize
+
+    def fwd(vals):
+        return lax.psum(vals, ax), vals.shape
+
+    def bwd(shape, g):
+        flat = g.reshape(-1)
+        numel = flat.shape[0]
+        align = n * qblock
+        pad = (numel + align - 1) // align * align
+        if pad > numel:
+            flat = jnp.pad(flat, (0, pad - numel))
+        shards = flat.reshape(n, pad // n)
+        q, scales = _block_quantize(shards, qblock)
+        q = lax.all_to_all(q, ax, split_axis=0, concat_axis=0, tiled=False)
+        scales = lax.all_to_all(
+            scales, ax, split_axis=0, concat_axis=0, tiled=False
+        )
+        own = jnp.sum(_block_dequantize(q, scales, qblock), axis=0)
+        q2, s2 = _block_quantize(own, qblock)
+        q2 = lax.all_gather(q2, ax, tiled=True)
+        s2 = lax.all_gather(s2, ax, tiled=True)
+        full = _block_dequantize(q2, s2, qblock)[:numel]
+        return (full.reshape(shape).astype(g.dtype),)
+
+    @jax.custom_vjp
+    def exchange(vals):
+        return lax.psum(vals, ax)
+
+    exchange.defvjp(fwd, bwd)
+    return exchange
+
+
+def _record_embed_wire(op, kind, payload_elems, dtype, ax, n):
+    """Trace-time wire-byte accounting for the embedding exchanges, in the
+    PR-9 counter shape (collective.bytes.<kind>_<precision>)."""
+    if ax is None or n <= 1:
+        return
+    from .. import observability as _obs
+    from ..resilience.faults import fault_point
+
+    fault_point("collective.dispatch")
+    quant = op.attr("quant", "none") or "none"
+    block = int(op.attr("quant_block", 256) or 256)
+    if quant != "none":
+        per_elem = 1.0 + 4.0 / block
+        precision = quant
+    else:
+        per_elem = float(jnp.dtype(dtype).itemsize)
+        precision = "fp32" if jnp.dtype(dtype).itemsize == 4 else str(dtype)
+    wire = int(payload_elems * per_elem * (n - 1) / n)
+    _obs.add(f"collective.{kind}")
+    _obs.add(f"collective.bytes.{kind}_{precision}", wire)
+
+
+def _lookup_core(ctx, op, ids_list, tables):
+    """Shared kernel of both lookup ops: dedup once over the concatenated
+    id/key space, one gather against the row-concatenated tables, scatter
+    back per slot. Returns one [ids_shape..., D] value per ids tensor."""
+    from .. import observability as _obs
+
+    axis = op.attr("axis_name", "ps")
+    partition = op.attr("partition", "row")
+    dedup = bool(op.attr("dedup", True))
+    quant = op.attr("quant", "none") or "none"
+    qblock = int(op.attr("quant_block", 256) or 256)
+    sharded = axis in ctx.mesh_axes
+    n = int(ctx.axis_sizes[axis]) if sharded else 1
+
+    ids_list = [_squeeze_ids(i) for i in ids_list]
+    # slot -> table segment: fused slots sharing one table share ONE
+    # key-space segment (the W slot carries each table once), so the same
+    # id in two slots dedups to one gathered row; default = one table per
+    # slot (single-table op, or a hand-built fused op with distinct tables)
+    slot_idx = op.attr("slot_table_idx")
+    if slot_idx is None:
+        if len(tables) == len(ids_list):
+            slot_idx = list(range(len(ids_list)))
+        elif len(tables) == 1:
+            slot_idx = [0] * len(ids_list)
+        else:
+            from ..errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"{op.type}: {len(ids_list)} id slots over {len(tables)} "
+                "tables needs a slot_table_idx attr"
+            )
+    # under a column shard_map the local table is [V, D/n]; the assembled
+    # output rows are full-width D
+    dim = int(tables[0].shape[-1])
+    if sharded and partition == "col":
+        dim *= n
+    # per-table GLOBAL row counts: under a row shard_map each rank sees its
+    # [V/n, D] slice; column sharding and the meshless path see all rows
+    rows_local = [int(w.shape[0]) for w in tables]
+    if sharded and partition == "row":
+        rows_global = [r * n for r in rows_local]
+    else:
+        rows_global = list(rows_local)
+    goff = [0]
+    for r in rows_global[:-1]:
+        goff.append(goff[-1] + r)
+    loff = [0]
+    for r in rows_local[:-1]:
+        loff.append(loff[-1] + r)
+
+    flat_ids, spans = [], []
+    start = 0
+    for i, ids in enumerate(ids_list):
+        f = ids.reshape(-1) + goff[slot_idx[i]]
+        flat_ids.append(f)
+        spans.append((start, start + f.shape[0], ids.shape))
+        start += f.shape[0]
+    keys = jnp.concatenate(flat_ids) if len(flat_ids) > 1 else flat_ids[0]
+
+    if dedup:
+        u, inv = jnp.unique(
+            keys, size=keys.shape[0], fill_value=0, return_inverse=True
+        )
+        inv = inv.reshape(-1)
+    else:
+        u, inv = keys, jnp.arange(keys.shape[0])
+
+    combined = (
+        jnp.concatenate(tables, axis=0) if len(tables) > 1 else tables[0]
+    )
+
+    if not sharded:
+        # local tier: tables fully resident (or the engine's hot tier);
+        # the global key space IS the concat row space (goff == loff)
+        rows = combined[jnp.clip(u, 0, combined.shape[0] - 1)]
+    elif partition == "col":
+        # every rank holds all rows' [D/n] column slice: local gather of
+        # the full key set, then one all-gather over the feature dim
+        rows_part = combined[jnp.clip(u, 0, combined.shape[0] - 1)]
+        _obs.add("collective.fused_lookup_allgather")
+        rows = lax.all_gather(rows_part, axis, axis=rows_part.ndim - 1,
+                              tiled=True)
+        rows = rows / n + lax.stop_gradient(rows * (n - 1) / n)
+    else:
+        # row sharding: mask to the owned segment of each table, gather
+        # from the local concat, and exchange (each row owned by exactly
+        # one shard, so the sum is exact)
+        k = lax.axis_index(axis)
+        idx = jnp.zeros_like(u)
+        owned = jnp.zeros(u.shape, bool)
+        for t in range(len(tables)):
+            in_seg = jnp.logical_and(
+                u >= goff[t], u < goff[t] + rows_global[t]
+            )
+            local = u - goff[t] - k * rows_local[t]
+            own_t = jnp.logical_and(
+                in_seg,
+                jnp.logical_and(local >= 0, local < rows_local[t]),
+            )
+            idx = jnp.where(own_t, loff[t] + local, idx)
+            owned = jnp.logical_or(owned, own_t)
+        vals = jnp.where(
+            owned[..., None],
+            combined[jnp.clip(idx, 0, combined.shape[0] - 1)],
+            0,
+        )
+        _record_embed_wire(
+            op, "embed_grad_exchange", vals.size, vals.dtype, axis, n
+        )
+        if quant == "int8":
+            rows = _quant_psum_rows(axis, n, qblock)(vals)
+        else:
+            rows = lax.psum(vals, axis)
+        # psum transposes to psum under shard_map: the N replicated
+        # downstream losses each seed a unit cotangent, which would scatter
+        # N-times-too-large row gradients into the owning shard. Rescale
+        # the GRADIENT only (value unchanged) — same correction as
+        # pipeline_block's loss psum.
+        rows = rows / n + lax.stop_gradient(rows * (n - 1) / n)
+
+    gathered = rows[inv]
+    outs = []
+    for s, e, shape in spans:
+        outs.append(gathered[s:e].reshape(tuple(shape) + (dim,)))
+    return outs
+
+
+@register_op("distributed_lookup_table", inputs=["Ids", "W"], outputs=["Out"])
+def _distributed_lookup_table(ctx, op, ins):
+    (out,) = _lookup_core(ctx, op, [ins["Ids"][0]], [ins["W"][0]])
     return {"Out": [out]}
+
+
+@register_op("fused_lookup_table", inputs=["Ids", "W"], outputs=["Out"])
+def _fused_lookup_table(ctx, op, ins):
+    from .. import observability as _obs
+
+    tables = ins["W"]
+    _obs.add("embedding.fused_lookup_sites")
+    _obs.add("embedding.fused_lookup_tables", len(tables))
+    outs = _lookup_core(ctx, op, ins["Ids"], tables)
+    return {"Out": outs}

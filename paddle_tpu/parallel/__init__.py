@@ -29,4 +29,8 @@ from .pipeline_uniform import (  # noqa: F401  (registers pipeline_uniform)
     gate_loss,
     uniform_pipeline,
 )
-from .sparse import shard_sparse_tables, sparse_table_names  # noqa: F401
+from .sparse import (  # noqa: F401
+    quantize_embedding_grads,
+    shard_sparse_tables,
+    sparse_table_names,
+)

@@ -4,71 +4,180 @@ Reference: the DistributeTranspiler sliced each table into per-pserver
 blocks and rewired the trainer program with prefetch/send ops
 (transpiler/distribute_transpiler.py:1675, ps_dispatcher.py). Here the
 "transpile" is pure metadata: mark every sparse table (and its grad +
-optimizer accumulators) as row-sharded over the mesh axis, then let
-shard_map place the shards. See ops/sparse.py for the lookup kernel.
+optimizer accumulators) as sharded over the mesh axis, then let shard_map
+place the shards. See ops/sparse.py for the lookup kernel and
+``paddle_tpu.embedding`` for the fused-lookup transform + cache tiers.
+
+Two partitions (PR 11):
+
+* ``partition="row"`` — [V/n, D] shards; a lookup masks to the owned row
+  segment and psum-assembles (ids are replicated, so there is no forward
+  id exchange; the backward row-gradient exchange optionally rides the
+  PR-9 int8 wire, see ``quantize_embedding_grads``).
+* ``partition="col"`` — [V, D/n] shards; a lookup gathers every row's
+  column slice locally and all-gathers over the feature dim (the Megatron
+  embedding split). Quantized grad exchange is row-partition only.
 """
 
 from __future__ import annotations
 
 from ..framework.program import grad_var_name
 
+LOOKUP_OPS = ("distributed_lookup_table", "fused_lookup_table")
+
+
+def _lookup_tables(op):
+    """Table var names consumed by a (possibly fused) lookup op."""
+    return [w for w in op.inputs.get("W", ()) if w]
+
+
+def _stamp_lookup_attrs(program, attrs):
+    """Stamp `attrs` onto every lookup op AND onto the ``fwd_attrs``
+    snapshot of every ``__vjp__`` grad op replaying one: append_backward
+    copies the forward attrs at minimize time, so a post-minimize rewrite
+    that only touched the forward op would leave the backward replay
+    running the OLD exchange (wrong axis/partition, or silently
+    unquantized grads)."""
+    stamped = 0
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type in LOOKUP_OPS and _lookup_tables(op):
+                op.attrs.update(attrs)
+                stamped += 1
+            elif (
+                op.type == "__vjp__"
+                and op.attr("fwd_type") in LOOKUP_OPS
+            ):
+                fwd_attrs = dict(op.attr("fwd_attrs") or {})
+                fwd_attrs.update(attrs)
+                op.attrs["fwd_attrs"] = fwd_attrs
+    program._bump()
+    return stamped
+
 
 def sparse_table_names(program):
-    """Names of every table consumed by a distributed_lookup_table op."""
+    """Names of every table consumed by a lookup op (fused or single)."""
     names = []
     for blk in program.blocks:
         for op in blk.ops:
-            if op.type == "distributed_lookup_table":
-                w = op.inputs["W"][0]
-                if w not in names:
-                    names.append(w)
+            if op.type in LOOKUP_OPS:
+                for w in _lookup_tables(op):
+                    if w not in names:
+                        names.append(w)
     return names
 
 
-def shard_sparse_tables(program, axis="ps"):
-    """Row-shard every sparse table + grad + optimizer state over `axis`.
+def quantize_embedding_grads(program, quant="int8", quant_block=256):
+    """Opt in to the int8 block-quantized embedding-gradient exchange on
+    every row-partitioned lookup op (the PR-9 EQuARX wire applied to the
+    backward row-cotangent psum). ``quant=None``/"none" switches back to
+    the fp32 psum, which is bitwise-identical to the pre-engine path."""
+    quant = quant if quant not in (None, "", "none") else "none"
+    if quant not in ("none", "int8"):
+        raise ValueError(
+            f"quantize_embedding_grads: unknown quantization {quant!r}; "
+            "supported: None | 'int8'"
+        )
+    if int(quant_block) < 1:
+        raise ValueError(
+            f"quantize_embedding_grads: quant_block must be a positive "
+            f"element count, got {quant_block!r}"
+        )
+    for blk in program.blocks:
+        for op in blk.ops:
+            if (
+                op.type in LOOKUP_OPS
+                and quant != "none"
+                and op.attr("partition", "row") == "col"
+            ):
+                raise NotImplementedError(
+                    "quantize_embedding_grads: the column-partitioned "
+                    "lookup's grad exchange (psum_scatter over the feature "
+                    "dim) is not quantized; use partition='row'"
+                )
+    return _stamp_lookup_attrs(
+        program, {"quant": quant, "quant_block": int(quant_block)}
+    )
+
+
+def shard_sparse_tables(program, axis="ps", partition="row"):
+    """Shard every sparse table + grad + optimizer state over `axis`.
 
     Call AFTER optimizer.minimize (so accumulator vars exist) and before
     shard_program. Optimizer accumulators are matched by the exact
     `_accum_of` tag Optimizer._add_accumulator stamps on each accumulator
-    Variable (row-shaped ones only; scalar state like beta powers stays
-    replicated) — row-sharding them keeps Adam/SGD state local to the
-    owning shard, the locality the reference's per-pserver optimize blocks
+    Variable (table-shaped ones only; scalar state like beta powers stays
+    replicated) — sharding them keeps Adam/SGD state local to the owning
+    shard, the locality the reference's per-pserver optimize blocks
     (listen_and_serv_op.cc) achieved over RPC. Custom state created outside
     _add_accumulator is NOT auto-sharded; tag it with `_accum_of` yourself.
+
+    ``partition``: "row" shards dim 0 ([V/n, D]); "col" shards dim 1
+    ([V, D/n], the Megatron embedding split — backward stays a local
+    column-slice scatter, no row exchange at all).
     """
+    if partition not in ("row", "col"):
+        raise ValueError(
+            f"shard_sparse_tables: unknown partition {partition!r}; "
+            "supported: 'row' | 'col'"
+        )
+    if partition == "col":
+        # order-independent guard: quantize_embedding_grads refuses col
+        # AFTER the partition is stamped; stamping col AFTER a quant
+        # opt-in would silently drop the compression while telemetry and
+        # the collective lint keep claiming int8
+        for blk in program.blocks:
+            for op in blk.ops:
+                if (
+                    op.type in LOOKUP_OPS
+                    and (op.attr("quant", "none") or "none") != "none"
+                ):
+                    raise NotImplementedError(
+                        "shard_sparse_tables: partition='col' does not "
+                        "support the quantized grad exchange stamped on "
+                        f"op {op.type!r}; use partition='row' or drop "
+                        "quantize_embedding_grads"
+                    )
     tables = sparse_table_names(program)
     blk = program.global_block
+    dim_idx = 0 if partition == "row" else 1
+    spec = (axis,) if partition == "row" else (None, axis)
     for t in tables:
-        rows = blk.var(t).shape[0]
-        program._sharding[t] = (axis,)
+        shape = blk.var(t).shape
+        program._sharding[t] = spec
         # divisibility is NOT auto-padded at this layer: fail loudly at
         # build time instead of an opaque shard_map error at run time
-        # (sparse_embedding's pad_to_multiple should cover the mesh size)
+        # (sparse_embedding's pad_to_multiple should cover the mesh size
+        # for rows; embed_dim must divide the mesh for columns)
         if program._mesh is not None and axis in program._mesh.shape:
             n = program._mesh.shape[axis]
-            if rows % n:
-                raise ValueError(
-                    f"table {t!r} has {rows} rows, not divisible by mesh "
-                    f"axis {axis!r} size {n}; raise pad_to_multiple on "
-                    "sparse_embedding"
+            if shape[dim_idx] % n:
+                fix = (
+                    "raise pad_to_multiple on sparse_embedding"
+                    if dim_idx == 0 else
+                    "pick an embed_dim the mesh divides (or "
+                    "partition='row')"
                 )
-        program._sharding[grad_var_name(t)] = (axis,)
+                raise ValueError(
+                    f"table {t!r} has {shape[dim_idx]} "
+                    f"{'rows' if dim_idx == 0 else 'columns'}, not "
+                    f"divisible by mesh axis {axis!r} size {n}; {fix}"
+                )
+        program._sharding[grad_var_name(t)] = spec
         for name, v in blk.vars.items():
-            # exact match on the optimizer's accumulator tag (row-shaped
+            # exact match on the optimizer's accumulator tag (table-shaped
             # only; scalar state like beta powers stays replicated)
             if (
                 getattr(v, "_accum_of", None) == t
                 and v.shape
-                and len(v.shape) >= 1
-                and v.shape[0] == rows
+                and len(v.shape) > dim_idx
+                and tuple(v.shape) == tuple(shape)
             ):
-                program._sharding[name] = (axis,)
-    for blk_ in program.blocks:
-        for op in blk_.ops:
-            if op.type == "distributed_lookup_table":
-                # unconditional: a stale axis_name from build time would
-                # shard storage over one axis but psum over another
-                op.attrs["axis_name"] = axis
-    program._bump()
+                program._sharding[name] = spec
+    # unconditional: a stale axis_name from build time would shard storage
+    # over one axis but psum over another; the partition attr must match
+    # the storage layout the same way (forward ops AND __vjp__ snapshots)
+    _stamp_lookup_attrs(
+        program, {"axis_name": axis, "partition": partition}
+    )
     return tables

@@ -248,6 +248,23 @@ class ShardedWeightUpdate:
                     "per-param regularizer, which rewrites its gradient "
                     "after the reduce-scatter insertion point"
                 )
+        # mesh-sharded sparse embedding tables compose, not conflict: their
+        # storage, grads and accumulators are already partitioned over the
+        # "ps" axis (parallel/sparse.py) and their gradients are
+        # dp-replicated (ids feed replicated), so the dense ZeRO rewrite
+        # must SKIP them — flat-[pad] dp-sharding a ps-sharded table would
+        # fight its row/column spec and its in-graph grad exchange
+        from .sparse import sparse_table_names
+
+        sparse = set(sparse_table_names(main))
+        skipped_sparse = [p.name for p, _g in params_grads
+                          if p.name in sparse]
+        params_grads = [pg for pg in params_grads
+                        if pg[0].name not in sparse]
+        if skipped_sparse:
+            _obs.add("collective.zero_sparse_tables_skipped",
+                     len(skipped_sparse))
+
         per_rank = replicated = master = 0
         shard_names = []
         unshardable = []

@@ -282,6 +282,76 @@ class TestOpGoldens:
             est._record(None, t, 1.0, 1.0, 1, 0, 0, loc="")
             assert table.ops[-1].family == fam, t
 
+    def test_fused_lookup_unique_row_gather_bytes(self):
+        """fused_lookup_table forward: ids + outputs + the UNIQUE-row
+        gather — bounded by min(total ids, total table rows), never the
+        whole table, never one row per occurrence."""
+        v, d, b = 1_000_000, 8, 64
+        tables = [_f32((v, d))] * 4
+        ids = [((b, 1), 8)] * 4  # 4 slots of int64 [64, 1] ids
+        outs = [_f32((b, d))] * 4
+        op = OpView("fused_lookup_table", {"axis_name": "ps"})
+        flops, nbytes = op_cost(
+            op, {"Ids": ids, "W": tables}, {"Out": outs}
+        )
+        assert flops == 0.0
+        total_ids = 4 * b
+        assert nbytes == (
+            total_ids * 8          # ids read
+            + total_ids * d * 4    # outputs written
+            + total_ids * d * 4    # unique-row gather (<= total ids rows)
+        )
+        # a table smaller than the batch bounds the gather by its rows
+        tiny = [_f32((16, d))]
+        _, small = op_cost(
+            OpView("fused_lookup_table", {}),
+            {"Ids": [((b,), 8)], "W": tiny}, {"Out": [_f32((b, d))]},
+        )
+        assert small == b * 8 + b * d * 4 + 16 * d * 4
+        # dedup=False: the legacy per-occurrence gather (output-sized)
+        _, nodedup = op_cost(
+            OpView("fused_lookup_table", {"dedup": False}),
+            {"Ids": [((b,), 8)], "W": tiny}, {"Out": [_f32((b, d))]},
+        )
+        assert nodedup == b * 8 + 2 * b * d * 4
+
+    def test_fused_lookup_sharded_exchange_wire(self):
+        """Row partition adds the psum row-assembly wire; the backward
+        segment-sum (via __vjp__) adds the grad exchange at the quantized
+        element size when int8 is opted in."""
+        from paddle_tpu.analysis.cost import _lookup_grad_cost
+
+        v, d, b, n = 4096, 16, 32, 8
+        ins = {"Ids": [((b,), 8)], "W": [_f32((v, d))]}
+        outs = {"Out": [_f32((b, d))]}
+        base_op = OpView("fused_lookup_table", {"axis_name": "ps"})
+        _, local = op_cost(base_op, ins, outs, axis_sizes={})
+        _, sharded = op_cost(base_op, ins, outs, axis_sizes={"ps": n})
+        assert sharded - local == pytest.approx(
+            b * d * 4 * 2 * (n - 1) / n
+        )
+        # backward: fp32 grad exchange vs int8 block-quantized wire
+        g_flops, g_fp32 = _lookup_grad_cost(
+            base_op, ins, outs, {"ps": n}
+        )
+        assert g_flops >= b * d  # segment-sum adds + shard accumulation
+        q_op = OpView("fused_lookup_table", {
+            "axis_name": "ps", "quant": "int8", "quant_block": 256,
+        })
+        _, g_int8 = _lookup_grad_cost(q_op, ins, outs, {"ps": n})
+        fixed = 2 * b * d * 4 + b * d * 4  # segment-sum local traffic
+        assert (g_int8 - fixed) < 0.3 * (g_fp32 - fixed)
+        # col partition: all-gather forward, no quantized grad exchange
+        col_op = OpView("fused_lookup_table", {
+            "axis_name": "ps", "partition": "col",
+        })
+        _, col = op_cost(col_op, ins, outs, axis_sizes={"ps": n})
+        assert col - local == pytest.approx(b * d * 4 * (n - 1) / n)
+
+    def test_fused_lookup_family_is_embedding(self):
+        assert family_of("fused_lookup_table") == "embedding"
+        assert family_of("distributed_lookup_table") == "embedding"
+
 
 # ---------------------------------------------------------------------------
 # Program.estimate()

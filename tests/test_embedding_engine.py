@@ -1,0 +1,696 @@
+"""PR-11 embedding engine: fused multi-table lookup, hot-row cache tiers,
+async prefetch, sharded/quantized exchanges.
+
+Parity bars mirror the seed's sparse contract: fused/cached paths are
+BITWISE against the per-slot baseline; mesh-sharded training matches to
+tight tolerance (the grad psum's n-way summation order is the only
+difference, same as the pre-engine path — the forward lookup VALUES stay
+bitwise even sharded)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability
+from paddle_tpu.embedding import EmbeddingEngine, Prefetcher, fuse_lookups
+from paddle_tpu.framework import unique_name
+from paddle_tpu.framework.scope import Scope
+from paddle_tpu.models.deepfm import DeepFMConfig, deepfm
+from paddle_tpu.parallel import (
+    ShardedWeightUpdate,
+    quantize_embedding_grads,
+    shard_program,
+    shard_sparse_tables,
+)
+from paddle_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        yield main, startup, scope
+
+
+CFG = DeepFMConfig(vocab_size=256, num_fields=6, embed_dim=8,
+                   mlp_sizes=(16,))
+B = 16
+
+
+def _feeds(n, vocab=None, b=B, fields=None, seed=0):
+    vocab = vocab or CFG.vocab_size
+    fields = fields or CFG.num_fields
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        idv = (vocab * rng.power(0.4, (b, fields))).astype(np.int64)
+        out.append({"feat_ids": idv,
+                    "label": (idv[:, :1] % 2 == 0).astype(np.float32)})
+    return out
+
+
+def _build_deepfm(per_slot=False, fused=False, hot_rows=None, shard=None,
+                  quant=None, opt="sgd", seed=3, cfg=CFG, b=B):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    scope = Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        ids = fluid.data("feat_ids", [b, cfg.num_fields], "int64")
+        label = fluid.data("label", [b, 1], "float32")
+        loss, pred = deepfm(ids, label, cfg, per_slot=per_slot)
+        if fused:
+            fuse_lookups(main)
+        engine = None
+        if hot_rows:
+            engine = EmbeddingEngine(main, startup, hot_rows=hot_rows)
+        optimizer = (fluid.optimizer.SGD(0.1) if opt == "sgd"
+                     else fluid.optimizer.Momentum(0.05, 0.9))
+        optimizer.minimize(loss)
+        if shard:
+            shard_sparse_tables(main, partition=shard)
+            if quant:
+                quantize_embedding_grads(main, quant)
+            shard_program(main, make_mesh({"ps": 8}))
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        if engine:
+            engine.attach(scope)
+    return main, startup, scope, exe, loss, pred, engine
+
+
+def _train(main, scope, exe, loss, feeds, engine=None):
+    losses = []
+    for f in feeds:
+        ff = engine.prepare_feed(f, scope) if engine else f
+        (lv,) = exe.run(main, feed=ff, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    return losses
+
+
+# ---------------------------------------------------------------------------
+# fused multi-table lookup
+# ---------------------------------------------------------------------------
+
+
+def test_fuse_lookups_coalesces_per_slot_graph():
+    main, *_ = _build_deepfm(per_slot=True, fused=True)[:1]
+    singles = [op for op in main.global_block.ops
+               if op.type == "distributed_lookup_table"]
+    fused = [op for op in main.global_block.ops
+             if op.type == "fused_lookup_table"]
+    assert not singles
+    # one fused site per table width: [V, 1] (w1) and [V, D] (emb)
+    assert len(fused) == 2
+    for op in fused:
+        # every slot reads the SHARED table: the W slot carries it ONCE
+        # and slot_table_idx maps all F slots onto its key segment (so
+        # the same id dedups ACROSS slots and the gather operand is one
+        # table, not F aliases of it)
+        assert len(op.inputs["W"]) == 1
+        assert op.attr("slot_table_idx") == [0] * CFG.num_fields
+        assert len(op.inputs["Ids"]) == CFG.num_fields
+        assert len(op.outputs["Out"]) == CFG.num_fields
+
+
+def test_fused_training_parity_across_layouts():
+    """Training losses agree across the three layouts. Two-table vs
+    per-slot vs fused accumulate a repeated id's row gradient in different
+    orders (one segment-sum vs F partial sums), so cross-LAYOUT parity is
+    tight-allclose; the first step (identical params, forward-only
+    difference) is bitwise."""
+    feeds = _feeds(5)
+    ref = _train(*_pick(_build_deepfm(per_slot=False)), feeds)
+    per_slot = _train(*_pick(_build_deepfm(per_slot=True)), feeds)
+    fused = _train(*_pick(_build_deepfm(per_slot=True, fused=True)), feeds)
+    assert ref[0] == per_slot[0] == fused[0]
+    np.testing.assert_allclose(ref, per_slot, rtol=1e-5)
+    np.testing.assert_allclose(per_slot, fused, rtol=1e-5)
+
+
+def _pick(built):
+    main, _startup, scope, exe, loss, _pred, _eng = built
+    return main, scope, exe, loss
+
+
+def test_fused_forward_values_bitwise():
+    """The fused gather returns exactly the rows the per-slot gathers
+    return, slot for slot."""
+    b, f, v, d = 8, 4, 64, 8
+    rng = np.random.RandomState(1)
+    idv = rng.randint(0, v, (b, f)).astype(np.int64)
+    outs = {}
+    for fused in (False, True):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        scope = Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), unique_name.guard():
+            ids = fluid.data("ids", [b, f], "int64")
+            parts = []
+            for i in range(f):
+                si = layers.slice(ids, [1], [i], [i + 1])
+                parts.append(layers.sparse_embedding(
+                    si, [v, d], param_attr=fluid.ParamAttr(name="tab"),
+                ))
+            if fused:
+                assert fuse_lookups(main) == 1
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            vals = exe.run(main, feed={"ids": idv},
+                           fetch_list=list(parts), scope=scope)
+            outs[fused] = [np.asarray(x) for x in vals]
+    for a, b_ in zip(outs[False], outs[True]):
+        np.testing.assert_array_equal(a, b_)
+
+
+def test_fuse_respects_intermediate_readers():
+    """A consumer between two lookups pins the first group: fusing past it
+    would feed the consumer an output produced later."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        ids = fluid.data("ids", [8, 1], "int64")
+        a = layers.sparse_embedding(
+            ids, [32, 4], param_attr=fluid.ParamAttr(name="t1"))
+        consumed = layers.scale(a, scale=2.0)  # reads a before lookup 2
+        b_ = layers.sparse_embedding(
+            ids, [32, 4], param_attr=fluid.ParamAttr(name="t2"))
+        _ = consumed + b_
+    assert fuse_lookups(main) == 0
+    assert all(op.type != "fused_lookup_table"
+               for op in main.global_block.ops)
+
+
+def test_fuse_groups_by_width_and_dtype():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        ids = fluid.data("ids", [8, 1], "int64")
+        outs = [
+            layers.sparse_embedding(
+                ids, [32, 4], param_attr=fluid.ParamAttr(name="a4")),
+            layers.sparse_embedding(
+                ids, [32, 8], param_attr=fluid.ParamAttr(name="a8")),
+            layers.sparse_embedding(
+                ids, [64, 4], param_attr=fluid.ParamAttr(name="b4")),
+            layers.sparse_embedding(
+                ids, [32, 8], param_attr=fluid.ParamAttr(name="b8")),
+        ]
+        _ = layers.concat([layers.reshape(o, [8, -1]) for o in outs],
+                          axis=1)
+    assert fuse_lookups(main) == 2  # width-4 pair + width-8 pair
+    fused = [op for op in main.global_block.ops
+             if op.type == "fused_lookup_table"]
+    widths = sorted(
+        main.global_block.var(op.inputs["W"][0]).shape[1] for op in fused
+    )
+    assert widths == [4, 8]
+
+
+# ---------------------------------------------------------------------------
+# single-table dedup (the satellite bugfix)
+# ---------------------------------------------------------------------------
+
+
+def test_dedup_golden_parity_vs_legacy_path():
+    """dedup=True (unique -> gather -> scatter-back) must be bitwise
+    identical to the legacy gather-per-occurrence path, forward and
+    training, on a batch dense with repeats."""
+    b, v, d = 32, 16, 4  # 32 ids over 16 rows: guaranteed repeats
+    rng = np.random.RandomState(0)
+    idv = rng.randint(0, v, b).astype(np.int64)
+    runs = {}
+    for dedup in (False, True):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 2
+        scope = Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), unique_name.guard():
+            ids = fluid.data("ids", [b], "int64")
+            out = layers.sparse_embedding(
+                ids, [v, d], param_attr=fluid.ParamAttr(name="table"),
+                dedup=dedup,
+            )
+            loss = layers.reduce_sum(layers.square(out))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            (fwd,) = exe.run(main, feed={"ids": idv}, fetch_list=[out],
+                             scope=scope)
+            losses = []
+            for _ in range(4):
+                (lv,) = exe.run(main, feed={"ids": idv},
+                                fetch_list=[loss], scope=scope)
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+            (g,) = exe.run(main, feed={"ids": idv},
+                           fetch_list=["table@GRAD"], scope=scope)
+        runs[dedup] = (np.asarray(fwd), losses, np.asarray(g))
+    np.testing.assert_array_equal(runs[False][0], runs[True][0])
+    # the backward segment-sum accumulates repeated rows in a different
+    # order than the legacy per-occurrence scatter — tight allclose, and
+    # the repeated-row grads must really have accumulated (not last-wins)
+    np.testing.assert_allclose(runs[False][2], runs[True][2], rtol=1e-5)
+    np.testing.assert_allclose(runs[False][1], runs[True][1], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sharded tables: row/col partition, quantized grad exchange, ZeRO compose
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_fused_lookup_values_bitwise():
+    feeds = _feeds(1)
+    ref = _build_deepfm(per_slot=True, fused=True)
+    sharded = _build_deepfm(per_slot=True, fused=True, shard="row")
+    for built in (ref, sharded):
+        main, _s, scope, exe, _l, pred, _e = built
+        (pv,) = exe.run(main, feed=feeds[0], fetch_list=[pred],
+                        scope=scope)
+        built_out = np.asarray(pv)
+        if built is ref:
+            ref_out = built_out
+    np.testing.assert_array_equal(ref_out, built_out)
+
+
+def test_sharded_vs_replicated_training_loss_parity_row():
+    feeds = _feeds(5)
+    ref = _train(*_pick(_build_deepfm(per_slot=True, fused=True)), feeds)
+    got = _train(
+        *_pick(_build_deepfm(per_slot=True, fused=True, shard="row")),
+        feeds,
+    )
+    np.testing.assert_allclose(ref, got, rtol=1e-5)
+
+
+def test_sharded_vs_replicated_training_loss_parity_col():
+    """Column partition ([V, D/n] Megatron split) needs every table width
+    divisible by the mesh — a fused embedding-only tower here (deepfm's
+    [V, 1] first-order table cannot column-shard over ps=8)."""
+    b, f, v, d = 8, 4, 64, 16
+    rng = np.random.RandomState(2)
+    idv = rng.randint(0, v, (b, f)).astype(np.int64)
+
+    def run(shard):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 4
+        scope = Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), unique_name.guard():
+            ids = fluid.data("ids", [b, f], "int64")
+            parts = [
+                layers.sparse_embedding(
+                    layers.slice(ids, [1], [i], [i + 1]), [v, d],
+                    param_attr=fluid.ParamAttr(name="tab"),
+                )
+                for i in range(f)
+            ]
+            assert fuse_lookups(main) == 1
+            stacked = layers.concat(
+                [layers.reshape(p, [b, 1, d]) for p in parts], axis=1
+            )
+            loss = layers.reduce_sum(layers.square(stacked))
+            fluid.optimizer.SGD(0.01).minimize(loss)
+            if shard:
+                shard_sparse_tables(main, partition="col")
+                shard_program(main, make_mesh({"ps": 8}))
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            out = []
+            for _ in range(4):
+                (lv,) = exe.run(main, feed={"ids": idv},
+                                fetch_list=[loss], scope=scope)
+                out.append(float(np.asarray(lv).reshape(-1)[0]))
+        return out
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-5)
+
+
+def test_quantized_grad_exchange_fp32_is_bitwise_noop():
+    """quant='none' must keep the exact pre-engine psum path."""
+    feeds = _feeds(4)
+    plain = _train(
+        *_pick(_build_deepfm(per_slot=True, fused=True, shard="row")),
+        feeds,
+    )
+    # explicit quant="none" stamp (exercises the stamping path)
+    built = _build_deepfm(per_slot=True, fused=True, shard="row")
+    quantize_embedding_grads(built[0], None)
+    noop = _train(*_pick(built), feeds)
+    assert plain == noop
+
+
+def test_quantized_grad_exchange_int8_trains_close():
+    feeds = _feeds(5)
+    plain = _train(
+        *_pick(_build_deepfm(per_slot=True, fused=True, shard="row")),
+        feeds,
+    )
+    q = _train(
+        *_pick(_build_deepfm(per_slot=True, fused=True, shard="row",
+                             quant="int8")),
+        feeds,
+    )
+    assert q != plain  # the int8 wire really engaged
+    np.testing.assert_allclose(plain, q, rtol=0.05, atol=0.02)
+
+
+def test_quant_refuses_col_partition_and_unknown_strings():
+    built = _build_deepfm(per_slot=True, fused=True, shard="col")
+    with pytest.raises(NotImplementedError):
+        quantize_embedding_grads(built[0], "int8")
+    with pytest.raises(ValueError):
+        quantize_embedding_grads(built[0], "int4")
+    # order-independent: quant stamped FIRST, col partition second must
+    # refuse too (it would silently drop the opted-in compression)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        ids = fluid.data("ids", [8], "int64")
+        _ = layers.sparse_embedding(
+            ids, [32, 8], param_attr=fluid.ParamAttr(name="t"))
+        quantize_embedding_grads(main, "int8")
+        with pytest.raises(NotImplementedError):
+            shard_sparse_tables(main, partition="col")
+
+
+def test_zero_sharded_dense_composes_with_sharded_sparse_tables():
+    """ONE training program: dense params under the ZeRO dp weight-update
+    shard, sparse tables row-sharded over ps — trains on a dp=2 x ps=4
+    mesh with loss parity vs the replicated build."""
+    cfg = DeepFMConfig(vocab_size=128, num_fields=4, embed_dim=8,
+                       mlp_sizes=(16,))
+    feeds = _feeds(4, vocab=cfg.vocab_size, fields=cfg.num_fields, b=8)
+
+    def build(compose):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        scope = Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), unique_name.guard():
+            ids = fluid.data("feat_ids", [8, cfg.num_fields], "int64")
+            label = fluid.data("label", [8, 1], "float32")
+            loss, _p = deepfm(ids, label, cfg, per_slot=True)
+            fuse_lookups(main)
+            opt = fluid.optimizer.Momentum(0.05, 0.9)
+            pgs = opt.minimize(loss)
+            if compose:
+                params_grads = pgs[1] if isinstance(pgs, tuple) else pgs
+                ShardedWeightUpdate(2, axis_name="dp").transpile(
+                    main, startup, params_grads
+                )
+                shard_sparse_tables(main, axis="ps")
+                shard_program(main, make_mesh({"dp": 2, "ps": 4}))
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            return _train(main, scope, exe, loss, feeds)
+
+    ref = build(False)
+    got = build(True)
+    np.testing.assert_allclose(ref, got, rtol=1e-4)
+
+
+def test_zero_transpile_skips_sparse_tables():
+    """The ZeRO pass must leave ps-sharded tables (and their state) out of
+    the flat dp shard — no @ZERO_SHARD twin for a lookup table."""
+    cfg = DeepFMConfig(vocab_size=128, num_fields=4, embed_dim=8,
+                       mlp_sizes=(16,))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        ids = fluid.data("feat_ids", [8, cfg.num_fields], "int64")
+        label = fluid.data("label", [8, 1], "float32")
+        loss, _p = deepfm(ids, label, cfg, per_slot=True)
+        fuse_lookups(main)
+        pgs = fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+        params_grads = pgs[1] if isinstance(pgs, tuple) else pgs
+        ShardedWeightUpdate(2, axis_name="dp").transpile(
+            main, startup, params_grads
+        )
+    shards = [n for n in main.global_block.vars if "@ZERO_SHARD" in n]
+    assert shards, "dense params should have been ZeRO-sharded"
+    assert not any(n.startswith(("deepfm_w1", "deepfm_emb"))
+                   for n in shards), shards
+    # the dense MLP weights DID shard
+    assert any("deepfm_mlp" in n or "deepfm_out" in n for n in shards)
+
+
+# ---------------------------------------------------------------------------
+# cache tier: capacity, eviction/refetch, checkpoint
+# ---------------------------------------------------------------------------
+
+
+def test_cached_training_bitwise_vs_full_table():
+    """hot tier = vocab/2: misses, evictions and write-backs all fire, and
+    the run stays BITWISE equal to the full-table run seeded with the same
+    host-store init (SGD: absent rows are exact no-ops)."""
+    feeds = _feeds(8)
+    main, _s, scope, exe, loss, _p, engine = _build_deepfm(
+        per_slot=True, fused=True, hot_rows=CFG.vocab_size // 2
+    )
+    host_init = {
+        t: g.host[t].copy() for g in engine.groups for t in g.table_names
+    }
+    cached = _train(main, scope, exe, loss, feeds, engine)
+    snap = observability.snapshot()["counters"]
+    assert snap.get("embedding.cache_evictions", 0) > 0
+    assert snap.get("embedding.cache_writebacks", 0) > 0
+
+    fmain, _fs, fscope, fexe, floss, _fp, _fe = _build_deepfm(
+        per_slot=True, fused=True
+    )
+    for name, arr in host_init.items():
+        fscope.set_var(name, jnp.asarray(arr))
+    full = _train(fmain, fscope, fexe, floss, feeds)
+    assert cached == full
+
+
+def test_cache_capacity_exceeds_device_tier():
+    main, _s, scope, exe, loss, _p, engine = _build_deepfm(
+        per_slot=True, fused=True, hot_rows=CFG.vocab_size // 4
+    )
+    g = engine.groups[0]
+    assert g.hot_rows * 4 == CFG.vocab_size
+    # the device-resident table really is hot-tier sized
+    table = scope.find_var("deepfm_emb")
+    assert table.shape[0] == g.hot_rows
+    assert g.host["deepfm_emb"].shape[0] == CFG.vocab_size
+    assert g.host_bytes() > g.device_bytes()
+    gauges = observability.get_gauges()
+    assert gauges[f"embedding.vocab_rows.{g.name}"] == CFG.vocab_size
+    assert gauges[f"embedding.hot_rows.{g.name}"] == g.hot_rows
+
+
+def test_cache_hit_rate_and_histograms_recorded():
+    feeds = _feeds(6)
+    main, _s, scope, exe, loss, _p, engine = _build_deepfm(
+        per_slot=True, fused=True, hot_rows=CFG.vocab_size // 2
+    )
+    _train(main, scope, exe, loss, feeds, engine)
+    gauges = observability.get_gauges()
+    hists = observability.get_histograms()
+    name = engine.groups[0].name
+    assert 0.0 < gauges[f"embedding.hot_hit_rate.{name}"] <= 1.0
+    assert hists["embedding.unique_ids_per_batch"]["count"] == len(feeds)
+    assert hists["embedding.dedup_ratio"]["count"] == len(feeds)
+    assert hists["embedding.dedup_ratio"]["max"] < 1.0  # dedup active
+    assert hists["embedding.host_fetch_latency"]["count"] > 0
+
+
+def test_cache_refuses_batch_larger_than_hot_tier():
+    main, _s, scope, exe, loss, _p, engine = _build_deepfm(
+        per_slot=True, fused=True, hot_rows=8
+    )
+    from paddle_tpu.errors import PreconditionNotMetError
+
+    with pytest.raises(PreconditionNotMetError):
+        engine.prepare_feed(_feeds(1)[0], scope)
+
+
+def test_engine_requires_feed_level_ids():
+    """Ids computed in-graph (not derivable from a feed) must refuse at
+    engine construction, naming the table."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [8, 1], "float32")
+        ids = layers.cast(layers.scale(x, scale=100.0), "int64")
+        _ = layers.sparse_embedding(
+            ids, [32, 4], param_attr=fluid.ParamAttr(name="t"))
+    from paddle_tpu.errors import InvalidArgumentError
+
+    with pytest.raises(InvalidArgumentError, match="computed in-graph"):
+        EmbeddingEngine(main, startup, hot_rows=16)
+
+
+def test_cached_checkpoint_resume_bitwise(tmp_path):
+    """state_dict + persistables round trip: a rebuilt engine resumes the
+    training stream bitwise (Momentum: residency itself is state)."""
+    feeds = _feeds(6)
+
+    def build():
+        return _build_deepfm(per_slot=True, fused=True,
+                             hot_rows=CFG.vocab_size // 2, opt="momentum")
+
+    main, _s, scope, exe, loss, _p, engine = build()
+    control = _train(main, scope, exe, loss, feeds, engine)
+
+    main, _s, scope, exe, loss, _p, engine = build()
+    got = _train(main, scope, exe, loss, feeds[:3], engine)
+    from paddle_tpu.framework.scope import scope_guard
+
+    ckpt = str(tmp_path / "ck")
+    engine.flush(scope)
+    with scope_guard(scope):
+        fluid.io.save_persistables(exe, ckpt, main_program=main)
+    np.savez(str(tmp_path / "estate.npz"), **engine.state_dict(scope))
+    rng_state = main.rng_state()
+
+    main, _s, scope, exe, loss, _p, engine = build()
+    with scope_guard(scope):
+        fluid.io.load_persistables(exe, ckpt, main_program=main)
+    engine.load_state_dict(
+        dict(np.load(str(tmp_path / "estate.npz"))), scope
+    )
+    main.set_rng_state(rng_state)
+    got += _train(main, scope, exe, loss, feeds[3:], engine)
+    assert got == control
+
+
+# ---------------------------------------------------------------------------
+# async prefetch
+# ---------------------------------------------------------------------------
+
+
+def test_prefetcher_bitwise_and_overlap_recorded():
+    feeds = _feeds(8)
+    main, _s, scope, exe, loss, _p, engine = _build_deepfm(
+        per_slot=True, fused=True, hot_rows=CFG.vocab_size // 2
+    )
+    sync = _train(main, scope, exe, loss, feeds, engine)
+
+    main, _s, scope, exe, loss, _p, engine = _build_deepfm(
+        per_slot=True, fused=True, hot_rows=CFG.vocab_size // 2
+    )
+    pre = []
+    for f in Prefetcher(engine, feeds, scope):
+        (lv,) = exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+        pre.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert pre == sync
+    hists = observability.get_histograms()
+    assert hists["embedding.prefetch_overlap"]["count"] == len(feeds)
+    counters = observability.get_counters()
+    assert counters["embedding.prefetch_batches"] >= len(feeds)
+
+
+def test_prefetcher_propagates_worker_errors():
+    main, _s, scope, exe, loss, _p, engine = _build_deepfm(
+        per_slot=True, fused=True, hot_rows=CFG.vocab_size // 2
+    )
+    bad = [{"feat_ids": np.full((B, CFG.num_fields), 10 ** 6, np.int64),
+            "label": np.zeros((B, 1), np.float32)}]
+    from paddle_tpu.errors import InvalidArgumentError
+
+    with pytest.raises(InvalidArgumentError, match="outside"):
+        for _ in Prefetcher(engine, bad, scope):
+            pass
+
+
+def test_multi_feed_group_translates_each_feed_once():
+    """A table keyed by TWO feeds (ids concatenated in-graph) forms one
+    multi-feed group: one plan covers both feeds and each is translated
+    exactly once (the regression was one plan PER feed, whose first apply
+    pass translated the other feed before its rows were resident)."""
+    b, v, d = 8, 64, 4
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    scope = Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        user = fluid.data("user_ids", [b, 1], "int64")
+        item = fluid.data("item_ids", [b, 1], "int64")
+        both = layers.concat([user, item], axis=0)  # [2B, 1]
+        out = layers.sparse_embedding(
+            both, [v, d], param_attr=fluid.ParamAttr(name="t"))
+        loss = layers.reduce_sum(layers.square(out))
+        engine = EmbeddingEngine(main, startup, hot_rows=32)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        engine.attach(scope)
+        assert sorted(engine.groups[0].feeds) == ["item_ids", "user_ids"]
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            feed = {
+                "user_ids": rng.randint(0, v, (b, 1)).astype(np.int64),
+                "item_ids": rng.randint(0, v, (b, 1)).astype(np.int64),
+            }
+            ff = engine.prepare_feed(feed, scope)
+            # translated slot ids are in hot-tier range, originals untouched
+            assert ff["user_ids"].max() < 32 and ff["item_ids"].max() < 32
+            (lv,) = exe.run(main, feed=ff, fetch_list=[loss], scope=scope)
+            assert np.isfinite(np.asarray(lv)).all()
+
+
+def test_prefetcher_close_stops_feed_consumption():
+    """close() after an early exit must halt the worker — it must NOT keep
+    draining the feed source behind the caller's back."""
+    import time as _time
+
+    main, _s, scope, exe, loss, _p, engine = _build_deepfm(
+        per_slot=True, fused=True, hot_rows=CFG.vocab_size // 2
+    )
+    consumed = []
+
+    def src():
+        for f in _feeds(100):
+            consumed.append(1)
+            yield f
+
+    pf = Prefetcher(engine, src(), scope, depth=1)
+    next(pf)
+    pf.close()
+    n = len(consumed)
+    _time.sleep(0.3)
+    assert len(consumed) <= n + 1, "worker kept consuming after close()"
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_pipelines_a_dataloader():
+    """Composition: DataLoader workers parse, the prefetcher stages rows."""
+    from paddle_tpu.dataloader.dataset import Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            idv = (CFG.vocab_size * rng.power(0.4, CFG.num_fields))
+            return idv.astype(np.int64), np.float32([i % 2])
+
+        def __len__(self):
+            return 4 * B
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    scope = Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard():
+        ids = fluid.data("feat_ids", [-1, CFG.num_fields], "int64")
+        label = fluid.data("label", [-1, 1], "float32")
+        loss, _p = deepfm(ids, label, CFG, per_slot=True)
+        fuse_lookups(main)
+        engine = EmbeddingEngine(main, startup,
+                                 hot_rows=CFG.vocab_size // 2)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        engine.attach(scope)
+        loader = fluid.DataLoader(
+            DS(), feed_list=[ids, label], batch_size=B,
+            use_buffer_reader=False,
+        )
+        n = 0
+        for f in Prefetcher(engine, loader, scope):
+            (lv,) = exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+            assert np.isfinite(np.asarray(lv)).all()
+            n += 1
+        assert n == 4

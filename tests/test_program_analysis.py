@@ -453,6 +453,109 @@ def test_sharded_weight_update_program_is_error_clean(fresh):
 
 
 # ---------------------------------------------------------------------------
+# embedding-engine lookup kinds (PR 11): the fused/partitioned/quantized
+# lookups are collective-bearing sites — one broken fixture per new kind
+# ---------------------------------------------------------------------------
+
+
+def _poison_pipeline_with_lookup(op_type, attrs):
+    """A 2-stage pipeline whose stage-0 block gains one lookup site over
+    the bound "ps" axis that the other stage never issues."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [8, 4])
+        with fluid.device_guard("pipeline:0"):
+            h = layers.fc(x, 4)
+        with fluid.device_guard("pipeline:1"):
+            loss = layers.mean(layers.fc(h, 4))
+        main._pipeline = {"num_microbatches": 2, "axis_name": "pp"}
+        _, pipe_op = slice_program_into_stages(main, loss)
+    stage = main.blocks[pipe_op.attr("stage_blocks")[0]]
+    stage.create_var(name="lk_ids", shape=[8], dtype="int64")
+    stage.create_var(name="lk_w", shape=[32, 4], dtype="float32")
+    stage.create_var(name="lk_out", shape=[8, 4], dtype="float32")
+    stage.append_op(
+        op_type, {"Ids": ["lk_ids"], "W": ["lk_w"]}, {"Out": ["lk_out"]},
+        attrs,
+    )
+    shard_program(main, make_mesh({"ps": 4, "pp": 2}), {"x": ("ps",)})
+    return main, loss
+
+
+@pytest.mark.parametrize("op_type,attrs,kind", [
+    ("fused_lookup_table", {"axis_name": "ps"}, "fused_lookup_table"),
+    ("fused_lookup_table",
+     {"axis_name": "ps", "quant": "int8", "quant_block": 256},
+     "fused_lookup_table:int8"),
+    ("fused_lookup_table", {"axis_name": "ps", "partition": "col"},
+     "fused_lookup_table:col"),
+    ("distributed_lookup_table",
+     {"axis_name": "ps", "quant": "int8", "quant_block": 256},
+     "distributed_lookup_table:int8"),
+    ("distributed_lookup_table", {"axis_name": "ps", "partition": "col"},
+     "distributed_lookup_table:col"),
+])
+def test_divergent_lookup_site_detected(fresh, op_type, attrs, kind):
+    main, loss = _poison_pipeline_with_lookup(op_type, attrs)
+    rep = verify_program(main, ("x",), (loss.name,),
+                         families=("collectives",))
+    findings = rep.by_category(COLLECTIVE_DIVERGENCE)
+    assert findings, f"{kind}: stage-divergent lookup site not flagged"
+    f = findings[0]
+    assert f.severity == Severity.ERROR
+    assert f.op_type == op_type
+    assert kind in f.message
+
+
+def test_lookup_quant_wire_format_is_part_of_the_site_kind(fresh):
+    """An int8 grad-exchange lookup on one cond branch against an fp32 one
+    on the other is a different collective sequence — the branch lint must
+    see two DIFFERENT kinds (exactly the zero_reduce_scatter contract)."""
+    main, _, _ = fresh
+    blk = main.global_block
+    fluid.data("ids", [8], "int64")
+    cond_v = fluid.data("c", [1], "bool")
+    blk.create_var(name="w", shape=[32, 4], dtype="float32",
+                   persistable=True)
+    branches = []
+    for quant in ("none", "int8"):
+        b = main.create_block()
+        main.rollback()
+        b.create_var(name=f"lk_{quant}", shape=[8, 4], dtype="float32")
+        b.append_op(
+            "fused_lookup_table", {"Ids": ["ids"], "W": ["w"]},
+            {"Out": [f"lk_{quant}"]},
+            {"axis_name": "ps", "quant": quant, "quant_block": 256},
+        )
+        branches.append(b)
+    blk.create_var(name="out", shape=[8, 4], dtype="float32")
+    blk.append_op(
+        "cond",
+        {"Cond": [cond_v.name], "TrueIn": ["ids"], "FalseIn": ["ids"]},
+        {"Out": ["out"]},
+        {"true_block": branches[0].idx, "false_block": branches[1].idx,
+         "true_out_names": ["ids"], "false_out_names": ["ids"]},
+    )
+    shard_program(main, make_mesh({"ps": 8}))
+    rep = verify_program(main, ("ids", "c"), ("out",),
+                         families=("collectives",))
+    (f,) = rep.by_category(COLLECTIVE_BRANCH_DIVERGENCE)
+    assert "fused_lookup_table:int8" in f.message
+    assert "fused_lookup_table@ps" in f.message
+
+
+def test_fused_deepfm_zoo_model_is_error_clean(fresh):
+    """The real fused + ps-sharded DeepFM (zoo: deepfm_fused) must come out
+    of the FULL verifier with zero ERROR findings."""
+    from paddle_tpu.models.zoo import build_model
+
+    bm = build_model("deepfm_fused")
+    rep = verify_program(bm.main, bm.feed_names, bm.fetch_names)
+    errors = [f for f in rep.findings if f.severity == Severity.ERROR]
+    assert not errors, [f.format() for f in errors]
+
+
+# ---------------------------------------------------------------------------
 # executor wiring: strict rejects, warn warns, off is silent
 # ---------------------------------------------------------------------------
 

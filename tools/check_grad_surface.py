@@ -73,6 +73,9 @@ DEDICATED = {
     "through arrays)",
     "lookup_sparse_table": "tests/test_sparse.py (sharded-table DeepFM "
     "training; gradient-scale correction test)",
+    "fused_lookup_table": "tests/test_embedding_engine.py (fused == "
+    "per-slot training parity; dedup segment-sum golden; sharded/"
+    "quantized grad-exchange parity)",
 }
 
 # differentiable-flagged but not numerically swept: reason recorded, the

@@ -201,6 +201,142 @@ def assert_sharded_state_audited(out_dir, nranks=2):
         )
 
 
+def audit_embedding(work_dir, sharded=False):
+    """PR-11 leg: a checkpoint carrying CACHED (host-cold/device-hot) or
+    ps-SHARDED embedding tables must resume bitwise. In-process: train the
+    fused DeepFM 4 steps, checkpoint (persistables + engine host state +
+    RNG), rebuild everything from scratch, restore, train 4 more — the
+    continuation's losses and final flushed table state must be bitwise
+    identical to an uninterrupted 8-step run."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.embedding import EmbeddingEngine, fuse_lookups
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.models.deepfm import DeepFMConfig, deepfm
+
+    cfg = DeepFMConfig(vocab_size=256, num_fields=4, embed_dim=8,
+                       mlp_sizes=(16,))
+    b, total_steps, ckpt_step = 16, 8, 4
+    rng = np.random.RandomState(5)
+    feeds = []
+    for _ in range(total_steps):
+        idv = (cfg.vocab_size * rng.power(0.4, (b, cfg.num_fields)))
+        idv = idv.astype(np.int64)
+        feeds.append({"feat_ids": idv,
+                      "label": (idv[:, :1] % 2 == 0).astype(np.float32)})
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        scope = Scope()
+        with fluid.program_guard(main, startup), unique_name.guard():
+            ids = fluid.data("feat_ids", [b, cfg.num_fields], "int64")
+            label = fluid.data("label", [b, 1], "float32")
+            loss, _p = deepfm(ids, label, cfg, per_slot=True)
+            fuse_lookups(main)
+            engine = None
+            if not sharded:
+                engine = EmbeddingEngine(main, startup,
+                                         hot_rows=cfg.vocab_size // 2)
+            # Momentum: the checkpoint must carry hot-tier/sharded
+            # accumulator state, not just the tables
+            fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+            if sharded:
+                from paddle_tpu.parallel import (
+                    make_mesh,
+                    shard_program,
+                    shard_sparse_tables,
+                )
+
+                shard_sparse_tables(main)
+                shard_program(main, make_mesh({"ps": 8}))
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        if engine:
+            engine.attach(scope)
+        return main, startup, scope, exe, loss, engine
+
+    def step(main, scope, exe, loss, engine, feed):
+        f = engine.prepare_feed(feed, scope) if engine else feed
+        (lv,) = exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+        return float(np.asarray(lv).reshape(-1)[0])
+
+    def final_state(main, scope, engine):
+        out = {}
+        if engine:
+            for k, v in engine.state_dict(scope).items():
+                out[k] = np.asarray(v)
+        for v in main.list_vars():
+            if v.persistable and scope.find_var(v.name) is not None:
+                out[v.name] = np.asarray(scope.find_var(v.name))
+        return out
+
+    # control: uninterrupted
+    main, startup, scope, exe, loss, engine = build()
+    control_losses = [
+        step(main, scope, exe, loss, engine, f) for f in feeds
+    ]
+    control_state = final_state(main, scope, engine)
+
+    # resume timeline: train to the checkpoint, persist, REBUILD, restore
+    main, startup, scope, exe, loss, engine = build()
+    losses = [
+        step(main, scope, exe, loss, engine, f)
+        for f in feeds[:ckpt_step]
+    ]
+    ckpt = os.path.join(
+        work_dir, f"embed_ckpt_{'sharded' if sharded else 'cached'}"
+    )
+    if engine:
+        engine.flush(scope)
+    from paddle_tpu.framework.scope import scope_guard
+
+    with scope_guard(scope):
+        fluid.io.save_persistables(exe, ckpt, main_program=main)
+    if engine:
+        np.savez(os.path.join(ckpt, "embedding_state.npz"),
+                 **engine.state_dict(scope))
+    rng_state = main.rng_state()
+
+    main, startup, scope, exe, loss, engine = build()
+    with scope_guard(scope):
+        fluid.io.load_persistables(exe, ckpt, main_program=main)
+    if engine:
+        state = dict(np.load(os.path.join(ckpt, "embedding_state.npz")))
+        engine.load_state_dict(state, scope)
+        # the freshly-installed device tier is stale placeholder data;
+        # residency restarts empty so first-touch refills from host
+    main.set_rng_state(rng_state)
+    losses += [
+        step(main, scope, exe, loss, engine, f)
+        for f in feeds[ckpt_step:]
+    ]
+    resumed_state = final_state(main, scope, engine)
+
+    label = "sharded" if sharded else "cached"
+    assert losses == control_losses, (
+        f"embedding {label} resume: losses diverge\n control: "
+        f"{control_losses}\n resumed: {losses}"
+    )
+    assert sorted(control_state) == sorted(resumed_state), (
+        sorted(control_state), sorted(resumed_state))
+    for name in control_state:
+        a, barr = control_state[name], resumed_state[name]
+        assert a.tobytes() == barr.tobytes(), (
+            f"embedding {label} resume: var {name!r} differs bitwise"
+        )
+    if sharded:
+        print(f"embedding resume OK ({label}): 8-step continuation bitwise "
+              "with ps=8 row-sharded tables + Momentum velocity in the "
+              "checkpoint")
+    else:
+        print(f"embedding resume OK ({label}): 8-step continuation bitwise "
+              "with hot-tier cache (hot=vocab/2), host cold store + "
+              "velocity tiers round-tripped")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser("resume_audit")
     ap.add_argument("--out", default=None,
@@ -211,10 +347,28 @@ def main(argv=None):
                     help="train with the ZeRO sharded weight update "
                          "(Momentum over a dp=2 virtual mesh) so the "
                          "audit covers dp-sharded optimizer state")
+    ap.add_argument("--embedding", action="store_true",
+                    help="audit checkpoints carrying the PR-11 embedding "
+                         "engine state: hot-tier cached tables (host cold "
+                         "store + velocity tiers) and ps-sharded tables "
+                         "must both resume bitwise")
     args = ap.parse_args(argv)
     work = args.out or tempfile.mkdtemp(prefix="paddle_tpu_resume_audit_")
     os.makedirs(work, exist_ok=True)
     sys.path.insert(0, REPO)
+    if args.embedding:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+        )
+        try:
+            print("== resume audit: embedding engine (cached tables) ==")
+            audit_embedding(work, sharded=False)
+            print("== resume audit: embedding engine (ps-sharded tables) ==")
+            audit_embedding(work, sharded=True)
+            return 0
+        finally:
+            if not args.keep and args.out is None:
+                shutil.rmtree(work, ignore_errors=True)
     label = "sharded " if args.sharded else ""
     ports = (6470, 6490) if args.sharded else (6370, 6390)
     try:
