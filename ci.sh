@@ -71,6 +71,69 @@ rm -rf "$EMBED_DIR"
 # tables must resume bitwise (Momentum state tiers included)
 python tools/resume_audit.py --embedding
 
+echo "== async checkpoint bench: save stall off the step loop =="
+# sync-vs-async save-step jitter (gate >= 10x reduction: the step loop
+# pays only the device->host snapshot) and delta shards on the
+# embedding-cached model (gate: repeat-save dir <= 60% of the full save,
+# row deltas keyed off the cache's write-back ticks, compressed, chain
+# reload bitwise); the snapshot must carry the checkpoint.* telemetry
+ACK_DIR=$(mktemp -d)
+python tools/bench_async_checkpoint.py --smoke \
+    --dump "$ACK_DIR/async_ck_stats.json"
+python tools/stats_report.py "$ACK_DIR/async_ck_stats.json" \
+    --require checkpoint. \
+    --require checkpoint.snapshot_latency \
+    --require checkpoint.publish_latency \
+    --require checkpoint.save_bandwidth --require checkpoint.pending \
+    --require checkpoint.delta_saves
+rm -rf "$ACK_DIR"
+
+echo "== async checkpoint chaos: injected snapshot + publish faults heal =="
+# one fault on each new seam: the snapshot retries on the step loop, the
+# publish retries on the publisher thread — the save must still commit a
+# loadable checkpoint and the retry counters must show the healing
+PADDLE_TPU_FAULT_INJECT="checkpoint.snapshot:io:1.0:0:1,checkpoint.publish:io:1.0:0:1" \
+python - <<'EOF'
+import shutil
+
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability
+from paddle_tpu.fleet import collective as fc
+from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+
+shutil.rmtree("/tmp/paddle_tpu_async_chaos_ckpt", ignore_errors=True)
+x = fluid.data("x", [-1, 4])
+y = fluid.data("y", [-1, 1])
+pred = layers.fc(x, 1)
+loss = layers.mean(layers.square_error_cost(pred, y))
+fluid.optimizer.SGD(0.05).minimize(loss)
+exe = fluid.Executor()
+exe.run(fluid.default_startup_program())
+fleet = fc.Fleet()
+fleet.init(UserDefinedRoleMaker())
+rng = np.random.RandomState(0)
+with fc.AsyncCheckpointer(fleet, "/tmp/paddle_tpu_async_chaos_ckpt",
+                          executor=exe, delta=True, full_every=2) as saver:
+    for i in range(3):
+        xa = rng.randn(8, 4).astype(np.float32)
+        exe.run(feed={"x": xa, "y": xa @ np.ones((4, 1), np.float32)},
+                fetch_list=[loss])
+        saver.save(fc.TrainStatus(i, global_step=i + 1)).result(timeout=60)
+status = fleet.load_check_point(exe, "/tmp/paddle_tpu_async_chaos_ckpt")
+assert status.global_step == 3, status
+c = observability.snapshot()["counters"]
+assert c.get("resilience.faults_injected.checkpoint.snapshot", 0) == 1, c
+assert c.get("resilience.faults_injected.checkpoint.publish", 0) == 1, c
+assert c.get("resilience.retries.checkpoint.snapshot", 0) >= 1, c
+assert c.get("resilience.retries.checkpoint.save", 0) >= 1, c
+assert c.get("checkpoint.publish_failures", 0) == 0, c
+print(f"async checkpoint chaos OK: snapshot+publish faults healed "
+      f"({c['resilience.retries']} retries), "
+      f"{c.get('checkpoint.delta_saves', 0)} delta links committed, "
+      "resume lands on step 3")
+EOF
+
 echo "== serving smoke (load gen + chaos ingest + drain) =="
 # short load-gen run over all three traffic mixes with a fault injected
 # on the request-ingestion seam (dataloader.fetch-style): the router's
@@ -426,6 +489,19 @@ python tools/resume_audit.py
 # ...and again with dp-sharded optimizer state (Momentum velocity shards
 # under the ZeRO weight-update transpile): kill/resume must stay bitwise
 python tools/resume_audit.py --sharded
+
+echo "== async-checkpoint chaos stage: SIGKILL mid-async-publish =="
+# checkpoints through the async snapshot/publish pipeline (delta chains
+# included); rank 1 wedges its in-flight publish (hang on the
+# checkpoint.publish seam) and SIGKILLs itself — the elastic resume must
+# come bitwise from the newest COMMITTED checkpoint, with the wedged
+# publish leaving only ignorable tmp debris
+python tools/resume_audit.py --async
+# ...composed with dp-sharded optimizer state (per-rank shard tiers)
+python tools/resume_audit.py --async --sharded
+# ...and with the embedding engine (host stores as the aux payload,
+# row deltas keyed off write-back ticks, compressed chain reload)
+python tools/resume_audit.py --async --embedding
 
 echo "== driver entry points =="
 python __graft_entry__.py

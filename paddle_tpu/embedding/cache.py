@@ -337,6 +337,21 @@ class CachedGroup:
             rows = self.row_of[resident_slots]
             self._writeback(scope, rows, resident_slots)
 
+    # -- tiered-checkpoint delta hooks ---------------------------------------
+    def delta_tick(self):
+        """Current write-back clock value — the mark a delta checkpoint
+        records so the next save can name exactly the host rows that
+        changed since (host stores mutate ONLY through write-back, so
+        rows at or below a recorded tick are bitwise unchanged)."""
+        with self._lock:
+            return int(self._tick)
+
+    def dirty_rows_since(self, tick):
+        """Global row indices written back after `tick` — the row-level
+        delta payload for every host store of this group."""
+        with self._lock:
+            return np.nonzero(self._wb_tick > int(tick))[0]
+
 
 def zlib_crc(s: str) -> int:
     import zlib

@@ -401,6 +401,34 @@ class EmbeddingEngine:
                     out[f"{g.name}::host::{aname}"] = g.host[aname].copy()
         return out
 
+    def delta_row_oracles(self):
+        """Row oracles for tiered checkpointing, keyed by the
+        :meth:`state_dict` host-store names: ``oracle(last_mark) ->
+        (dirty_rows, new_mark)`` backed by each group's write-back tick
+        — a delta save then carries only the host rows written back
+        since the last published save instead of the full ``[V, ...]``
+        stores (``fleet.AsyncCheckpointer(row_oracles=...)``). With
+        ``last_mark=None`` (no published base yet) rows is None, which
+        tells the checkpointer to store the array in full."""
+
+        def _make(group):
+            def oracle(last_mark):
+                mark = group.delta_tick()
+                if last_mark is None:
+                    return None, mark
+                return group.dirty_rows_since(last_mark), mark
+
+            return oracle
+
+        out = {}
+        for g in self.groups:
+            oracle = _make(g)
+            for t in g.table_names:
+                out[f"{g.name}::host::{t}"] = oracle
+                for aname, _fill in g.accums.get(t, ()):
+                    out[f"{g.name}::host::{aname}"] = oracle
+        return out
+
     def load_state_dict(self, state, scope):
         """Restore :meth:`state_dict` output. The hot-tier DEVICE arrays
         are ordinary persistables restored by the checkpoint load

@@ -5,9 +5,12 @@ Role makers mirror the reference's env-driven discovery (role_maker.py).
 """
 
 from .collective import (  # noqa: F401
+    AsyncCheckpointer,
+    CheckpointSnapshot,
     CollectiveOptimizer,
     DistributedStrategy,
     Fleet,
+    PendingSave,
     TrainStatus,
     fleet,
 )

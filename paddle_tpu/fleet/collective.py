@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+import time
 
 import numpy as np
 
@@ -63,6 +65,76 @@ _CHECKPOINT_PREFIX = "__paddle_checkpoint__"
 _TRAIN_STATUS_FILE = "train_status.json"
 _COMMIT_FILE = "commit.json"
 _RANK_PREFIX = "rank_"
+#: marker of a tiered (delta) checkpoint dir: {"base_checkpoint_no": M,
+#: "chain_len": K} — the payload holds only arrays/rows changed since M;
+#: load walks the base chain back to the nearest full save. Absent = full.
+_DELTA_FILE = "delta.json"
+#: auxiliary (non-scope) checkpoint payload — e.g. the embedding engine's
+#: flushed host cold stores — published atomically alongside the
+#: replicated payload with its own CRC manifest; load_check_point(...,
+#: load_aux=True) returns it on ``status.aux``.
+_AUX_FILE = "__aux__.npz"
+_AUX_MANIFEST = "aux_manifest.json"
+
+#: bytes/second buckets for the checkpoint.save_bandwidth histogram
+#: (1 MB/s .. 10 GB/s)
+_BANDWIDTH_BUCKETS = (
+    1e6, 2.5e6, 5e6, 1e7, 2.5e7, 5e7, 1e8, 2.5e8, 5e8, 1e9, 2.5e9, 5e9,
+    1e10,
+)
+
+
+def _as_touch(heartbeat):
+    """Normalize a liveness argument — a health.Heartbeat, any zero-arg
+    callable, or None — into a touch callback for LivenessPulse."""
+    if heartbeat is None:
+        return None
+    if callable(heartbeat) and not hasattr(heartbeat, "touch"):
+        return heartbeat
+    return heartbeat.touch
+
+
+def _dir_bytes(path):
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+class CheckpointSnapshot:
+    """Immutable host staging of one checkpoint — the output of the async
+    snapshot stage and the input of the publish stage. `arrays` is the
+    replicated persistable payload, `local_arrays` this rank's
+    ``local_vars`` shard payload (dim-0 slice keys included for
+    cross-process-sharded values), `aux` the optional auxiliary payload,
+    `status` a frozen TrainStatus copy."""
+
+    __slots__ = ("arrays", "local_arrays", "aux", "status")
+
+    def __init__(self, arrays, local_arrays=None, aux=None, status=None):
+        self.arrays = dict(arrays)
+        self.local_arrays = dict(local_arrays or {})
+        self.aux = dict(aux) if aux else None
+        self.status = status
+
+    def nbytes(self):
+        import numpy as np
+
+        return int(sum(
+            np.asarray(a).nbytes
+            for payload in (self.arrays, self.local_arrays, self.aux or {})
+            for a in payload.values()
+        ))
+
+    def _replace_payloads(self, arrays, aux):
+        """A view of this snapshot with delta-filtered replicated/aux
+        payloads (the publish stage's working copy)."""
+        return CheckpointSnapshot(arrays, self.local_arrays, aux,
+                                  self.status)
 
 #: Schema version written into train_status.json / commit.json. v1 was the
 #: bare ``{"epoch_no": N}`` payload; v2 adds global step, per-program RNG
@@ -262,18 +334,106 @@ class Fleet:
                 f"undecodable commit record in {ckpt!r}: {e}"
             ) from e
 
+    @staticmethod
+    def _read_delta(fs, ckpt):
+        """The checkpoint's delta marker ({"base_checkpoint_no": M,
+        "chain_len": K}), or None for a full checkpoint (no delta.json)
+        or an FS backend without read_file support."""
+        from ..errors import CheckpointCorruptionError
+
+        try:
+            blob = fs.read_file(os.path.join(ckpt, _DELTA_FILE))
+        except NotImplementedError:
+            return None
+        if blob is None:
+            return None
+        try:
+            meta = json.loads(blob.decode())
+        except (UnicodeDecodeError, ValueError) as e:
+            raise CheckpointCorruptionError(
+                f"undecodable delta marker in {ckpt!r}: {e}"
+            ) from e
+        if not isinstance(meta, dict) or "base_checkpoint_no" not in meta:
+            raise CheckpointCorruptionError(
+                f"malformed delta marker in {ckpt!r}: {meta!r}"
+            )
+        return meta
+
+    #: hard upper bound on delta-chain length at load time. Termination
+    #: is already guaranteed by the strictly-decreasing base check; this
+    #: only bounds pathological hand-built chains. AsyncCheckpointer
+    #: refuses full_every anywhere near it, so a legitimately published
+    #: chain can never be rejected here.
+    CHAIN_LIMIT = 1024
+
+    def _resolve_chain(self, fs, path, no, limit=CHAIN_LIMIT):
+        """The delta chain of checkpoint `no`, oldest-first: ``[full,
+        d1, ..., no]`` (just ``[no]`` for a full checkpoint). Raises
+        ResumeMismatchError when a link is missing (base rotated away)
+        and CheckpointCorruptionError on a cycle/overlong chain or a
+        garbled marker — either way the candidate is unloadable."""
+        from ..errors import CheckpointCorruptionError, ResumeMismatchError
+
+        chain = [int(no)]
+        cur = int(no)
+        while True:
+            d = os.path.join(path, f"{_CHECKPOINT_PREFIX}{cur}")
+            if not fs.is_exist(d):
+                raise ResumeMismatchError(
+                    f"checkpoint {cur} in the delta chain of {no} under "
+                    f"{path!r} is missing (base rotated away?)"
+                )
+            meta = self._read_delta(fs, d)
+            if meta is None:
+                return list(reversed(chain))
+            base = int(meta["base_checkpoint_no"])
+            if base >= cur or len(chain) >= limit:
+                raise CheckpointCorruptionError(
+                    f"delta chain of checkpoint {no} under {path!r} does "
+                    f"not terminate (link {cur} -> base {base}, length "
+                    f"{len(chain)})"
+                )
+            chain.append(base)
+            cur = base
+
+    @staticmethod
+    def _collect_local_arrays(local_vars, scope=None):
+        """Host payload of this rank's `local_vars`: fully-addressable
+        values as plain arrays, cross-process-sharded values (ZeRO
+        optimizer shards) as the dim-0 slices THIS process holds, keyed by
+        their global offset (load overlays them back onto the
+        startup-initialized full value)."""
+        import numpy as np
+
+        from ..framework.scope import global_scope
+
+        scope = scope if scope is not None else global_scope()
+        arrays = {}
+        for v in local_vars or ():
+            name = v if isinstance(v, str) else v.name
+            value = scope.find_var(name)
+            if value is None:
+                continue
+            if getattr(value, "is_fully_addressable", True):
+                from .. import io as _io
+
+                arrays[name] = _io._private_host_copy(value)
+            else:
+                arrays.update(_local_dim0_slices(name, value))
+        return arrays
+
     def _write_rank_shard(self, local_dir, rank, commit, train_status,
-                          local_vars, scope=None):
+                          local_vars, scope=None, arrays=None,
+                          compress=False):
         """Materialize one ``rank_<i>/`` shard into `local_dir`: this
         rank's full TrainStatus, a commit record echoing the checkpoint it
         belongs to (the rank-coherence check compares the two on load),
         and — when `local_vars` names non-replicated persistables (sharded
         optimizer state, per-rank tables) — a CRC-manifested payload of
-        their scope values."""
-        import numpy as np
-
+        their scope values. `arrays` overrides the scope read with a
+        pre-collected (possibly delta-filtered) payload — the async
+        publisher path, which must never touch the live scope."""
         from .. import io as _io
-        from ..framework.scope import global_scope
 
         shard = os.path.join(local_dir, _rank_dir_name(rank))
         os.makedirs(shard, exist_ok=True)
@@ -281,36 +441,25 @@ class Fleet:
             json.dump(train_status.to_dict(), f)
         with open(os.path.join(shard, _COMMIT_FILE), "w") as f:
             json.dump(dict(commit, rank=int(rank)), f)
-        if local_vars:
-            scope = scope or global_scope()
-            arrays = {}
-            for v in local_vars:
-                name = v if isinstance(v, str) else v.name
-                value = scope.find_var(name)
-                if value is None:
-                    continue
-                if getattr(value, "is_fully_addressable", True):
-                    arrays[name] = np.asarray(value)
-                else:
-                    # cross-process-sharded state (ZeRO optimizer shards):
-                    # persist only the dim-0 slices THIS process holds,
-                    # keyed by their global offset; load overlays them
-                    # back into the startup-initialized full value
-                    arrays.update(_local_dim0_slices(name, value))
-            payload = os.path.join(shard, "__params__.npz")
-            _io._atomic_write(payload, lambda f: np.savez(f, **arrays))
-            _io._write_manifest(
-                os.path.join(shard, _io.MANIFEST_NAME), payload, arrays
-            )
+        if arrays is None and local_vars:
+            arrays = self._collect_local_arrays(local_vars, scope)
+        if arrays is not None:
+            _io.save_arrays(shard, arrays, compress=compress)
         return shard
 
     def _publish_rank_shard(self, fs, path, train_status, local_vars,
-                            wait_timeout):
+                            wait_timeout, shard_arrays_fn=None,
+                            compress=False):
         """Non-first-worker half of save_check_point: wait for the first
         worker to publish the replicated checkpoint whose commit record
         matches this save (same epoch/global step), then publish this
         rank's shard into it with the same tmp+mv discipline. Returns the
-        checkpoint number."""
+        checkpoint number.
+
+        `shard_arrays_fn(dir_is_delta)` (async path) supplies the
+        pre-collected shard payload — full when the matched dir is a full
+        checkpoint, delta-filtered when it is a link of a delta chain, so
+        the shard tier always follows the dir's own chain shape."""
         import shutil
         import tempfile
         import time as _time
@@ -366,14 +515,24 @@ class Fleet:
         local = tempfile.mkdtemp(prefix="paddle_tpu_shard_")
         shard_tmp = os.path.join(ckpt, _rank_dir_name(rank) + ".tmp")
         shard_dst = os.path.join(ckpt, _rank_dir_name(rank))
+        arrays = None
+        if shard_arrays_fn is not None:
+            try:
+                dir_is_delta = self._read_delta(fs, ckpt) is not None
+            except Exception:
+                dir_is_delta = False  # unreadable marker: err toward full
+            arrays = shard_arrays_fn(dir_is_delta)
 
         def _publish():
+            from ..resilience.faults import fault_point
+
+            fault_point("checkpoint.publish")
             if fs.is_exist(shard_dst):  # prior attempt's mv already landed
                 fs.delete(shard_tmp)
                 return
             src = self._write_rank_shard(
                 local, rank, self._commit_record(train_status, no, True),
-                train_status, local_vars,
+                train_status, local_vars, arrays=arrays, compress=compress,
             )
             fs.delete(shard_tmp)
             fs.upload(src, shard_tmp)
@@ -391,7 +550,9 @@ class Fleet:
     def save_check_point(
         self, executor, path, train_status, main_program=None, fs=None,
         remain_all_checkpoint=False, max_checkpoint_num=3, local_vars=None,
-        per_rank=None, shard_wait_timeout=120.0,
+        per_rank=None, shard_wait_timeout=120.0, snapshot=None,
+        heartbeat=None, compress=False, delta_meta=None,
+        shard_arrays_fn=None,
     ):
         """Save persistables + the full TrainStatus into a new numbered
         checkpoint dir and rotate old ones. The payload is written locally
@@ -419,23 +580,50 @@ class Fleet:
         The just-published checkpoint is spot-verified (manifest/CRC
         readback) BEFORE predecessors rotate away, so a bad publish can
         never leave zero loadable checkpoints. Returns the checkpoint
-        number."""
+        number.
+
+        Async/tiered extensions (all optional; the classic synchronous
+        contract is the default): `snapshot` is a pre-collected
+        :class:`CheckpointSnapshot` — the publish then never touches the
+        live scope, which is how the async publisher runs this whole
+        method off the step loop; `heartbeat` (a health.Heartbeat or any
+        zero-arg callable) is pulsed for the duration of the save so a
+        slow publish never reads as a hung step; `compress` writes
+        zlib-compressed payloads; `delta_meta` marks the dir as a delta
+        link ({"base_checkpoint_no": M, "chain_len": K}) whose payload
+        holds only changed arrays/rows — rotation then spares every chain
+        ancestor a surviving delta still needs."""
         import tempfile
+        import time as _time
 
         from .fs_wrapper import LocalFS
         from .. import io as _io
+        from .. import observability as _obs
         from ..errors import CheckpointCorruptionError
         from ..resilience import retry
+        from ..resilience.faults import fault_point
+        from ..resilience.health import LivenessPulse
 
         fs = fs or LocalFS()
         if per_rank is None:
             per_rank = local_vars is not None
+        if snapshot is not None and train_status is None:
+            train_status = snapshot.status
         if not self.is_first_worker():
             if not per_rank:
                 return None
-            return self._publish_rank_shard(
-                fs, path, train_status, local_vars, shard_wait_timeout
-            )
+            if snapshot is not None and shard_arrays_fn is None:
+                # even an EMPTY dict must flow through (never None): a
+                # None payload makes _write_rank_shard re-collect from
+                # the LIVE scope on whatever thread runs the publish
+                shard_arrays_fn = lambda _delta: (  # noqa: E731
+                    dict(snapshot.local_arrays) if local_vars else None
+                )
+            with LivenessPulse(_as_touch(heartbeat)):
+                return self._publish_rank_shard(
+                    fs, path, train_status, local_vars, shard_wait_timeout,
+                    shard_arrays_fn=shard_arrays_fn, compress=compress,
+                )
         import shutil
 
         def _prepare():
@@ -450,87 +638,157 @@ class Fleet:
                     fs.delete(os.path.join(path, d))
             return _dir_numbers(dirs)
 
-        nos = retry(
-            max_attempts=4, base_delay=0.05, max_delay=2.0,
-            name="checkpoint.prepare",
-        ).call(_prepare)
-        no = (nos[-1] + 1) if nos else 0
-        ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
-        tmp = ckpt + ".tmp"
-        local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
-
-        def _write_and_publish():
-            # a prior attempt's mv may have landed even though it REPORTED
-            # failure (remote rename applied, response lost); mv onto an
-            # existing dir would nest tmp inside the live checkpoint, so
-            # treat an existing ckpt as "already published"
-            if fs.is_exist(ckpt):
-                fs.delete(tmp)
-                return
-            # local_vars travel in the per-rank shards, not the
-            # replicated payload (on a cross-process mesh this process
-            # could not materialize them anyway)
-            _io.save_persistables(
-                executor, local, main_program,
-                exclude=[
-                    v if isinstance(v, str) else v.name
-                    for v in (local_vars or ())
-                ],
-            )
-            with open(os.path.join(local, _TRAIN_STATUS_FILE), "w") as f:
-                json.dump(train_status.to_dict(), f)
-            commit = self._commit_record(train_status, no, per_rank)
-            with open(os.path.join(local, _COMMIT_FILE), "w") as f:
-                json.dump(commit, f)
-            # the first worker's own shard rides inside the atomic publish
-            self._write_rank_shard(local, 0, commit, train_status, local_vars)
-            fs.delete(tmp)
-            fs.upload(local, tmp)
-            # atomic publish: a crash mid-save leaves only a .tmp dir
-            # behind, never a half-written numbered checkpoint
-            fs.mv(tmp, ckpt)
-
+        pulse = LivenessPulse(_as_touch(heartbeat))
+        pulse.__enter__()
+        t_publish = _time.perf_counter()
+        published_bytes = [0]
         try:
-            retry(
+            nos = retry(
                 max_attempts=4, base_delay=0.05, max_delay=2.0,
-                name="checkpoint.save",
-            ).call(_write_and_publish)
-        finally:
-            shutil.rmtree(local, ignore_errors=True)
-        if not remain_all_checkpoint:
-            # spot-verify the JUST-PUBLISHED checkpoint (manifest/CRC
-            # readback through the backend) before deleting predecessors:
-            # rotating first and verifying never could leave a run with
-            # zero loadable checkpoints after one bad publish
-            self._verify_published(fs, ckpt)
-            doomed = (nos + [no])[:-max_checkpoint_num]
-            if per_rank and doomed:
-                # the new checkpoint is complete only once every PEER
-                # attached its shard (asynchronously, after this return);
-                # if no surviving checkpoint is complete yet, spare the
-                # newest complete predecessor so a peer dying before its
-                # attach can never leave zero resumable checkpoints
-                def _complete(n):
-                    d = os.path.join(path, f"{_CHECKPOINT_PREFIX}{n}")
-                    try:
-                        return not self._missing_shards(
-                            fs, d, self._read_commit(fs, d)
-                        )
-                    except Exception:
-                        # corrupt commit or transient scan failure: treat
-                        # as not-complete (errs toward sparing more) —
-                        # the save itself already succeeded, a completeness
-                        # probe must not turn it into a failure
-                        return False
+                name="checkpoint.prepare",
+            ).call(_prepare)
+            no = (nos[-1] + 1) if nos else 0
+            ckpt = os.path.join(path, f"{_CHECKPOINT_PREFIX}{no}")
+            tmp = ckpt + ".tmp"
+            local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
 
-                survivors = (nos + [no])[-max_checkpoint_num:]
-                if not any(_complete(n) for n in survivors):
-                    spared = next(
-                        (n for n in reversed(doomed) if _complete(n)), None
+            def _write_and_publish():
+                fault_point("checkpoint.publish")
+                # a prior attempt's mv may have landed even though it
+                # REPORTED failure (remote rename applied, response lost);
+                # mv onto an existing dir would nest tmp inside the live
+                # checkpoint, so treat an existing ckpt as "published"
+                if fs.is_exist(ckpt):
+                    fs.delete(tmp)
+                    return
+                # local_vars travel in the per-rank shards, not the
+                # replicated payload (on a cross-process mesh this process
+                # could not materialize them anyway)
+                if snapshot is not None:
+                    _io.save_arrays(local, snapshot.arrays,
+                                    compress=compress)
+                    if snapshot.aux is not None:
+                        _io.save_arrays(
+                            local, snapshot.aux, filename=_AUX_FILE,
+                            compress=compress, manifest_name=_AUX_MANIFEST,
+                        )
+                else:
+                    _io.save_persistables(
+                        executor, local, main_program,
+                        exclude=[
+                            v if isinstance(v, str) else v.name
+                            for v in (local_vars or ())
+                        ],
+                        progress=_as_touch(heartbeat), compress=compress,
                     )
-                    doomed = [n for n in doomed if n != spared]
-            for old in doomed:
-                fs.delete(os.path.join(path, f"{_CHECKPOINT_PREFIX}{old}"))
+                with open(os.path.join(local, _TRAIN_STATUS_FILE), "w") as f:
+                    json.dump(train_status.to_dict(), f)
+                commit = self._commit_record(train_status, no, per_rank)
+                with open(os.path.join(local, _COMMIT_FILE), "w") as f:
+                    json.dump(commit, f)
+                if delta_meta is not None:
+                    with open(os.path.join(local, _DELTA_FILE), "w") as f:
+                        json.dump(delta_meta, f)
+                # the first worker's own shard rides inside the publish
+                shard_arrays = None
+                if shard_arrays_fn is not None:
+                    shard_arrays = shard_arrays_fn(delta_meta is not None)
+                elif snapshot is not None and local_vars:
+                    # possibly-empty dict, never None: the publish must
+                    # not re-read the live scope (see CheckpointSnapshot)
+                    shard_arrays = dict(snapshot.local_arrays)
+                self._write_rank_shard(
+                    local, 0, commit, train_status, local_vars,
+                    arrays=shard_arrays, compress=compress,
+                )
+                published_bytes[0] = _dir_bytes(local)
+                fs.delete(tmp)
+                fs.upload(local, tmp)
+                # atomic publish: a crash mid-save leaves only a .tmp dir
+                # behind, never a half-written numbered checkpoint
+                fs.mv(tmp, ckpt)
+
+            try:
+                retry(
+                    max_attempts=4, base_delay=0.05, max_delay=2.0,
+                    name="checkpoint.save",
+                ).call(_write_and_publish)
+            finally:
+                shutil.rmtree(local, ignore_errors=True)
+            elapsed = _time.perf_counter() - t_publish
+            _obs.observe("checkpoint.publish_latency", elapsed)
+            if published_bytes[0]:
+                _obs.add("checkpoint.bytes_written", published_bytes[0])
+                _obs.set_gauge("checkpoint.last_payload_bytes",
+                               published_bytes[0])
+                if elapsed > 0:
+                    _obs.observe(
+                        "checkpoint.save_bandwidth",
+                        published_bytes[0] / elapsed, _BANDWIDTH_BUCKETS,
+                    )
+            _obs.add(
+                "checkpoint.delta_saves" if delta_meta is not None
+                else "checkpoint.full_saves"
+            )
+            if not remain_all_checkpoint:
+                # spot-verify the JUST-PUBLISHED checkpoint (manifest/CRC
+                # readback through the backend) before deleting
+                # predecessors: rotating first and verifying never could
+                # leave a run with zero loadable checkpoints after one
+                # bad publish
+                self._verify_published(fs, ckpt)
+                doomed = (nos + [no])[:-max_checkpoint_num]
+                if per_rank and doomed:
+                    # the new checkpoint is complete only once every PEER
+                    # attached its shard (asynchronously, after this
+                    # return); if no surviving checkpoint is complete yet,
+                    # spare the newest complete predecessor so a peer
+                    # dying before its attach can never leave zero
+                    # resumable checkpoints
+                    def _complete(n):
+                        d = os.path.join(path, f"{_CHECKPOINT_PREFIX}{n}")
+                        try:
+                            return not self._missing_shards(
+                                fs, d, self._read_commit(fs, d)
+                            )
+                        except Exception:
+                            # corrupt commit or transient scan failure:
+                            # treat as not-complete (errs toward sparing
+                            # more) — the save itself already succeeded, a
+                            # completeness probe must not turn it into a
+                            # failure
+                            return False
+
+                    survivors = (nos + [no])[-max_checkpoint_num:]
+                    if not any(_complete(n) for n in survivors):
+                        spared = next(
+                            (n for n in reversed(doomed) if _complete(n)),
+                            None,
+                        )
+                        doomed = [n for n in doomed if n != spared]
+                if doomed:
+                    # a surviving delta's chain must stay loadable: spare
+                    # every ancestor some survivor still reaches (they
+                    # rotate once the next full save supersedes the chain)
+                    survivors = [
+                        n for n in (nos + [no]) if n not in doomed
+                    ]
+                    required = set()
+                    for n in survivors:
+                        try:
+                            required.update(
+                                self._resolve_chain(fs, path, n)
+                            )
+                        except Exception:
+                            # already-broken chain: nothing to protect
+                            pass
+                    doomed = [n for n in doomed if n not in required]
+                for old in doomed:
+                    fs.delete(
+                        os.path.join(path, f"{_CHECKPOINT_PREFIX}{old}")
+                    )
+        finally:
+            pulse.__exit__(None, None, None)
         return no
 
     @staticmethod
@@ -616,13 +874,14 @@ class Fleet:
             if _rank_dir_name(i) not in present
         ]
 
-    def _fetch_for_rank(self, fs, ckpt, local, tid, commit):
+    def _fetch_for_rank(self, fs, ckpt, local, tid, commit, with_aux=False):
         """Stage the slice of a checkpoint THIS rank needs: the replicated
         top-level files plus its own ``rank_<tid>/`` shard. Skipping the
         peers' shards keeps resume traffic O(shard) per rank instead of
         O(nranks * shard) — across the pod, linear instead of quadratic.
         Backends that cannot fetch single paths fall back to the whole
-        directory."""
+        directory. `with_aux` additionally stages the auxiliary payload
+        (fetched only on request: it can be embedding-table sized)."""
         import shutil
 
         if not commit or int(commit.get("nranks", 1)) <= 1:
@@ -632,8 +891,11 @@ class Fleet:
             os.makedirs(local, exist_ok=True)
             from .. import io as _io
 
-            for fname in ("__params__.npz", _io.MANIFEST_NAME,
-                          _TRAIN_STATUS_FILE, _COMMIT_FILE):
+            fnames = ("__params__.npz", _io.MANIFEST_NAME,
+                      _TRAIN_STATUS_FILE, _COMMIT_FILE, _DELTA_FILE)
+            if with_aux:
+                fnames += (_AUX_FILE, _AUX_MANIFEST)
+            for fname in fnames:
                 src = os.path.join(ckpt, fname)
                 if fs.is_exist(src):
                     fs.download(src, os.path.join(local, fname))
@@ -645,23 +907,26 @@ class Fleet:
             os.makedirs(local, exist_ok=True)
             fs.download(ckpt, local)
 
-    def _load_rank_shard(self, local, trainer_id, dir_commit):
-        """This rank's slice of a downloaded checkpoint: verify the shard's
-        commit record against the checkpoint-level one (the rank-coherence
-        check — a shard that belongs to a different checkpoint number or
-        global step means the ranks would silently train on different
-        timelines), overlay its per-rank payload onto the scope, and
-        return its TrainStatus. None when the checkpoint predates shards
-        or this rank joined after the save (elastic resize)."""
+    def _load_rank_shard(self, locals_, trainer_id, dir_commit):
+        """This rank's slice of a downloaded checkpoint chain (`locals_`
+        is the staged chain, oldest-first; a full checkpoint is a chain of
+        one): verify the newest shard's commit record against the
+        checkpoint-level one (the rank-coherence check — a shard that
+        belongs to a different checkpoint number or global step means the
+        ranks would silently train on different timelines), merge the
+        per-rank payloads along the chain, overlay the result onto the
+        scope, and return the newest shard's TrainStatus. None when the
+        checkpoint predates shards or this rank joined after the save
+        (elastic resize)."""
         import jax.numpy as jnp
 
         from ..errors import ResumeMismatchError
         from .. import io as _io
 
-        shard = os.path.join(local, _rank_dir_name(trainer_id))
-        if not os.path.isdir(shard):
+        newest = os.path.join(locals_[-1], _rank_dir_name(trainer_id))
+        if not os.path.isdir(newest):
             return None
-        commit_file = os.path.join(shard, _COMMIT_FILE)
+        commit_file = os.path.join(newest, _COMMIT_FILE)
         if dir_commit is not None and os.path.exists(commit_file):
             with open(commit_file) as f:
                 shard_commit = json.load(f)
@@ -677,28 +942,58 @@ class Fleet:
                         f"{dir_commit.get(field)!r} — refusing a resume "
                         "that would silently diverge the ranks"
                     )
-        payload = os.path.join(shard, "__params__.npz")
-        if os.path.exists(payload):
+        merged = {}
+        have_payload = False
+        for local in locals_:
+            payload = os.path.join(
+                local, _rank_dir_name(trainer_id), "__params__.npz"
+            )
+            if os.path.exists(payload):
+                have_payload = True
+                _io.merge_checkpoint_arrays(
+                    merged, _io._load_npz_verified(payload), payload
+                )
+        if have_payload:
             from .. import observability as _obs
             from ..framework.scope import global_scope
 
-            arrays = _io._load_npz_verified(payload)
             scope = global_scope()
-            for name, arr in arrays.items():
+            for name, arr in merged.items():
                 if _SLICE_MARK in name:
                     if not _overlay_slice(scope, name, arr):
                         _obs.add("resilience.shard_overlay_skipped")
                     continue
                 scope.set_var(name, jnp.asarray(arr))
-        status_file = os.path.join(shard, _TRAIN_STATUS_FILE)
+        status_file = os.path.join(newest, _TRAIN_STATUS_FILE)
         if os.path.exists(status_file):
             with open(status_file) as f:
                 return TrainStatus.from_dict(json.load(f))
         return None
 
+    @staticmethod
+    def _read_aux_chain(locals_):
+        """Chain-merged auxiliary payload of a staged checkpoint chain
+        (oldest-first), or None when no link carries one."""
+        from .. import io as _io
+
+        merged = {}
+        found = False
+        for local in locals_:
+            p = os.path.join(local, _AUX_FILE)
+            if os.path.exists(p):
+                found = True
+                _io.merge_checkpoint_arrays(
+                    merged,
+                    _io._load_npz_verified(
+                        p, os.path.join(local, _AUX_MANIFEST)
+                    ),
+                    p,
+                )
+        return merged if found else None
+
     def load_check_point(
         self, executor, path, trainer_id=None, main_program=None, fs=None,
-        checkpoint_no=None,
+        checkpoint_no=None, load_aux=False,
     ):
         """Load the newest (or requested) checkpoint via the FS backend;
         returns its TrainStatus. Missing dir -> TrainStatus(-1) (cold
@@ -706,13 +1001,21 @@ class Fleet:
 
         Candidate selection skips checkpoints that fail integrity
         verification (CheckpointCorruptionError from io.py's manifest/CRC
-        check) AND checkpoints whose commit record promises rank shards
-        that never landed (a save interrupted after the replicated publish)
-        — every rank walks the same FS view newest-first, so all ranks
-        settle on the same newest COMPLETE checkpoint instead of silently
-        diverging. An explicitly requested checkpoint_no never falls back:
-        corruption raises CheckpointCorruptionError, incompleteness raises
+        check), checkpoints whose commit record promises rank shards
+        that never landed (a save interrupted after the replicated
+        publish), AND delta checkpoints whose base chain is broken or
+        incomplete (``resilience.checkpoint_chain_broken``) — every rank
+        walks the same FS view newest-first, so all ranks settle on the
+        same newest COMPLETE checkpoint instead of silently diverging. An
+        explicitly requested checkpoint_no never falls back: corruption
+        raises CheckpointCorruptionError, incompleteness raises
         ResumeMismatchError.
+
+        A delta candidate is reconstructed by loading its full base and
+        overlaying each chain link's changed arrays/rows, then applied to
+        the scope in one pass. `load_aux=True` additionally stages the
+        auxiliary payload chain (e.g. embedding host stores) and returns
+        it on ``status.aux``.
 
         When this rank's ``rank_<i>/`` shard is present its commit record
         must match the checkpoint's (number + global step) — mismatch
@@ -774,12 +1077,53 @@ class Fleet:
                 if checkpoint_no is not None:
                     raise last_err
                 continue
-            local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
+            # a delta candidate is only loadable through its whole chain:
+            # resolve it (and check every PRIOR link's completeness) before
+            # fetching anything, so a broken chain falls back cheaply
             try:
-                self._fetch_for_rank(fs, ckpt, local, tid, remote_commit)
-                _io.load_persistables(executor, local, main_program)
+                chain = self._resolve_chain(fs, path, no)
+                for prior in chain[:-1]:
+                    d = os.path.join(path, f"{_CHECKPOINT_PREFIX}{prior}")
+                    pm = self._scan_retry().call(
+                        self._missing_shards, fs, d,
+                        self._read_commit(fs, d),
+                    )
+                    if pm:
+                        raise ResumeMismatchError(
+                            f"delta chain link {prior} of checkpoint {no} "
+                            f"under {path!r} is missing rank shards {pm}"
+                        )
+            except (CheckpointCorruptionError, ResumeMismatchError) as e:
+                _obs.add("resilience.checkpoint_chain_broken")
+                last_err = e
+                had_corruption = True
+                if checkpoint_no is not None:
+                    raise
+                continue
+            locals_ = []
+            try:
+                for cno in chain:
+                    d = os.path.join(path, f"{_CHECKPOINT_PREFIX}{cno}")
+                    local = tempfile.mkdtemp(prefix="paddle_tpu_ckpt_")
+                    locals_.append(local)
+                    self._fetch_for_rank(
+                        fs, d, local, tid,
+                        remote_commit if cno == no
+                        else self._read_commit(fs, d),
+                        with_aux=load_aux,
+                    )
+                # replicated payload: chain-merge host-side, apply ONCE
+                merged = {}
+                for local in locals_:
+                    _io.merge_checkpoint_arrays(
+                        merged, _io.read_persistables(local), local
+                    )
+                _io.apply_persistables(merged, main_program)
                 if i > 0:
                     _obs.add("resilience.checkpoint_fallbacks")
+                if len(chain) > 1:
+                    _obs.add("resilience.checkpoint_chain_loads")
+                local = locals_[-1]
                 dir_commit = remote_commit
                 commit_file = os.path.join(local, _COMMIT_FILE)
                 if dir_commit is None and os.path.exists(commit_file):
@@ -787,7 +1131,7 @@ class Fleet:
                     # along in the full-directory download
                     with open(commit_file) as f:
                         dir_commit = json.load(f)
-                status = self._load_rank_shard(local, tid, dir_commit)
+                status = self._load_rank_shard(locals_, tid, dir_commit)
                 if status is None:
                     status_file = os.path.join(local, _TRAIN_STATUS_FILE)
                     if os.path.exists(status_file):
@@ -796,6 +1140,8 @@ class Fleet:
                     else:
                         status = TrainStatus(-1)
                 status.checkpoint_no = no
+                if load_aux:
+                    status.aux = self._read_aux_chain(locals_)
                 if status.global_step or status.cursor:
                     # a v2 mid-run position is being restored, not a bare
                     # epoch boundary: the exact-resume path fired
@@ -806,7 +1152,8 @@ class Fleet:
                 last_err = e
                 had_corruption = True
             finally:
-                shutil.rmtree(local, ignore_errors=True)
+                for local in locals_:
+                    shutil.rmtree(local, ignore_errors=True)
         if (
             isinstance(last_err, ResumeMismatchError)
             and not saw_my_shard and not had_corruption
@@ -857,6 +1204,10 @@ class TrainStatus:
         self.guard = dict(guard) if guard else {}
         self.cursor = dict(cursor) if cursor else {}
         self.checkpoint_no = None  # set by load_check_point
+        # auxiliary (non-scope) payload — e.g. embedding host stores —
+        # populated by load_check_point(load_aux=True); never serialized
+        # into train_status.json (it is array data, not metadata)
+        self.aux = None
 
     @property
     def epoch_no(self):
@@ -943,6 +1294,568 @@ class TrainStatus:
     def __repr__(self):
         extra = f", global_step={self.global_step}" if self.global_step else ""
         return f"TrainStatus(epoch_no={self._epoch_no}{extra})"
+
+
+class PendingSave:
+    """Handle for one queued async save. :meth:`result` blocks until this
+    snapshot — or a newer one that superseded it via coalescing — is
+    durably committed, and returns the checkpoint number. A cancelled
+    save (rollback quiesce) raises UnavailableError; a failed publish
+    re-raises the publisher's error."""
+
+    __slots__ = ("checkpoint_no", "error", "cancelled", "is_full",
+                 "snapshot", "row_marks", "_event", "_successor",
+                 "_fp_proposals")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.checkpoint_no = None
+        self.error = None
+        self.cancelled = False
+        self.is_full = True
+        self.snapshot = None
+        self.row_marks = {}
+        self._successor = None
+        self._fp_proposals = []
+
+    def done(self):
+        p = self
+        while p._successor is not None:
+            p = p._successor
+        return p._event.is_set()
+
+    def result(self, timeout=None):
+        from ..errors import ExecutionTimeoutError, UnavailableError
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        p = self
+        while True:
+            remaining = (
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+            if not p._event.wait(remaining):
+                raise ExecutionTimeoutError(
+                    "async checkpoint publish did not finish within "
+                    f"{timeout}s"
+                )
+            if p._successor is not None:
+                # coalesced: durability is carried by the newer snapshot
+                p = p._successor
+                continue
+            if p.cancelled:
+                raise UnavailableError(
+                    "async checkpoint save was cancelled before publish "
+                    "(rollback quiesce dropped the queued snapshot)"
+                )
+            if p.error is not None:
+                raise p.error
+            return p.checkpoint_no
+
+
+class AsyncCheckpointer:
+    """Async snapshot/publish checkpoint pipeline — take the save stall
+    off the step loop (ROADMAP item 5).
+
+    :meth:`save` splits the synchronous ``Fleet.save_check_point`` stall
+    into (1) a **snapshot stage** the step loop waits for — device→host
+    copies of every persistable (plus this rank's ``local_vars`` and any
+    auxiliary payload) into an immutable staging buffer — and (2) a
+    **publish stage** on a background thread: serialize, CRC-manifest,
+    temp+fsync+``os.replace`` publish, per-rank shard attach, commit
+    record, readback verify, rotation — all through
+    ``Fleet.save_check_point(snapshot=...)``, so sync and async saves
+    share one durability contract, retry policy, and fault-seam catalog
+    (plus the new ``checkpoint.snapshot`` / ``checkpoint.publish``
+    seams).
+
+    The queue is bounded: one pending snapshot behind the in-flight
+    publish. ``queue_policy="coalesce"`` (default) replaces a still-
+    queued snapshot with the newer one — the superseded handle resolves
+    when its successor commits, so the caller's state *or newer* is
+    always what lands; ``"block"`` makes :meth:`save` wait for the slot.
+    Either way durability is never silently dropped: a publish failure
+    re-raises from the handle, from :meth:`wait`/:meth:`close`, and from
+    the next :meth:`save`.
+
+    Tiered saves: ``delta=True`` writes, after each full save, up to
+    ``full_every`` delta checkpoints carrying only arrays whose content
+    CRC changed since the chain's last write — plus row-level deltas for
+    names with a registered oracle in ``row_oracles`` (e.g.
+    ``EmbeddingEngine.delta_row_oracles()``, keyed off the cache's
+    write-back ticks), stored as ``<name>@@rows``/``<name>@@ridx``
+    pairs. Per-rank shard payloads tier at array granularity, following
+    the published dir's own full/delta shape. ``compress=True`` writes
+    zlib-compressed payloads. ``Fleet.load_check_point`` reconstructs
+    the chain (never longer than ``full_every`` deltas) and skips
+    candidates whose chain is broken.
+
+    Lifecycle: :meth:`quiesce` (cancel the queued snapshot, await the
+    in-flight publish) before a TrainGuard rollback; :meth:`wait` after
+    the drain save so exit-75 never leaves a half-published final
+    checkpoint; :meth:`close` / context-manager exit drains the queue.
+    `heartbeat` (a health.Heartbeat or zero-arg callable) is pulsed for
+    the whole publish so a slow save never reads as a hung step."""
+
+    def __init__(self, fleet, path, executor=None, main_program=None,
+                 fs=None, scope=None, local_vars=None, per_rank=None,
+                 max_checkpoint_num=3, remain_all_checkpoint=False,
+                 queue_policy="coalesce", delta=False, full_every=4,
+                 compress=False, row_oracles=None, heartbeat=None,
+                 shard_wait_timeout=120.0):
+        from ..errors import InvalidArgumentError
+
+        if queue_policy not in ("coalesce", "block"):
+            raise InvalidArgumentError(
+                f"AsyncCheckpointer queue_policy={queue_policy!r}: "
+                "supported: 'coalesce' | 'block'"
+            )
+        if not 1 <= int(full_every) <= 256:
+            raise InvalidArgumentError(
+                f"AsyncCheckpointer full_every must be in [1, 256], got "
+                f"{full_every}: resume replays the whole delta chain, and "
+                "256 links is already far past any sane RPO/replay "
+                f"trade-off (the load-side cap is {Fleet.CHAIN_LIMIT})"
+            )
+        if row_oracles and not delta:
+            raise InvalidArgumentError(
+                "AsyncCheckpointer row_oracles requires delta=True: row "
+                "oracles only shape delta payloads"
+            )
+        self._fleet = fleet
+        self.path = path
+        self._executor = executor
+        self._main_program = main_program
+        self._fs = fs
+        self._scope = scope
+        self._local_vars = list(local_vars) if local_vars else None
+        self._per_rank = per_rank
+        self._max_num = int(max_checkpoint_num)
+        self._remain_all = bool(remain_all_checkpoint)
+        self._queue_policy = queue_policy
+        self._delta = bool(delta)
+        self._full_every = int(full_every)
+        self._compress = bool(compress)
+        self._row_oracles = dict(row_oracles or {})
+        self._heartbeat = heartbeat
+        self._shard_wait_timeout = shard_wait_timeout
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending = None
+        self._inflight = None
+        self._closed = False
+        self._failed = None
+        self._last_no = None
+        #: delta checkpoints published since the last full one (None =
+        #: no full published yet → the next save must be full)
+        self._published_since_full = None
+        #: per-payload content fingerprints of the chain's last written
+        #: value ({name: manifest entry}); an array matching its
+        #: fingerprint is omitted from a delta payload
+        self._fp = {"main": {}, "aux": {}, "shard": {}}
+        #: per-name row-oracle marks of the last PUBLISHED save — marks
+        #: advance only on publish, so a coalesced-away snapshot can
+        #: never lose rows dirtied in its window
+        self._row_marks = {}
+        #: identity-reuse cache for snapshot_persistables: a scope value
+        #: still held by the SAME object since the last snapshot (values
+        #: are replaced via set_var, never mutated in place) reuses its
+        #: host copy, so steady-state snapshots cost O(changed bytes)
+        self._snap_cache = {}
+
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ckpt-publisher"
+        )
+        self._thread.start()
+
+    # -- step-loop side ----------------------------------------------------
+    def save(self, train_status, aux=None):
+        """Snapshot now (the only part the step loop waits for), publish
+        in the background. `aux` is an optional ``{key: array}`` payload
+        saved alongside the replicated payload with its own manifest
+        (``load_check_point(load_aux=True)`` returns it on
+        ``status.aux``). Returns a :class:`PendingSave`."""
+        from .. import observability as _obs
+        from ..resilience import retry
+        from ..resilience.faults import fault_point
+
+        with self._lock:
+            self._raise_if_failed()
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointer is closed")
+            is_full = self._decide_full_locked()
+        t0 = time.perf_counter()
+
+        def _snap():
+            fault_point("checkpoint.snapshot")
+            return self._snapshot(train_status, aux, is_full)
+
+        job = retry(
+            max_attempts=3, base_delay=0.05, max_delay=1.0,
+            name="checkpoint.snapshot",
+        ).call(_snap)
+        _obs.observe(
+            "checkpoint.snapshot_latency", time.perf_counter() - t0
+        )
+        _obs.add("checkpoint.async_saves")
+        with self._lock:
+            self._raise_if_failed()
+            while (
+                self._pending is not None
+                and (
+                    self._queue_policy == "block"
+                    # a row-filtered delta snapshot can never absorb a
+                    # queued FULL's obligation (its payload is already
+                    # rows-only); if one slipped in while we were off the
+                    # lock snapshotting, wait for the publisher to take
+                    # it instead of coalescing it away
+                    or (self._pending.is_full and not job.is_full)
+                )
+                and not self._closed and self._failed is None
+            ):
+                self._cond.wait()
+            self._raise_if_failed()
+            if self._closed:
+                # closed while we were snapshotting/waiting: the
+                # publisher may already have drained and exited — an
+                # enqueue now would be silently dropped
+                raise RuntimeError("AsyncCheckpointer is closed")
+            if self._pending is not None:  # coalesce (same-kind only)
+                old = self._pending
+                # a queued FULL save anchors the chain math — a newer
+                # full (or a full-payload job) inherits the obligation;
+                # the wait above guarantees old.is_full implies
+                # job.is_full here
+                job.is_full = job.is_full or old.is_full
+                old._successor = job
+                old._event.set()
+                _obs.add("checkpoint.coalesced")
+            self._pending = job
+            self._update_pending_gauge_locked()
+            self._cond.notify_all()
+        return job
+
+    def wait(self, timeout=None):
+        """Block until the queue and any in-flight publish drain;
+        re-raises a publish failure, else returns the newest committed
+        checkpoint number (None when nothing was ever saved)."""
+        from ..errors import ExecutionTimeoutError
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while (
+                (self._pending is not None or self._inflight is not None)
+                and self._failed is None
+            ):
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise ExecutionTimeoutError(
+                        "async checkpoint publish still in flight after "
+                        f"{timeout}s"
+                    )
+                self._cond.wait(remaining)
+            self._raise_if_failed()
+            return self._last_no
+
+    def quiesce(self, cancel_pending=True, timeout=None):
+        """Settle the pipeline before a rollback decision: drop the
+        queued (not yet started) snapshot when `cancel_pending` — it was
+        captured from, or after, the state being abandoned — then await
+        any in-flight publish (which is atomic: it either commits
+        durably or leaves only a ``*.tmp`` dir, so awaiting is always
+        safe; an uncommitted dir can never be loaded). Returns True when
+        the pipeline is idle. A prior publish failure is NOT raised here
+        — recovery paths must proceed to the rollback regardless."""
+        from .. import observability as _obs
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            if cancel_pending and self._pending is not None:
+                job = self._pending
+                self._pending = None
+                job.cancelled = True
+                job._event.set()
+                _obs.add("checkpoint.cancelled")
+                self._update_pending_gauge_locked()
+                self._cond.notify_all()
+            while self._pending is not None or self._inflight is not None:
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def close(self, timeout=None):
+        """Drain the queue (anything accepted by :meth:`save` still
+        publishes — durability), stop the publisher thread, re-raise any
+        publish failure. Idempotent."""
+        with self._lock:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        with self._lock:
+            self._raise_if_failed()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.close()
+        else:
+            try:
+                self.close()
+            except Exception:
+                pass  # don't mask the body's exception
+        return False
+
+    # -- internals ---------------------------------------------------------
+    def _raise_if_failed(self):
+        if self._failed is not None:
+            raise self._failed
+
+    def _update_pending_gauge_locked(self):
+        from .. import observability as _obs
+
+        _obs.set_gauge(
+            "checkpoint.pending",
+            (self._pending is not None) + (self._inflight is not None),
+        )
+
+    def _decide_full_locked(self):
+        if not self._delta:
+            return True
+        if self._pending is not None and self._pending.is_full:
+            # this snapshot may coalesce the queued FULL away; a delta
+            # (row-filtered) payload cannot carry a full save's
+            # obligation, so inherit it up front
+            return True
+        queued = [
+            j for j in (self._pending, self._inflight) if j is not None
+        ]
+        if self._published_since_full is None and not any(
+            j.is_full for j in queued
+        ):
+            return True  # no full anywhere in the chain yet
+        queued_deltas = sum(1 for j in queued if not j.is_full)
+        return (
+            (self._published_since_full or 0) + queued_deltas
+            >= self._full_every
+        )
+
+    def _snapshot(self, train_status, aux, is_full):
+        from .. import io as _io
+
+        exclude = [
+            v if isinstance(v, str) else v.name
+            for v in (self._local_vars or ())
+        ]
+        arrays = _io.snapshot_persistables(
+            self._main_program, scope=self._scope, exclude=exclude,
+            reuse_cache=self._snap_cache,
+        )
+        local_arrays = (
+            self._fleet._collect_local_arrays(self._local_vars, self._scope)
+            if self._local_vars else {}
+        )
+        aux_arrays = None
+        if aux is not None:
+            aux_arrays = {
+                k: _io._private_host_copy(v) for k, v in aux.items()
+            }
+        job = PendingSave()
+        job.is_full = bool(is_full)
+        # row tier: each oracle names the rows dirtied since the last
+        # PUBLISHED mark (e.g. embedding write-back ticks); on a delta
+        # save the full array is replaced by a (rows, indices) pair.
+        # local_arrays stay row-unfiltered: the shard tier follows the
+        # published dir's shape, decided at publish time.
+        if self._row_oracles:
+            with self._lock:
+                marks = dict(self._row_marks)
+            for name, oracle in self._row_oracles.items():
+                last = marks.get(name)
+                rows, mark = oracle(last)
+                job.row_marks[name] = mark
+                if job.is_full or last is None or rows is None:
+                    continue
+                rows = np.asarray(rows, dtype=np.int64)
+                for payload in (arrays, aux_arrays or {}):
+                    if name in payload:
+                        full = payload.pop(name)
+                        payload[name + _io.ROW_VAL_MARK] = (
+                            np.ascontiguousarray(full[rows])
+                        )
+                        payload[name + _io.ROW_IDX_MARK] = rows
+        job.snapshot = CheckpointSnapshot(
+            arrays, local_arrays, aux_arrays,
+            TrainStatus.from_dict(train_status.to_dict()),
+        )
+        return job
+
+    @staticmethod
+    def _fingerprint_all(payload):
+        from .. import io as _io
+
+        return {
+            name: _io._array_entry(arr)
+            for name, arr in payload.items()
+            if not (
+                name.endswith(_io.ROW_VAL_MARK)
+                or name.endswith(_io.ROW_IDX_MARK)
+            )
+        }
+
+    def _filter_unchanged(self, payload, fingerprints):
+        """Drop arrays whose content CRC matches the chain's last written
+        value; returns (kept payload, fingerprint updates for the kept
+        arrays). Row-delta pairs always pass (already minimal)."""
+        from .. import io as _io
+        from .. import observability as _obs
+
+        out, fp = {}, {}
+        dropped = 0
+        for name, arr in payload.items():
+            if name.endswith(_io.ROW_VAL_MARK) or name.endswith(
+                _io.ROW_IDX_MARK
+            ):
+                out[name] = arr
+                continue
+            entry = _io._array_entry(arr)
+            if fingerprints.get(name) == entry:
+                dropped += int(arr.nbytes)
+                continue
+            out[name] = arr
+            fp[name] = entry
+        if dropped:
+            _obs.add("checkpoint.delta_bytes_dropped", dropped)
+        return out, fp
+
+    def _publish(self, job):
+        from .. import observability as _obs
+
+        snap = job.snapshot
+        delta_meta = None
+        arrays, aux = snap.arrays, snap.aux
+        proposals = job._fp_proposals = []
+        if not job.is_full:
+            with self._lock:
+                base_no = self._last_no
+                chain_len = (self._published_since_full or 0) + 1
+            arrays, fp = self._filter_unchanged(arrays, self._fp["main"])
+            proposals.append(("main", fp, False))
+            if aux is not None:
+                aux, fpa = self._filter_unchanged(aux, self._fp["aux"])
+                proposals.append(("aux", fpa, False))
+            delta_meta = {
+                "base_checkpoint_no": int(base_no),
+                "chain_len": int(chain_len),
+            }
+        else:
+            proposals.append(("main", self._fingerprint_all(arrays), True))
+            if aux is not None:
+                proposals.append(
+                    ("aux", self._fingerprint_all(aux), True)
+                )
+
+        def shard_arrays_fn(dir_is_delta):
+            # the shard tier follows the published dir's shape: full
+            # shard into a full dir (and reset fingerprints), delta
+            # shard into a delta link. A fresh saver (elastic restart)
+            # has no fingerprints and fails open to a full shard.
+            # Without local_vars there is no shard payload at all;
+            # WITH them, even an empty snapshot dict must flow through
+            # (never None — _write_rank_shard would re-read the live
+            # scope on the publisher thread)
+            if self._local_vars is None:
+                return None
+            if not snap.local_arrays:
+                return dict(snap.local_arrays)
+            if not dir_is_delta:
+                proposals.append(
+                    ("shard", self._fingerprint_all(snap.local_arrays),
+                     True)
+                )
+                return dict(snap.local_arrays)
+            filtered, fps = self._filter_unchanged(
+                snap.local_arrays, self._fp["shard"]
+            )
+            proposals.append(("shard", fps, False))
+            return filtered
+
+        t0 = time.perf_counter()
+        no = self._fleet.save_check_point(
+            self._executor, self.path, snap.status,
+            main_program=self._main_program, fs=self._fs,
+            remain_all_checkpoint=self._remain_all,
+            max_checkpoint_num=self._max_num,
+            local_vars=self._local_vars, per_rank=self._per_rank,
+            shard_wait_timeout=self._shard_wait_timeout,
+            snapshot=snap._replace_payloads(arrays, aux)
+            if (arrays is not snap.arrays or aux is not snap.aux)
+            else snap,
+            heartbeat=self._heartbeat, compress=self._compress,
+            delta_meta=delta_meta, shard_arrays_fn=shard_arrays_fn,
+        )
+        _obs.observe(
+            "checkpoint.async_publish_latency", time.perf_counter() - t0
+        )
+        return no
+
+    def _run(self):
+        from .. import observability as _obs
+
+        while True:
+            with self._lock:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None:
+                    break  # closed and drained
+                job = self._pending
+                self._pending = None
+                self._inflight = job
+                self._update_pending_gauge_locked()
+                self._cond.notify_all()
+            try:
+                no = self._publish(job)
+            except BaseException as e:  # noqa: BLE001 — surfaced to callers
+                _obs.add("checkpoint.publish_failures")
+                with self._lock:
+                    self._failed = e
+                    self._inflight = None
+                    job.error = e
+                    job._event.set()
+                    p, self._pending = self._pending, None
+                    if p is not None:
+                        p.error = e
+                        p._event.set()
+                    self._update_pending_gauge_locked()
+                    self._cond.notify_all()
+                break
+            with self._lock:
+                self._inflight = None
+                self._last_no = no
+                if job.is_full:
+                    self._published_since_full = 0
+                elif self._published_since_full is not None:
+                    self._published_since_full += 1
+                self._row_marks.update(job.row_marks)
+                for kind, fp, replace in job._fp_proposals:
+                    if replace:
+                        self._fp[kind] = dict(fp)
+                    else:
+                        self._fp[kind].update(fp)
+                job.checkpoint_no = no
+                job._event.set()
+                self._update_pending_gauge_locked()
+                self._cond.notify_all()
 
 
 class CollectiveOptimizer:

@@ -50,9 +50,23 @@ __all__ = [
     "load_inference_model",
     "prune",
     "verify_checkpoint_dir",
+    "snapshot_persistables",
+    "save_arrays",
+    "read_persistables",
+    "apply_persistables",
+    "merge_checkpoint_arrays",
 ]
 
 MANIFEST_NAME = "manifest.json"
+
+#: npz-key suffixes of one row-level delta entry: ``<var>@@rows`` holds
+#: only the dim-0 rows that changed since the chain's previous save,
+#: ``<var>@@ridx`` their indices into the full array. Written by the
+#: async checkpointer's tiered-delta path (fleet/collective.py) when a
+#: row oracle — e.g. the embedding cache's write-back tick — can name the
+#: dirty rows; :func:`merge_checkpoint_arrays` scatters them back.
+ROW_VAL_MARK = "@@rows"
+ROW_IDX_MARK = "@@ridx"
 
 
 # -- durable write/verify helpers -------------------------------------------
@@ -91,6 +105,19 @@ def _atomic_write(path, write_fn):
         except OSError:
             pass
         raise
+
+
+def _private_host_copy(val):
+    """Host ndarray of `val` guaranteed not to alias caller-visible
+    memory — the snapshot-immutability contract shared by every staging
+    path (replicated payload, per-rank shard, aux). np.asarray of a jax
+    array already materializes a fresh host buffer unless it returns a
+    zero-copy view; numpy inputs come back as themselves; both aliasing
+    shapes get an explicit copy."""
+    arr = np.asarray(val)
+    if arr is val or getattr(arr, "base", None) is not None:
+        arr = arr.copy()
+    return arr
 
 
 def _array_entry(arr):
@@ -189,15 +216,36 @@ def verify_checkpoint_dir(dirname, filename=None):
     _load_npz_verified(path)
 
 
-def _collect(program, scope, predicate, exclude=frozenset()):
+def _collect(program, scope, predicate, exclude=frozenset(), progress=None,
+             copy=False, reuse_cache=None):
+    """`progress`: zero-arg callable invoked once per collected var — the
+    sync checkpoint path threads a heartbeat touch through it so a save
+    big enough to span a watchdog timeout still reads as alive.
+    `copy`: force a private host buffer even for numpy-backed scope values
+    (the snapshot stage's immutability contract; jax arrays already
+    materialize a fresh host copy under np.asarray).
+    `reuse_cache`: caller-owned ``{name: (scope value, host copy)}`` map;
+    a var whose scope value is still the IDENTICAL object as at the last
+    snapshot reuses that host copy instead of re-copying — sound because
+    the framework replaces values via ``scope.set_var`` (jax arrays are
+    immutable) rather than mutating them in place, and it makes repeated
+    snapshots O(changed bytes): untouched cold state (sharded embedding
+    tiers, frozen towers) costs nothing per save."""
     out = {}
     skipped = []
     for var in program.list_vars():
         if not predicate(var) or var.name in exclude:
             continue
+        if progress is not None:
+            progress()
         val = scope.find_var(var.name)
         if val is None:
             continue
+        if reuse_cache is not None:
+            ent = reuse_cache.get(var.name)
+            if ent is not None and ent[0] is val:
+                out[var.name] = ent[1]
+                continue
         if not _is_fully_addressable(val):
             # multi-process array: a REPLICATED value is recoverable from
             # the local replica; a genuinely cross-process-sharded value
@@ -209,7 +257,10 @@ def _collect(program, scope, predicate, exclude=frozenset()):
             else:
                 skipped.append(var.name)
             continue
-        out[var.name] = np.asarray(val)
+        arr = _private_host_copy(val) if copy else np.asarray(val)
+        out[var.name] = arr
+        if reuse_cache is not None:
+            reuse_cache[var.name] = (val, arr)
     if skipped:
         import warnings
 
@@ -260,25 +311,110 @@ def save_params(executor, dirname, main_program=None, filename=None):
 
 
 def save_persistables(executor, dirname, main_program=None, filename=None,
-                      exclude=None):
+                      exclude=None, progress=None, compress=False):
     """`exclude`: var names to leave out of the payload — the per-rank
     checkpoint machinery passes its `local_vars` here so state that each
     rank persists in its own shard is not duplicated (or warned about)
     in the replicated payload."""
     _save_vars(dirname, main_program, _is_persistable, filename,
-               exclude=exclude)
+               exclude=exclude, progress=progress, compress=compress)
 
 
-def _save_vars(dirname, main_program, predicate, filename, exclude=None):
+def _save_vars(dirname, main_program, predicate, filename, exclude=None,
+               progress=None, compress=False):
     fault_point("io.save")
     program = main_program or default_main_program()
     scope = global_scope()
     arrays = _collect(program, scope, predicate,
-                      exclude=frozenset(exclude or ()))
+                      exclude=frozenset(exclude or ()), progress=progress)
+    save_arrays(dirname, arrays, filename=filename, compress=compress)
+
+
+def snapshot_persistables(main_program=None, scope=None, exclude=None,
+                          progress=None, reuse_cache=None):
+    """The snapshot half of a save: device→host copies of every
+    scope-resident persistable, returned as a private ``{name: ndarray}``
+    staging dict — later training steps cannot alter it, so a background
+    publisher can serialize/CRC/fsync it entirely off the step loop
+    (the async checkpoint pipeline's only on-loop cost). With a
+    `reuse_cache` (AsyncCheckpointer keeps one per pipeline), values the
+    scope still holds by identity since the last snapshot are not
+    re-copied — the steady-state snapshot stall is O(changed bytes)."""
+    program = main_program or default_main_program()
+    scope = scope if scope is not None else global_scope()
+    return _collect(program, scope, _is_persistable,
+                    exclude=frozenset(exclude or ()), progress=progress,
+                    copy=True, reuse_cache=reuse_cache)
+
+
+def save_arrays(dirname, arrays, filename=None, compress=False,
+                manifest_name=None):
+    """The serialize half of a save: write a pre-collected host payload as
+    a durable CRC-manifested dir (temp+fsync+``os.replace``). `compress`
+    swaps ``np.savez`` for ``np.savez_compressed`` (zlib DEFLATE inside
+    the zip container); manifest CRCs cover the raw array bytes, so
+    verification is compression-agnostic. Returns the payload path."""
     os.makedirs(dirname, exist_ok=True)
     path = os.path.join(dirname, filename or "__params__.npz")
-    _atomic_write(path, lambda f: np.savez(f, **arrays))
-    _write_manifest(os.path.join(dirname, MANIFEST_NAME), path, arrays)
+    writer = np.savez_compressed if compress else np.savez
+    _atomic_write(path, lambda f: writer(f, **arrays))
+    _write_manifest(os.path.join(dirname, manifest_name or MANIFEST_NAME),
+                    path, arrays)
+    return path
+
+
+def read_persistables(dirname, filename=None):
+    """Verified host arrays of a checkpoint dir — no scope mutation (the
+    read half of :func:`load_persistables`; delta-chain loads read every
+    chain link this way, merge, then apply once)."""
+    fault_point("io.load")
+    path = os.path.join(dirname, filename or "__params__.npz")
+    return _load_npz_verified(path)
+
+
+def apply_persistables(arrays, main_program=None, scope=None):
+    """Write pre-verified host arrays into the scope (the apply half of
+    :func:`load_persistables`), then re-derive any ZeRO shards."""
+    import jax.numpy as jnp
+
+    program = main_program or default_main_program()
+    scope = scope if scope is not None else global_scope()
+    for name, arr in arrays.items():
+        scope.set_var(name, jnp.asarray(arr))
+    _rederive_zero_shards(program, scope, set(arrays))
+
+
+def merge_checkpoint_arrays(acc, arrays, origin):
+    """Overlay one checkpoint payload onto the accumulated chain state
+    (delta-chain reconstruction, oldest→newest): plain names replace
+    outright; a row-delta pair (``<name>@@rows`` + ``<name>@@ridx``)
+    scatters the changed rows onto the base value, which must already be
+    in `acc` from an earlier link. Returns `acc`."""
+    for name in arrays:
+        if name.endswith(ROW_IDX_MARK):
+            continue
+        arr = arrays[name]
+        if name.endswith(ROW_VAL_MARK):
+            base_name = name[: -len(ROW_VAL_MARK)]
+            idx = arrays.get(base_name + ROW_IDX_MARK)
+            if idx is None:
+                raise CheckpointCorruptionError(
+                    f"delta payload {origin!r}: {name!r} has no matching "
+                    f"{base_name + ROW_IDX_MARK!r} index array"
+                )
+            base = acc.get(base_name)
+            if base is None:
+                raise CheckpointCorruptionError(
+                    f"delta payload {origin!r}: row delta for {base_name!r} "
+                    "has no base array earlier in the chain (was the base "
+                    "checkpoint rotated away?)"
+                )
+            base = np.array(base, copy=True)
+            base[np.asarray(idx, dtype=np.int64)] = arr
+            acc[base_name] = base
+        else:
+            acc[name] = arr
+    return acc
 
 
 def load_params(executor, dirname, main_program=None, filename=None):
@@ -290,18 +426,10 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 
 
 def _load_vars(dirname, main_program, filename):
-    import jax.numpy as jnp
-
-    fault_point("io.load")
-    program = main_program or default_main_program()
-    scope = global_scope()
-    path = os.path.join(dirname, filename or "__params__.npz")
     # verify the WHOLE payload before the first scope write: a corrupt
     # checkpoint must never leave the scope half-overwritten
-    arrays = _load_npz_verified(path)
-    for name, arr in arrays.items():
-        scope.set_var(name, jnp.asarray(arr))
-    _rederive_zero_shards(program, scope, set(arrays))
+    arrays = read_persistables(dirname, filename)
+    apply_persistables(arrays, main_program)
 
 
 def _rederive_zero_shards(program, scope, loaded_names):

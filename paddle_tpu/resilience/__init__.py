@@ -43,6 +43,7 @@ from .guard import TrainGuard  # noqa: F401
 from .health import (  # noqa: F401
     PREEMPTION_EXIT_CODE,
     Heartbeat,
+    LivenessPulse,
     StepWatchdog,
     heartbeat_path,
     read_beat,

@@ -36,8 +36,15 @@ fetch),
 ``guard.step`` (TrainGuard pre-step: corrupt_point over the feed, so
 ``nonfinite`` fabricates a divergence and ``hang`` a stuck step),
 ``health.beat`` (Heartbeat.beat: ``hang`` makes the beat never land, what
-a stalled rank looks like to the launcher). The catalog is documented in
-README §Resilience.
+a stalled rank looks like to the launcher),
+``checkpoint.snapshot`` (the async checkpointer's device→host staging
+stage, on the step-loop thread — retried under the ``checkpoint.snapshot``
+policy; ``hang`` stalls the step exactly where a slow host copy would)
+and ``checkpoint.publish`` (inside the background publisher's — and the
+sync save's — write-and-publish body, within the ``checkpoint.save`` /
+``checkpoint.shard`` retry scope, so raising kinds heal and ``hang``
+deterministically wedges a publish mid-flight for SIGKILL chaos). The
+catalog is documented in README §Resilience.
 """
 
 from __future__ import annotations

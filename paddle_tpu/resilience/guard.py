@@ -86,6 +86,15 @@ class TrainGuard:
     executor, program:  what to run (program=None → default main program).
     fleet, checkpoint_dir, fs:  enable rollback (load_check_point) and the
         final preemption checkpoint (save_check_point).
+    checkpointer:  a fleet.AsyncCheckpointer; the guard then (a) quiesces
+        it before a rollback — cancel the queued snapshot, await the
+        in-flight publish — so rollback always restores the newest
+        COMMITTED checkpoint and never races a publish of the diverged
+        state, (b) routes the SIGTERM drain checkpoint through it and
+        awaits the publish before exiting 75 (never a half-published
+        final checkpoint), and (c) wires the guard's heartbeat/watchdog
+        into its publish liveness pulse. fleet/checkpoint_dir/fs default
+        from the checkpointer when omitted.
     max_bad_steps:  consecutive non-finite steps before a rollback (or
         TrainingDivergedError when rollback is unavailable). Default 3.
     max_rollbacks:  rollback budget; the next rollback request past it
@@ -119,6 +128,8 @@ class TrainGuard:
         watchdog_timeout=None,
         exit_on_preempt=True,
         train_status=None,
+        checkpointer=None,
+        quiesce_timeout=600.0,
     ):
         self.executor = executor
         self.program = program
@@ -126,6 +137,16 @@ class TrainGuard:
         self.fleet = fleet
         self.checkpoint_dir = checkpoint_dir
         self.fs = fs
+        self.checkpointer = checkpointer
+        self.quiesce_timeout = quiesce_timeout
+        if checkpointer is not None:
+            # rollback + drain run against the checkpointer's store
+            if self.fleet is None:
+                self.fleet = checkpointer._fleet
+            if self.checkpoint_dir is None:
+                self.checkpoint_dir = checkpointer.path
+            if self.fs is None:
+                self.fs = checkpointer._fs
         self.max_bad_steps = int(max_bad_steps)
         self.max_rollbacks = int(max_rollbacks)
         self.amp = amp
@@ -162,6 +183,14 @@ class TrainGuard:
             self.watchdog = StepWatchdog(
                 self._watchdog_timeout, name="guard"
             ).start()
+        if (
+            self.checkpointer is not None
+            and self.checkpointer._heartbeat is None
+        ):
+            # publish-time liveness: the publisher thread pulses the
+            # guard's heartbeat + watchdog so a slow async publish never
+            # reads as a hung step
+            self.checkpointer._heartbeat = self._touch_liveness
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -265,6 +294,27 @@ class TrainGuard:
         # TrainStatus(-1) BOTH for "nothing on disk" (cold start, scope
         # untouched) and for a real checkpoint whose status predates the
         # first epoch — only the former means rollback is impossible.
+        if self.checkpointer is not None:
+            # an async publish may be racing this rollback: drop the
+            # queued snapshot (captured from the diverging timeline) and
+            # await the in-flight publish, so load_check_point below sees
+            # only committed checkpoints — never an uncommitted dir, and
+            # never a later-landing publish of the state being abandoned.
+            # BOUNDED wait: the publisher pulses this guard's own
+            # heartbeat/watchdog, so an unbounded quiesce on a wedged
+            # publish would hang forever while looking perfectly alive —
+            # fail loudly instead
+            if not self.checkpointer.quiesce(
+                cancel_pending=True, timeout=self.quiesce_timeout
+            ):
+                from ..errors import ExecutionTimeoutError
+
+                raise ExecutionTimeoutError(
+                    "rollback blocked: the in-flight async checkpoint "
+                    f"publish did not settle within {self.quiesce_timeout}"
+                    "s (wedged upload?); refusing to wait forever behind "
+                    "a liveness pulse that masks the stall"
+                )
         if (
             self.fleet is not None and self.checkpoint_dir is not None
             and self.rollbacks < self.max_rollbacks
@@ -308,10 +358,19 @@ class TrainGuard:
                 self.train_status if self.train_status is not None
                 else TrainStatus(-1)
             )
-            self.fleet.save_check_point(
-                self.executor, self.checkpoint_dir, status,
-                main_program=self.program, fs=self.fs,
-            )
+            if self.checkpointer is not None:
+                # drain through the async pipeline, then AWAIT the
+                # publish: exit 75 promises a committed final checkpoint,
+                # never a half-published one (a publish failure surfaces
+                # here and the preemption contract is abandoned loudly)
+                self.checkpointer.save(status)
+                self.checkpointer.wait()
+            else:
+                self.fleet.save_check_point(
+                    self.executor, self.checkpoint_dir, status,
+                    main_program=self.program, fs=self.fs,
+                    heartbeat=self._touch_liveness,
+                )
         if self.exit_on_preempt:
             raise SystemExit(PREEMPTION_EXIT_CODE)
         return None
@@ -366,5 +425,15 @@ class TrainGuard:
     def _beat(self):
         if self.heartbeat is not None:
             self.heartbeat.beat()
+        if self.watchdog is not None:
+            self.watchdog.touch()
+
+    def _touch_liveness(self):
+        """Alive-but-same-step liveness for long checkpoint publishes:
+        refresh the beat file's timestamp and the watchdog without
+        advancing the per-step beat counter (safe from the publisher
+        thread — Heartbeat and StepWatchdog are both lock-protected)."""
+        if self.heartbeat is not None:
+            self.heartbeat.touch()
         if self.watchdog is not None:
             self.watchdog.touch()

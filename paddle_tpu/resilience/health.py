@@ -36,6 +36,7 @@ __all__ = [
     "HEARTBEAT_TIMEOUT_ENV",
     "PREEMPTION_EXIT_CODE",
     "Heartbeat",
+    "LivenessPulse",
     "StepWatchdog",
     "heartbeat_path",
     "read_beat",
@@ -101,6 +102,10 @@ class Heartbeat:
         self.rank = int(rank)
         self.step = 0
         self._time = _time
+        # beat() is called from the step loop AND (during an async
+        # checkpoint publish) from the publisher's liveness pulse; the
+        # counter bump + tmp/replace pair must not interleave
+        self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
 
     @property
@@ -116,8 +121,20 @@ class Heartbeat:
         # the chaos seam: an armed "hang" sleeps HERE, i.e. the beat never
         # lands — exactly what a stuck collective looks like to a watcher
         fault_point("health.beat")
-        self.step = self.step + 1 if step is None else int(step)
-        payload = {"rank": self.rank, "step": self.step, "time": self._time()}
+        with self._lock:
+            self.step = self.step + 1 if step is None else int(step)
+            payload = self._publish_locked()
+        from .. import observability as _obs
+
+        _obs.add("resilience.heartbeats")
+        return payload
+
+    def _publish_locked(self):
+        """Write the current counter + a fresh timestamp to the beat file
+        (lock held by the caller)."""
+        payload = {
+            "rank": self.rank, "step": self.step, "time": self._time()
+        }
         fd, tmp = tempfile.mkstemp(
             dir=self.directory, prefix=f"hb_rank{self.rank}.tmp."
         )
@@ -131,10 +148,62 @@ class Heartbeat:
             except OSError:
                 pass
             raise
-        from .. import observability as _obs
-
-        _obs.add("resilience.heartbeats")
         return payload
+
+    def touch(self):
+        """Republish the CURRENT step with a fresh wall-clock time — an
+        "alive, still on the same step" beat. A slow checkpoint publish
+        pulses this so the launcher's stale-beat watcher never mistakes a
+        long fsync/upload for a hung step. The counter is read and
+        republished under the lock, so a concurrent per-step ``beat()``
+        can never be regressed by a racing touch (the step counter stays
+        monotonic per *training* step, which restart logic relies on).
+        Deliberately NOT routed through the ``health.beat`` fault seam:
+        a touch is a liveness refresh, not a step beat, and it must not
+        consume the seam's seeded draws."""
+        with self._lock:
+            return self._publish_locked()
+
+
+class LivenessPulse:
+    """Context manager: a daemon thread calling `touch_cb` every
+    `interval` seconds while the body runs.
+
+    Wrapped around a checkpoint save — synchronous or on the async
+    publisher thread — it keeps heartbeats/watchdog touches landing while
+    a single slow stage (one big fsync, one slow ``fs.upload``) blocks;
+    per-stage beats alone would starve exactly when they matter most.
+    Callback exceptions are swallowed: a broken beat must not fail a
+    save."""
+
+    def __init__(self, touch_cb, interval=0.25):
+        self._cb = touch_cb
+        self._interval = float(interval)
+        self._stop = threading.Event()
+        self._thread = None
+
+    def __enter__(self):
+        if self._cb is not None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="liveness-pulse"
+            )
+            self._thread.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self._interval * 4 + 1.0)
+        return False
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._cb()
+            except Exception:
+                pass
 
 
 class StepWatchdog:

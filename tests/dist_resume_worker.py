@@ -18,6 +18,14 @@ the checkpoint's step, fast-skip to the cursor, replay. The audit
 (tools/resume_audit.py) diffs final weights and the consumed log bitwise
 against an uninterrupted control run.
 
+PADDLE_TPU_RESUME_ASYNC=1 (tools/resume_audit.py --async): checkpoints
+go through fleet.AsyncCheckpointer (delta chains, full_every=2) instead
+of the synchronous save, and on attempt 0 the kill rank arms a ``hang``
+fault on the ``checkpoint.publish`` seam after its first committed save
+— so the SIGKILL lands while an async publish is IN FLIGHT and the
+restart must resume from the newest *committed* checkpoint (the wedged
+publish left only a ``*.tmp`` dir behind).
+
 argv: out_dir [kill_rank]   (kill_rank defaults to -1 = never kill)
 """
 
@@ -120,6 +128,8 @@ def main(out_dir, kill_rank=-1):
         if getattr(v, "persistable", False) and not getattr(v, "is_data", False)
     ]
 
+    async_mode = os.environ.get("PADDLE_TPU_RESUME_ASYNC") == "1"
+
     status = fleet.load_check_point(exe, ckpt_dir)
     step = int(status.global_step)
     if step > 0:
@@ -136,6 +146,14 @@ def main(out_dir, kill_rank=-1):
     else:
         start_epoch = 0
         open(log_path, "w").close()
+
+    saver = None
+    if async_mode:
+        saver = fc.AsyncCheckpointer(
+            fleet, ckpt_dir, executor=exe, main_program=main_prog,
+            local_vars=local_vars, remain_all_checkpoint=True,
+            delta=True, full_every=2,
+        )
 
     logf = open(log_path, "a")
     for epoch in range(start_epoch, EPOCHS):
@@ -155,11 +173,28 @@ def main(out_dir, kill_rank=-1):
                     epoch_no=epoch - 1, global_step=step,
                     program=main_prog, loader=loader,
                 )
-                fleet.save_check_point(
-                    exe, ckpt_dir, st, local_vars=local_vars,
-                    remain_all_checkpoint=True,
-                )
+                if saver is not None:
+                    saver.save(st)
+                    if (step == CKPT_EVERY and rank == kill_rank
+                            and attempt == 0):
+                        # make one checkpoint durably committed, then wedge
+                        # the NEXT publish mid-flight: the step-11 SIGKILL
+                        # lands while the step-10 publish is hung — the
+                        # "killed mid-async-publish" shape the audit proves
+                        saver.wait()
+                        from paddle_tpu.resilience import faults
+
+                        faults.inject(
+                            "checkpoint.publish", "hang", 1.0, 0, 1
+                        )
+                else:
+                    fleet.save_check_point(
+                        exe, ckpt_dir, st, local_vars=local_vars,
+                        remain_all_checkpoint=True,
+                    )
     logf.close()
+    if saver is not None:
+        saver.close()
 
     scope = fluid.framework.scope.global_scope()
     arrays = {

@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Async checkpoint bench: measure the save stall coming OFF the step
+loop, and delta shards cutting repeat-save bytes on an embedding-cached
+model. Self-gating (BASELINE.md r12 acceptance):
+
+* **stall leg** — a model with checkpoint-heavy persistables trains
+  while checkpointing synchronously vs through fleet.AsyncCheckpointer.
+  Step-time jitter during a save (save-step wall minus the median plain
+  step) must drop >= 10x async vs sync: the async step loop pays only
+  the device→host snapshot, while serialize/CRC/fsync/publish/verify
+  run on the publisher thread.
+* **delta leg** — the fused DeepFM with the hot-tier cache checkpoints
+  twice through the async pipeline (delta=True, compressed, row oracles
+  keyed off the cache's write-back ticks): the second (delta) checkpoint
+  dir must be <= 60% of the full save's bytes, and the delta-chain
+  reload must be bitwise identical to the live state.
+
+Usage: python tools/bench_async_checkpoint.py [--smoke] [--dump OUT.json]
+Exit 0 only if every gate holds.
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _du(path):
+    from paddle_tpu.fleet.collective import _dir_bytes
+
+    return _dir_bytes(path)
+
+
+def bench_stall(work, ballast_mb, steps, save_every):
+    """Sync-vs-async save stall on one model; returns the gate dict."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.fleet import collective as fc
+    from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    rows = int(ballast_mb * 1024 * 1024 / (64 * 4))
+    rng = np.random.RandomState(0)
+    fleet = fc.Fleet()
+    fleet.init(UserDefinedRoleMaker())
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        scope = Scope()
+        with fluid.program_guard(main, startup), unique_name.guard():
+            x = fluid.data("x", [-1, 16])
+            y = fluid.data("y", [-1, 1])
+            pred = layers.fc(x, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+            # checkpoint-heavy state that is NOT touched every step —
+            # embedding-table-shaped ballast the save must still move
+            main.global_block.create_parameter(
+                "ck_ballast", [rows, 64], "float32"
+            )
+        with scope_guard(scope):
+            fluid.Executor().run(startup, scope=scope)
+        scope.set_var(
+            "ck_ballast",
+            rng.randn(rows, 64).astype(np.float32),
+        )
+        return main, scope, loss
+
+    def run(mode, path):
+        main, scope, loss = build()
+        exe = fluid.Executor()
+        saver = None
+        if mode == "async":
+            saver = fc.AsyncCheckpointer(
+                fleet, path, executor=exe, main_program=main, scope=scope,
+                remain_all_checkpoint=True,
+            )
+        plain, stalls = [], []
+        with scope_guard(scope):
+            for i in range(steps):
+                xa = rng.randn(64, 16).astype(np.float32)
+                feed = {"x": xa, "y": xa @ np.ones((16, 1), np.float32)}
+                t0 = time.perf_counter()
+                exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+                if (i + 1) % save_every == 0:
+                    st = fc.TrainStatus(0, global_step=i + 1)
+                    if saver is not None:
+                        saver.save(st)
+                    else:
+                        fleet.save_check_point(
+                            exe, path, st, main_program=main,
+                            remain_all_checkpoint=True,
+                        )
+                    stalls.append(time.perf_counter() - t0)
+                else:
+                    plain.append(time.perf_counter() - t0)
+        if saver is not None:
+            saver.wait()
+            saver.close()
+        base = float(np.median(plain))
+        jitter = [max(0.0, s - base) for s in stalls]
+        return base, float(np.median(jitter))
+
+    sync_base, sync_jitter = run("sync", os.path.join(work, "sync_ck"))
+    async_base, async_jitter = run("async", os.path.join(work, "async_ck"))
+    ratio = sync_jitter / max(async_jitter, 1e-9)
+    print(f"stall leg: plain step ~{sync_base * 1e3:.1f} ms; save-step "
+          f"jitter sync {sync_jitter * 1e3:.1f} ms vs async "
+          f"{async_jitter * 1e3:.1f} ms -> {ratio:.1f}x reduction "
+          f"({ballast_mb} MB checkpoint payload)")
+    # the async leg's committed checkpoint must be loadable
+    import paddle_tpu as fluid_mod  # noqa: F401
+
+    status = fleet.load_check_point(
+        fluid.Executor(), os.path.join(work, "async_ck")
+    )
+    assert status.global_step > 0, status
+    return {
+        "payload_mb": ballast_mb,
+        "sync_jitter_ms": sync_jitter * 1e3,
+        "async_jitter_ms": async_jitter * 1e3,
+        "jitter_reduction": ratio,
+        "gate": ratio >= 10.0,
+    }
+
+
+def bench_delta(work, vocab, steps):
+    """Repeat-save bytes on an embedding-cached model: full vs delta."""
+    import paddle_tpu as fluid
+    from paddle_tpu.embedding import EmbeddingEngine, fuse_lookups
+    from paddle_tpu.fleet import collective as fc
+    from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.models.deepfm import DeepFMConfig, deepfm
+
+    cfg = DeepFMConfig(vocab_size=vocab, num_fields=4, embed_dim=16,
+                       mlp_sizes=(16,))
+    b = 16
+    rng = np.random.RandomState(5)
+    fleet = fc.Fleet()
+    fleet.init(UserDefinedRoleMaker())
+    path = os.path.join(work, "delta_ck")
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    scope = Scope()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        ids = fluid.data("feat_ids", [b, cfg.num_fields], "int64")
+        label = fluid.data("label", [b, 1], "float32")
+        loss, _p = deepfm(ids, label, cfg, per_slot=True)
+        fuse_lookups(main)
+        engine = EmbeddingEngine(main, startup, hot_rows=cfg.vocab_size // 16)
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup, scope=scope)
+        engine.attach(scope)
+
+        saver = fc.AsyncCheckpointer(
+            fleet, path, executor=exe, main_program=main, scope=scope,
+            delta=True, full_every=8, compress=True, queue_policy="block",
+            remain_all_checkpoint=True,
+            row_oracles=engine.delta_row_oracles(),
+        )
+
+        def train(n):
+            for _ in range(n):
+                idv = (cfg.vocab_size * rng.power(0.4, (b, cfg.num_fields)))
+                idv = idv.astype(np.int64)
+                feed = engine.prepare_feed(
+                    {"feat_ids": idv,
+                     "label": (idv[:, :1] % 2 == 0).astype(np.float32)},
+                    scope,
+                )
+                exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+
+        train(steps)
+        saver.save(fc.TrainStatus(0, global_step=steps),
+                   aux=engine.state_dict(scope)).result(timeout=300)
+        train(steps)
+        saver.save(fc.TrainStatus(0, global_step=2 * steps),
+                   aux=engine.state_dict(scope)).result(timeout=300)
+        saver.close()
+        live_aux = engine.state_dict(scope)
+        live_scope = {
+            v.name: np.asarray(scope.find_var(v.name)).copy()
+            for v in main.list_vars()
+            if v.persistable and scope.find_var(v.name) is not None
+        }
+
+    full_b = _du(os.path.join(path, "__paddle_checkpoint__0"))
+    delta_b = _du(os.path.join(path, "__paddle_checkpoint__1"))
+    ratio = delta_b / full_b
+    print(f"delta leg: vocab {vocab} hot {cfg.vocab_size // 16}; full save "
+          f"{full_b / 1e3:.1f} KB -> repeat (delta) save "
+          f"{delta_b / 1e3:.1f} KB ({ratio:.0%}), compressed, row deltas "
+          "keyed off cache write-back ticks")
+
+    # chain reload must be bitwise identical to the live state
+    scope2 = Scope()
+    with scope_guard(scope2):
+        exe.run(startup, scope=scope2)
+        engine.attach(scope2)
+        status = fleet.load_check_point(
+            exe, path, main_program=main, load_aux=True
+        )
+        engine.load_state_dict(status.aux, scope2)
+        for name, want in live_aux.items():
+            got = status.aux[name]
+            assert np.asarray(got).tobytes() == want.tobytes(), name
+        for name, want in live_scope.items():
+            got = np.asarray(scope2.find_var(name))
+            assert got.tobytes() == want.tobytes(), name
+    print("delta leg: chain reload (full + 1 delta) bitwise == live state")
+    return {
+        "full_bytes": full_b,
+        "delta_bytes": delta_b,
+        "delta_ratio": ratio,
+        "gate": ratio <= 0.6,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized payloads (smaller ballast/vocab)")
+    ap.add_argument("--dump", default=None,
+                    help="write the observability snapshot JSON here")
+    ap.add_argument("--keep", action="store_true")
+    args = ap.parse_args(argv)
+
+    ballast_mb = 24 if args.smoke else 96
+    vocab = 8192 if args.smoke else 65536
+    work = tempfile.mkdtemp(prefix="paddle_tpu_async_ck_bench_")
+    try:
+        stall = bench_stall(work, ballast_mb, steps=12, save_every=4)
+        delta = bench_delta(work, vocab, steps=4)
+        from paddle_tpu import observability
+
+        if args.dump:
+            observability.dump(args.dump)
+        ok = stall["gate"] and delta["gate"]
+        print(f"gates: jitter reduction {stall['jitter_reduction']:.1f}x "
+              f"(need >= 10), repeat-save ratio {delta['delta_ratio']:.0%} "
+              f"(need <= 60%) -> {'OK' if ok else 'FAIL'}")
+        return 0 if ok else 1
+    finally:
+        if not args.keep:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,7 +41,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "dist_resume_worker.py")
 
 
-def run_pod(out_dir, kill, started_port, sharded=False):
+def run_pod(out_dir, kill, started_port, sharded=False, async_=False):
     os.makedirs(out_dir, exist_ok=True)
     cmd = [
         sys.executable, "-m", "paddle_tpu.distributed.launch",
@@ -57,6 +57,8 @@ def run_pod(out_dir, kill, started_port, sharded=False):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if sharded:
         env["PADDLE_TPU_RESUME_SHARDED"] = "1"
+    if async_:
+        env["PADDLE_TPU_RESUME_ASYNC"] = "1"
     proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
     if proc.returncode != 0:
         for rank in (0, 1):
@@ -152,6 +154,34 @@ def assert_resume_fired(kill_dir):
     )
 
 
+def assert_async_pipeline_audited(kill_dir):
+    """The --async leg proved something only if the surviving rank's
+    checkpoints really went through the async pipeline (snapshot/publish
+    stage histograms, at least one delta link) and the killed rank's
+    wedged publish left only tmp debris — every numbered checkpoint dir
+    on disk is committed (load-candidate) state."""
+    obs = json.load(open(os.path.join(kill_dir, "obs_rank0_attempt0.json")))
+    c = obs.get("counters", {})
+    h = obs.get("histograms", {})
+    assert c.get("checkpoint.async_saves", 0) >= 3, c
+    assert c.get("checkpoint.delta_saves", 0) >= 1, (
+        "no delta checkpoint was published on the async leg", c)
+    assert h["checkpoint.snapshot_latency"]["count"] >= 3, h.keys()
+    assert h["checkpoint.publish_latency"]["count"] >= 1, h.keys()
+    ckpt_root = os.path.join(kill_dir, "ckpts")
+    bad = [d for d in os.listdir(ckpt_root) if d.endswith(".tmp")]
+    # a wedged publish may leave a *.tmp shard dir INSIDE a checkpoint —
+    # never a torn numbered checkpoint at the top level; committed dirs
+    # must each carry a commit record
+    for d in os.listdir(ckpt_root):
+        full = os.path.join(ckpt_root, d)
+        if d.startswith("__paddle_checkpoint__") and not d.endswith(".tmp"):
+            assert os.path.exists(os.path.join(full, "commit.json")), d
+    print(f"async pipeline audited: {c['checkpoint.async_saves']} async "
+          f"saves, {c['checkpoint.delta_saves']} delta links, "
+          f"{len(bad)} uncommitted tmp dirs (ignored by load)")
+
+
 def audit_v1_compat(work_dir):
     """A v1 (epoch-only) checkpoint — the PR-2/3 on-disk format: payload +
     manifest + bare train_status.json, no commit record, no shards — must
@@ -201,13 +231,25 @@ def assert_sharded_state_audited(out_dir, nranks=2):
         )
 
 
-def audit_embedding(work_dir, sharded=False):
+def _du(path):
+    from paddle_tpu.fleet.collective import _dir_bytes
+
+    return _dir_bytes(path)
+
+
+def audit_embedding(work_dir, sharded=False, async_=False):
     """PR-11 leg: a checkpoint carrying CACHED (host-cold/device-hot) or
     ps-SHARDED embedding tables must resume bitwise. In-process: train the
     fused DeepFM 4 steps, checkpoint (persistables + engine host state +
     RNG), rebuild everything from scratch, restore, train 4 more — the
     continuation's losses and final flushed table state must be bitwise
-    identical to an uninterrupted 8-step run."""
+    identical to an uninterrupted 8-step run.
+
+    ``async_``: route the checkpoints through fleet.AsyncCheckpointer
+    instead — a full save at step 2 and a DELTA link at step 4 (row
+    oracles keyed off the embedding cache's write-back ticks, compressed
+    payloads, engine host state as the aux payload) — and resume through
+    ``Fleet.load_check_point(load_aux=True)``'s chain reconstruction."""
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
@@ -216,7 +258,10 @@ def audit_embedding(work_dir, sharded=False):
     from paddle_tpu.framework.scope import Scope
     from paddle_tpu.models.deepfm import DeepFMConfig, deepfm
 
-    cfg = DeepFMConfig(vocab_size=256, num_fields=4, embed_dim=8,
+    # vocab >> hot tier (the capacity-beyond-device shape the cache
+    # exists for): only the resident slice writes back between saves, so
+    # the --async leg's row-delta payloads stay far below a full save
+    cfg = DeepFMConfig(vocab_size=2048, num_fields=4, embed_dim=8,
                        mlp_sizes=(16,))
     b, total_steps, ckpt_step = 16, 8, 4
     rng = np.random.RandomState(5)
@@ -239,7 +284,7 @@ def audit_embedding(work_dir, sharded=False):
             engine = None
             if not sharded:
                 engine = EmbeddingEngine(main, startup,
-                                         hot_rows=cfg.vocab_size // 2)
+                                         hot_rows=cfg.vocab_size // 8)
             # Momentum: the checkpoint must carry hot-tier/sharded
             # accumulator state, not just the tables
             fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
@@ -280,42 +325,112 @@ def audit_embedding(work_dir, sharded=False):
     ]
     control_state = final_state(main, scope, engine)
 
-    # resume timeline: train to the checkpoint, persist, REBUILD, restore
-    main, startup, scope, exe, loss, engine = build()
-    losses = [
-        step(main, scope, exe, loss, engine, f)
-        for f in feeds[:ckpt_step]
-    ]
-    ckpt = os.path.join(
-        work_dir, f"embed_ckpt_{'sharded' if sharded else 'cached'}"
-    )
-    if engine:
-        engine.flush(scope)
     from paddle_tpu.framework.scope import scope_guard
 
-    with scope_guard(scope):
-        fluid.io.save_persistables(exe, ckpt, main_program=main)
-    if engine:
-        np.savez(os.path.join(ckpt, "embedding_state.npz"),
-                 **engine.state_dict(scope))
-    rng_state = main.rng_state()
+    label = ("sharded" if sharded else "cached") + (
+        " async" if async_ else ""
+    )
+    if async_:
+        from paddle_tpu.fleet import collective as fc
+        from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
 
-    main, startup, scope, exe, loss, engine = build()
-    with scope_guard(scope):
-        fluid.io.load_persistables(exe, ckpt, main_program=main)
-    if engine:
-        state = dict(np.load(os.path.join(ckpt, "embedding_state.npz")))
-        engine.load_state_dict(state, scope)
-        # the freshly-installed device tier is stale placeholder data;
-        # residency restarts empty so first-touch refills from host
-    main.set_rng_state(rng_state)
-    losses += [
-        step(main, scope, exe, loss, engine, f)
-        for f in feeds[ckpt_step:]
-    ]
-    resumed_state = final_state(main, scope, engine)
+        fleet = fc.Fleet()
+        fleet.init(UserDefinedRoleMaker())
+        ckpt = os.path.join(
+            work_dir,
+            f"embed_async_{'sharded' if sharded else 'cached'}",
+        )
+        # resume timeline: full save at step 2, delta link at step 4
+        main, startup, scope, exe, loss, engine = build()
+        losses = []
+        with scope_guard(scope):
+            saver = fc.AsyncCheckpointer(
+                fleet, ckpt, executor=exe, main_program=main, scope=scope,
+                delta=True, full_every=4, compress=True,
+                queue_policy="block", remain_all_checkpoint=True,
+                row_oracles=engine.delta_row_oracles() if engine else None,
+            )
+            for k, f in enumerate(feeds[:ckpt_step], 1):
+                losses.append(step(main, scope, exe, loss, engine, f))
+                if k % 2 == 0:
+                    st = fc.TrainStatus.capture(
+                        epoch_no=0, global_step=k, program=main
+                    )
+                    saver.save(
+                        st,
+                        aux=engine.state_dict(scope) if engine else None,
+                    ).result(timeout=120)
+            saver.close()
+        dirs = sorted(
+            d for d in os.listdir(ckpt)
+            if d.startswith("__paddle_checkpoint__")
+        )
+        assert len(dirs) == 2 and os.path.exists(
+            os.path.join(ckpt, dirs[1], "delta.json")
+        ), dirs
+        full_b, delta_b = _du(os.path.join(ckpt, dirs[0])), _du(
+            os.path.join(ckpt, dirs[1])
+        )
+        if engine is not None:
+            # the byte cut is a CACHED-model property: the write-back-tick
+            # row oracles shrink the host stores to the resident slice.
+            # (The sharded leg has no oracle — every table mutates every
+            # step, so its delta only proves chain-resume correctness.)
+            assert delta_b < full_b * 0.8, (
+                f"delta link ({delta_b}B) did not cut repeat-save bytes "
+                f"vs the full save ({full_b}B) on the cached model"
+            )
+        # rebuild from scratch; resume through the committed delta chain
+        main, startup, scope, exe, loss, engine = build()
+        with scope_guard(scope):
+            status = fleet.load_check_point(
+                exe, ckpt, main_program=main, load_aux=True
+            )
+            assert status.global_step == ckpt_step, status
+            if engine:
+                engine.load_state_dict(status.aux, scope)
+            status.restore(program=main)
+            losses += [
+                step(main, scope, exe, loss, engine, f)
+                for f in feeds[ckpt_step:]
+            ]
+            resumed_state = final_state(main, scope, engine)
+        print(f"  delta chain: full {full_b}B -> delta {delta_b}B "
+              f"({delta_b / full_b:.0%} of the full link, compressed)")
+    else:
+        # resume timeline: train to the checkpoint, persist, REBUILD,
+        # restore
+        main, startup, scope, exe, loss, engine = build()
+        losses = [
+            step(main, scope, exe, loss, engine, f)
+            for f in feeds[:ckpt_step]
+        ]
+        ckpt = os.path.join(
+            work_dir, f"embed_ckpt_{'sharded' if sharded else 'cached'}"
+        )
+        if engine:
+            engine.flush(scope)
+        with scope_guard(scope):
+            fluid.io.save_persistables(exe, ckpt, main_program=main)
+        if engine:
+            np.savez(os.path.join(ckpt, "embedding_state.npz"),
+                     **engine.state_dict(scope))
+        rng_state = main.rng_state()
 
-    label = "sharded" if sharded else "cached"
+        main, startup, scope, exe, loss, engine = build()
+        with scope_guard(scope):
+            fluid.io.load_persistables(exe, ckpt, main_program=main)
+        if engine:
+            state = dict(np.load(os.path.join(ckpt, "embedding_state.npz")))
+            engine.load_state_dict(state, scope)
+            # the freshly-installed device tier is stale placeholder data;
+            # residency restarts empty so first-touch refills from host
+        main.set_rng_state(rng_state)
+        losses += [
+            step(main, scope, exe, loss, engine, f)
+            for f in feeds[ckpt_step:]
+        ]
+        resumed_state = final_state(main, scope, engine)
     assert losses == control_losses, (
         f"embedding {label} resume: losses diverge\n control: "
         f"{control_losses}\n resumed: {losses}"
@@ -333,7 +448,7 @@ def audit_embedding(work_dir, sharded=False):
               "checkpoint")
     else:
         print(f"embedding resume OK ({label}): 8-step continuation bitwise "
-              "with hot-tier cache (hot=vocab/2), host cold store + "
+              "with hot-tier cache (hot=vocab/8), host cold store + "
               "velocity tiers round-tripped")
 
 
@@ -352,6 +467,13 @@ def main(argv=None):
                          "engine state: hot-tier cached tables (host cold "
                          "store + velocity tiers) and ps-sharded tables "
                          "must both resume bitwise")
+    ap.add_argument("--async", dest="async_", action="store_true",
+                    help="route checkpoints through the async "
+                         "snapshot/publish pipeline (delta chains "
+                         "included) and SIGKILL the rank while a publish "
+                         "is IN FLIGHT: resume must come bitwise from the "
+                         "newest committed checkpoint. Composes with "
+                         "--sharded and --embedding")
     args = ap.parse_args(argv)
     work = args.out or tempfile.mkdtemp(prefix="paddle_tpu_resume_audit_")
     os.makedirs(work, exist_ok=True)
@@ -360,37 +482,53 @@ def main(argv=None):
         os.environ.setdefault(
             "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
         )
+        alabel = "async " if args.async_ else ""
         try:
-            print("== resume audit: embedding engine (cached tables) ==")
-            audit_embedding(work, sharded=False)
-            print("== resume audit: embedding engine (ps-sharded tables) ==")
-            audit_embedding(work, sharded=True)
+            print(f"== resume audit: embedding engine ({alabel}cached "
+                  "tables) ==")
+            audit_embedding(work, sharded=False, async_=args.async_)
+            print(f"== resume audit: embedding engine ({alabel}ps-sharded "
+                  "tables) ==")
+            audit_embedding(work, sharded=True, async_=args.async_)
             return 0
         finally:
             if not args.keep and args.out is None:
                 shutil.rmtree(work, ignore_errors=True)
-    label = "sharded " if args.sharded else ""
+    label = ("async " if args.async_ else "") + (
+        "sharded " if args.sharded else ""
+    )
     ports = (6470, 6490) if args.sharded else (6370, 6390)
+    if args.async_:
+        ports = (ports[0] + 200, ports[1] + 200)
     try:
         control, kill = os.path.join(work, "control"), os.path.join(work, "kill")
         print(f"== resume audit: {label}control run (uninterrupted) ==")
         run_pod(control, kill=False, started_port=ports[0],
-                sharded=args.sharded)
+                sharded=args.sharded, async_=args.async_)
         print(f"== resume audit: {label}kill run (SIGKILL rank 1 "
-              "mid-epoch, elastic resume) ==")
+              f"{'mid-async-publish' if args.async_ else 'mid-epoch'}, "
+              "elastic resume) ==")
         run_pod(kill, kill=True, started_port=ports[1],
-                sharded=args.sharded)
+                sharded=args.sharded, async_=args.async_)
 
         assert_resume_fired(kill)
         audit_logs(kill)
         audit_logs(control)
         assert_bitwise_equal(control, kill)
+        if args.async_:
+            assert_async_pipeline_audited(kill)
         if args.sharded:
             assert_sharded_state_audited(control)
             assert_sharded_state_audited(kill)
-            print("resume audit OK (sharded): SIGKILL+elastic-resume with "
-                  "dp-sharded optimizer state is bitwise identical to the "
-                  "uninterrupted run — velocity shards included")
+            print(f"resume audit OK ({label.strip()}): "
+                  "SIGKILL+elastic-resume with dp-sharded optimizer state "
+                  "is bitwise identical to the uninterrupted run — "
+                  "velocity shards included")
+        elif args.async_:
+            print("resume audit OK (async): SIGKILL mid-async-publish + "
+                  "elastic resume is bitwise identical to the "
+                  "uninterrupted run; only committed checkpoints were "
+                  "loadable, delta chain included")
         else:
             audit_v1_compat(work)
             print("resume audit OK: SIGKILL+elastic-resume run is bitwise "

@@ -107,6 +107,43 @@ def render(snap, top_ops=0):
             lines.append(
                 f"  {name:<{width}}  {payload[name] / 1e6:>10.3f} MB"
             )
+    # checkpoint pipeline digest: the stage split (snapshot = the step
+    # loop's only cost; publish = background), bandwidth, and the tiered
+    # save mix — the numbers the async-checkpoint bench gates on
+    snap_h, pub_h = hists.get("checkpoint.snapshot_latency"), hists.get(
+        "checkpoint.publish_latency"
+    )
+    if snap_h or pub_h:
+        lines.append("-- checkpoint pipeline --")
+
+        def _mean_ms(h):
+            return (h["sum"] / h["count"] * 1e3) if h and h["count"] else 0.0
+
+        snap_ms, pub_ms = _mean_ms(snap_h), _mean_ms(pub_h)
+        lines.append(
+            f"  snapshot (on-loop) mean {snap_ms:.2f} ms | publish "
+            f"(background) mean {pub_ms:.2f} ms"
+            + (f" | off-loop ratio {pub_ms / snap_ms:.1f}x"
+               if snap_ms > 0 else "")
+        )
+        bw = hists.get("checkpoint.save_bandwidth")
+        if bw and bw["count"]:
+            lines.append(
+                f"  save bandwidth mean "
+                f"{bw['sum'] / bw['count'] / 1e6:.1f} MB/s over "
+                f"{bw['count']} publishes"
+            )
+        mix = {
+            k: counters.get(f"checkpoint.{k}", 0)
+            for k in ("full_saves", "delta_saves", "coalesced",
+                      "cancelled", "publish_failures")
+        }
+        dropped = counters.get("checkpoint.delta_bytes_dropped", 0)
+        lines.append(
+            "  saves: " + " ".join(f"{k}={v}" for k, v in mix.items())
+            + (f" delta_bytes_dropped={dropped / 1e6:.2f}MB"
+               if dropped else "")
+        )
     if "perf.cost_table" in tables:
         _render_cost_table(tables["perf.cost_table"], top_ops, lines)
     lines.append(f"span buffer: {snap.get('span_count', 0)} spans")
