@@ -59,6 +59,47 @@ def _bucket_histogram(endpoint_name):
     }
 
 
+def _trace_latency_split(endpoint_name):
+    """Queue-wait vs dispatch (compute) p50/p99 reconstructed from the
+    request traces alone (serving.queue_wait / serving.dispatch spans the
+    scheduler records under each request's TraceContext), cross-checked
+    against the serving.* histograms: per request, queue_wait + dispatch
+    must account for the request latency the endpoint histogram measured
+    (mean-level check — the two are recorded by different clocks/sides,
+    so the bar is agreement, not equality)."""
+    from paddle_tpu import observability
+
+    waits, disps = [], []
+    for s in observability.get_spans():
+        if (s.get("args") or {}).get("endpoint") != endpoint_name \
+                or "trace_id" not in s:
+            continue
+        if s["name"] == "serving.queue_wait":
+            waits.append(s["dur"] / 1e6)
+        elif s["name"] == "serving.dispatch":
+            disps.append(s["dur"] / 1e6)
+    if not waits or not disps:
+        return {"trace_spans": 0}
+    hist = observability.get_histograms().get(
+        f"serving.request_latency.{endpoint_name}"
+    )
+    consistent = None
+    if hist and hist["count"]:
+        hist_mean = hist["sum"] / hist["count"]
+        trace_mean = (sum(waits) / len(waits)) + (sum(disps) / len(disps))
+        # ingest/future-resolution overheads ride on the histogram side
+        consistent = bool(
+            trace_mean <= hist_mean * 1.25 + 2e-3
+            and trace_mean >= hist_mean * 0.25
+        )
+    return {
+        "trace_spans": len(waits) + len(disps),
+        "trace_queue_wait_ms": _percentiles(waits),
+        "trace_dispatch_ms": _percentiles(disps),
+        "trace_vs_hist_consistent": consistent,
+    }
+
+
 def _roofline(frozen, bucket, feed_builder):
     """Program.estimate() at the largest bucket: analytic per-batch
     latency lower bound for the frozen graph."""
@@ -212,6 +253,7 @@ def bench_classify_mix(name, kind, buckets, mode, load, duration,
         "buckets": _bucket_histogram(name),
         **_percentiles(lats),
         **_roofline(frozen, buckets[-1], build),
+        **_trace_latency_split(name),
     }
     results[name] = entry
     return frozen, build, exe, scope, entry
@@ -498,6 +540,15 @@ def main(argv=None):
         "kv_decode_speedup": gpt["kv_decode_speedup"],
         "kv_parity": gpt["kv_parity"],
         "served_embedding_qps": ctr["qps"],
+        "trace_queue_wait_ms": results["bert_classify"].get(
+            "trace_queue_wait_ms"
+        ),
+        "trace_dispatch_ms": results["bert_classify"].get(
+            "trace_dispatch_ms"
+        ),
+        "trace_vs_hist_consistent": results["bert_classify"].get(
+            "trace_vs_hist_consistent"
+        ),
     }
     print(json.dumps(summary), flush=True)
     ok = (
@@ -506,6 +557,11 @@ def main(argv=None):
         and gpt["kv_parity"]
         and (ctr["qps"] or 0) > 0
         and ctr["fused_lookup_sites_frozen"] == 2
+        # the request traces must reconstruct the queue-wait/compute
+        # split (tracing is the observability contract of this router)
+        and results["bert_classify"].get("trace_spans", 0) > 0
+        and results["bert_classify"].get("trace_vs_hist_consistent")
+        is not False
     )
     if not ok:
         print("serving acceptance ratios NOT met", file=sys.stderr)

@@ -50,7 +50,13 @@ python tools/stats_report.py "$DPS_DIR/dp_sharding_stats.json" \
     --require collective.bytes.reduce_scatter_int8 \
     --require collective.bytes.all_gather_int8 \
     --require collective.bytes.reduce_scatter_fp32 \
-    --require collective.zero_
+    --require collective.zero_ --require perf.wait_fraction
+# per-step attribution on the dp-sharded leg: the measured
+# compute-vs-collective-wait split must exist with a nonzero wire term
+# cross-checked against the cost model (the serialized-wire denominator
+# ROADMAP item 4 will measure overlap against)
+python tools/perf_report.py --attribution "$DPS_DIR/dp_sharding_stats.json" \
+    --require-wait
 rm -rf "$DPS_DIR"
 
 echo "== embedding engine smoke: fused lookup + cache tier + prefetch =="
@@ -253,6 +259,160 @@ EOF
 python tools/stats_report.py /tmp/paddle_tpu_obs_snapshot.json \
     --require executor. --require analysis. --require detection. \
     --require perf. --require embedding. --top-ops 5
+
+echo "== causal tracing: cross-thread traces, rank stamps, live watcher =="
+# 2-rank mini-train with traces on: each step runs under its own trace;
+# the async checkpoint save chains step -> snapshot -> publisher ->
+# liveness pulse across THREE threads, heartbeats carry the trace stamp,
+# and a serving request chains client -> ingest thread -> scheduler.
+# trace_report must reconstruct complete >=3-thread traces from the
+# export files alone; the watcher must flag the seeded straggler and
+# SLO breach as structured watch.* findings.
+TRACE_DIR=$(mktemp -d)
+python - "$TRACE_DIR" <<'EOF'
+import sys
+import threading
+
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability as obs
+from paddle_tpu.fleet import collective as fc
+from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.observability import trace, watch
+from paddle_tpu.resilience.health import Heartbeat
+from paddle_tpu.serving import Server, freeze_program
+from paddle_tpu.serving.router import EndpointConfig
+
+out = sys.argv[1]
+x = fluid.data("x", [-1, 4])
+y = fluid.data("y", [-1, 1])
+pred = layers.fc(x, 1)
+loss = layers.mean(layers.square_error_cost(pred, y))
+fluid.optimizer.SGD(0.05).minimize(loss)
+exe = fluid.Executor()
+exe.run(fluid.default_startup_program())
+fleet = fc.Fleet()
+fleet.init(UserDefinedRoleMaker())
+rng = np.random.RandomState(0)
+
+# -- two "ranks": same program stepped per rank, each under per-step
+# traces with an async checkpoint mid-run, exporting its own span file
+rank0_tr = None
+for rank in (0, 1):
+    obs.reset()
+    hb = Heartbeat(out + "/hb", rank=rank)
+    with fc.AsyncCheckpointer(fleet, f"{out}/ck_rank{rank}", executor=exe,
+                              heartbeat=hb) as saver:
+        for step in range(4):
+            tr = trace.new_trace()
+            if rank == 0 and step == 3:
+                rank0_tr = tr  # spans land in rank 0's export below
+            with trace.activate(tr), obs.span("train.step", step=step,
+                                              rank=rank):
+                xa = rng.randn(8, 4).astype(np.float32)
+                exe.run(feed={"x": xa,
+                              "y": xa @ np.ones((4, 1), np.float32)},
+                        fetch_list=[loss])
+                if step == 2:
+                    saver.save(
+                        fc.TrainStatus(0, global_step=step + 1)
+                    ).result(timeout=60)
+                hb.beat()
+        saver.wait(timeout=60)
+    if rank == 0:
+        obs.spans.save_chrome_trace(f"{out}/trace_rank0.json")
+# rank 1's buffer still holds its spans (reset happened between ranks)
+
+# -- one serving request chaining three threads: the main thread's
+# client.prepare span hands its context to a submitter thread
+# (capture/activate), whose ingest hands off to the scheduler thread
+smain, sstartup = fluid.Program(), fluid.Program()
+sscope = Scope()
+with fluid.program_guard(smain, sstartup):
+    sx = fluid.data("sx", [-1, 4])
+    sprob = layers.softmax(layers.fc(sx, 2))
+with scope_guard(sscope):
+    exe.run(sstartup, scope=sscope)
+frozen = freeze_program(smain, [sprob], feed_names=("sx",))
+server = Server()
+server.add_endpoint("trace_demo", None,
+                    EndpointConfig(buckets=(1, 2), max_wait_ms=2.0),
+                    frozen=frozen, executor=exe, scope=sscope)
+server.warmup()
+req_tr = trace.new_trace()
+with trace.activate(req_tr), obs.span("client.prepare") as prep:
+    ctx = trace.capture()
+
+def submit_and_wait():
+    with trace.activate(ctx):
+        server.submit(
+            "trace_demo", {"sx": np.ones(4, np.float32)}
+        ).result(timeout=30)
+
+t = threading.Thread(target=submit_and_wait)
+t.start(); t.join()
+server.drain(timeout=30)
+
+# -- cross-rank stitch: rank 1 beats INSIDE a trace that began on rank
+# 0 (the pod contract: a step's trace spans ranks; the beat carries the
+# stamp) — the merge below must count this trace on BOTH ranks
+with trace.activate(rank0_tr):
+    Heartbeat(out + "/hb", rank=1).beat(step=4)
+
+# -- the live watcher over genuine signals: rank 0 races ahead of rank
+# 1's final beat (straggler), and a 1us SLO guarantees the serving
+# latencies breach it — both must land as structured findings
+Heartbeat(out + "/hb", rank=0).beat(step=40)
+w = watch.Watcher(heartbeat_dir=out + "/hb", skew_steps=2,
+                  slo_p99_s=1e-6)
+w.poll()
+kinds = {f["kind"] for f in w.findings}
+assert "straggler" in kinds, w.findings
+assert "slo_breach" in kinds, w.findings
+
+obs.spans.save_chrome_trace(f"{out}/trace_rank1.json")
+obs.dump(f"{out}/trace_stats.json")
+EOF
+# reconstruction from export files ALONE: >= 1 complete trace spanning
+# >= 3 threads containing the checkpoint publish (the training chain)
+# and >= 1 containing the serving ingest (the request chain)
+python tools/trace_report.py "$TRACE_DIR"/trace_rank*.json \
+    --check --min-threads 3 --require-span checkpoint.publish --top 2
+python tools/trace_report.py "$TRACE_DIR"/trace_rank*.json \
+    --check --min-threads 3 --require-span serving.ingest --quiet
+python tools/stats_report.py "$TRACE_DIR/trace_stats.json" \
+    --require trace. --require watch. --require perf.wait_fraction \
+    --require checkpoint.
+# the heartbeat-carried trace stamp must stitch into the pod merge
+python tools/perf_report.py \
+    --merge "$TRACE_DIR"/trace_rank0.json "$TRACE_DIR"/trace_rank1.json \
+    --heartbeat-dir "$TRACE_DIR/hb" -o "$TRACE_DIR/pod_trace.json" \
+    | tee "$TRACE_DIR/trace_merge.out"
+python - "$TRACE_DIR" <<'EOF'
+import json, sys
+stats = json.loads(
+    open(sys.argv[1] + "/trace_merge.out").read().strip().splitlines()[-1]
+)
+assert stats["traced_trace_ids"] > 0, stats
+# the heartbeat-carried stamp must have stitched rank 1's beat into a
+# trace whose spans live on rank 0 — deleting either side of the stamp
+# path (Heartbeat ctx stamping or the merge's beat handling) fails here
+assert stats["cross_rank_traces"] >= 1, stats
+print(f"trace merge OK: {stats['traced_trace_ids']} traces stitched "
+      f"across ranks (cross-rank: {stats['cross_rank_traces']})")
+EOF
+# ...and the checker must still reject a seeded orphan-span export
+if python tools/trace_report.py --broken-fixture > /dev/null 2>&1; then
+    echo "trace_report failed to reject the orphan-span fixture" >&2
+    exit 1
+fi
+rm -rf "$TRACE_DIR"
+
+echo "== tracing overhead gate: on-vs-off step latency <= 2% =="
+# tracing only stays default-on if it is cheap: interleaved
+# median-pairs on the zoo bert model, self-gating
+python tools/bench_tracing.py --smoke
 
 echo "== perf report (IR cost model vs XLA over the zoo) =="
 # every zoo model's Program.estimate() must stay within 25% of XLA's own

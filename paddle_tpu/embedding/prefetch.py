@@ -37,6 +37,8 @@ class Prefetcher:
     """
 
     def __init__(self, engine, feeds, scope, depth=2):
+        from ..observability import trace
+
         if depth < 1:
             raise ValueError(f"Prefetcher depth must be >= 1, got {depth}")
         self.engine = engine
@@ -46,6 +48,10 @@ class Prefetcher:
         self._done = object()
         self._err = None
         self._stop = threading.Event()
+        # capture/activate handoff: plan spans on the worker thread file
+        # under the trace that CONSTRUCTED the prefetcher (a restarted
+        # prefetcher re-captures, so the restart joins the live trace)
+        self._ctx = trace.capture()
         self._thread = threading.Thread(
             target=self._worker, name="embedding-prefetch", daemon=True
         )
@@ -64,15 +70,21 @@ class Prefetcher:
         return False
 
     def _worker(self):
+        from .. import observability as _obs
+        from ..observability import trace
+
         try:
-            for feed in self._src:
-                if self._stop.is_set():
-                    break
-                t0 = time.perf_counter()
-                plans = self.engine.plan(feed)
-                prep = time.perf_counter() - t0
-                if not self._put((feed, plans, prep)):
-                    break
+            with trace.activate(self._ctx):
+                for feed in self._src:
+                    if self._stop.is_set():
+                        break
+                    t0 = time.perf_counter()
+                    with _obs.span("embedding.prefetch_plan",
+                                   category="embedding"):
+                        plans = self.engine.plan(feed)
+                    prep = time.perf_counter() - t0
+                    if not self._put((feed, plans, prep)):
+                        break
             self._put(self._done)
         except BaseException as e:  # surfaced on the consumer thread
             self._err = e

@@ -1304,7 +1304,7 @@ class PendingSave:
     re-raises the publisher's error."""
 
     __slots__ = ("checkpoint_no", "error", "cancelled", "is_full",
-                 "snapshot", "row_marks", "_event", "_successor",
+                 "snapshot", "row_marks", "ctx", "_event", "_successor",
                  "_fp_proposals")
 
     def __init__(self):
@@ -1315,6 +1315,11 @@ class PendingSave:
         self.is_full = True
         self.snapshot = None
         self.row_marks = {}
+        # TraceContext captured on the saving thread: the publisher
+        # activates it so the publish span parents under the save that
+        # actually publishes (a coalesced-away save's context dies with
+        # its snapshot — the surviving save owns the publish)
+        self.ctx = None
         self._successor = None
         self._fp_proposals = []
 
@@ -1477,6 +1482,7 @@ class AsyncCheckpointer:
         (``load_check_point(load_aux=True)`` returns it on
         ``status.aux``). Returns a :class:`PendingSave`."""
         from .. import observability as _obs
+        from ..observability import trace as _trace
         from ..resilience import retry
         from ..resilience.faults import fault_point
 
@@ -1491,10 +1497,19 @@ class AsyncCheckpointer:
             fault_point("checkpoint.snapshot")
             return self._snapshot(train_status, aux, is_full)
 
-        job = retry(
-            max_attempts=3, base_delay=0.05, max_delay=1.0,
-            name="checkpoint.snapshot",
-        ).call(_snap)
+        # the snapshot span files under the caller's active trace (the
+        # step loop's); its context is captured onto the job so the
+        # background publish — possibly seconds later, on the publisher
+        # thread — parents under THIS save in the same trace
+        with _obs.span("checkpoint.snapshot", category="checkpoint",
+                       full=bool(is_full)):
+            job = retry(
+                max_attempts=3, base_delay=0.05, max_delay=1.0,
+                name="checkpoint.snapshot",
+            ).call(_snap)
+            # inside the span the active context IS (trace, snapshot
+            # span), so the publish parents under the snapshot
+            job.ctx = _trace.capture()
         _obs.observe(
             "checkpoint.snapshot_latency", time.perf_counter() - t0
         )
@@ -1811,6 +1826,7 @@ class AsyncCheckpointer:
 
     def _run(self):
         from .. import observability as _obs
+        from ..observability import trace as _trace
 
         while True:
             with self._lock:
@@ -1824,7 +1840,15 @@ class AsyncCheckpointer:
                 self._update_pending_gauge_locked()
                 self._cond.notify_all()
             try:
-                no = self._publish(job)
+                # activate the SURVIVING job's captured context (a
+                # coalesced-away snapshot never publishes): the publish
+                # span — and the liveness pulse under it — chain into
+                # the saving step's trace across the thread boundary
+                with _trace.activate(job.ctx), \
+                        _obs.span("checkpoint.publish",
+                                  category="checkpoint",
+                                  full=bool(job.is_full)):
+                    no = self._publish(job)
             except BaseException as e:  # noqa: BLE001 — surfaced to callers
                 _obs.add("checkpoint.publish_failures")
                 with self._lock:

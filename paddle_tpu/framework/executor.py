@@ -38,9 +38,10 @@ from .scope import global_scope
 
 class _Compiled:
     __slots__ = ("fn", "state_ro", "state_mut", "fetch_names", "nan_ops",
-                 "est", "recent_dts")
+                 "est", "recent_dts", "recent_segs", "wire_stats")
 
-    def __init__(self, fn, state_ro, state_mut, fetch_names, nan_ops=None):
+    def __init__(self, fn, state_ro, state_mut, fetch_names, nan_ops=None,
+                 wire_stats=None):
         self.fn = fn
         self.state_ro = state_ro
         self.state_mut = state_mut
@@ -54,6 +55,13 @@ class _Compiled:
         # steady-state step latencies (compile-carrying runs excluded) —
         # the window behind the live perf.mfu gauge
         self.recent_dts = None
+        # steady-state (host_seconds, device_seconds) pairs — the window
+        # behind the perf.wait_fraction.* attribution gauges
+        self.recent_segs = None
+        # mutable {"bytes": float} the collective emitters fill at trace
+        # time (ops/collective.py): this executable's per-step estimated
+        # wire payload, an estimate-independent attribution cross-check
+        self.wire_stats = wire_stats
 
 
 class _PerfEstimate:
@@ -61,17 +69,33 @@ class _PerfEstimate:
     perf.* updates (the full table is published once, to the
     "perf.cost_table" observability table)."""
 
-    __slots__ = ("flops", "bytes", "peak", "family_shares")
+    __slots__ = ("flops", "bytes", "peak", "family_shares",
+                 "wire_latency", "compute_latency")
 
     def __init__(self, table):
         self.flops = float(table.total_flops)
         self.bytes = float(table.total_bytes)
         self.peak = float(table.peak_flops)
         total_lat = table.total_latency
+        fams = table.by_family()
         self.family_shares = {
             fam: (agg["latency"] / total_lat if total_lat else 0.0)
-            for fam, agg in table.by_family().items()
+            for fam, agg in fams.items()
         }
+        # the serialized-wire split (ROADMAP item 4's denominator): the
+        # roofline latency the collective family alone accounts for vs
+        # everything else — the cost model's closed forms already carry
+        # the ring (n-1)/n wire factors and quantized element sizes
+        self.wire_latency = float(
+            fams.get("collective", {}).get("latency", 0.0)
+        )
+        self.compute_latency = max(0.0, float(total_lat) - self.wire_latency)
+
+    @property
+    def wire_fraction(self):
+        """Share of the estimated step roofline the wire serializes."""
+        denom = self.wire_latency + self.compute_latency
+        return self.wire_latency / denom if denom > 0 else 0.0
 
 
 def _analyze_block(block, feed_names, fetch_names):
@@ -117,7 +141,9 @@ class Executor:
 
         self.place = place if place is not None else default_place()
         self._cache = OrderedDict()
-        self._last_run = None  # (compiled, fresh_compile) of the last run
+        # (compiled, fresh_compile, (host_s, device_s) | None) of the
+        # last run — consumed once by _note_perf
+        self._last_run = None
         self._est_memo = {}  # cache key -> _PerfEstimate | False
 
     def close(self):
@@ -157,8 +183,12 @@ class Executor:
     @staticmethod
     def _drop_perf_gauges(_obs):
         for prefix in ("perf.mfu", "perf.step_seconds",
-                       "perf.family_time."):
+                       "perf.family_time.", "perf.wait_fraction."):
             _obs.drop_gauges(prefix)
+        # the attribution table describes ONE executable, same as the
+        # gauges: a snapshot taken right after an executable switch must
+        # not pair the old split with the new program
+        _obs.drop_tables("perf.step_attribution")
 
     def _note_perf(self, dt):
         """Per-run perf.* telemetry from the analytic cost estimate: step
@@ -170,7 +200,7 @@ class Executor:
         self._last_run = None
         if noted is None or not _obs.enabled():
             return
-        compiled, fresh_compile = noted
+        compiled, fresh_compile, seg = noted
         est = compiled.est
         if not est:
             # this executable has no estimate: a previous executable's
@@ -188,6 +218,7 @@ class Executor:
             from collections import deque
 
             compiled.recent_dts = deque(maxlen=32)
+            compiled.recent_segs = deque(maxlen=32)
         compiled.recent_dts.append(dt)
         mean_dt = sum(compiled.recent_dts) / len(compiled.recent_dts)
         _obs.set_gauge("perf.step_seconds", mean_dt)
@@ -199,13 +230,63 @@ class Executor:
         _obs.drop_gauges("perf.family_time.")
         for fam, share in est.family_shares.items():
             _obs.set_gauge(f"perf.family_time.{fam}", share * mean_dt)
+        if seg is not None:
+            self._note_attribution(_obs, compiled, est, mean_dt, seg)
+
+    @staticmethod
+    def _note_attribution(_obs, compiled, est, mean_dt, seg):
+        """Per-step compute / collective-wait / host-stall attribution
+        (the serialized-wire denominator ROADMAP item 4 measures against):
+        the measured step splits into host time (feed/state assembly +
+        write-back, directly measured) and device time (dispatch +
+        block-until-ready); device time splits into compute vs
+        collective-wait by the cost model's wire share — under serialized
+        collectives the wire's roofline share of the device step IS the
+        time the math waits on the wire."""
+        host, device = seg
+        compiled.recent_segs.append((host, device))
+        n = len(compiled.recent_segs)
+        mean_host = sum(s[0] for s in compiled.recent_segs) / n
+        mean_device = sum(s[1] for s in compiled.recent_segs) / n
+        wire_share = est.wire_fraction
+        coll_wait = mean_device * wire_share
+        compute = mean_device - coll_wait
+        denom = mean_host + mean_device
+        if denom <= 0:
+            return
+        # the wait_fraction gauge SET is fixed (3 names), so unlike the
+        # per-family gauges there is nothing stale to drop per step —
+        # _drop_perf_gauges clears them on executable switch
+        _obs.set_gauge("perf.wait_fraction.collective", coll_wait / denom)
+        _obs.set_gauge("perf.wait_fraction.host", mean_host / denom)
+        _obs.set_gauge("perf.wait_fraction.compute", compute / denom)
+        _obs.observe("perf.compute_seconds", device * (1.0 - wire_share))
+        _obs.observe("perf.collective_wait_seconds", device * wire_share)
+        _obs.observe("perf.host_stall_seconds", host)
+        wire_stats = compiled.wire_stats or {}
+        _obs.set_table("perf.step_attribution", {
+            "step_seconds": mean_dt,
+            "compute_seconds": compute,
+            "collective_wait_seconds": coll_wait,
+            "host_stall_seconds": mean_host,
+            "wait_fraction_collective": coll_wait / denom,
+            "wait_fraction_host": mean_host / denom,
+            "est_compute_seconds": est.compute_latency,
+            "est_wire_seconds": est.wire_latency,
+            "est_wait_fraction": wire_share,
+            "traced_wire_bytes": float(wire_stats.get("bytes", 0.0)),
+            "window_steps": n,
+        })
 
     def _run_body(
         self, program, feed, fetch_list, scope, return_numpy,
         use_program_cache,
     ):
+        import time
+
         from .. import observability as _obs
 
+        t_body = time.perf_counter()
         # the shared prologue keys the cache on the Program OBJECT
         # (identity hash, strong ref) so a freed Program's recycled id
         # cannot produce a stale hit; _prepared is the single source of
@@ -242,7 +323,9 @@ class Executor:
                     self._est_memo.pop(next(iter(self._est_memo)))
                 self._est_memo[key] = est
             compiled.est = est
-        self._last_run = (compiled, fresh_compile)
+        # seg (host vs device split) is filled in below once the dispatch
+        # completes; a run that raises before then reports no attribution
+        self._last_run = (compiled, fresh_compile, None)
 
         state_ro = {n: self._from_scope(scope, n, block) for n in compiled.state_ro}
         state_mut = {n: self._from_scope(scope, n, block) for n in compiled.state_mut}
@@ -283,12 +366,43 @@ class Executor:
             jax.random.key(seed, impl=prng_impl()), step
         )
 
+        # host/device split for the per-step attribution (perf.wait_*):
+        # everything up to the dispatch is host prologue; the dispatch is
+        # bounded with block_until_ready so the device segment is real
+        # device wall time, not async-dispatch return time. ONLY on the
+        # return_numpy path: those callers synchronize inside this very
+        # call anyway (np.asarray below), so the early block changes
+        # nothing — while return_numpy=False callers (bench.py's
+        # pipelined timing loops) rely on async dispatch overlapping
+        # step N's device work with step N+1's host prologue, and an
+        # attribution block there would serialize the accelerator
+        # pipeline. Such runs simply publish no attribution sample.
+        attributing = return_numpy and _obs.enabled()
+        t_dispatch = time.perf_counter()
         fetches, new_state = compiled.fn(feed_arrays, state_mut, state_ro, step_key)
+        if attributing:
+            # one leaf suffices: XLA materializes every output of the
+            # computation together, and walking the whole state pytree
+            # (hundreds of arrays) would cost more than the span itself
+            leaf = fetches[0] if fetches else next(
+                iter(new_state.values()), None
+            )
+            if leaf is not None:
+                jax.block_until_ready(leaf)
+        t_device_end = time.perf_counter()
         # write-back FIRST: state_mut buffers were donated, so skipping the
         # write-back on error would leave the scope holding deleted arrays
         # (params irretrievably lost right when the user wants to inspect)
         for n, v in new_state.items():
             scope.set_var(n, v)
+        if attributing:
+            # host = prologue + write-back epilogue; the trailing numpy
+            # conversion is already-on-host copies, charged to the caller
+            self._last_run = (compiled, fresh_compile, (
+                (t_dispatch - t_body)
+                + (time.perf_counter() - t_device_end),
+                t_device_end - t_dispatch,
+            ))
         if compiled.nan_ops is not None:
             bad = np.asarray(fetches[-1])
             fetches = fetches[:-1]
@@ -630,16 +744,23 @@ class Executor:
         # while serving requests
         is_test = bool(getattr(program, "_is_inference", False))
 
+        # filled by the collective emitters at trace time with this
+        # executable's estimated per-step wire bytes (ops/collective.py);
+        # reset at each (re)trace so retraces never double-count
+        wire_stats = {"bytes": 0.0}
+
         def traced(feeds, smut, sro, step_key):
             env = {}
             env.update(sro)
             env.update(smut)
             env.update(feeds)
             axis_sizes = dict(mesh.shape) if mesh is not None else {}
+            wire_stats["bytes"] = 0.0
             ctx = EmitContext(
                 step_key=step_key, is_test=is_test, mesh_axes=mesh_axes,
                 axis_sizes=axis_sizes, program=program,
             )
+            ctx.wire_stats = wire_stats
             nan_flags = []
             for i, op in enumerate(ops):
                 try:
@@ -708,6 +829,7 @@ class Executor:
         return _Compiled(
             fn, state_ro, state_mut, fetch_names,
             nan_ops=ops if (check_nan and ops) else None,
+            wire_stats=wire_stats,
         )
 
 

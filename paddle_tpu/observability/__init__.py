@@ -27,13 +27,22 @@ Canonical metric names are documented in README.md §Observability.
 
 from __future__ import annotations
 
-from . import export, metrics, spans  # noqa: F401
+from . import export, metrics, spans, trace, watch  # noqa: F401
 from .export import dump, prometheus_text, snapshot  # noqa: F401
+from .trace import (  # noqa: F401
+    TraceContext,
+    activate,
+    capture,
+    current_trace,
+    new_trace,
+)
+from .watch import Watcher  # noqa: F401
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS,
     add,
     enabled,
     drop_gauges,
+    drop_tables,
     get_counters,
     get_gauges,
     get_histograms,
@@ -47,6 +56,7 @@ from .metrics import (  # noqa: F401
 from .spans import (  # noqa: F401
     chrome_trace,
     get_spans,
+    record,
     save_chrome_trace,
     span,
     span_count,

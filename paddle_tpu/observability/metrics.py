@@ -139,6 +139,17 @@ def set_table(name: str, table: dict) -> None:
         _tables[name] = table
 
 
+def drop_tables(prefix: str) -> None:
+    """Remove every table whose name starts with `prefix` — the table
+    analogue of :func:`drop_gauges`, for publishers whose table describes
+    ONE source (e.g. the executor's per-executable
+    ``perf.step_attribution``): dropping on source switch keeps a stale
+    table from being read as live for the new source."""
+    with _lock:
+        for k in [k for k in _tables if k.startswith(prefix)]:
+            del _tables[k]
+
+
 class _Timed:
     """Context manager AND decorator: wall time -> histogram `name`."""
 

@@ -6,10 +6,23 @@ always (unless the monitor kill-switch is off) into a deque capped at
 PADDLE_TPU_SPAN_BUFFER entries (default 4096) — old spans fall off, a
 long-running trainer never grows memory.
 
+Causal tracing (trace.py): when a TraceContext is active on the recording
+thread, the span record additionally carries ``trace_id`` / ``span_id`` /
+``parent_id`` and pushes its own child context while the body runs, so
+nested spans — and spans on other threads holding a capture()/activate()
+handoff of this context — chain into one reconstructible tree.
+:func:`record` writes a span retrospectively (known duration, ended now)
+for costs measured after the fact, e.g. a request's queue wait.
+
+The kill-switch is the ONE metrics switch: every write path here consults
+``metrics.enabled()`` (PADDLE_TPU_MONITOR=0 / set_enabled), never a local
+flag, so spans and traces die with counters — not just when the buffer is
+sized to zero.
+
 Export goes through tools/timeline._ChromeTraceFormatter, so host spans
-are ordinary Chrome-trace "X" events: load them alone (`chrome_trace()`)
-or merged with a jax.profiler device capture
-(`tools.timeline.Timeline(dir, include_host_spans=True)`) in one
+are ordinary Chrome-trace "X" events (trace ids ride in ``args``): load
+them alone (`chrome_trace()`) or merged with a jax.profiler device
+capture (`tools.timeline.Timeline(dir, include_host_spans=True)`) in one
 Perfetto-loadable JSON.
 """
 
@@ -21,7 +34,7 @@ import os
 import threading
 import time
 
-from . import metrics
+from . import metrics, trace
 
 try:
     # clamp: deque(maxlen=negative) raises; malformed env must not break
@@ -36,24 +49,39 @@ _spans: collections.deque = collections.deque(maxlen=_MAX_SPANS)
 class _Span:
     """Context manager AND decorator recording one ring-buffer span."""
 
-    __slots__ = ("name", "category", "args", "_wall_us", "_t0")
+    __slots__ = ("name", "category", "args", "_wall_us", "_t0", "_trace")
 
     def __init__(self, name, category="host", args=None):
         self.name = name
         self.category = category
         self.args = args or {}
         self._t0 = None
+        self._trace = None  # (trace_id, span_id, parent_id) when traced
+
+    @property
+    def span_id(self):
+        """This span's id once entered under an active TraceContext
+        (None otherwise) — lets producers parent later work under it."""
+        return self._trace[1] if self._trace else None
 
     def __enter__(self):
+        self._trace = None
         if metrics.enabled():
             self._wall_us = time.time_ns() / 1e3
             self._t0 = time.perf_counter_ns()
+            ctx = trace.current()
+            if ctx is not None:
+                sid = trace.new_id()
+                self._trace = (ctx.trace_id, sid, ctx.span_id)
+                trace._push(ctx.child(sid))
         else:
             self._t0 = None
         return self
 
     def __exit__(self, *exc):
         if self._t0 is not None:
+            if self._trace is not None:
+                trace._pop()
             dur_us = (time.perf_counter_ns() - self._t0) / 1e3
             rec = {
                 "name": self.name,
@@ -63,6 +91,10 @@ class _Span:
                 "tid": threading.get_ident(),
                 "args": self.args,
             }
+            if self._trace is not None:
+                rec["trace_id"], rec["span_id"], rec["parent_id"] = \
+                    self._trace
+                metrics.add("trace.spans")
             with _lock:
                 _spans.append(rec)
         return False
@@ -79,6 +111,38 @@ class _Span:
 def span(name: str, category: str = "host", **args) -> _Span:
     """``with span("executor.step", step=i): ...`` or ``@span("f")``."""
     return _Span(name, category, args)
+
+
+def record(name, duration_s, category="host", ctx=None, args=None):
+    """Retrospectively record a span that ENDED now and lasted
+    ``duration_s`` — for costs only measurable after the fact (a
+    request's queue wait, a batch slot's dispatch share). ``ctx`` parents
+    the span (default: the thread's active context; pass a captured
+    context to file it under another thread's trace). Returns the new
+    span_id, or None when monitoring is off."""
+    if not metrics.enabled():
+        return None
+    if ctx is None:
+        ctx = trace.current()
+    dur_us = max(0.0, float(duration_s)) * 1e6
+    rec = {
+        "name": name,
+        "cat": category,
+        "ts": time.time_ns() / 1e3 - dur_us,
+        "dur": dur_us,
+        "tid": threading.get_ident(),
+        "args": dict(args or {}),
+    }
+    sid = None
+    if ctx is not None:
+        sid = trace.new_id()
+        rec["trace_id"] = ctx.trace_id
+        rec["span_id"] = sid
+        rec["parent_id"] = ctx.span_id
+        metrics.add("trace.spans")
+    with _lock:
+        _spans.append(rec)
+    return sid
 
 
 def get_spans() -> list[dict]:
@@ -98,7 +162,8 @@ def reset() -> None:
 
 def emit_into(fmt, pid: int = 0) -> None:
     """Write the buffered spans into a _ChromeTraceFormatter as process
-    `pid`, one trace tid per host thread."""
+    `pid`, one trace tid per host thread. Trace ids (when present) ride
+    in each event's args so export files alone reconstruct causality."""
     recs = get_spans()
     fmt.emit_pid("paddle_tpu host spans", pid)
     tids: dict[int, int] = {}
@@ -107,9 +172,16 @@ def emit_into(fmt, pid: int = 0) -> None:
     for native_tid, tid in sorted(tids.items(), key=lambda kv: kv[1]):
         fmt.emit_tid(f"thread-{native_tid}", pid, tid)
     for rec in recs:
+        args = rec["args"]
+        if "trace_id" in rec:
+            args = dict(args)
+            args["trace_id"] = rec["trace_id"]
+            args["span_id"] = rec["span_id"]
+            if rec.get("parent_id") is not None:
+                args["parent_id"] = rec["parent_id"]
         fmt.emit_region(
             rec["ts"], rec["dur"], pid, tids[rec["tid"]], rec["cat"],
-            rec["name"], rec["args"],
+            rec["name"], args,
         )
 
 

@@ -1,0 +1,143 @@
+"""Causal trace contexts: trace_id/span_id propagation across threads/ranks.
+
+Spans alone answer "what ran"; they cannot answer "where did THIS
+request's 40 ms go" once work hops a thread (the serving scheduler, the
+AsyncCheckpointer publisher, the embedding Prefetcher worker) or a rank
+(heartbeat files, per-rank span exports). A :class:`TraceContext` is the
+missing edge: an immutable ``(trace_id, span_id)`` pair naming a position
+in one causal tree. While a context is *active* on a thread, every
+``span()`` recorded there attaches ``trace_id``/``span_id``/``parent_id``
+to its ring-buffer record — ``tools/trace_report.py`` reconstructs the
+tree from export files alone, and ``tools/perf_report.py --merge``
+stitches contexts stamped into heartbeat files across ranks.
+
+Thread handoff is EXPLICIT (no ambient magic a worker thread could
+inherit by accident): the producing thread calls :func:`capture`, ships
+the context with the work item, and the consuming thread wraps the work
+in ``with activate(ctx):``. Each in-flight span pushes its own child
+context for the duration of its body, so nesting falls out of ordinary
+``with`` scoping.
+
+Kill-switch: the module rides the one metrics switch
+(``PADDLE_TPU_MONITOR=0`` / ``set_enabled``) — when monitoring is off,
+:func:`new_trace` returns ``None``, ``activate(None)`` is a no-op mask,
+and spans record nothing, so tracing cannot outlive the kill-switch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from . import metrics
+
+_tls = threading.local()
+
+
+def new_id() -> str:
+    """A fresh 64-bit hex span/trace id (random: unique across ranks)."""
+    return os.urandom(8).hex()
+
+
+class TraceContext:
+    """Immutable position in a trace: ``trace_id`` + the span to parent
+    new work under (``span_id``; ``None`` = root position)."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str | None = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def child(self, span_id: str) -> "TraceContext":
+        """The context a span's body runs under (same trace, new parent)."""
+        return TraceContext(self.trace_id, span_id)
+
+    def to_dict(self) -> dict:
+        d = {"trace_id": self.trace_id}
+        if self.span_id is not None:
+            d["span_id"] = self.span_id
+        return d
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, TraceContext)
+            and self.trace_id == other.trace_id
+            and self.span_id == other.span_id
+        )
+
+    def __hash__(self):
+        return hash((self.trace_id, self.span_id))
+
+    def __repr__(self):
+        return f"TraceContext({self.trace_id!r}, {self.span_id!r})"
+
+
+def new_trace() -> TraceContext | None:
+    """Root context of a brand-new trace (``None`` when monitoring is
+    off, so call sites can thread it through unconditionally)."""
+    if not metrics.enabled():
+        return None
+    metrics.add("trace.traces_started")
+    return TraceContext(new_id())
+
+
+def current() -> TraceContext | None:
+    """The calling thread's active context (None outside any trace)."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def capture() -> TraceContext | None:
+    """Snapshot the active context for an explicit thread handoff: ship
+    the return value with the work item and ``activate`` it on the
+    consuming thread."""
+    return current()
+
+
+def ensure() -> TraceContext | None:
+    """The active context, or a fresh trace when there is none."""
+    return current() or new_trace()
+
+
+def _push(ctx):
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(ctx)
+
+
+def _pop():
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        stack.pop()
+
+
+class _Activate:
+    __slots__ = ("ctx",)
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def __enter__(self):
+        _push(self.ctx)
+        return self.ctx
+
+    def __exit__(self, *exc):
+        _pop()
+        return False
+
+
+def activate(ctx: TraceContext | None) -> _Activate:
+    """Context manager installing ``ctx`` as the thread's active context
+    — the consuming side of a :func:`capture` handoff. ``activate(None)``
+    masks any outer context (spans inside record untraced), so handoff
+    code never needs a conditional."""
+    if ctx is not None and metrics.enabled():
+        metrics.add("trace.activations")
+    return _Activate(ctx)
+
+
+#: package-level alias (``observability.current_trace()``): "current"
+#: alone is too ambiguous a name to re-export from the package root
+current_trace = current

@@ -1,0 +1,258 @@
+"""Live straggler / regression / SLO watcher over the telemetry stream.
+
+A pod that is *slowly* going wrong never trips the resilience layer: a
+straggling rank still beats its heartbeat, a 30% step-time regression
+still converges, a serving endpoint blowing its p99 still answers. The
+:class:`Watcher` follows the signals the rest of the stack already
+publishes — heartbeat files (per-rank step counters), the
+``executor.step_latency`` histogram, the ``serving.request_latency``
+histogram — and turns excursions into structured ``watch.*`` findings
+instead of log lines:
+
+* **straggler** — the spread between the fastest and slowest rank's
+  heartbeat step counter exceeds ``skew_steps`` (one finding per
+  excursion; re-arms when the pod re-converges);
+* **step_regression** — the mean step latency of the most recent poll
+  window exceeds the best window seen so far by ``drift_tolerance``
+  (catches slow decay AND sharp knees, not just absolute thresholds);
+* **slo_breach** — the latency metric's per-window p99 (estimated from
+  histogram bucket deltas) exceeds ``slo_p99_s``.
+
+Each finding is a plain dict (kind, severity, detail, wall time) kept in
+a bounded list, mirrored to the ``watch.findings`` observability table,
+and counted as ``watch.findings`` / ``watch.findings.<kind>`` so a
+``stats_report --require watch.`` proves the watcher was alive. Use
+:meth:`Watcher.poll` from your own loop, or :meth:`start` for a daemon
+polling thread. The whole module rides the metrics kill-switch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import metrics
+
+__all__ = ["Watcher"]
+
+_SEVERITY = {"straggler": "warning", "step_regression": "warning",
+             "slo_breach": "error"}
+
+
+def _hist_state(name):
+    """(count, sum, cumulative buckets) of one histogram, or None."""
+    h = metrics.get_histograms().get(name)
+    if h is None:
+        return None
+    return h["count"], h["sum"], h["buckets"]
+
+
+def _window_p99(prev_buckets, cur_buckets):
+    """p99 upper-bound estimate from the bucket-count delta between two
+    polls. Both sides are cumulative Prometheus buckets, so per-bucket
+    subtraction yields the window's cumulative counts directly. A p99
+    landing in +Inf reports the largest finite edge x2 — an upper bound
+    is the conservative answer an SLO check wants."""
+    prev = {str(le): c for le, c in (prev_buckets or [])}
+    deltas = [(le, cum - prev.get(str(le), 0)) for le, cum in cur_buckets]
+    total = deltas[-1][1] if deltas else 0
+    if total <= 0:
+        return None
+    target = 0.99 * total
+    finite = [float(le) for le, _ in deltas if not isinstance(le, str)]
+    for le, cum_d in deltas:
+        if cum_d >= target:
+            if isinstance(le, str):  # +Inf bucket
+                return (max(finite) * 2.0) if finite else float("inf")
+            return float(le)
+    return (max(finite) * 2.0) if finite else float("inf")
+
+
+class Watcher:
+    """Online watcher emitting structured ``watch.*`` findings.
+
+    Pure-poll core (deterministic, testable): every :meth:`poll` reads
+    the heartbeat dir + metric registry, updates ``watch.*`` gauges, and
+    returns the NEW findings it raised. :meth:`start`/:meth:`stop` wrap
+    poll in a daemon thread for live use.
+    """
+
+    def __init__(self, heartbeat_dir=None, skew_steps=2,
+                 drift_tolerance=0.25, min_window=8, slo_p99_s=None,
+                 step_metric="executor.step_latency",
+                 latency_metric="serving.request_latency",
+                 interval=1.0, max_findings=256):
+        self.heartbeat_dir = heartbeat_dir
+        self.skew_steps = int(skew_steps)
+        self.drift_tolerance = float(drift_tolerance)
+        self.min_window = int(min_window)
+        self.slo_p99_s = slo_p99_s
+        self.step_metric = step_metric
+        self.latency_metric = latency_metric
+        self.interval = float(interval)
+        self.findings: list[dict] = []
+        self._max_findings = int(max_findings)
+        self._lock = threading.Lock()
+        # excursion latches: one finding per excursion, re-armed on recovery
+        self._straggling = False
+        self._breaching = False
+        self._regressed = False
+        self._step_prev = None  # (count, sum) at the last poll
+        self._best_window_mean = None
+        self._lat_prev = None  # (count, buckets) at the last poll
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- finding plumbing --------------------------------------------------
+    def _emit(self, kind, detail):
+        finding = {
+            "kind": kind,
+            "severity": _SEVERITY.get(kind, "warning"),
+            "detail": detail,
+            "time": time.time(),
+        }
+        with self._lock:
+            self.findings.append(finding)
+            del self.findings[:-self._max_findings]
+            table = list(self.findings[-32:])
+        metrics.add("watch.findings")
+        metrics.add(f"watch.findings.{kind}")
+        metrics.set_table("watch.findings", {"findings": table})
+        return finding
+
+    # -- the three checks --------------------------------------------------
+    def _check_straggler(self, new):
+        from ..resilience.health import read_beat
+
+        if not self.heartbeat_dir or not os.path.isdir(self.heartbeat_dir):
+            return
+        steps = {}
+        for fn in sorted(os.listdir(self.heartbeat_dir)):
+            if not fn.startswith("hb_rank") or ".tmp." in fn:
+                continue
+            beat = read_beat(os.path.join(self.heartbeat_dir, fn))
+            if beat and "step" in beat:
+                steps[int(beat.get("rank", len(steps)))] = int(beat["step"])
+        if len(steps) < 2:
+            return
+        lead = max(steps.values())
+        skew = lead - min(steps.values())
+        metrics.set_gauge("watch.step_skew", skew)
+        if skew > self.skew_steps:
+            if not self._straggling:
+                self._straggling = True
+                lagging = sorted(
+                    r for r, s in steps.items()
+                    if lead - s > self.skew_steps
+                )
+                new.append(self._emit("straggler", {
+                    "skew_steps": skew,
+                    "lagging_ranks": lagging,
+                    "steps": {str(r): s for r, s in sorted(steps.items())},
+                }))
+        else:
+            self._straggling = False
+
+    def _check_step_regression(self, new):
+        state = _hist_state(self.step_metric)
+        if state is None:
+            return
+        count, total, _ = state
+        prev = self._step_prev
+        self._step_prev = (count, total)
+        if prev is None:
+            return
+        d_count, d_sum = count - prev[0], total - prev[1]
+        if d_count < self.min_window:
+            return  # not enough fresh steps for a stable window mean
+        mean = d_sum / d_count
+        best = self._best_window_mean
+        if best is None or mean < best:
+            self._best_window_mean = best = mean
+        ratio = mean / best if best > 0 else 1.0
+        metrics.set_gauge("watch.step_time_ratio", ratio)
+        if ratio > 1.0 + self.drift_tolerance:
+            if not self._regressed:
+                self._regressed = True
+                new.append(self._emit("step_regression", {
+                    "window_mean_s": mean,
+                    "best_window_mean_s": best,
+                    "ratio": ratio,
+                    "window_steps": d_count,
+                    "metric": self.step_metric,
+                }))
+        else:
+            self._regressed = False
+
+    def _check_slo(self, new):
+        if self.slo_p99_s is None:
+            return
+        state = _hist_state(self.latency_metric)
+        if state is None:
+            return
+        count, _total, buckets = state
+        prev = self._lat_prev
+        self._lat_prev = (count, buckets)
+        prev_buckets = prev[1] if prev else None
+        prev_count = prev[0] if prev else 0
+        if count - prev_count <= 0:
+            return
+        p99 = _window_p99(prev_buckets, buckets)
+        if p99 is None:
+            return
+        metrics.set_gauge("watch.request_p99_s", p99)
+        if p99 > float(self.slo_p99_s):
+            if not self._breaching:
+                self._breaching = True
+                new.append(self._emit("slo_breach", {
+                    "p99_s": p99,
+                    "slo_p99_s": float(self.slo_p99_s),
+                    "window_requests": count - prev_count,
+                    "metric": self.latency_metric,
+                }))
+        else:
+            self._breaching = False
+
+    # -- public surface ----------------------------------------------------
+    def poll(self):
+        """Run every check once; returns the list of NEW findings."""
+        if not metrics.enabled():
+            return []
+        metrics.add("watch.polls")
+        new: list[dict] = []
+        self._check_straggler(new)
+        self._check_step_regression(new)
+        self._check_slo(new)
+        return new
+
+    def start(self):
+        """Poll on a daemon thread every ``interval`` seconds."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="obs-watcher"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval * 4 + 1.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll()
+            except Exception:
+                pass  # a broken check must not kill the monitor thread

@@ -30,13 +30,19 @@ def _axis(ctx, op):
     return name if name in ctx.mesh_axes else None
 
 
-def _record(kind, x, ax):
+def _record(ctx, kind, x, ax):
     """Count the collective and its per-shard payload bytes by kind.
 
     Emitters run at TRACE time, so these counters advance once per program
     compile (per collective op in the block), not once per device step —
     the right granularity for "how much ICI traffic does one step issue",
-    since the compiled step replays the same collectives every run."""
+    since the compiled step replays the same collectives every run.
+
+    When the Executor attached a ``ctx.wire_stats`` holder, the site also
+    accumulates its single-traversal ring wire estimate (payload x
+    (n-1)/n) there — the per-executable wire total behind the
+    ``perf.step_attribution`` cross-check, available even when the full
+    cost model declines the program."""
     if ax is None:
         return
     from .. import observability as _obs
@@ -52,6 +58,10 @@ def _record(kind, x, ax):
     except (AttributeError, TypeError):
         return
     _obs.add(f"collective.{kind}.bytes", nbytes)
+    if ctx is not None and getattr(ctx, "wire_stats", None) is not None:
+        n = int(ctx.axis_sizes.get(ax, 1))
+        if n > 1:
+            ctx.wire_stats["bytes"] += nbytes * (n - 1) / n
 
 
 def _register_allreduce(op_type, reducer):
@@ -59,7 +69,7 @@ def _register_allreduce(op_type, reducer):
     def emit(ctx, op, ins):
         x = ins["X"][0]
         ax = _axis(ctx, op)
-        _record(op_type, x, ax)
+        _record(ctx, op_type, x, ax)
         return {"Out": [x if ax is None else reducer(x, ax)]}
 
     return emit
@@ -85,7 +95,7 @@ def _mp_allreduce_sum(ctx, op, ins):
     while scaling the cotangent down (same trick as pipeline.py:196)."""
     x = ins["X"][0]
     ax = _axis(ctx, op)
-    _record("mp_allreduce_sum", x, ax)
+    _record(ctx, "mp_allreduce_sum", x, ax)
     if ax is None:
         return {"Out": [x]}
     n = ctx.axis_sizes[ax]
@@ -97,7 +107,7 @@ def _mp_allreduce_sum(ctx, op, ins):
 def _c_broadcast(ctx, op, ins):
     x = ins["X"][0]
     ax = _axis(ctx, op)
-    _record("c_broadcast", x, ax)
+    _record(ctx, "c_broadcast", x, ax)
     if ax is None:
         return {"Out": [x]}
     root = op.attr("root", 0)
@@ -110,7 +120,7 @@ def _c_broadcast(ctx, op, ins):
 def _c_allgather(ctx, op, ins):
     x = ins["X"][0]
     ax = _axis(ctx, op)
-    _record("c_allgather", x, ax)
+    _record(ctx, "c_allgather", x, ax)
     if ax is None:
         return {"Out": [x]}
     out = lax.all_gather(x, ax)  # [nranks, ...]
@@ -123,7 +133,7 @@ def _c_allgather(ctx, op, ins):
 def _c_reducescatter(ctx, op, ins):
     x = ins["X"][0]
     ax = _axis(ctx, op)
-    _record("c_reducescatter", x, ax)
+    _record(ctx, "c_reducescatter", x, ax)
     if ax is None:
         return {"Out": [x]}
     return {"Out": [lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)]}
@@ -133,7 +143,7 @@ def _c_reducescatter(ctx, op, ins):
 def _alltoall(ctx, op, ins):
     x = ins["X"][0]
     ax = _axis(ctx, op)
-    _record("alltoall", x, ax)
+    _record(ctx, "alltoall", x, ax)
     if ax is None:
         return {"Out": [x]}
     n = lax.axis_size(ax)
@@ -148,7 +158,7 @@ def _alltoall(ctx, op, ins):
 def _collective_permute(ctx, op, ins):
     x = ins["X"][0]
     ax = _axis(ctx, op)
-    _record("collective_permute", x, ax)
+    _record(ctx, "collective_permute", x, ax)
     if ax is None:
         return {"Out": [x]}
     n = lax.axis_size(ax)
@@ -169,7 +179,7 @@ def _c_allreduce_any(ctx, op, ins):
     on found_inf)."""
     x = ins["X"][0]
     ax = _axis(ctx, op)
-    _record("c_allreduce_any", x, ax)
+    _record(ctx, "c_allreduce_any", x, ax)
     if ax is None:
         return {"Out": [x]}
     return {"Out": [lax.pmax(x.astype(jnp.int32), ax).astype(x.dtype)]}
@@ -196,11 +206,13 @@ def _quant_precision(quant, dtype):
             "float64": "fp64"}.get(str(jnp.dtype(dtype)), str(dtype))
 
 
-def _record_zero(kind, op, payload_elems, dtype, ax, n):
+def _record_zero(ctx, kind, op, payload_elems, dtype, ax, n):
     """Count a sharded-update collective and its estimated ring WIRE bytes
     (payload x (n-1)/n, plus per-block scale overhead when quantized) by
     kind and precision: collective.bytes.reduce_scatter_int8 etc. Trace-
-    time granularity, like _record (once per compiled collective site)."""
+    time granularity, like _record (once per compiled collective site);
+    the exact wire estimate also lands in ``ctx.wire_stats`` when the
+    Executor attached the per-executable attribution holder."""
     if ax is None:
         return
     from .. import observability as _obs
@@ -218,6 +230,8 @@ def _record_zero(kind, op, payload_elems, dtype, ax, n):
     wire = int(payload * (n - 1) / n) if n > 1 else 0
     _obs.add(f"collective.{kind}")
     _obs.add(f"collective.bytes.{kind}_{precision}", wire)
+    if ctx is not None and getattr(ctx, "wire_stats", None) is not None:
+        ctx.wire_stats["bytes"] += wire
 
 
 def _block_quantize(x, block):
@@ -260,7 +274,7 @@ def _zero_reduce_scatter(ctx, op, ins):
     if pad_len > flat.shape[0]:
         flat = jnp.pad(flat, (0, pad_len - flat.shape[0]))
     n = int(ctx.axis_sizes.get(ax, 1)) if ax is not None else 1
-    _record_zero("reduce_scatter", op, pad_len, flat.dtype, ax, n)
+    _record_zero(ctx, "reduce_scatter", op, pad_len, flat.dtype, ax, n)
     if ax is None:
         return {"Out": [flat]}
     if quant == "none":
@@ -298,7 +312,7 @@ def _zero_all_gather(ctx, op, ins):
     quant = op.attr("quant", "none") or "none"
     block = int(op.attr("quant_block", 256) or 256)
     n = int(ctx.axis_sizes.get(ax, 1)) if ax is not None else 1
-    _record_zero("all_gather", op, pad_len, x.dtype, ax, n)
+    _record_zero(ctx, "all_gather", op, pad_len, x.dtype, ax, n)
     if ax is None:
         full = x
     elif quant == "none":
@@ -355,7 +369,7 @@ def _c_comm_init_all(ctx, op, ins):
 def _barrier(ctx, op, ins):
     x = ins["X"][0] if ins.get("X") and ins["X"][0] is not None else jnp.zeros([1])
     ax = _axis(ctx, op)
-    _record("barrier", None, ax)  # zero-payload sync: count the op, no bytes
+    _record(ctx, "barrier", None, ax)  # zero-payload sync: count the op, no bytes
     if ax is None:
         return {"Out": [x]}
     return {"Out": [x + 0 * lax.psum(jnp.zeros([1], x.dtype), ax)]}

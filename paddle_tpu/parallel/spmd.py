@@ -174,35 +174,43 @@ def wrap_shard_map(
 
     _obs.set_gauge("collective.mesh_devices", mesh.size)
 
+    mesh_desc = "x".join(f"{k}{v}" for k, v in mesh.shape.items())
+
     def fn(feeds, smut, sro, step_key):
         _obs.add("collective.shard_map_dispatches")
-        feeds = {
-            k: stage_global(v, mesh, spec_for(program, k), multiproc)
-            for k, v in feeds.items()
-        }
-        if multiproc or partial_manual:
-            # multi-process: state must be global arrays; each process's
-            # scope holds the FULL value (startup ran locally), so
-            # local_is_full slices out this process's part.
-            # hybrid: the Auto axes' sharding lives ONLY on the arrays'
-            # committed NamedShardings (the body specs project them away),
-            # so state must be staged with its full spec or mp-annotated
-            # params silently stay replicated on every device
-            smut = {
-                k: stage_global(
-                    v, mesh, spec_for(program, k), multiproc,
-                    local_is_full=True,
-                )
-                for k, v in smut.items()
+        # a traced child span under executor.step: in a causal trace the
+        # staging+dispatch segment is attributable to the mesh, and the
+        # mesh shape rides on the span for the pod-timeline merge
+        with _obs.span("spmd.dispatch", category="spmd", mesh=mesh_desc):
+            feeds = {
+                k: stage_global(v, mesh, spec_for(program, k), multiproc)
+                for k, v in feeds.items()
             }
-            sro = {
-                k: stage_global(
-                    v, mesh, spec_for(program, k), multiproc,
-                    local_is_full=True,
-                )
-                for k, v in sro.items()
-            }
-        return jitted(feeds, smut, sro, step_key)
+            if multiproc or partial_manual:
+                # multi-process: state must be global arrays; each
+                # process's scope holds the FULL value (startup ran
+                # locally), so local_is_full slices out this process's
+                # part.
+                # hybrid: the Auto axes' sharding lives ONLY on the
+                # arrays' committed NamedShardings (the body specs
+                # project them away), so state must be staged with its
+                # full spec or mp-annotated params silently stay
+                # replicated on every device
+                smut = {
+                    k: stage_global(
+                        v, mesh, spec_for(program, k), multiproc,
+                        local_is_full=True,
+                    )
+                    for k, v in smut.items()
+                }
+                sro = {
+                    k: stage_global(
+                        v, mesh, spec_for(program, k), multiproc,
+                        local_is_full=True,
+                    )
+                    for k, v in sro.items()
+                }
+            return jitted(feeds, smut, sro, step_key)
 
     return fn
 
@@ -234,12 +242,15 @@ def wrap_gspmd(
             v, mesh, spec_for(program, k), multiproc, local_is_full=True
         )
 
+    mesh_desc = "x".join(f"{k}{v}" for k, v in mesh.shape.items())
+
     def fn(feeds, smut, sro, step_key):
         _obs.add("collective.gspmd_dispatches")
-        feeds = {k: put(k, v) for k, v in feeds.items()}
-        smut = {k: put(k, v) for k, v in smut.items()}
-        sro = {k: put(k, v) for k, v in sro.items()}
-        return jitted(feeds, smut, sro, step_key)
+        with _obs.span("spmd.dispatch", category="spmd", mesh=mesh_desc):
+            feeds = {k: put(k, v) for k, v in feeds.items()}
+            smut = {k: put(k, v) for k, v in smut.items()}
+            sro = {k: put(k, v) for k, v in sro.items()}
+            return jitted(feeds, smut, sro, step_key)
 
     return fn
 

@@ -131,10 +131,20 @@ class Heartbeat:
 
     def _publish_locked(self):
         """Write the current counter + a fresh timestamp to the beat file
-        (lock held by the caller)."""
+        (lock held by the caller). When a TraceContext is active on the
+        beating thread, its ids are stamped into the payload — the
+        cross-RANK leg of causal tracing: per-rank span exports plus
+        these beat stamps let ``perf_report --merge`` stitch one pod-wide
+        causal timeline (a beat names the trace its rank's current step
+        belongs to)."""
         payload = {
             "rank": self.rank, "step": self.step, "time": self._time()
         }
+        from ..observability import trace as _trace
+
+        ctx = _trace.current()
+        if ctx is not None:
+            payload.update(ctx.to_dict())
         fd, tmp = tempfile.mkstemp(
             dir=self.directory, prefix=f"hb_rank{self.rank}.tmp."
         )
@@ -181,9 +191,17 @@ class LivenessPulse:
         self._interval = float(interval)
         self._stop = threading.Event()
         self._thread = None
+        self._ctx = None
 
     def __enter__(self):
         if self._cb is not None:
+            from ..observability import trace as _trace
+
+            # capture/activate handoff onto the pulse thread: the pulse
+            # span files under whatever the guarded body runs in (the
+            # async publish span, the sync save's step trace), so a
+            # trace of a slow save SHOWS its liveness pulses
+            self._ctx = _trace.capture()
             self._stop.clear()
             self._thread = threading.Thread(
                 target=self._run, daemon=True, name="liveness-pulse"
@@ -199,11 +217,18 @@ class LivenessPulse:
         return False
 
     def _run(self):
-        while not self._stop.wait(self._interval):
-            try:
-                self._cb()
-            except Exception:
-                pass
+        from .. import observability as _obs
+        from ..observability import trace as _trace
+
+        # ONE span for the pulse thread's whole life (per-tick spans
+        # would flood the ring buffer on a genuinely slow upload)
+        with _trace.activate(self._ctx), \
+                _obs.span("health.pulse", category="health"):
+            while not self._stop.wait(self._interval):
+                try:
+                    self._cb()
+                except Exception:
+                    pass
 
 
 class StepWatchdog:

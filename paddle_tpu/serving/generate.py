@@ -151,27 +151,36 @@ class GPTGenerator:
                 f"exceeds max_len {self.max_len}"
             )
         self.reset()
+        # child spans under the caller's trace (the serving router
+        # activates the request's context around runner.run): the
+        # prefill/decode split of a generate request's latency — each
+        # executor.step inside nests one level further
         with self._scope_guard(self.scope):
-            (logits,) = self.executor.run(
-                self.prefill_prog, feed={"context_ids": ids},
-                fetch_list=self._prefill_fetch, scope=self.scope,
-            )
+            with _obs.span("serving.prefill", category="serving",
+                           context_len=self.context_len):
+                (logits,) = self.executor.run(
+                    self.prefill_prog, feed={"context_ids": ids},
+                    fetch_list=self._prefill_fetch, scope=self.scope,
+                )
             _obs.add("serving.prefill_steps")
             out = np.zeros((self.batch, max_new_tokens), np.int64)
             nxt = np.argmax(np.asarray(logits)[:, -1, :], axis=-1)
             out[:, 0] = nxt
-            for t in range(1, max_new_tokens):
-                pos = self.context_len + t - 1  # position of the fed token
-                (logits,) = self.executor.run(
-                    self.decode_prog,
-                    feed={
-                        "token_ids": nxt[:, None].astype(np.int64),
-                        "pos_ids": np.array([[pos]], np.int64),
-                    },
-                    fetch_list=self._decode_fetch, scope=self.scope,
-                )
-                nxt = np.argmax(np.asarray(logits)[:, -1, :], axis=-1)
-                out[:, t] = nxt
+            with _obs.span("serving.decode_loop", category="serving",
+                           tokens=int(max_new_tokens)):
+                for t in range(1, max_new_tokens):
+                    # position of the fed token
+                    pos = self.context_len + t - 1
+                    (logits,) = self.executor.run(
+                        self.decode_prog,
+                        feed={
+                            "token_ids": nxt[:, None].astype(np.int64),
+                            "pos_ids": np.array([[pos]], np.int64),
+                        },
+                        fetch_list=self._decode_fetch, scope=self.scope,
+                    )
+                    nxt = np.argmax(np.asarray(logits)[:, -1, :], axis=-1)
+                    out[:, t] = nxt
             _obs.add("serving.decode_steps", max(0, max_new_tokens - 1))
         return out
 

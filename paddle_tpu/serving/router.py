@@ -74,12 +74,16 @@ class EndpointConfig:
 
 
 class _Request:
-    __slots__ = ("feeds", "future", "t_enqueue")
+    __slots__ = ("feeds", "future", "t_enqueue", "ctx")
 
     def __init__(self, feeds):
         self.feeds = feeds
         self.future = Future()
         self.t_enqueue = time.perf_counter()
+        # TraceContext parenting this request's scheduler-side spans
+        # (queue wait, dispatch) under its ingest span — the explicit
+        # capture/activate handoff across the scheduler thread boundary
+        self.ctx = None
 
 
 class FrozenRunner:
@@ -169,6 +173,7 @@ class Endpoint:
             raise
 
     def _ingest(self, feeds):
+        from ..observability import trace
         from ..resilience.faults import fault_point
 
         # the chaos seam (dataloader.fetch analogue): an armed fault
@@ -179,23 +184,35 @@ class Endpoint:
             n: np.asarray(feeds[n]) for n in self.runner.feed_names
         }
         req = _Request(feeds)
-        with self._cond:
-            if self._draining or self._stopped:
-                raise ServerDrainingError(
-                    f"endpoint {self.name!r} is draining; request refused"
+        # each request gets a causal trace: join the submitter's active
+        # trace when there is one (the client's own span becomes the
+        # root), else start a fresh one — either way the scheduler-side
+        # spans parent under THIS ingest span via the request's context
+        tr = trace.ensure()
+        with trace.activate(tr), \
+                self._obs.span("serving.ingest", category="serving",
+                               endpoint=self.name) as ingest_span:
+            with self._cond:
+                if self._draining or self._stopped:
+                    raise ServerDrainingError(
+                        f"endpoint {self.name!r} is draining; request "
+                        "refused"
+                    )
+                if len(self._queue) >= self.config.max_queue:
+                    self._obs.add("serving.rejected")
+                    self._obs.add(f"serving.rejected.{self.name}")
+                    raise PreconditionNotMetError(
+                        f"endpoint {self.name!r} queue full "
+                        f"({self.config.max_queue}); shed load or add "
+                        "capacity"
+                    )
+                if tr is not None and ingest_span.span_id is not None:
+                    req.ctx = tr.child(ingest_span.span_id)
+                self._queue.append(req)
+                self._obs.set_gauge(
+                    f"serving.queue_depth.{self.name}", len(self._queue)
                 )
-            if len(self._queue) >= self.config.max_queue:
-                self._obs.add("serving.rejected")
-                self._obs.add(f"serving.rejected.{self.name}")
-                raise PreconditionNotMetError(
-                    f"endpoint {self.name!r} queue full "
-                    f"({self.config.max_queue}); shed load or add capacity"
-                )
-            self._queue.append(req)
-            self._obs.set_gauge(
-                f"serving.queue_depth.{self.name}", len(self._queue)
-            )
-            self._cond.notify_all()
+                self._cond.notify_all()
         self._obs.add("serving.requests")
         self._obs.add(f"serving.requests.{self.name}")
         return req.future
@@ -234,9 +251,22 @@ class Endpoint:
         return self.config.buckets[-1]
 
     def _run_batch(self, batch):
+        from ..observability import spans, trace
+
         t0 = time.perf_counter()
         n = len(batch)
         bucket = self._bucket_for(n)
+        # queue wait ends the moment the batch forms: recorded per
+        # request under ITS trace (the capture/activate handoff — this
+        # runs on the scheduler thread, the context was captured at
+        # ingest), so "where did this request's latency go" splits into
+        # queue-wait vs dispatch from the trace alone
+        for r in batch:
+            spans.record(
+                "serving.queue_wait", t0 - r.t_enqueue,
+                category="serving", ctx=r.ctx,
+                args={"endpoint": self.name, "batch_size": n},
+            )
         try:
             feed = {}
             for name in self.runner.feed_names:
@@ -248,7 +278,16 @@ class Endpoint:
                     rows = np.concatenate([rows, pad], axis=0)
                 feed[name] = rows
             with self._run_lock:
-                outs = [np.asarray(o) for o in self.runner.run(feed)]
+                # the live dispatch span (and everything the runner
+                # records inside: executor.step, GPT prefill/decode)
+                # files under the FIRST request's trace; the other
+                # requests get their dispatch share recorded
+                # retrospectively below, so every trace is complete
+                with trace.activate(batch[0].ctx), \
+                        self._obs.span("serving.batch", category="serving",
+                                       endpoint=self.name, bucket=bucket,
+                                       batch_size=n):
+                    outs = [np.asarray(o) for o in self.runner.run(feed)]
         except Exception as exc:
             self._obs.add("serving.request_errors", n)
             for r in batch:
@@ -256,6 +295,13 @@ class Endpoint:
             return
         dt = time.perf_counter() - t0
         now = time.perf_counter()
+        for r in batch:
+            spans.record(
+                "serving.dispatch", now - t0, category="serving",
+                ctx=r.ctx,
+                args={"endpoint": self.name, "bucket": bucket,
+                      "batch_size": n},
+            )
         self._obs.add("serving.batches")
         self._obs.add(f"serving.batches.{self.name}")
         self._obs.add(f"serving.bucket_runs.{self.name}.{bucket}")

@@ -218,7 +218,8 @@ def test_payload_byte_counters_by_kind_and_precision():
     n = 64 * 256
     observability.reset()
     for quant in ("none", "int8"):
-        _record_zero("reduce_scatter", _Op(quant), n, jnp.float32, "dp", 2)
+        _record_zero(None, "reduce_scatter", _Op(quant), n, jnp.float32,
+                     "dp", 2)
     c = observability.snapshot()["counters"]
     fp = c["collective.bytes.reduce_scatter_fp32"]
     q8 = c["collective.bytes.reduce_scatter_int8"]

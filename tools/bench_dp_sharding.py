@@ -112,8 +112,12 @@ def _build_and_train(mode, steps, quant=None):
             rng = np.random.RandomState(100 + i)
             feed = {"x": rng.randn(b, d).astype(np.float32),
                     "y": rng.randn(b, 1).astype(np.float32)}
+            # return_numpy=True: this loop materializes the loss every
+            # step anyway (no pipelining to preserve), and the numpy
+            # path is the one that publishes the perf.step_attribution
+            # sample the CI attribution gate reads
             (lv,) = exe.run(main, feed=feed, fetch_list=[loss],
-                            scope=scope, return_numpy=False)
+                            scope=scope)
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
         # baseline optimizer-state bytes: the replicated accumulators
         state_bytes = 0

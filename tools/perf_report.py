@@ -136,6 +136,7 @@ def merge_traces(paths, heartbeat_dir=None):
     """
     merged = []
     per_rank_steps = {}
+    trace_ranks = {}  # trace_id -> set of ranks that recorded it
     # two passes over the rank ids: collisions (same basename copied into
     # per-host dirs) remap to ids NO input declares, so a duplicate never
     # steals a later file's genuine rank
@@ -170,6 +171,9 @@ def merge_traces(paths, heartbeat_dir=None):
                     tid_seen.add(e.get("tid"))
                     merged.append({**e, "pid": rank})
                 continue
+            tr = (e.get("args") or {}).get("trace_id")
+            if tr:
+                trace_ranks.setdefault(tr, set()).add(rank)
             merged.append({**e, "pid": rank})
         per_rank_steps[rank] = _step_spans(
             [e for e in events if e.get("ph") == "X"]
@@ -188,6 +192,13 @@ def merge_traces(paths, heartbeat_dir=None):
                 continue
             if not isinstance(beat, dict):
                 continue
+            # beats carry the beating step's trace stamp (health.py):
+            # the cross-RANK stitch — a trace whose spans live on one
+            # rank and whose beat lands on another is one causal timeline
+            if beat.get("trace_id"):
+                trace_ranks.setdefault(beat["trace_id"], set()).add(
+                    int(beat.get("rank", 0))
+                )
             merged.append({
                 "ph": "I", "s": "p", "pid": int(beat.get("rank", 0)),
                 "tid": 0, "name": f"heartbeat step {beat.get('step')}",
@@ -195,6 +206,10 @@ def merge_traces(paths, heartbeat_dir=None):
                 "args": dict(beat),
             })
     stats = _skew_stats(per_rank_steps)
+    stats["traced_trace_ids"] = len(trace_ranks)
+    stats["cross_rank_traces"] = sum(
+        1 for ranks_ in trace_ranks.values() if len(ranks_) > 1
+    )
     return {"traceEvents": merged}, stats
 
 
@@ -266,6 +281,86 @@ def _print_merge_stats(stats):
 
 
 # ---------------------------------------------------------------------------
+# per-step attribution: estimate vs measured compute / wait split
+# ---------------------------------------------------------------------------
+
+
+def report_attribution(snapshot_path, require_wait=False):
+    """Render the executor's ``perf.step_attribution`` table (measured
+    compute / collective-wait / host-stall split vs the cost model's
+    wire-time estimate) from an observability snapshot. This is the
+    serialized-wire denominator ROADMAP item 4 measures overlap against:
+    ``wait_fraction_collective`` of a serialized step is the share an
+    overlapped schedule can hide.
+
+    ``require_wait=True`` additionally fails unless the leg actually
+    exercised the wire (est_wire_seconds > 0) — the dp-sharded CI leg's
+    guard that the split did not silently degrade to compute-only."""
+    with open(snapshot_path) as f:
+        snap = json.load(f)
+    table = (snap.get("tables") or {}).get("perf.step_attribution")
+    if not table:
+        print(
+            "no perf.step_attribution table in the snapshot — run at "
+            "least 2 steps of one executable (the first carries the "
+            "compile) with monitoring on",
+            file=sys.stderr,
+        )
+        return 2
+    ms = 1e3
+    print("==== per-step attribution (steady-state window mean) ====")
+    print(
+        f"  measured step      {table['step_seconds'] * ms:9.3f} ms over "
+        f"{table.get('window_steps', 0)} step(s)"
+    )
+    denom = table["step_seconds"] or 1.0
+    for key, label in (
+        ("compute_seconds", "compute"),
+        ("collective_wait_seconds", "collective wait"),
+        ("host_stall_seconds", "host stall"),
+    ):
+        v = table.get(key, 0.0)
+        print(f"  {label:<18} {v * ms:9.3f} ms  ({v / denom:6.1%})")
+    est_wire = table.get("est_wire_seconds", 0.0)
+    est_comp = table.get("est_compute_seconds", 0.0)
+    print(
+        f"  cost-model roofline: compute {est_comp * ms:.3f} ms, wire "
+        f"{est_wire * ms:.3f} ms -> est wait fraction "
+        f"{table.get('est_wait_fraction', 0.0):.1%} "
+        f"(measured {table.get('wait_fraction_collective', 0.0):.1%} of "
+        "the step)"
+    )
+    if table.get("traced_wire_bytes"):
+        print(
+            f"  traced collective sites move ~"
+            f"{table['traced_wire_bytes'] / 1e6:.3f} MB wire/step "
+            "(emitter-side cross-check)"
+        )
+    gauges = snap.get("gauges", {})
+    waits = {k: v for k, v in gauges.items()
+             if k.startswith("perf.wait_fraction.")}
+    if waits:
+        print("  live gauges: " + "  ".join(
+            f"{k.split('.')[-1]}={v:.1%}" for k, v in sorted(waits.items())
+        ))
+    bad = []
+    for key in ("wait_fraction_collective", "wait_fraction_host",
+                "est_wait_fraction"):
+        v = table.get(key)
+        if v is None or not (0.0 <= v <= 1.0):
+            bad.append(f"{key}={v!r}")
+    if require_wait and est_wire <= 0:
+        bad.append("est_wire_seconds=0 (leg never touched the wire)")
+    if require_wait and table.get("collective_wait_seconds", 0) <= 0:
+        bad.append("collective_wait_seconds=0")
+    if bad:
+        print(f"attribution check FAILED: {bad}", file=sys.stderr)
+        return 2
+    print(json.dumps({"attribution": table}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 
 
 def main(argv=None):
@@ -288,12 +383,23 @@ def main(argv=None):
                          "exit status fails (default 1)")
     ap.add_argument("--merge", nargs="+", metavar="TRACE.json",
                     help="merge per-rank chrome span exports")
+    ap.add_argument("--attribution", metavar="SNAPSHOT.json",
+                    help="render the perf.step_attribution table "
+                         "(measured compute/wait/host split vs the cost "
+                         "model's wire estimate) from a snapshot")
+    ap.add_argument("--require-wait", action="store_true",
+                    help="with --attribution: fail unless the leg "
+                         "exercised the wire (est_wire_seconds > 0)")
     ap.add_argument("--heartbeat-dir", metavar="DIR",
                     help="fold hb_rank* beats into the merged trace")
     ap.add_argument("-o", "--out", metavar="PATH",
                     help="write the merged trace JSON here")
     args = ap.parse_args(argv)
 
+    if args.attribution:
+        return report_attribution(
+            args.attribution, require_wait=args.require_wait
+        )
     if args.merge:
         trace, stats = merge_traces(args.merge, args.heartbeat_dir)
         _print_merge_stats(stats)

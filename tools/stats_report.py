@@ -146,6 +146,35 @@ def render(snap, top_ops=0):
         )
     if "perf.cost_table" in tables:
         _render_cost_table(tables["perf.cost_table"], top_ops, lines)
+    # per-step attribution digest: the compute/collective-wait/host-stall
+    # split the executor publishes (the serialized-wire denominator)
+    attr = tables.get("perf.step_attribution")
+    if attr:
+        lines.append("-- step attribution --")
+        lines.append(
+            f"  step {attr.get('step_seconds', 0) * 1e3:.3f} ms = compute "
+            f"{attr.get('compute_seconds', 0) * 1e3:.3f} + collective-wait "
+            f"{attr.get('collective_wait_seconds', 0) * 1e3:.3f} + "
+            f"host-stall {attr.get('host_stall_seconds', 0) * 1e3:.3f} ms"
+        )
+        lines.append(
+            f"  wait fraction {attr.get('wait_fraction_collective', 0):.1%}"
+            f" (cost-model wire estimate "
+            f"{attr.get('est_wait_fraction', 0):.1%} of roofline)"
+        )
+    # live watcher digest: structured findings, newest last
+    wf = (tables.get("watch.findings") or {}).get("findings") or []
+    if wf:
+        lines.append(f"-- watch findings ({len(wf)} recent) --")
+        for f_ in wf[-8:]:
+            detail = ", ".join(
+                f"{k}={v}" for k, v in sorted(f_.get("detail", {}).items())
+                if not isinstance(v, dict)
+            )
+            lines.append(
+                f"  [{f_.get('severity', '?'):<7}] {f_.get('kind', '?')}: "
+                f"{detail}"
+            )
     lines.append(f"span buffer: {snap.get('span_count', 0)} spans")
     if not (counters or gauges or hists):
         lines.append("(snapshot is empty — PADDLE_TPU_MONITOR=0, or nothing "

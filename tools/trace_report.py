@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Reconstruct causal traces from per-rank Chrome span exports.
+
+The observability layer stamps every span recorded under an active
+TraceContext with ``trace_id`` / ``span_id`` / ``parent_id`` (ride-along
+in each "X" event's args — see paddle_tpu/observability/trace.py), so the
+causal tree is reconstructible from export files ALONE: no live process,
+no jax. Feed it one file per rank (``observability.save_chrome_trace``)
+or a merged pod trace (``perf_report.py --merge`` output):
+
+    python tools/trace_report.py trace_rank0.json trace_rank1.json
+
+Per trace it prints the span tree (indent = causality, not wall order),
+thread/rank fan-out, and a per-category time rollup; the last line is a
+machine-readable JSON stats summary.
+
+CI modes:
+
+* ``--check`` — exit non-zero unless at least ``--min-traces`` COMPLETE
+  traces exist that span at least ``--min-threads`` distinct threads
+  (complete = has a root and every parent_id resolves inside the trace;
+  an orphan span means a broken handoff or a parent lost to the ring
+  buffer). ``--require-span NAME`` (repeatable) additionally demands a
+  qualifying trace contain the named span.
+* ``--broken-fixture`` — self-test: runs the checker over a seeded trace
+  with an orphan span; the exit status MUST be non-zero (ci.sh asserts
+  the checker still catches broken traces).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_RANK_RE = re.compile(r"rank[_-]?(\d+)")
+
+
+def _rank_of(path, position):
+    m = _RANK_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else position
+
+
+def load_spans(paths):
+    """Traced spans from chrome-trace export files.
+
+    Returns a list of dicts with name/cat/ts/dur/trace_id/span_id/
+    parent_id/thread, where ``thread`` is a (rank, pid, tid) triple —
+    distinct triples are distinct execution threads. Untraced spans
+    (no trace_id) are skipped: they are the flat legacy view."""
+    spans = []
+    for i, path in enumerate(paths):
+        rank = _rank_of(path, i)
+        with open(path) as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents", trace)
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            args = e.get("args") or {}
+            if "trace_id" not in args:
+                continue
+            spans.append({
+                "name": e.get("name", "?"),
+                "cat": e.get("cat", ""),
+                "ts": float(e.get("ts", 0.0)),
+                "dur": float(e.get("dur", 0.0)),
+                "trace_id": args["trace_id"],
+                "span_id": args.get("span_id"),
+                "parent_id": args.get("parent_id"),
+                # a merged pod trace carries rank as pid; per-rank export
+                # files carry it in the filename
+                "rank": e.get("pid", rank) if len(paths) == 1 else rank,
+                "thread": (rank, e.get("pid", 0), e.get("tid", 0)),
+            })
+    return spans
+
+
+def build_traces(spans):
+    """Group spans into traces and judge completeness."""
+    traces = {}
+    for s in spans:
+        traces.setdefault(s["trace_id"], []).append(s)
+    out = []
+    for tid, ss in traces.items():
+        ids = {s["span_id"] for s in ss if s["span_id"]}
+        roots = [s for s in ss if not s["parent_id"]]
+        orphans = [
+            s for s in ss
+            if s["parent_id"] and s["parent_id"] not in ids
+        ]
+        threads = {s["thread"] for s in ss}
+        ranks = {s["rank"] for s in ss}
+        t0 = min(s["ts"] for s in ss)
+        t1 = max(s["ts"] + s["dur"] for s in ss)
+        out.append({
+            "trace_id": tid,
+            "spans": sorted(ss, key=lambda s: s["ts"]),
+            "roots": roots,
+            "orphans": orphans,
+            "complete": bool(roots) and not orphans,
+            "threads": threads,
+            "ranks": ranks,
+            "wall_us": t1 - t0,
+        })
+    # widest traces first: the interesting ones for a human
+    out.sort(key=lambda t: (-len(t["threads"]), -len(t["spans"])))
+    return out
+
+
+def _print_tree(trace, max_spans=40):
+    children = {}
+    for s in trace["spans"]:
+        children.setdefault(s["parent_id"], []).append(s)
+
+    lines = []
+
+    def walk(span, depth):
+        if len(lines) >= max_spans:
+            return
+        lines.append(
+            f"  {'  ' * depth}{span['name']:<28} "
+            f"{span['dur'] / 1e3:>9.3f} ms  "
+            f"[rank {span['rank']} tid {span['thread'][2]}]"
+        )
+        for c in sorted(children.get(span["span_id"], []),
+                        key=lambda s: s["ts"]):
+            walk(c, depth + 1)
+
+    for root in sorted(trace["roots"], key=lambda s: s["ts"]):
+        walk(root, 0)
+    for line in lines:
+        print(line)
+    n = len(trace["spans"])
+    if n > max_spans:
+        print(f"  ... ({n - max_spans} more spans)")
+    for o in trace["orphans"][:5]:
+        print(f"  ORPHAN {o['name']} (parent {o['parent_id']} missing)")
+
+
+def _category_rollup(trace):
+    cats = {}
+    for s in trace["spans"]:
+        cats[s["cat"]] = cats.get(s["cat"], 0.0) + s["dur"]
+    return {c: round(d / 1e3, 3) for c, d in
+            sorted(cats.items(), key=lambda kv: -kv[1])}
+
+
+def report(paths, check=False, min_threads=2, min_traces=1,
+           require_spans=(), top=5, quiet=False):
+    spans = load_spans(paths)
+    traces = build_traces(spans)
+    qualifying = []
+    for t in traces:
+        if not t["complete"] or len(t["threads"]) < min_threads:
+            continue
+        names = {s["name"] for s in t["spans"]}
+        if any(r not in names for r in require_spans):
+            continue
+        qualifying.append(t)
+    if not quiet:
+        for t in traces[:top]:
+            mark = "complete" if t["complete"] else (
+                f"INCOMPLETE ({len(t['orphans'])} orphans)"
+                if t["orphans"] else "INCOMPLETE (no root)"
+            )
+            print(
+                f"== trace {t['trace_id']}: {len(t['spans'])} spans, "
+                f"{len(t['threads'])} thread(s), {len(t['ranks'])} "
+                f"rank(s), {t['wall_us'] / 1e3:.3f} ms [{mark}] =="
+            )
+            _print_tree(t)
+            print(f"  by category (ms): {_category_rollup(t)}")
+        if len(traces) > top:
+            print(f"... ({len(traces) - top} more traces)")
+    stats = {
+        "files": len(paths),
+        "traced_spans": len(spans),
+        "traces": len(traces),
+        "complete_traces": sum(1 for t in traces if t["complete"]),
+        "orphan_spans": sum(len(t["orphans"]) for t in traces),
+        "max_threads": max((len(t["threads"]) for t in traces), default=0),
+        "cross_thread_traces": sum(
+            1 for t in traces if len(t["threads"]) > 1
+        ),
+        "cross_rank_traces": sum(1 for t in traces if len(t["ranks"]) > 1),
+        "qualifying_traces": len(qualifying),
+        "min_threads": min_threads,
+    }
+    print(json.dumps(stats))
+    if check and len(qualifying) < min_traces:
+        print(
+            f"CHECK FAILED: {len(qualifying)} complete trace(s) spanning "
+            f">= {min_threads} threads"
+            + (f" containing {list(require_spans)}" if require_spans
+               else "")
+            + f", need {min_traces}",
+            file=sys.stderr,
+        )
+        return 3
+    return 0
+
+
+def _broken_fixture(tmpdir):
+    """A seeded export whose only trace has an orphan span (its parent was
+    never exported — the exact signature of a broken thread handoff)."""
+    events = [
+        {"ph": "X", "name": "train.step", "cat": "host", "ts": 1000.0,
+         "dur": 5000.0, "pid": 0, "tid": 0,
+         "args": {"trace_id": "t1", "span_id": "a"}},
+        {"ph": "X", "name": "checkpoint.publish", "cat": "checkpoint",
+         "ts": 2000.0, "dur": 1000.0, "pid": 0, "tid": 1,
+         "args": {"trace_id": "t1", "span_id": "c",
+                  "parent_id": "DEAD-NEVER-EXPORTED"}},
+    ]
+    path = os.path.join(tmpdir, "broken_trace_rank0.json")
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("traces", nargs="*", metavar="TRACE.json",
+                    help="chrome span export files (one per rank, or one "
+                         "merged pod trace)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero unless the completeness bar holds")
+    ap.add_argument("--min-threads", type=int, default=2,
+                    help="threads a qualifying trace must span (default 2)")
+    ap.add_argument("--min-traces", type=int, default=1,
+                    help="qualifying traces --check requires (default 1)")
+    ap.add_argument("--require-span", action="append", default=[],
+                    metavar="NAME",
+                    help="qualifying traces must contain this span "
+                         "(repeatable)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="traces to print trees for (default 5)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="stats JSON only, no trees")
+    ap.add_argument("--broken-fixture", action="store_true",
+                    help="self-test: check a seeded orphan-span export "
+                         "(must exit non-zero)")
+    args = ap.parse_args(argv)
+
+    if args.broken_fixture:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            return report(
+                [_broken_fixture(td)], check=True,
+                min_threads=2, min_traces=1, quiet=True,
+            )
+    if not args.traces:
+        ap.error("pass trace export files (or --broken-fixture)")
+    return report(
+        args.traces, check=args.check, min_threads=args.min_threads,
+        min_traces=args.min_traces, require_spans=args.require_span,
+        top=args.top, quiet=args.quiet,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
