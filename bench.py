@@ -974,6 +974,27 @@ def bench_mask_rcnn(on_accel):
     }
 
 
+def _run_bench_child(script):
+    """Run a tools/ bench script in its own (virtual-mesh-pinned) child
+    process and parse the ONE JSON line it prints as its result."""
+    import os
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", script)],
+        capture_output=True, text=True, timeout=1200,
+    )
+    line = (proc.stdout or "").strip().splitlines()
+    if proc.returncode != 0 or not line:
+        raise RuntimeError(
+            f"{script} failed (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}"
+        )
+    return json.loads(line[-1])
+
+
 def bench_dp_sharding(on_accel):
     """ZeRO weight-update sharding + quantized collectives on the dp=8
     virtual mesh (tools/bench_dp_sharding.py in a pinned CPU child
@@ -981,27 +1002,30 @@ def bench_dp_sharding(on_accel):
     wire bytes vs the allreduce baseline, optimizer-state bytes/rank,
     and loss parity. Gates: >=40% int8 payload reduction, state/rank
     ~1/8, fp32 parity."""
-    import os
-    import subprocess
-
-    proc = subprocess.run(
-        [sys.executable,
-         os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                      "tools", "bench_dp_sharding.py")],
-        capture_output=True, text=True, timeout=1200,
-    )
-    line = (proc.stdout or "").strip().splitlines()
-    if proc.returncode != 0 or not line:
-        raise RuntimeError(
-            f"bench_dp_sharding failed (rc={proc.returncode}): "
-            f"{proc.stderr[-500:]}"
-        )
-    m = json.loads(line[-1])
+    m = _run_bench_child("bench_dp_sharding.py")
     return {
         **m,
         "metric": "dp_sharding_payload_reduction",
         "value": m["int8_payload_reduction"],
         "unit": "fraction_of_allreduce_wire_bytes_saved",
+    }
+
+
+def bench_dp_overlap(on_accel):
+    """Communication/compute overlap on the dp=8 virtual mesh
+    (tools/bench_overlap.py in a pinned CPU child): bucketed grad
+    collectives + prefetched all-gathers vs PR 9's serialized ZeRO — the
+    r9 schedule is the denominator, PR 13's wait-fraction attribution the
+    measurement. Self-gating: overlapped step <= serialized, fp32 bitwise
+    parity, int8 within the r9 tolerance, wait fraction drops."""
+    m = _run_bench_child("bench_overlap.py")
+    return {
+        **m,
+        "metric": "dp_overlap_speedup",
+        "value": m["overlap_speedup"],
+        "unit": "serialized_step_over_overlapped_step",
+        "baseline_note": "serialized ZeRO (r9 schedule) on the same "
+                         "model/mesh is the denominator",
     }
 
 
@@ -1019,6 +1043,7 @@ def main():
         ("deepfm_fused", lambda: bench_deepfm_fused(on_accel)),
         ("mask_rcnn", lambda: bench_mask_rcnn(on_accel)),
         ("dp_sharding", lambda: bench_dp_sharding(on_accel)),
+        ("dp_overlap", lambda: bench_dp_overlap(on_accel)),
     ]
     if on_accel:
         legs += [

@@ -59,6 +59,31 @@ python tools/perf_report.py --attribution "$DPS_DIR/dp_sharding_stats.json" \
     --require-wait
 rm -rf "$DPS_DIR"
 
+echo "== communication/compute overlap: bucketed collectives + prefetch =="
+# overlapped vs serialized ZeRO on the dp=8 virtual mesh: the tool
+# self-gates (overlapped step <= serialized, fp32 bitwise parity, int8
+# parity, measured perf.wait_fraction.collective drops) and its snapshot
+# must carry the bucket counters + the overlap-ratio gauge
+OVL_DIR=$(mktemp -d)
+python tools/bench_overlap.py --dump "$OVL_DIR/overlap_stats.json"
+python tools/stats_report.py "$OVL_DIR/overlap_stats.json" \
+    --require collective.buckets --require collective.bucket_bytes \
+    --require collective.overlap_ratio \
+    --require collective.bytes.bucket_reduce_scatter \
+    --require perf.wait_fraction
+# the overlapped schedule's attribution split must exist with a nonzero
+# exposed-wire term (the overlap-aware estimate stays inside the same
+# estimate-vs-XLA discipline the perf-report stage gates below)
+python tools/perf_report.py --attribution "$OVL_DIR/overlap_stats.json" \
+    --require-wait
+rm -rf "$OVL_DIR"
+# ...and the collective-schedule lint must reject a rank-divergent
+# bucketing (bucket membership is part of the cross-rank wire contract)
+if python tools/program_lint.py --broken-bucket-fixture > /dev/null 2>&1; then
+    echo "program_lint failed to reject the rank-divergent bucket fixture" >&2
+    exit 1
+fi
+
 echo "== embedding engine smoke: fused lookup + cache tier + prefetch =="
 # fused-vs-per-slot op reduction, batch dedup, hot-tier capacity beyond
 # the device-resident rows (cold host path, eviction+write-back), async

@@ -59,6 +59,8 @@ ATTR_AXIS_OPS = {
     "barrier": "dp",
     "c_allreduce_any": "dp",
     "zero_reduce_scatter": "dp",
+    "zero_bucket_reduce_scatter": "dp",
+    "c_bucket_allreduce_sum": "dp",
     "zero_all_gather": "dp",
     "dgc_momentum_step": "dp",
     "distributed_lookup_table": "ps",
@@ -90,18 +92,32 @@ MAX_RANK_COMBOS = 128
 # different collective SEQUENCE per wire format, and the column partition
 # runs an all-gather instead of a psum — both are part of the site kind.
 _QUANT_KIND_OPS = frozenset({
-    "zero_reduce_scatter", "zero_all_gather",
+    "zero_reduce_scatter", "zero_all_gather", "zero_bucket_reduce_scatter",
     "distributed_lookup_table", "fused_lookup_table",
 })
 _LOOKUP_KIND_OPS = frozenset({
     "distributed_lookup_table", "fused_lookup_table",
 })
+# bucketed collectives: MEMBERSHIP AND ORDER are part of the cross-rank
+# wire contract — two ranks disagreeing on which grads share a bucket (or
+# on their order inside it) exchange different payload layouts on the same
+# collective slot, which deadlocks or silently corrupts exactly like a
+# kind mismatch. The per-member size list therefore joins the site kind,
+# so a rank-divergent bucketing is a build-time COLLECTIVE_DIVERGENCE
+# ERROR, not a pod hang. op type -> attr carrying the member sizes.
+_BUCKET_KIND_OPS = {
+    "zero_bucket_reduce_scatter": "pad_lens",
+    "c_bucket_allreduce_sum": "bucket_numels",
+}
 
 
 def _site_kind(op, t):
     kind = t
     if t in _LOOKUP_KIND_OPS and op.attr("partition", "row") == "col":
         kind = f"{t}:col"
+    if t in _BUCKET_KIND_OPS:
+        sizes = op.attr(_BUCKET_KIND_OPS[t]) or ()
+        kind = f"{kind}[{','.join(str(int(s)) for s in sizes)}]"
     if t in _QUANT_KIND_OPS:
         quant = op.attr("quant", "none")
         if quant and quant != "none":

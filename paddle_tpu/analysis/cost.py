@@ -192,6 +192,11 @@ _COLLECTIVE_FACTORS = {
     "zero_reduce_scatter": lambda n: float(n - 1) / n,
     "zero_all_gather": lambda n: float(n - 1) / n,
     "c_allreduce_any": lambda n: 2.0 * (n - 1) / n,
+    # bucketed overlap schedule (ROADMAP item 4): a bucket moves the same
+    # ring bytes as its members' individual collectives would — the win is
+    # dispatch count and firing position, not payload
+    "c_bucket_allreduce_sum": lambda n: 2.0 * (n - 1) / n,
+    "zero_bucket_reduce_scatter": lambda n: float(n - 1) / n,
 }
 
 #: int8 block quantization (ops/collective.py): effective bytes per
@@ -260,6 +265,16 @@ class CostTable:
     assumptions: list = field(default_factory=list)
     peak_flops: float = 0.0
     peak_bandwidth: float = 0.0
+    #: overlap-aware step-time estimate (seconds), set by
+    #: :func:`estimate_program` ONLY for programs whose collective
+    #: schedule was restructured for overlap (``program._overlap_schedule``
+    #: — bucketed grad collectives / prefetched all-gathers): a
+    #: two-resource simulation where collectives run on the wire channel
+    #: concurrently with compute, and compute blocks only when it consumes
+    #: a collective's output — max(compute, wire) per overlap segment
+    #: instead of a global sum. None = serialized schedule: the step
+    #: estimate is ``total_latency``.
+    scheduled_latency: float = None
 
     @property
     def total_flops(self):
@@ -274,6 +289,40 @@ class CostTable:
         """Sum of per-op rooflines: a LOWER bound on the step (assumes
         perfect overlap within each op, none across ops)."""
         return sum(e.latency for e in self.ops)
+
+    @property
+    def wire_latency(self):
+        """Roofline latency of the collective family alone — the wire
+        time a fully SERIALIZED schedule pays."""
+        return sum(e.latency for e in self.ops if e.family == "collective")
+
+    @property
+    def step_latency(self):
+        """Best step-time estimate under the program's actual collective
+        schedule: :attr:`scheduled_latency` when the schedule is
+        overlap-structured, else the serialized ``total_latency``."""
+        return (
+            self.scheduled_latency if self.scheduled_latency is not None
+            else self.total_latency
+        )
+
+    @property
+    def wire_exposed_latency(self):
+        """Wire seconds the schedule can NOT hide behind compute: the
+        part of :attr:`wire_latency` still on the critical path. Equals
+        ``wire_latency`` for a serialized schedule."""
+        wire = self.wire_latency
+        compute = self.total_latency - wire
+        return min(wire, max(0.0, self.step_latency - compute))
+
+    @property
+    def overlap_ratio(self):
+        """Wire seconds hidden / total wire seconds (0 = fully
+        serialized, 1 = the wire disappears behind the math)."""
+        wire = self.wire_latency
+        if wire <= 0:
+            return 0.0
+        return max(0.0, min(1.0, 1.0 - self.wire_exposed_latency / wire))
 
     def by_family(self):
         fams = {}
@@ -318,6 +367,10 @@ class CostTable:
             "total_flops": self.total_flops,
             "total_bytes": self.total_bytes,
             "total_latency": self.total_latency,
+            "scheduled_latency": self.scheduled_latency,
+            "wire_latency": self.wire_latency,
+            "wire_exposed_latency": self.wire_exposed_latency,
+            "overlap_ratio": self.overlap_ratio,
             "peak_flops": self.peak_flops,
             "peak_bandwidth": self.peak_bandwidth,
             "by_family": self.by_family(),
@@ -334,6 +387,14 @@ class CostTable:
             f"(peak {self.peak_flops / 1e12:.0f} TFLOP/s, "
             f"{self.peak_bandwidth / 1e9:.0f} GB/s)"
         ]
+        if self.scheduled_latency is not None:
+            lines.append(
+                f"overlap schedule: step >= "
+                f"{self.scheduled_latency * 1e3:.3f} ms "
+                f"(wire {self.wire_latency * 1e3:.3f} ms, exposed "
+                f"{self.wire_exposed_latency * 1e3:.3f} ms, "
+                f"{self.overlap_ratio:.0%} hidden behind compute)"
+            )
         fams = sorted(self.by_family().items(),
                       key=lambda kv: -kv[1]["latency"])
         tot_lat = self.total_latency or 1.0
@@ -635,18 +696,31 @@ def _collective_cost(op, ins, outs, axis_sizes):
     if n <= 1:
         return 0.0, 0.0  # unbound axis: the emitter degrades to identity
     factor = _COLLECTIVE_FACTORS.get(op.type, lambda n: 1.0)(n)
-    if op.type in ("zero_reduce_scatter", "zero_all_gather"):
+    if op.type in ("zero_reduce_scatter", "zero_all_gather",
+                   "zero_bucket_reduce_scatter"):
         # the wire payload is the PADDED flat vector at the (possibly
         # quantized) element size, not the declared input tensor:
-        # pad_len * (1B + 4B/quant_block) int8, pad_len * itemsize fp
-        pad = int(op.attr("pad_len") or _nelem(payload))
+        # pad_len * (1B + 4B/quant_block) int8, pad_len * itemsize fp.
+        # A bucket's payload is the sum of its members' pads.
+        if op.type == "zero_bucket_reduce_scatter":
+            pad = int(sum(int(p) for p in (op.attr("pad_lens") or ())))
+            if not pad:
+                pad = sum(
+                    _nelem(v) for v in ins.get("X", ()) if v is not None
+                )
+        else:
+            pad = int(op.attr("pad_len") or _nelem(payload))
         elem = _quant_elem_bytes(
             op.attr("quant", "none"), op.attr("quant_block", 256),
             payload[1] if payload else 4,
         )
         # reduce-scatter sums n contributions per received element
-        flops = float(pad) if op.type == "zero_reduce_scatter" else 0.0
+        flops = float(pad) if op.type != "zero_all_gather" else 0.0
         return flops, pad * elem * factor
+    if op.type == "c_bucket_allreduce_sum":
+        elems = sum(_nelem(v) for v in ins.get("X", ()) if v is not None)
+        itemsize = payload[1] if payload else 4
+        return float(elems), elems * itemsize * factor
     flops = float(_nelem(payload)) if "allreduce" in op.type else 0.0
     return flops, nbytes * factor
 
@@ -707,6 +781,44 @@ def op_cost(op, in_specs, out_specs, axis_sizes=None):
 
 
 # ---------------------------------------------------------------------------
+# overlap-aware schedule simulation
+# ---------------------------------------------------------------------------
+
+
+def _scheduled_latency(entries):
+    """Two-resource step-time simulation over the walk-order cost entries
+    ``(latency, is_wire, reads, writes)``: compute executes ops in program
+    order on one timeline; a collective occupies the wire channel (one
+    collective in flight at a time — the ICI serializes) starting when its
+    inputs exist and the channel is free, WITHOUT blocking compute; a
+    compute op that READS a collective's output waits for that collective
+    to land. The result is max(compute, wire) per overlap segment instead
+    of the serialized global sum — the latency-hiding-scheduler model the
+    bucketed/prefetched transpile is shaped for."""
+    t_c = 0.0  # compute timeline
+    wire_free = 0.0  # when the wire channel is next available
+    pending = {}  # var name -> completion time of the collective writing it
+    for lat, is_wire, reads, writes in entries:
+        if is_wire:
+            dep = max(
+                (pending[r] for r in reads if r in pending), default=0.0
+            )
+            start = max(t_c, wire_free, dep)
+            end = start + lat
+            wire_free = end
+            for w in writes:
+                pending[w] = end
+        else:
+            for r in reads:
+                if r in pending:
+                    t_c = max(t_c, pending.pop(r))
+            for w in writes:
+                pending.pop(w, None)  # overwritten: the wire result is dead
+            t_c += lat
+    return max(t_c, wire_free)
+
+
+# ---------------------------------------------------------------------------
 # the walker
 # ---------------------------------------------------------------------------
 
@@ -729,6 +841,9 @@ class _Estimator:
         )
         self.pinned = set()  # distinct (var name, dim index) pins
         self.unknown_ops = {}
+        # walk-order (latency, is_wire, reads, writes) entries feeding the
+        # overlap-aware schedule simulation (_scheduled_latency)
+        self.sched = []
         mesh = getattr(program, "_mesh", None)
         self.axis_sizes = dict(mesh.shape) if mesh is not None else {}
 
@@ -922,6 +1037,7 @@ class _Estimator:
                 best, best_sub = lat, sub
         if best_sub is not None:
             self.table.ops.extend(best_sub.table.ops)
+            self.sched.extend(best_sub.sched)
             # pins / skipped ops inside the charged branch must still
             # surface in the parent's assumptions
             self.table.assumptions.extend(best_sub.table.assumptions)
@@ -938,10 +1054,22 @@ class _Estimator:
             nbytes / self.table.peak_bandwidth
             if self.table.peak_bandwidth else 0.0,
         )
+        family = family_of(
+            op_type[:-5] if op_type.endswith("_grad") else op_type
+        )
+        reads = tuple(
+            n for names in (getattr(op, "inputs", None) or {}).values()
+            for n in names if n
+        )
+        writes = tuple(
+            n for names in (getattr(op, "outputs", None) or {}).values()
+            for n in names if n
+        )
+        if not hasattr(self, "sched"):  # bare _Estimator (tests) tolerated
+            self.sched = []
+        self.sched.append((lat, family == "collective", reads, writes))
         self.table.ops.append(OpCost(
-            op_type=op_type, family=family_of(
-                op_type[:-5] if op_type.endswith("_grad") else op_type
-            ),
+            op_type=op_type, family=family,
             flops=flops, bytes=float(nbytes), latency=lat, count=count,
             block_idx=block_idx, op_index=op_index,
             loc=loc if loc is not None else str(
@@ -969,6 +1097,16 @@ def estimate_program(program, feed_shapes=None, peak_tflops=None,
     )
     est = _Estimator(program, feed_shapes, table)
     est.walk_block(program.global_block)
+    if getattr(program, "_overlap_schedule", False):
+        # the transpiler restructured the collective schedule for overlap
+        # (bucketed grad collectives / prefetched all-gathers): estimate
+        # the step as the two-resource simulation instead of the
+        # serialized sum, and record the modeling choice
+        table.scheduled_latency = _scheduled_latency(est.sched)
+        table.assumptions.append(
+            "overlap schedule: step estimated as max(compute, wire) per "
+            "overlap segment (collectives on a concurrent wire channel)"
+        )
     if est.pinned:
         table.assumptions.append(
             f"pinned {len(est.pinned)} unknown (-1) dims to batch hint "

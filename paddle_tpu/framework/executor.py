@@ -70,7 +70,8 @@ class _PerfEstimate:
     "perf.cost_table" observability table)."""
 
     __slots__ = ("flops", "bytes", "peak", "family_shares",
-                 "wire_latency", "compute_latency")
+                 "wire_latency", "compute_latency", "wire_total_latency",
+                 "overlap_ratio", "step_latency")
 
     def __init__(self, table):
         self.flops = float(table.total_flops)
@@ -82,18 +83,26 @@ class _PerfEstimate:
             fam: (agg["latency"] / total_lat if total_lat else 0.0)
             for fam, agg in fams.items()
         }
-        # the serialized-wire split (ROADMAP item 4's denominator): the
-        # roofline latency the collective family alone accounts for vs
-        # everything else — the cost model's closed forms already carry
-        # the ring (n-1)/n wire factors and quantized element sizes
-        self.wire_latency = float(
-            fams.get("collective", {}).get("latency", 0.0)
+        # the wire split (ROADMAP item 4's denominator, now
+        # OVERLAP-AWARE): `wire_total_latency` is the serialized wire —
+        # the collective family's roofline, closed forms carrying the
+        # ring (n-1)/n factors and quantized element sizes;
+        # `wire_latency` is the EXPOSED wire — the part the program's
+        # actual collective schedule cannot hide behind compute
+        # (CostTable.wire_exposed_latency; == total for a serialized
+        # schedule, so pre-overlap programs attribute exactly as before).
+        self.wire_total_latency = float(table.wire_latency)
+        self.wire_latency = float(table.wire_exposed_latency)
+        self.compute_latency = max(
+            0.0, float(total_lat) - self.wire_total_latency
         )
-        self.compute_latency = max(0.0, float(total_lat) - self.wire_latency)
+        self.step_latency = float(table.step_latency)
+        # wire seconds hidden / total wire seconds under the schedule
+        self.overlap_ratio = float(table.overlap_ratio)
 
     @property
     def wire_fraction(self):
-        """Share of the estimated step roofline the wire serializes."""
+        """Share of the estimated step the EXPOSED wire serializes."""
         denom = self.wire_latency + self.compute_latency
         return self.wire_latency / denom if denom > 0 else 0.0
 
@@ -183,7 +192,8 @@ class Executor:
     @staticmethod
     def _drop_perf_gauges(_obs):
         for prefix in ("perf.mfu", "perf.step_seconds",
-                       "perf.family_time.", "perf.wait_fraction."):
+                       "perf.family_time.", "perf.wait_fraction.",
+                       "collective.overlap_ratio"):
             _obs.drop_gauges(prefix)
         # the attribution table describes ONE executable, same as the
         # gauges: a snapshot taken right after an executable switch must
@@ -260,6 +270,10 @@ class Executor:
         _obs.set_gauge("perf.wait_fraction.collective", coll_wait / denom)
         _obs.set_gauge("perf.wait_fraction.host", mean_host / denom)
         _obs.set_gauge("perf.wait_fraction.compute", compute / denom)
+        # wire seconds hidden / total wire seconds under the executable's
+        # collective schedule (0 = serialized) — the overlap consumer of
+        # the PR-13 attribution split
+        _obs.set_gauge("collective.overlap_ratio", est.overlap_ratio)
         _obs.observe("perf.compute_seconds", device * (1.0 - wire_share))
         _obs.observe("perf.collective_wait_seconds", device * wire_share)
         _obs.observe("perf.host_stall_seconds", host)
@@ -273,6 +287,11 @@ class Executor:
             "wait_fraction_host": mean_host / denom,
             "est_compute_seconds": est.compute_latency,
             "est_wire_seconds": est.wire_latency,
+            "est_wire_total_seconds": est.wire_total_latency,
+            "est_wire_hidden_seconds": max(
+                0.0, est.wire_total_latency - est.wire_latency
+            ),
+            "est_overlap_ratio": est.overlap_ratio,
             "est_wait_fraction": wire_share,
             "traced_wire_bytes": float(wire_stats.get("bytes", 0.0)),
             "window_steps": n,

@@ -252,6 +252,52 @@ def _block_dequantize(q, scales, block):
     return (qb.astype(jnp.float32) * scales[..., None]).reshape(q.shape)
 
 
+def _record_bucket(members, payload_bytes):
+    """Count one bucketed collective site: how many buckets the compiled
+    step issues and how many payload bytes ride in them. Trace-time
+    granularity like every other collective counter (once per compiled
+    site, which the step replays)."""
+    from .. import observability as _obs
+
+    _obs.add("collective.buckets")
+    _obs.add("collective.bucket_bytes", int(payload_bytes))
+    _obs.add("collective.bucket_members", int(members))
+
+
+@register_op(
+    "c_bucket_allreduce_sum", inputs=["X"], outputs=["Out"],
+    differentiable=False,
+)
+def _c_bucket_allreduce_sum(ctx, op, ins):
+    """Bucketed gradient allreduce (the DP overlap schedule): flatten and
+    concatenate the member gradients (optional 1/N scale folded in), issue
+    ONE psum over the bucket, split the reduced buffer back per member.
+    Elementwise sums are unchanged by concatenation, so the fp32 result is
+    BITWISE the per-grad c_allreduce_sum sequence — the bucket only
+    changes how many collectives the wire sees and how early each fires.
+    Bucket membership and order are part of the cross-rank contract
+    (analysis/collectives.py carries them in the site kind)."""
+    # no None-filtering: every member slot must hold a real gradient, and
+    # dropping one would silently misalign the split-back below
+    xs = list(ins["X"])
+    ax = _axis(ctx, op)
+    scale = op.attr("scale", None)
+    if scale is not None:
+        xs = [x * jnp.asarray(scale, x.dtype) for x in xs]
+    if ax is None:
+        return {"Out": list(xs)}
+    sizes = [int(x.size) for x in xs]
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    _record(ctx, "c_bucket_allreduce_sum", flat, ax)
+    _record_bucket(len(xs), int(flat.size) * flat.dtype.itemsize)
+    total = lax.psum(flat, ax)
+    out, off = [], 0
+    for x, n in zip(xs, sizes):
+        out.append(total[off:off + n].reshape(x.shape))
+        off += n
+    return {"Out": out}
+
+
 @register_op(
     "zero_reduce_scatter", inputs=["X"], outputs=["Out"],
     differentiable=False,
@@ -291,6 +337,91 @@ def _zero_reduce_scatter(ctx, op, ins):
     )
     acc = jnp.sum(_block_dequantize(q, scales, block), axis=0)
     return {"Out": [acc.astype(x.dtype)]}
+
+
+@register_op(
+    "zero_bucket_reduce_scatter", inputs=["X"], outputs=["Out"],
+    differentiable=False,
+)
+def _zero_bucket_reduce_scatter(ctx, op, ins):
+    """Bucketed ZeRO gradient reduce-scatter: every member gradient is
+    flattened + scaled + padded to its own [pad_len_i] exactly like
+    zero_reduce_scatter, then the members' per-rank shards are interleaved
+    into ONE [sum(pad)] exchange — rank r's slice of the bucket is the
+    concatenation of the members' rank-r shards, so each output shard is
+    elementwise identical to the per-grad op's. One collective per bucket
+    instead of one per gradient; the bucket fires as soon as its LAST
+    member gradient is produced (transpiler), so earlier buckets' wire
+    time hides behind the remaining backward compute.
+
+    quant="int8" runs the same EQuARX block-quantized exchange as
+    zero_reduce_scatter; every member pad is aligned to nranks*quant_block
+    (ShardedWeightUpdate._pad_len), so quant blocks never straddle member
+    boundaries and the per-block scales equal the per-grad path's.
+
+    Exchange layout: members sharing a pad length STACK into one
+    [m, n, pad/n] buffer — a contiguous concatenation of their flat
+    [pad] vectors viewed rank-major, zero data movement beyond the copy —
+    and scatter over the rank dim in ONE collective; distinct pad lengths
+    within a bucket each get their own stack. An interleaved single-buffer
+    layout would need a strided transpose of the whole bucket per step,
+    which costs more than the collectives it saves."""
+    # no None-filtering: members zip pairwise against pad_lens and the
+    # declared Out shards, so a dropped slot would shift every later
+    # member onto the wrong pad/output
+    xs = list(ins["X"])
+    ax = _axis(ctx, op)
+    pad_lens = [int(p) for p in op.attr("pad_lens")]
+    scale = op.attr("scale", None)
+    quant = op.attr("quant", "none") or "none"
+    block = int(op.attr("quant_block", 256) or 256)
+    flats = []
+    for x, pad in zip(xs, pad_lens):
+        flat = x.reshape(-1)
+        if scale is not None:
+            flat = flat * jnp.asarray(scale, flat.dtype)
+        if pad > flat.shape[0]:
+            flat = jnp.pad(flat, (0, pad - flat.shape[0]))
+        flats.append(flat)
+    total = sum(pad_lens)
+    n = int(ctx.axis_sizes.get(ax, 1)) if ax is not None else 1
+    dtype = flats[0].dtype if flats else jnp.float32
+    _record_zero(ctx, "bucket_reduce_scatter", op, total, dtype, ax, n)
+    if ax is not None:
+        _record_bucket(len(xs), total * jnp.dtype(dtype).itemsize)
+    if ax is None:
+        return {"Out": flats}
+    # group members by pad length (deterministic from pad_lens, so the
+    # grouping is rank-uniform by construction)
+    groups = {}
+    for i, pad in enumerate(pad_lens):
+        groups.setdefault(pad, []).append(i)
+    out = [None] * len(flats)
+    for pad, idxs in groups.items():
+        k = pad // n
+        stacked = jnp.stack([flats[i] for i in idxs]).reshape(
+            len(idxs), n, k
+        )
+        if quant == "none":
+            shards = lax.psum_scatter(
+                stacked, ax, scatter_dimension=1, tiled=True
+            )  # [m, 1, k]: rank r holds the summed member rows r
+        else:
+            q, scales = _block_quantize(stacked, block)
+            q = lax.all_to_all(
+                q, ax, split_axis=1, concat_axis=1, tiled=True
+            )
+            scales = lax.all_to_all(
+                scales, ax, split_axis=1, concat_axis=1, tiled=True
+            )
+            deq = _block_dequantize(
+                q.reshape(len(idxs), n, k), scales, block
+            )
+            shards = jnp.sum(deq, axis=1, keepdims=True).astype(dtype)
+        shards = shards.reshape(len(idxs), k)
+        for j, i in enumerate(idxs):
+            out[i] = shards[j]
+    return {"Out": out}
 
 
 @register_op(

@@ -47,6 +47,115 @@ def _insert_pos_after(block, names):
     return pos
 
 
+def plan_grad_buckets(block, entries, bucket_bytes):
+    """Group gradients into size-targeted buckets in reverse-topological
+    order — the order the backward PRODUCES them (last forward layer's
+    grads first), which is ascending producer index in the block.
+
+    `entries` is a list of dicts with at least ``name`` (grad var name),
+    ``nbytes`` (payload size) and ``group`` (dtype key: members of one
+    bucket concatenate, so they must share a dtype). A grad that would
+    push a non-empty bucket past `bucket_bytes` CLOSES that bucket and
+    starts the next one — straddling grads move whole, never split, so a
+    single grad larger than the target gets a bucket of its own.
+
+    Returns buckets ordered by firing position: each is a dict with
+    ``members`` (entries, production order), ``nbytes``, and ``pos`` (the
+    block index just after the LAST member's producer — the earliest
+    point the whole bucket is ready to fire). Bucket membership and order
+    are part of the cross-rank collective contract: every rank must plan
+    the same buckets (analysis/collectives.py carries the membership in
+    the site kind, so divergence is a build-time ERROR, not a pod hang).
+    """
+    bucket_bytes = int(bucket_bytes)
+    if bucket_bytes <= 0:
+        raise ValueError(
+            f"plan_grad_buckets: bucket_bytes must be positive, got "
+            f"{bucket_bytes!r}"
+        )
+    order = []
+    for i, e in enumerate(entries):
+        order.append((_insert_pos_after(block, [e["name"]]), i, e))
+    order.sort(key=lambda t: (t[0], t[1]))
+    buckets = []
+    open_ = {}  # dtype group -> accumulating bucket
+    for pos, _i, e in order:
+        g = e.get("group")
+        b = open_.get(g)
+        if b is not None and b["nbytes"] + e["nbytes"] > bucket_bytes:
+            buckets.append(open_.pop(g))
+            b = None
+        if b is None:
+            b = {"members": [], "nbytes": 0, "pos": 0, "group": g}
+            open_[g] = b
+        b["members"].append(e)
+        b["nbytes"] += int(e["nbytes"])
+        b["pos"] = max(b["pos"], pos)
+    buckets.extend(b for b in open_.values() if b["members"])
+    buckets.sort(key=lambda b: b["pos"])
+    return buckets
+
+
+def _normalize_bucket_bytes(value):
+    """None/0/"" -> None (per-grad schedule); a positive int enables
+    bucketing; anything negative refuses, naming the knob."""
+    if not value:
+        return None
+    value = int(value)
+    if value < 0:
+        raise ValueError(
+            f"bucket_bytes must be a positive byte count (or 0/None for "
+            f"the per-grad schedule), got {value!r}"
+        )
+    return value
+
+
+def _hoist_grad_finalizers(block, names):
+    """The backward appends each gradient's finalizing op (the
+    ``@GRAD@RENAME -> @GRAD`` assign, or the multi-part sum) at the block
+    TAIL in forward-parameter order — which would pin every bucket's
+    firing position behind the entire backward. Hoist each grad's last
+    producer to its dataflow frontier (right after the vjp that computed
+    it) so bucket positions reflect the TRUE reverse-topological
+    production order. Pure reordering via :func:`_hoist_earliest`."""
+    for name in names:
+        producer = None
+        for op in block.ops:
+            if op.type in _AMP_CHECK_OPS:
+                continue
+            if name in op.output_names():
+                producer = op
+        if producer is not None:
+            _hoist_earliest(block, producer)
+
+
+def _hoist_earliest(block, op):
+    """Move `op` to the earliest block index its dataflow allows: after
+    the last producer of anything it reads, after the last reader of
+    anything it writes (write-after-read — e.g. a hoisted zero_all_gather
+    rewrites the param the backward still reads), and after the last
+    OTHER writer of anything it writes. Returns the new index. This is
+    the prefetch pass's only primitive — it can only tighten the
+    schedule, never change a value."""
+    ops = block.ops
+    i = ops.index(op)
+    reads = set(op.input_names())
+    writes = set(op.output_names())
+    barrier = -1
+    for j in range(i):
+        o = ops[j]
+        if (set(o.output_names()) & (reads | writes)) or (
+            set(o.input_names()) & writes
+        ):
+            barrier = j
+    if barrier + 1 >= i:
+        return i
+    ops.pop(i)
+    ops.insert(barrier + 1, op)
+    block.program._bump()
+    return barrier + 1
+
+
 def insert_grad_allreduce(block, grad, axis_name, scale=None):
     """Insert (optional scale +) c_allreduce_sum on a gradient, right after
     its producer and BEFORE any AMP bookkeeping ops (_insert_pos_after):
@@ -74,20 +183,83 @@ def insert_grad_allreduce(block, grad, axis_name, scale=None):
 
 
 class GradAllReduce:
-    """Insert per-gradient allreduce into a trained program (DP mode)."""
+    """Insert per-gradient allreduce into a trained program (DP mode).
 
-    def __init__(self, nranks, axis_name=DATA_AXIS):
+    ``bucket_bytes`` switches the schedule to BUCKETED collectives: grads
+    group into size-targeted buckets in reverse-topological (backward
+    production) order and each bucket issues ONE ``c_bucket_allreduce_sum``
+    as soon as its last member gradient is produced — early buckets' wire
+    time hides behind the remaining backward compute, and the per-grad
+    dispatch overhead collapses to one collective per bucket. The per-grad
+    1/N scale stays a separate op (identical math), so the fp32 result is
+    BITWISE the per-grad schedule's."""
+
+    def __init__(self, nranks, axis_name=DATA_AXIS, bucket_bytes=None):
         self.nranks = nranks
         self.axis_name = axis_name
+        self.bucket_bytes = _normalize_bucket_bytes(bucket_bytes)
 
     def transpile(self, program, params_grads):
         block = program.global_block
+        if not self.bucket_bytes:
+            for _, g in params_grads:
+                # mean-reduce: scale by 1/nranks then psum — identical
+                # math to the reference's loss-grad scaling
+                # (transpiler/collective.py:190)
+                insert_grad_allreduce(
+                    block, g, self.axis_name, scale=1.0 / self.nranks
+                )
+            return program
+        from .. import observability as _obs
+
+        _hoist_grad_finalizers(
+            block,
+            [g.name if hasattr(g, "name") else str(g)
+             for _, g in params_grads],
+        )
+        entries = []
         for _, g in params_grads:
-            # mean-reduce: scale by 1/nranks then psum — identical math to
-            # the reference's loss-grad scaling (transpiler/collective.py:190)
-            insert_grad_allreduce(
-                block, g, self.axis_name, scale=1.0 / self.nranks
+            gname = g.name if hasattr(g, "name") else str(g)
+            v = block._find_var_recursive(gname)
+            numel = 1
+            for d in (v.shape if v is not None else ()) or ():
+                numel *= int(d)
+            itemsize = ShardedWeightUpdate._itemsize(v) if v is not None \
+                else 4
+            entries.append({
+                "name": gname, "numel": numel, "nbytes": numel * itemsize,
+                "group": str((v.dtype if v is not None else None)
+                             or "float32"),
+            })
+        buckets = plan_grad_buckets(block, entries, self.bucket_bytes)
+        # insert back-to-front so earlier buckets' positions stay valid
+        for b in reversed(buckets):
+            names = [e["name"] for e in b["members"]]
+            pos = b["pos"]
+            for gname in names:  # per-grad mean scale, exactly like legacy
+                block.append_op(
+                    "scale",
+                    inputs={"X": [gname]},
+                    outputs={"Out": [gname]},
+                    attrs={"scale": 1.0 / self.nranks, "bias": 0.0},
+                    index=pos,
+                )
+                pos += 1
+            block.append_op(
+                "c_bucket_allreduce_sum",
+                inputs={"X": names},
+                outputs={"Out": names},
+                attrs={
+                    "axis_name": self.axis_name,
+                    "bucket_numels": [
+                        int(e["numel"]) for e in b["members"]
+                    ],
+                },
+                index=pos,
             )
+        program._overlap_schedule = True
+        _obs.add("collective.bucketed_grad_tensors", len(entries))
+        _obs.set_gauge("collective.bucket_count", len(buckets))
         return program
 
 
@@ -138,6 +310,21 @@ class ShardedWeightUpdate:
     aligned to ``nranks * quant_block`` so every shard quantizes in whole
     blocks.
 
+    Overlap schedule (ROADMAP item 4): ``bucket_bytes`` groups the
+    reduce-scatters into size-targeted buckets in reverse-topological
+    order — one ``zero_bucket_reduce_scatter`` per bucket, fired as soon
+    as the bucket's LAST member gradient is produced, so early buckets'
+    wire time hides behind the remaining backward compute. ``prefetch``
+    (default on) additionally hoists every shard update and its
+    ``zero_all_gather`` to the earliest dataflow-legal position — the
+    all-gather fires as soon as its shard update completes instead of
+    sitting at the program tail, giving XLA's latency-hiding scheduler
+    async-dispatch structure it can overlap with the remaining backward.
+    Both are pure schedule transforms: fp32 results stay BITWISE equal to
+    the serialized schedule, int8 stays bitwise equal to per-grad int8
+    (member pads are block-aligned, so quant blocks never straddle
+    members).
+
     Not supported (raises ``NotImplementedError``): grad clipping and
     regularization (both read full-tensor gradients after the insertion
     point — a shard-local norm would silently change the math) and DGC
@@ -145,7 +332,7 @@ class ShardedWeightUpdate:
     """
 
     def __init__(self, nranks, axis_name=DATA_AXIS, quant=None,
-                 quant_block=256):
+                 quant_block=256, bucket_bytes=None, prefetch=True):
         self.nranks = int(nranks)
         self.axis_name = axis_name
         self.quant = quant if quant not in (None, "", "none") else "none"
@@ -162,6 +349,8 @@ class ShardedWeightUpdate:
                 f"shard_weight_update: collective_quant_block must be a "
                 f"positive element count, got {quant_block!r}"
             )
+        self.bucket_bytes = _normalize_bucket_bytes(bucket_bytes)
+        self.prefetch = bool(prefetch)
 
     # -- helpers -----------------------------------------------------------
     def _pad_len(self, numel):
@@ -265,22 +454,37 @@ class ShardedWeightUpdate:
             _obs.add("collective.zero_sparse_tables_skipped",
                      len(skipped_sparse))
 
-        per_rank = replicated = master = 0
-        shard_names = []
+        # plan first: every param's update op, grad name, and pad — the
+        # bucketed path needs the full grad set before any insertion
+        plans = []
         unshardable = []
         for p, _g in params_grads:
-            stats = self._shard_one(main, startup, p, shard_names)
-            if stats is None:
+            _idx, op = self._find_update_op(block, p.name)
+            if op is None:
                 # a param with no recognizable update op would be left
                 # with NEITHER a reduce-scatter NOR an allreduce (the
                 # fleet path skips GradAllReduce entirely in sharded
                 # mode) — the replicas would silently diverge
                 unshardable.append(p.name)
                 continue
-            pr, rep, ms = stats
-            per_rank += pr
-            replicated += rep
-            master += ms
+            gname = op.inputs["Grad"][0]
+            if "@CLIP" in gname:
+                # every clip.py path (value / per-tensor norm / global
+                # norm) hands the update op a "<grad>@CLIP*" rewrite;
+                # clipping by a rank-LOCAL norm before the reduce-scatter
+                # is different math from the allreduce baseline (which
+                # reduces first), so refuse here too — not only in the
+                # fleet wrapper
+                raise NotImplementedError(
+                    "shard_weight_update: gradient clipping rewrites "
+                    f"{gname!r} with rank-local norms before the "
+                    "reduce-scatter would land; clipping does not compose "
+                    "with the sharded update yet"
+                )
+            numel = 1
+            for d in p.shape:
+                numel *= int(d)
+            plans.append((p, op, gname, numel, self._pad_len(numel)))
         if unshardable:
             raise NotImplementedError(
                 "shard_weight_update: no supported update op found for "
@@ -288,7 +492,31 @@ class ShardedWeightUpdate:
                 f"{sorted(UPDATE_OPS)}); their gradients would stay "
                 "rank-local and the replicas would diverge"
             )
+        if self.bucket_bytes:
+            gshards = self._insert_bucketed_reduce_scatters(main, plans)
+        else:
+            gshards = self._insert_reduce_scatters(main, plans)
+        per_rank = replicated = master = 0
+        shard_names = []
+        for p, op, gname, numel, pad in plans:
+            pr, rep, ms = self._rewrite_update(
+                main, startup, p, op, gname, gshards[gname], numel, pad,
+                shard_names,
+            )
+            per_rank += pr
+            replicated += rep
+            master += ms
         self._rewrite_amp(block)
+        moved = 0
+        if self.prefetch:
+            moved = self._prefetch_all_gathers(block)
+            if moved:
+                _obs.add("collective.zero_prefetched_gathers", moved)
+        if self.bucket_bytes or moved:
+            # the cost model's scheduled (overlap-aware) step estimate
+            # applies only to programs whose collective schedule was
+            # actually restructured for overlap
+            main._overlap_schedule = True
         main._zero_shard_vars = tuple(shard_names)
         main._zero_quant = self.quant
         main._bump()
@@ -303,33 +531,11 @@ class ShardedWeightUpdate:
         _obs.set_gauge("collective.zero_master_shard_bytes_per_rank", master)
         return main
 
-    def _shard_one(self, main, startup, p, shard_names):
+    def _make_grad_shard(self, main, gname, pad):
+        """Declare the flat dp-sharded [pad] counterpart of gradient
+        `gname` (the reduce-scatter's output)."""
         block = main.global_block
-        idx, op = self._find_update_op(block, p.name)
-        if op is None:
-            return None
-        numel = 1
-        for d in p.shape:
-            numel *= int(d)
-        pad = self._pad_len(numel)
-        shard_len = pad // self.nranks
-        gname = op.inputs["Grad"][0]
-        if "@CLIP" in gname:
-            # every clip.py path (value / per-tensor norm / global norm)
-            # hands the update op a "<grad>@CLIP*" rewrite; clipping by a
-            # rank-LOCAL norm before the reduce-scatter is different math
-            # from the allreduce baseline (which reduces first), so refuse
-            # here too — not only in the fleet wrapper
-            raise NotImplementedError(
-                "shard_weight_update: gradient clipping rewrites "
-                f"{gname!r} with rank-local norms before the "
-                "reduce-scatter would land; clipping does not compose "
-                "with the sharded update yet"
-            )
         gvar = block._find_var_recursive(gname)
-
-        # 1. reduce-scatter the gradient (mean: scale folded in), landing
-        # before the AMP bookkeeping ops exactly like insert_grad_allreduce
         gshard = gname + _SHARD_SUFFIX
         gv = block.create_var(
             name=gshard, shape=[pad],
@@ -337,16 +543,119 @@ class ShardedWeightUpdate:
         )
         gv.stop_gradient = True
         main._sharding[gshard] = (self.axis_name,)
-        pos = _insert_pos_after(block, [gname])
-        block.append_op(
-            "zero_reduce_scatter",
-            inputs={"X": [gname]},
-            outputs={"Out": [gshard]},
-            attrs=self._zero_attrs(
-                {"scale": 1.0 / self.nranks, "pad_len": pad}
-            ),
-            index=pos,
-        )
+        return gshard, str((gvar.dtype if gvar is not None else None)
+                           or "float32")
+
+    def _insert_reduce_scatters(self, main, plans):
+        """Per-grad schedule: one zero_reduce_scatter per gradient (mean
+        scale folded in), landing right after the grad's producer and
+        before the AMP bookkeeping ops, exactly like
+        insert_grad_allreduce. Returns {grad name: shard name}."""
+        block = main.global_block
+        if self.prefetch:
+            # the overlap schedule wants each reduce-scatter at its
+            # grad's TRUE production point (see _hoist_grad_finalizers),
+            # so the hoisted updates/all-gathers can interleave with the
+            # remaining backward
+            _hoist_grad_finalizers(block, [pl[2] for pl in plans])
+        gshards = {}
+        for _p, _op, gname, _numel, pad in plans:
+            gshard, _dtype = self._make_grad_shard(main, gname, pad)
+            gshards[gname] = gshard
+            pos = _insert_pos_after(block, [gname])
+            block.append_op(
+                "zero_reduce_scatter",
+                inputs={"X": [gname]},
+                outputs={"Out": [gshard]},
+                attrs=self._zero_attrs(
+                    {"scale": 1.0 / self.nranks, "pad_len": pad}
+                ),
+                index=pos,
+            )
+        return gshards
+
+    def _insert_bucketed_reduce_scatters(self, main, plans):
+        """Bucketed schedule: grads group into size-targeted buckets in
+        reverse-topological (backward production) order; each bucket
+        issues ONE zero_bucket_reduce_scatter as soon as its last member
+        gradient is produced. Returns {grad name: shard name}."""
+        from .. import observability as _obs
+
+        block = main.global_block
+        _hoist_grad_finalizers(block, [pl[2] for pl in plans])
+        entries = []
+        by_name = {}
+        for _p, _op, gname, numel, pad in plans:
+            gvar = block._find_var_recursive(gname)
+            itemsize = self._itemsize(gvar) if gvar is not None else 4
+            e = {
+                "name": gname, "numel": numel, "pad": pad,
+                "nbytes": numel * itemsize,
+                "group": str((gvar.dtype if gvar is not None else None)
+                             or "float32"),
+            }
+            entries.append(e)
+            by_name[gname] = e
+        buckets = plan_grad_buckets(block, entries, self.bucket_bytes)
+        gshards = {}
+        # insert back-to-front so earlier buckets' positions stay valid
+        for b in reversed(buckets):
+            names = [e["name"] for e in b["members"]]
+            outs = []
+            for e in b["members"]:
+                gshard, _dtype = self._make_grad_shard(
+                    main, e["name"], e["pad"]
+                )
+                gshards[e["name"]] = gshard
+                outs.append(gshard)
+            block.append_op(
+                "zero_bucket_reduce_scatter",
+                inputs={"X": names},
+                outputs={"Out": outs},
+                attrs=self._zero_attrs({
+                    "scale": 1.0 / self.nranks,
+                    "pad_lens": [int(e["pad"]) for e in b["members"]],
+                }),
+                index=b["pos"],
+            )
+        _obs.set_gauge("collective.bucket_count", len(buckets))
+        return gshards
+
+    def _prefetch_all_gathers(self, block):
+        """Hoist every rewritten shard update and its zero_all_gather to
+        the earliest dataflow-legal position: the update fires as soon as
+        its grad shard (and, under AMP, the loss-scale bookkeeping that
+        rewrites it) is ready, and the param's all-gather launches
+        immediately after — in flight while the remaining backward
+        computes, instead of queued at the program tail. Pure reordering
+        under `_hoist_earliest`'s hazard barriers (the all-gather rewrites
+        the param, so it can never cross a backward op still reading it).
+        Returns how many ops actually moved."""
+        moved = 0
+        updates = [
+            op for op in list(block.ops)
+            if op.type in UPDATE_OPS
+            and (op.inputs.get("Grad") or [""])[0].endswith(_SHARD_SUFFIX)
+        ]
+        gathers = {
+            op.inputs["X"][0]: op
+            for op in block.ops if op.type == "zero_all_gather"
+        }
+        for upd in updates:
+            before = block.ops.index(upd)
+            if _hoist_earliest(block, upd) != before:
+                moved += 1
+            gather = gathers.get(upd.inputs["Param"][0])
+            if gather is not None:
+                before = block.ops.index(gather)
+                if _hoist_earliest(block, gather) != before:
+                    moved += 1
+        return moved
+
+    def _rewrite_update(self, main, startup, p, op, gname, gshard, numel,
+                        pad, shard_names):
+        block = main.global_block
+        shard_len = pad // self.nranks
 
         # 2. rewrite the update op onto sharded flat state
         name_map = {gname: gshard}
@@ -426,11 +735,13 @@ class ShardedWeightUpdate:
         """Point the AMP bookkeeping ops at the grad shards and make their
         FoundInfinite rank-uniform (each rank now checks only its 1/N
         shard, so 'any rank overflowed' needs a collective)."""
-        shard_map = {
-            op.inputs["X"][0]: op.outputs["Out"][0]
-            for op in block.ops
-            if op.type == "zero_reduce_scatter"
-        }
+        shard_map = {}
+        for op in block.ops:
+            if op.type == "zero_reduce_scatter":
+                shard_map[op.inputs["X"][0]] = op.outputs["Out"][0]
+            elif op.type == "zero_bucket_reduce_scatter":
+                # bucket members map pairwise: X[i]'s shard is Out[i]
+                shard_map.update(zip(op.inputs["X"], op.outputs["Out"]))
         inserts = []
         for i, op in enumerate(block.ops):
             if op.type not in _AMP_CHECK_OPS:

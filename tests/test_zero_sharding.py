@@ -269,11 +269,16 @@ def test_fleet_shard_weight_update_knob():
     strategy's losses (dp=8 changes the reduction tree, so tolerance)."""
     base, main_b = _fleet_minimize(shard=False)
     shard, main_s = _fleet_minimize(shard=True)
+    zero_kinds = ("zero_reduce_scatter", "zero_bucket_reduce_scatter")
     assert not any(
-        op.type == "zero_reduce_scatter" for op in main_b.global_block.ops
+        op.type in zero_kinds for op in main_b.global_block.ops
     )
+    # the strategy's default collective_bucket_mb routes the sharded path
+    # through BUCKETED reduce-scatters (PR 14's overlap schedule); the
+    # per-grad kind comes back with collective_bucket_mb=0
     assert any(
-        op.type == "zero_reduce_scatter" for op in main_s.global_block.ops
+        op.type == "zero_bucket_reduce_scatter"
+        for op in main_s.global_block.ops
     )
     assert not any(
         op.type == "c_allreduce_sum" and "grad" in str(op.inputs).lower()

@@ -330,6 +330,17 @@ def report_attribution(snapshot_path, require_wait=False):
         f"(measured {table.get('wait_fraction_collective', 0.0):.1%} of "
         "the step)"
     )
+    if table.get("est_wire_total_seconds"):
+        # overlap-aware split (PR 14): est_wire_seconds above is the
+        # EXPOSED wire; the hidden share rides behind compute
+        hidden = table.get("est_wire_hidden_seconds", 0.0)
+        print(
+            f"  overlap schedule: serialized wire "
+            f"{table['est_wire_total_seconds'] * ms:.3f} ms, hidden "
+            f"{hidden * ms:.3f} ms "
+            f"({table.get('est_overlap_ratio', 0.0):.0%} of the wire "
+            "behind the math)"
+        )
     if table.get("traced_wire_bytes"):
         print(
             f"  traced collective sites move ~"
@@ -349,9 +360,19 @@ def report_attribution(snapshot_path, require_wait=False):
         v = table.get(key)
         if v is None or not (0.0 <= v <= 1.0):
             bad.append(f"{key}={v!r}")
-    if require_wait and est_wire <= 0:
-        bad.append("est_wire_seconds=0 (leg never touched the wire)")
-    if require_wait and table.get("collective_wait_seconds", 0) <= 0:
+    # "the leg touched the wire" means the SERIALIZED wire roofline is
+    # nonzero — a perfectly overlapped schedule may legitimately expose
+    # zero wire (est_wire_seconds == 0 with overlap_ratio == 1), and that
+    # must not read as a dead leg. Older snapshots without the overlap
+    # fields fall back to the exposed term (there the two are equal).
+    est_wire_total = table.get("est_wire_total_seconds", est_wire)
+    if require_wait and est_wire_total <= 0:
+        bad.append("est_wire_total_seconds=0 (leg never touched the wire)")
+    if require_wait and est_wire > 0 \
+            and table.get("collective_wait_seconds", 0) <= 0:
+        # measured wait must exist whenever the estimate says wire is
+        # still exposed; a fully hidden wire (est_wire == 0) makes a
+        # zero measured wait the CORRECT answer, not a degraded split
         bad.append("collective_wait_seconds=0")
     if bad:
         print(f"attribution check FAILED: {bad}", file=sys.stderr)

@@ -137,6 +137,48 @@ def _broken_frozen_fixture():
     return main, ("x",), (prob.name,)
 
 
+def _broken_bucket_fixture():
+    """A program whose pipeline stages BUCKET the same grad exchange
+    differently (two members on stage 0, one fused member on stage 1):
+    bucket membership is part of the cross-rank wire contract, so the
+    collective-schedule lint must reject this at build time — on a pod it
+    would deadlock (or silently corrupt) the exchange."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.parallel import make_mesh, shard_program
+    from paddle_tpu.parallel.pipeline import slice_program_into_stages
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [8, 4])
+        with fluid.device_guard("pipeline:0"):
+            h = layers.fc(x, 4)
+        with fluid.device_guard("pipeline:1"):
+            loss = layers.mean(layers.fc(h, 4))
+        main._pipeline = {"num_microbatches": 2, "axis_name": "pp"}
+        _, pipe_op = slice_program_into_stages(main, loss)
+    for si, pads in ((0, [256, 256]), (1, [512])):
+        stage = main.blocks[pipe_op.attr("stage_blocks")[si]]
+        gname = f"bucket_grad_{si}"
+        stage.create_var(name=gname, shape=[4, 4], dtype="float32")
+        stage.append_op(
+            "fill_constant", {}, {"Out": [gname]},
+            {"shape": [4, 4], "dtype": "float32", "value": 0.0},
+        )
+        outs = []
+        for j, p in enumerate(pads):
+            oname = f"bucket_shard_{si}_{j}"
+            stage.create_var(name=oname, shape=[p], dtype="float32")
+            outs.append(oname)
+        stage.append_op(
+            "zero_bucket_reduce_scatter",
+            {"X": [gname] * len(pads)}, {"Out": outs},
+            {"axis_name": "dp", "pad_lens": pads, "quant": "none"},
+        )
+    shard_program(main, make_mesh({"dp": 4, "pp": 2}), {"x": ("dp",)})
+    return main, ("x",), (loss.name,)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--all-models", action="store_true",
@@ -152,17 +194,23 @@ def main(argv=None):
     ap.add_argument("--broken-frozen-fixture", action="store_true",
                     help="lint a frozen program with a surviving "
                          "training op (must fail)")
+    ap.add_argument("--broken-bucket-fixture", action="store_true",
+                    help="lint a program whose ranks bucket the same "
+                         "grad exchange differently (must fail)")
     ap.add_argument("--cost", action="store_true",
                     help="print the Program.estimate() cost table per model")
     args = ap.parse_args(argv)
 
-    if args.broken_fixture or args.broken_frozen_fixture:
+    if (args.broken_fixture or args.broken_frozen_fixture
+            or args.broken_bucket_fixture):
         from paddle_tpu.analysis import verify_program
 
-        program, feeds, fetches = (
-            _broken_frozen_fixture() if args.broken_frozen_fixture
-            else _broken_fixture()
-        )
+        if args.broken_frozen_fixture:
+            program, feeds, fetches = _broken_frozen_fixture()
+        elif args.broken_bucket_fixture:
+            program, feeds, fetches = _broken_bucket_fixture()
+        else:
+            program, feeds, fetches = _broken_fixture()
         report = verify_program(program, feeds, fetches)
         for f in report.findings:
             print("    " + f.format())

@@ -107,6 +107,25 @@ def render(snap, top_ops=0):
             lines.append(
                 f"  {name:<{width}}  {payload[name] / 1e6:>10.3f} MB"
             )
+    # collective overlap digest (PR 14): bucketed grad collectives + the
+    # cost model's hidden-wire estimate — the numbers bench_overlap gates
+    n_buckets = counters.get("collective.buckets", 0)
+    overlap_ratio = gauges.get("collective.overlap_ratio")
+    if n_buckets or overlap_ratio is not None:
+        lines.append("-- collective overlap --")
+        if n_buckets:
+            members = counters.get("collective.bucket_members", 0)
+            lines.append(
+                f"  {n_buckets} bucket(s), "
+                f"{counters.get('collective.bucket_bytes', 0) / 1e6:.3f} "
+                f"MB bucketed payload"
+                + (f", {members} member grads" if members else "")
+            )
+        if overlap_ratio is not None:
+            lines.append(
+                f"  est overlap ratio {overlap_ratio:.1%} of wire "
+                "seconds hidden behind compute"
+            )
     # checkpoint pipeline digest: the stage split (snapshot = the step
     # loop's only cost; publish = background), bandwidth, and the tiered
     # save mix — the numbers the async-checkpoint bench gates on
@@ -162,6 +181,13 @@ def render(snap, top_ops=0):
             f" (cost-model wire estimate "
             f"{attr.get('est_wait_fraction', 0):.1%} of roofline)"
         )
+        if attr.get("est_wire_hidden_seconds"):
+            lines.append(
+                f"  overlap: {attr['est_wire_hidden_seconds'] * 1e3:.3f} "
+                f"ms wire hidden "
+                f"({attr.get('est_overlap_ratio', 0):.0%} of the "
+                "serialized wire)"
+            )
     # live watcher digest: structured findings, newest last
     wf = (tables.get("watch.findings") or {}).get("findings") or []
     if wf:
