@@ -216,12 +216,55 @@ python - "$SERVING_DIR" <<'EOF'
 import json, sys
 r = json.load(open(sys.argv[1] + "/result.json"))
 assert r["dropped"] == 0, r
-assert r["served"] == r["admitted"] > 0, r
+# every admitted request RESOLVED: served, or typed expired/shed for the
+# deadline/priority slice (the r15 fault-domain drain contract)
+assert r["served"] + r["expired"] + r["shed"] == r["admitted"], r
+assert r["served"] > 0 and r["admitted"] > 0, r
 assert r["drained_counter"] == 1, r
-print(f"serving drain OK: {r['served']}/{r['admitted']} admitted requests "
-      "completed under SIGTERM, exit 75")
+print(f"serving drain OK: {r['served']} served + {r['expired']} expired "
+      f"+ {r['shed']} shed = {r['admitted']} admitted under SIGTERM, "
+      "exit 75")
 EOF
 rm -rf "$SERVING_DIR"
+
+echo "== serving chaos (fault domain: replica kill + overload goodput) =="
+# leg 1 — replica failover under chaos: 3-replica set, one replica killed
+# mid-run via its per-replica dispatch seam, PLUS an env-armed
+# serving.dispatch:hang (a wedged executable the attempt timeout must
+# bound). bench gates: every admitted request resolves (zero hangs), the
+# killed replica's breaker opens, post-failover QPS within 20% of
+# pre-kill. stats_report proves the breaker/requeue telemetry was alive.
+FD_DIR=$(mktemp -d)
+PADDLE_TPU_FAULT_INJECT="serving.dispatch:hang:1.0:0:1" \
+PADDLE_TPU_FAULT_HANG_SECONDS=6 \
+python bench_serving.py --smoke --mix failover \
+    --dump "$FD_DIR/failover_stats.json"
+python tools/stats_report.py "$FD_DIR/failover_stats.json" \
+    --require serving.breaker --require serving.requeued \
+    --require serving.dispatch_failures
+python - "$FD_DIR" <<'EOF'
+import json, sys
+snap = json.load(open(sys.argv[1] + "/failover_stats.json"))
+c, g = snap["counters"], snap["gauges"]
+assert c.get("resilience.faults_injected.serving.dispatch", 0) == 1, (
+    "the env-armed dispatch hang never fired", c)
+assert c.get("serving.breaker_opened", 0) >= 1, c
+assert c.get("serving.requeued", 0) > 0, c
+assert g.get("serving.breaker_state.r0") == 1.0, g
+print(f"failover chaos OK: {c['serving.requeued']} requests requeued, "
+      f"breaker opened {c['serving.breaker_opened']}x, hang bounded")
+EOF
+
+# leg 2 — 2x-overload goodput: deadline+priority shedding + brownout
+# ladder must deliver >= 1.3x the shed-nothing r8 baseline's goodput at
+# equal-or-better interactive p99 (bench self-gates); the expired/shed/
+# brownout counters must be alive in the snapshot.
+python bench_serving.py --smoke --mix overload \
+    --dump "$FD_DIR/overload_stats.json"
+python tools/stats_report.py "$FD_DIR/overload_stats.json" \
+    --require serving.expired --require serving.shed \
+    --require serving.goodput --require serving.brownout
+rm -rf "$FD_DIR"
 
 # the frozen-graph verifier must reject a freeze that left a training op
 if python tools/program_lint.py --broken-frozen-fixture > /dev/null 2>&1; then

@@ -104,12 +104,35 @@ class ExecutionTimeoutError(EnforceNotMet, RuntimeError):
     code = ErrorCode.EXECUTION_TIMEOUT
 
 
+class DeadlineExceededError(ExecutionTimeoutError):
+    """A serving request's deadline expired before it was dispatched: the
+    scheduler dropped it ahead of batch formation (``serving.expired``), so
+    stale work never pads a bucket or burns a dispatch. Non-retryable — the
+    client's latency budget is spent; re-queueing the same request can only
+    produce an answer nobody is waiting for."""
+
+    code = ErrorCode.EXECUTION_TIMEOUT
+    retryable = False
+
+
 class UnimplementedError(EnforceNotMet, NotImplementedError):
     code = ErrorCode.UNIMPLEMENTED
 
 
 class UnavailableError(EnforceNotMet, RuntimeError):
     code = ErrorCode.UNAVAILABLE
+
+
+class RequestShedError(UnavailableError):
+    """The serving layer shed this request under overload: either a
+    higher-priority admission evicted it from a full queue, or the brownout
+    ladder is refusing its priority class outright (``serving.shed``).
+    Marked non-retryable at the in-process seam — an immediate retry lands
+    in the same overloaded queue; clients should back off (with jitter)
+    before resubmitting."""
+
+    code = ErrorCode.UNAVAILABLE
+    retryable = False
 
 
 class FatalError(EnforceNotMet, SystemError):

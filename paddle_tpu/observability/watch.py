@@ -215,6 +215,14 @@ class Watcher:
             self._breaching = False
 
     # -- public surface ----------------------------------------------------
+    @property
+    def breaching(self):
+        """True while the SLO excursion latch is set (one ``slo_breach``
+        finding was raised and p99 has not yet recovered) — the level
+        signal consumers like ``serving.brownout.BrownoutController``
+        need between the edge-triggered findings."""
+        return self._breaching
+
     def poll(self):
         """Run every check once; returns the list of NEW findings."""
         if not metrics.enabled():

@@ -40,11 +40,21 @@ a stalled rank looks like to the launcher),
 ``checkpoint.snapshot`` (the async checkpointer's device→host staging
 stage, on the step-loop thread — retried under the ``checkpoint.snapshot``
 policy; ``hang`` stalls the step exactly where a slow host copy would)
-and ``checkpoint.publish`` (inside the background publisher's — and the
+``checkpoint.publish`` (inside the background publisher's — and the
 sync save's — write-and-publish body, within the ``checkpoint.save`` /
 ``checkpoint.shard`` retry scope, so raising kinds heal and ``hang``
-deterministically wedges a publish mid-flight for SIGKILL chaos). The
-catalog is documented in README §Resilience.
+deterministically wedges a publish mid-flight for SIGKILL chaos),
+and ``serving.dispatch`` (the serving router's batch-dispatch boundary,
+alongside the existing ``serving.ingest`` admission seam: inside a
+``ReplicaSet`` the seam fires per replica attempt under the breaker +
+attempt-timeout machinery — raising kinds read as replica failures and
+``hang`` as a wedged executable the timeout converts to a typed error,
+so chaos exercises the exact failover path; a per-replica
+``serving.dispatch.<name>`` seam rides along for targeted replica
+kills, and on a plain single-runner endpoint a raising kind fails the
+batch typed while ``hang`` wedges the scheduler — the failure mode the
+ReplicaSet exists to bound). The catalog is documented in README
+§Resilience.
 """
 
 from __future__ import annotations

@@ -15,17 +15,37 @@ path from that checkpoint to traffic (ROADMAP item 1):
 * :mod:`generate` — ``GPTGenerator``: the KV-cache decode path (prefill
   + single-token decode programs sharing cache persistables in scope;
   O(1) recompute per generated token).
+* :mod:`replica` — ``ReplicaSet``: N replicas behind one endpoint with
+  per-replica circuit breakers, watchdog-bounded dispatch, exactly-once
+  batch failover, and per-replica drain.
+* :mod:`brownout` — ``BrownoutController``: turns sustained watcher
+  ``slo_breach``/``step_regression`` findings into an adaptive
+  degradation ladder (shrink max-wait, cap buckets, shed the background
+  class) that re-arms when p99 recovers.
+
+Fault domain: requests carry deadlines (``submit(deadline_ms=)``;
+expired work is dropped pre-dispatch with a typed
+``errors.DeadlineExceededError``) and priority classes
+(``INTERACTIVE``/``BATCH``/``BACKGROUND``; the lowest class sheds first
+under pressure, ``errors.RequestShedError``). Goodput — in-deadline
+completions — is first-class telemetry (``serving.goodput``).
 
 Lifecycle: ``serving.*`` counters/gauges/histograms ride the PR-1
 observability registry; ``Server.drain()`` / SIGTERM ride the PR-3
-preemption contract (stop admitting, flush in-flight batches, exit 75).
+preemption contract (stop admitting, flush in-flight batches, exit 75;
+the drain budget pro-rates across endpoints).
 """
 
 from __future__ import annotations
 
+from .brownout import BrownoutController  # noqa: F401
 from .freeze import FrozenModel, freeze_program, load_frozen  # noqa: F401
 from .generate import GPTGenerator  # noqa: F401
+from .replica import ReplicaSet  # noqa: F401
 from .router import (  # noqa: F401
+    BACKGROUND,
+    BATCH,
+    INTERACTIVE,
     Endpoint,
     EndpointConfig,
     Server,

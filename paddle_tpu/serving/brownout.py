@@ -1,0 +1,210 @@
+"""Adaptive brownout: watcher findings -> graceful degradation ladder.
+
+PR 13's :class:`observability.watch.Watcher` raises ``slo_breach`` /
+``step_regression`` findings that nothing consumed — an overloaded
+server kept batching at full patience until the hard ``max_queue`` cliff.
+:class:`BrownoutController` closes that loop: sustained breach signal
+walks a degradation LADDER (each rung applied to every endpoint via
+:meth:`Endpoint.apply_brownout`), and sustained recovery walks it back
+down — graceful degradation instead of cliff-edge rejection:
+
+======  ==========================================================
+rung    behavior
+======  ==========================================================
+0       full service (the configured knobs)
+1       halve the batch-former max-wait (latency over fill)
+2       quarter the max-wait AND shed the BACKGROUND class at
+        admission (``RequestShedError``)
+3       also shed the BATCH class (interactive-only service)
+4       additionally cap the bucket set to its lower half — the
+        last-ditch latency-over-THROUGHPUT move: big buckets are
+        the batching engine, so this rung cuts capacity and is
+        only reached when shedding everything non-interactive
+        still did not clear the SLO
+======  ==========================================================
+
+Rung ordering is load-bearing: shedding REDUCES demand while the bucket
+cap reduces CAPACITY — capping before shedding (measured on the overload
+bench) pins the saturated queue's wait at the deadline and mass-expires
+the class the ladder is protecting.
+
+The decision core is :meth:`observe` — pure state machine over (new
+findings, current p99), deterministic and directly testable: a breach
+signal on ``escalate_after`` consecutive observations steps UP one rung;
+p99 at or under ``slo_p99_s * recover_margin`` for ``recover_after``
+consecutive observations steps DOWN one (hysteresis both ways, so a
+noisy p99 cannot flap the ladder). :meth:`poll` feeds it live — new
+watcher findings plus the ``watch.request_p99_s`` gauge the watcher
+maintains — and :meth:`start` wraps poll in a daemon thread.
+
+Observability: ``serving.brownout_level`` gauge (plus the per-endpoint
+``serving.brownout_level.<ep>`` the endpoints maintain),
+``serving.brownout_escalations`` / ``serving.brownout_recoveries``
+counters.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..errors import InvalidArgumentError
+from .router import BACKGROUND, BATCH
+
+__all__ = ["DEFAULT_LADDER", "BrownoutController"]
+
+# rung -> Endpoint.apply_brownout kwargs; index 0 is full service.
+# Demand-reducing rungs (shed) come BEFORE the capacity-reducing one
+# (bucket cap) — see the module docstring.
+DEFAULT_LADDER = (
+    {"wait_scale": 1.0, "bucket_frac": 1.0, "shed_priority": None},
+    {"wait_scale": 0.5, "bucket_frac": 1.0, "shed_priority": None},
+    {"wait_scale": 0.25, "bucket_frac": 1.0, "shed_priority": BACKGROUND},
+    {"wait_scale": 0.25, "bucket_frac": 1.0, "shed_priority": BATCH},
+    {"wait_scale": 0.25, "bucket_frac": 0.5, "shed_priority": BATCH},
+)
+
+_BREACH_KINDS = ("slo_breach", "step_regression")
+
+
+class BrownoutController:
+    """Consume watcher findings; drive the endpoints' brownout ladder."""
+
+    def __init__(self, server, slo_p99_s=None, watcher=None,
+                 ladder=DEFAULT_LADDER, escalate_after=2, recover_after=4,
+                 recover_margin=0.8, interval=0.5):
+        if len(ladder) < 2:
+            raise InvalidArgumentError(
+                "brownout ladder needs >= 2 rungs (rung 0 = full service)"
+            )
+        if not 0.0 < float(recover_margin) <= 1.0:
+            raise InvalidArgumentError(
+                f"recover_margin must be in (0, 1], got {recover_margin}"
+            )
+        self._server = server
+        self.slo_p99_s = None if slo_p99_s is None else float(slo_p99_s)
+        self.watcher = watcher
+        self.ladder = tuple(ladder)
+        self.escalate_after = int(escalate_after)
+        self.recover_after = int(recover_after)
+        self.recover_margin = float(recover_margin)
+        self.interval = float(interval)
+        self.level = 0
+        self._breach_obs = 0
+        self._ok_obs = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._apply()
+
+    # -- decision core -----------------------------------------------------
+    def observe(self, findings=(), p99=None):
+        """One observation of the breach signal; returns the (possibly
+        changed) ladder level. `findings` are watcher finding dicts (only
+        ``slo_breach``/``step_regression`` kinds count); `p99` is the
+        current window p99 in seconds (compared against ``slo_p99_s``
+        for sustained-breach detection and for recovery — the watcher
+        only LATCHES one finding per excursion, so escalation past rung 1
+        needs the level signal, not just edges)."""
+        breach = any(
+            f.get("kind") in _BREACH_KINDS for f in findings or ()
+        )
+        ok = False
+        if p99 is not None and self.slo_p99_s is not None:
+            if p99 > self.slo_p99_s:
+                breach = True
+            elif p99 <= self.slo_p99_s * self.recover_margin:
+                ok = not breach
+        with self._lock:
+            if breach:
+                self._breach_obs += 1
+                self._ok_obs = 0
+            elif ok:
+                self._ok_obs += 1
+                self._breach_obs = 0
+            elif p99 is not None:
+                # dead band (recovered-ish but above the re-arm margin):
+                # BOTH streaks reset — two transient breaches hours apart
+                # must not add up to an escalation, and sub-margin blips
+                # interleaved with near-SLO hovering must not add up to a
+                # recovery. A no-signal observation (p99 None, no
+                # findings) leaves both streaks untouched.
+                self._breach_obs = 0
+                self._ok_obs = 0
+            changed = None
+            if (breach and self._breach_obs >= self.escalate_after
+                    and self.level < len(self.ladder) - 1):
+                self.level += 1
+                self._breach_obs = 0
+                changed = "serving.brownout_escalations"
+            elif (ok and self._ok_obs >= self.recover_after
+                    and self.level > 0):
+                self.level -= 1
+                self._ok_obs = 0
+                changed = "serving.brownout_recoveries"
+            level = self.level
+        if changed is not None:
+            from .. import observability as _obs
+
+            _obs.add(changed)
+            self._apply()
+        return level
+
+    def _apply(self):
+        from .. import observability as _obs
+
+        rung = dict(self.ladder[self.level])
+        endpoints = getattr(self._server, "endpoints", None)
+        eps = (
+            list(endpoints().values()) if callable(endpoints)
+            else list(self._server)
+        )
+        for ep in eps:
+            ep.apply_brownout(level=self.level, **rung)
+        _obs.set_gauge("serving.brownout_level", float(self.level))
+
+    # -- live wiring -------------------------------------------------------
+    def poll(self):
+        """One live observation: drain the watcher's new findings (when
+        one is attached) and read its p99 gauge. The current rung is
+        re-applied every poll (idempotent), so an endpoint registered
+        AFTER an escalation picks up the active brownout within one
+        interval instead of serving at full patience through the
+        breach."""
+        from ..observability import metrics
+
+        findings = self.watcher.poll() if self.watcher is not None else ()
+        p99 = metrics.get_gauges().get("watch.request_p99_s")
+        level = self.observe(findings, p99)
+        self._apply()
+        return level
+
+    def start(self):
+        """Poll on a daemon thread every ``interval`` seconds."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serving-brownout"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval * 4 + 1.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll()
+            except Exception:
+                pass  # a broken poll must not kill the controller thread

@@ -1,0 +1,400 @@
+"""Circuit-broken replica failover: N frozen replicas behind one endpoint.
+
+The r8 router binds an endpoint to ONE runner: a wedged or crashing
+executable takes the whole endpoint (and, under SIGTERM, the whole
+``Server.drain``) down with it. :class:`ReplicaSet` is the serving-side
+analog of the training stack's elastic restart — it IS a runner (same
+``feed_names``/``sample_spec``/``run`` surface), so an
+:class:`serving.router.Endpoint` fronts N replicas without the router
+changing, and it adds the fault domain the single-runner path cannot
+have:
+
+* **Per-replica circuit breakers** — ``breaker_threshold`` consecutive
+  dispatch failures open a replica's breaker (``serving.breaker_state.
+  <replica>`` gauge: 0 closed / 0.5 half-open / 1 open). An open breaker
+  takes the replica out of rotation; after ``cooldown_s`` the next batch
+  is routed to it as a HALF-OPEN probe (driven through the
+  ``resilience/retry.py`` policy machinery) — success closes the
+  breaker, failure re-opens it. A probe is real traffic, so its batch is
+  protected by the exactly-once re-route below.
+* **Bounded dispatch** — ``attempt_timeout`` runs each replica dispatch
+  under the retry policy's watchdog thread: a HUNG executable (the
+  ``serving.dispatch:hang`` chaos kind) surfaces as a typed
+  ``ExecutionTimeoutError`` after the timeout instead of wedging the
+  scheduler forever, and counts as a breaker failure.
+* **Exactly-once failover** — a failed dispatch re-routes its batch to a
+  healthy replica ONCE (``serving.requeued`` counts the requests,
+  ``serving.failovers`` the batches), keyed on the router's idempotent
+  per-request ids: a request that already survived one re-route is never
+  re-routed again (at-most-twice execution, bounded by construction),
+  the failure surfaces typed instead.
+* **Heartbeat-informed health** — pass ``heartbeats={name: beat_path}``
+  (the PR-3 ``Heartbeat`` file contract) and a replica whose beat is
+  staler than ``heartbeat_timeout`` is treated as unhealthy before a
+  single dispatch is burned on it.
+* **Per-replica drain** — :meth:`drain_replica` takes one replica out of
+  rotation (and drains its runner if it has a ``drain``) while the set
+  keeps serving; :meth:`restore_replica` re-admits it with a reset
+  breaker (the replaced-replica story).
+
+The ``serving.dispatch`` fault seam fires INSIDE each replica attempt
+(plus a per-replica ``serving.dispatch.<name>`` seam for targeted
+chaos), i.e. under the breaker/timeout machinery — injected raise/hang
+kinds exercise exactly the failover path production failures take.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..errors import InvalidArgumentError, UnavailableError
+
+__all__ = ["CLOSED", "HALF_OPEN", "OPEN", "ReplicaSet"]
+
+CLOSED = "closed"
+HALF_OPEN = "half_open"
+OPEN = "open"
+
+_STATE_GAUGE = {CLOSED: 0.0, HALF_OPEN: 0.5, OPEN: 1.0}
+
+
+class _Replica:
+    __slots__ = ("name", "runner", "state", "consecutive_failures",
+                 "opened_at", "draining", "probing", "beat_path",
+                 "beat_ok", "beat_checked_at")
+
+    def __init__(self, name, runner, beat_path=None):
+        self.name = name
+        self.runner = runner
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+        self.draining = False
+        self.probing = False
+        self.beat_path = beat_path
+        # cached heartbeat verdict (re-read at a bounded rate, not per
+        # dispatch: _pick holds the routing lock)
+        self.beat_ok = True
+        self.beat_checked_at = None
+
+
+class ReplicaSet:
+    """Front N runner replicas with circuit breakers + 1x failover.
+
+    ``replicas`` is ``{name: runner}`` (or a list, named ``r0..rN-1``);
+    every replica must expose the same ``feed_names`` (the FrozenRunner
+    surface). The set itself is a runner, so plug it straight into
+    ``Server.add_endpoint(name, replica_set, config)``.
+    """
+
+    # the router hands us the batch's request ids (idempotency tokens for
+    # exactly-once re-routing) and leaves the dispatch fault seam to us
+    wants_request_ids = True
+
+    def __init__(self, replicas, breaker_threshold=3, cooldown_s=2.0,
+                 attempt_timeout=None, heartbeats=None,
+                 heartbeat_timeout=10.0, name="replicas",
+                 clock=time.monotonic):
+        from ..resilience.retry import retry
+
+        if not isinstance(replicas, dict):
+            replicas = {f"r{i}": r for i, r in enumerate(replicas)}
+        if not replicas:
+            raise InvalidArgumentError("ReplicaSet needs >= 1 replica")
+        if int(breaker_threshold) < 1:
+            raise InvalidArgumentError(
+                f"breaker_threshold must be >= 1, got {breaker_threshold}"
+            )
+        heartbeats = heartbeats or {}
+        self.name = name
+        self.breaker_threshold = int(breaker_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.attempt_timeout = (
+            None if attempt_timeout is None else float(attempt_timeout)
+        )
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self._clock = clock
+        self._order = [
+            _Replica(n, r, heartbeats.get(n)) for n, r in replicas.items()
+        ]
+        first = self._order[0].runner
+        self.feed_names = tuple(first.feed_names)
+        self.fetch_names = tuple(getattr(first, "fetch_names", ()))
+        for rep in self._order[1:]:
+            if tuple(rep.runner.feed_names) != self.feed_names:
+                raise InvalidArgumentError(
+                    f"replica {rep.name!r} feed_names "
+                    f"{tuple(rep.runner.feed_names)} != {self.feed_names}"
+                )
+            # fetch order IS the output contract: a replica frozen with a
+            # different fetch set would silently serve wrong-attributed
+            # rows after a failover
+            fetches = tuple(getattr(rep.runner, "fetch_names", ()))
+            if fetches != self.fetch_names:
+                raise InvalidArgumentError(
+                    f"replica {rep.name!r} fetch_names {fetches} != "
+                    f"{self.fetch_names}"
+                )
+        self._lock = threading.Lock()
+        # round-robin cursor over the healthy set; starts so the FIRST
+        # dispatch lands on the first declared replica (deterministic)
+        self._rr = -1
+        # ids that already consumed their one re-route (bounded memory:
+        # ids are monotonic, so evicting the oldest is safe)
+        self._rerouted = set()
+        self._rerouted_fifo = deque()
+        self._rerouted_cap = 65536
+        # max_attempts=1: the retry POLICY only contributes the watchdog
+        # thread bounding one attempt — re-routing (this class) is the
+        # retry, and it must land on a DIFFERENT replica
+        self._attempt_policy = retry(
+            max_attempts=1, attempt_timeout=self.attempt_timeout,
+            name="serving.dispatch",
+        )
+        for rep in self._order:
+            self._gauge(rep)
+
+    # -- runner surface ----------------------------------------------------
+    def sample_spec(self, name):
+        return self._order[0].runner.sample_spec(name)
+
+    def validate_config(self, config):
+        for rep in self._order:
+            validate = getattr(rep.runner, "validate_config", None)
+            if validate is not None:
+                validate(config)
+
+    def warmup_run(self, feed):
+        """Warm EVERY (non-draining) replica on this bucket feed, breaker
+        and fault seam bypassed: a standby that compiles during failover
+        would pay the cold-start exactly when latency matters most.
+        Returns the last replica's outputs (the warmup discards them)."""
+        out = None
+        for rep in self._order:
+            if not rep.draining:
+                out = rep.runner.run(feed)
+        if out is None:
+            raise UnavailableError(
+                f"replica set {self.name!r}: every replica is draining"
+            )
+        return out
+
+    # -- dispatch + failover -----------------------------------------------
+    def run(self, feed, request_ids=None):
+        """Dispatch one batch: route to a healthy replica; on failure,
+        re-route to another healthy replica EXACTLY once (idempotent
+        request ids), then surface the failure typed."""
+        from .. import observability as _obs
+
+        # requeued counts REQUESTS: the ids when the router provided
+        # them (a partial batch is padded, so feed rows overcount)
+        n = (len(request_ids) if request_ids
+             else len(next(iter(feed.values()))) if feed else 0)
+        tried = []
+        rep = self._pick(tried)
+        if rep is None:
+            raise UnavailableError(
+                f"replica set {self.name!r}: no healthy replica "
+                f"(states {self.states()})"
+            )
+        for hop in (0, 1):
+            try:
+                out = self._dispatch(rep)
+                out = out(feed)
+            except Exception as exc:
+                self._on_failure(rep, exc)
+                tried.append(rep.name)
+                if hop == 1:
+                    raise
+                # the one re-route: only counted (and only charged
+                # against the requests' idempotency tokens) once a
+                # healthy failover TARGET actually exists
+                rep = self._pick(tried)
+                if rep is None:
+                    raise
+                if not self._mark_rerouted(request_ids):
+                    # some request in this batch already consumed its
+                    # one re-route on an earlier call: refuse a second
+                    # (unbounded duplicate execution), surface the
+                    # failure instead
+                    raise
+                _obs.add("serving.failovers")
+                _obs.add("serving.requeued", n)
+                _obs.add(f"serving.requeued.{self.name}", n)
+                continue
+            self._on_success(rep)
+            _obs.add(f"serving.replica_dispatches.{rep.name}")
+            return out
+
+    def _dispatch(self, rep):
+        from ..resilience.faults import fault_point
+
+        def attempt(feed):
+            # the dispatch chaos seams, INSIDE the watchdog-bounded
+            # attempt: a raising kind reads as a replica failure, a hang
+            # as a wedged executable the timeout converts to a typed
+            # ExecutionTimeoutError
+            fault_point("serving.dispatch")
+            fault_point(f"serving.dispatch.{rep.name}")
+            return rep.runner.run(feed)
+
+        if self.attempt_timeout is None:
+            return attempt
+        return lambda feed: self._attempt_policy.call(attempt, feed)
+
+    # -- breaker core ------------------------------------------------------
+    def _gauge(self, rep):
+        from .. import observability as _obs
+
+        _obs.set_gauge(
+            f"serving.breaker_state.{rep.name}", _STATE_GAUGE[rep.state]
+        )
+
+    def _beat_ok(self, rep):
+        if rep.beat_path is None:
+            return True
+        # the verdict is cached for a fraction of the staleness budget:
+        # one beat-file read per recheck window, not one per dispatch
+        # (this runs under the routing lock on the hot path)
+        now = time.time()
+        recheck = min(1.0, self.heartbeat_timeout / 4.0)
+        if (rep.beat_checked_at is not None
+                and now - rep.beat_checked_at < recheck):
+            return rep.beat_ok
+        from ..resilience.health import read_beat
+
+        beat = read_beat(rep.beat_path)
+        rep.beat_checked_at = now
+        rep.beat_ok = bool(
+            beat and "time" in beat
+            and now - float(beat["time"]) <= self.heartbeat_timeout
+        )
+        return rep.beat_ok
+
+    def _pick(self, exclude):
+        """Choose the dispatch target: a due half-open probe first (so
+        recovery actually happens under traffic — a failed probe re-routes
+        safely), else round-robin over closed replicas."""
+        now = self._clock()
+        with self._lock:
+            closed, probe = [], None
+            for rep in self._order:
+                if rep.name in exclude or rep.draining:
+                    continue
+                if not self._beat_ok(rep):
+                    continue
+                if rep.state == CLOSED:
+                    closed.append(rep)
+                elif probe is None and (
+                        (rep.state == OPEN
+                         and now - rep.opened_at >= self.cooldown_s)
+                        or (rep.state == HALF_OPEN and not rep.probing)):
+                    probe = rep
+            if probe is not None:
+                probe.state = HALF_OPEN
+                probe.probing = True
+                self._gauge(probe)
+                return probe
+            if closed:
+                self._rr += 1
+                return closed[self._rr % len(closed)]
+            return None
+
+    def _on_success(self, rep):
+        from .. import observability as _obs
+
+        with self._lock:
+            was = rep.state
+            rep.state = CLOSED
+            rep.consecutive_failures = 0
+            rep.probing = False
+            self._gauge(rep)
+        if was != CLOSED:
+            _obs.add("serving.breaker_closed")
+            _obs.add(f"serving.breaker_closed.{rep.name}")
+
+    def _on_failure(self, rep, exc):
+        from .. import observability as _obs
+
+        _obs.add("serving.dispatch_failures")
+        _obs.add(f"serving.dispatch_failures.{rep.name}")
+        opened = False
+        with self._lock:
+            rep.consecutive_failures += 1
+            was_probe = rep.probing
+            rep.probing = False
+            if (was_probe or rep.state == HALF_OPEN
+                    or rep.consecutive_failures >= self.breaker_threshold):
+                opened = rep.state != OPEN
+                rep.state = OPEN
+                rep.opened_at = self._clock()
+                self._gauge(rep)
+        if opened:
+            _obs.add("serving.breaker_opened")
+            _obs.add(f"serving.breaker_opened.{rep.name}")
+
+    def _mark_rerouted(self, request_ids):
+        """Claim the one re-route for every id in the batch; False when
+        any id already spent its re-route (callers must surface the
+        failure instead of re-routing again)."""
+        if not request_ids:
+            return True
+        with self._lock:
+            if any(rid in self._rerouted for rid in request_ids):
+                return False
+            for rid in request_ids:
+                self._rerouted.add(rid)
+                self._rerouted_fifo.append(rid)
+            while len(self._rerouted_fifo) > self._rerouted_cap:
+                self._rerouted.discard(self._rerouted_fifo.popleft())
+        return True
+
+    # -- introspection / lifecycle -----------------------------------------
+    def states(self):
+        """{replica: breaker state} snapshot ('draining' overrides)."""
+        with self._lock:
+            return {
+                rep.name: ("draining" if rep.draining else rep.state)
+                for rep in self._order
+            }
+
+    def _find(self, name):
+        for rep in self._order:
+            if rep.name == name:
+                return rep
+        raise InvalidArgumentError(
+            f"no replica {name!r} in set {self.name!r} "
+            f"({[r.name for r in self._order]})"
+        )
+
+    def drain_replica(self, name, timeout=None):
+        """Take one replica out of rotation (per-replica SIGTERM drain):
+        the set keeps serving on the survivors. Drains the replica's own
+        runner when it has a ``drain``. Returns the runner's drain result
+        (or True)."""
+        from .. import observability as _obs
+
+        rep = self._find(name)
+        with self._lock:
+            rep.draining = True
+        _obs.add("serving.replica_drains")
+        _obs.set_gauge(f"serving.replica_draining.{name}", 1.0)
+        drain = getattr(rep.runner, "drain", None)
+        return drain(timeout) if drain is not None else True
+
+    def restore_replica(self, name):
+        """Re-admit a drained (or broken) replica with a reset breaker —
+        the replaced-replica path. The caller re-warms via
+        ``Endpoint.warmup()`` when the new runner is cold."""
+        from .. import observability as _obs
+
+        rep = self._find(name)
+        with self._lock:
+            rep.draining = False
+            rep.state = CLOSED
+            rep.consecutive_failures = 0
+            rep.probing = False
+            self._gauge(rep)
+        _obs.set_gauge(f"serving.replica_draining.{name}", 0.0)

@@ -4,7 +4,10 @@ Driven by tests/test_serving.py::test_drain_worker_exits_75 and the ci.sh
 serving smoke: the parent SIGTERMs this process mid-load and asserts
 
 * exit code == PREEMPTION_EXIT_CODE (75, the PR-3 preemption contract),
-* every admitted request completed (result.json: dropped == 0),
+* every admitted request RESOLVED — served, or typed expired/shed for the
+  deadline/priority slice of the load (result.json: dropped == 0; the
+  r15 fault-domain drain contract: expired work resolves with
+  ``DeadlineExceededError`` instead of hanging the drain),
 * the ``serving.drained`` counter fired exactly once.
 
 Usage: python tests/serving_drain_worker.py OUT_DIR
@@ -69,15 +72,32 @@ def main():
     with open(os.path.join(out_dir, "ready"), "w") as f:
         f.write("1")
 
+    from paddle_tpu.errors import (  # noqa: E402
+        DeadlineExceededError,
+        RequestShedError,
+    )
+    from paddle_tpu.serving import BACKGROUND  # noqa: E402
+
     rng = np.random.RandomState(0)
     futures = []
+    i = 0
     while not server.draining:
         try:
+            # every 4th request carries a tight deadline + background
+            # class: under SIGTERM some of these are still queued and
+            # already expired — the drain must RESOLVE them typed, not
+            # hang on them
+            kwargs = (
+                {"deadline_ms": 2.0, "priority": BACKGROUND}
+                if i % 4 == 0 else {}
+            )
             futures.append(
                 server.submit(
-                    "clf", {"x": rng.randn(16).astype(np.float32)}
+                    "clf", {"x": rng.randn(16).astype(np.float32)},
+                    **kwargs,
                 )
             )
+            i += 1
         except ServerDrainingError:
             break
         except Exception:
@@ -90,11 +110,15 @@ def main():
         print("drain never completed", file=sys.stderr)
         sys.exit(1)
 
-    served = dropped = 0
+    served = expired = shed = dropped = 0
     for f in futures:
         try:
             f.result(timeout=5)
             served += 1
+        except DeadlineExceededError:
+            expired += 1
+        except RequestShedError:
+            shed += 1
         except Exception:
             dropped += 1
     counters = observability.get_counters()
@@ -102,9 +126,12 @@ def main():
         json.dump({
             "admitted": len(futures),
             "served": served,
+            "expired": expired,
+            "shed": shed,
             "dropped": dropped,
             "drained_counter": counters.get("serving.drained", 0),
             "requests_served": counters.get("serving.requests_served", 0),
+            "expired_counter": counters.get("serving.expired", 0),
         }, f)
     sys.exit(PREEMPTION_EXIT_CODE)
 

@@ -188,6 +188,54 @@ def render(snap, top_ops=0):
                 f"({attr.get('est_overlap_ratio', 0):.0%} of the "
                 "serialized wire)"
             )
+    # serving fault-domain digest (r15): goodput vs shed/expired, the
+    # brownout rung, and per-replica breaker states — the overload/
+    # failover picture at a glance
+    goodput = counters.get("serving.goodput", 0)
+    shed = counters.get("serving.shed", 0)
+    expired = counters.get("serving.expired", 0)
+    breakers = {
+        n[len("serving.breaker_state."):]: v
+        for n, v in gauges.items()
+        if n.startswith("serving.breaker_state.")
+    }
+    if goodput or shed or expired or breakers:
+        lines.append("-- serving fault domain --")
+        served = counters.get("serving.requests_served", 0)
+        late = counters.get("serving.late_completions", 0)
+        lines.append(
+            f"  goodput {goodput} in-deadline of {served} served "
+            f"({late} late) | expired {expired} | shed {shed} | "
+            f"rejected {counters.get('serving.rejected', 0)}"
+        )
+        shed_by_class = {
+            n[len("serving.shed_class."):]: c
+            for n, c in counters.items()
+            if n.startswith("serving.shed_class.")
+        }
+        if shed_by_class:
+            lines.append(
+                "  shed by class: " + " ".join(
+                    f"{k}={v}" for k, v in sorted(shed_by_class.items())
+                )
+            )
+        level = gauges.get("serving.brownout_level")
+        if level is not None:
+            lines.append(
+                f"  brownout level {level:.0f} "
+                f"(escalations={counters.get('serving.brownout_escalations', 0)}"
+                f" recoveries={counters.get('serving.brownout_recoveries', 0)})"
+            )
+        if breakers:
+            state_name = {0.0: "closed", 0.5: "half-open", 1.0: "open"}
+            lines.append(
+                "  breakers: " + " ".join(
+                    f"{k}={state_name.get(v, v)}"
+                    for k, v in sorted(breakers.items())
+                )
+                + f" | requeued {counters.get('serving.requeued', 0)}"
+                + f" failovers {counters.get('serving.failovers', 0)}"
+            )
     # live watcher digest: structured findings, newest last
     wf = (tables.get("watch.findings") or {}).get("findings") or []
     if wf:
