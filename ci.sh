@@ -482,6 +482,105 @@ echo "== tracing overhead gate: on-vs-off step latency <= 2% =="
 # median-pairs on the zoo bert model, self-gating
 python tools/bench_tracing.py --smoke
 
+echo "== telemetry plane chaos: 2-rank journals + SIGKILL + offline replay =="
+# two trainers join the plane via the one-env-var opt-in (the Executor
+# constructor starts publisher + flight recorder). rank 0 finishes
+# cleanly and dumps its live snapshot; rank 1 is SIGKILLed mid-run.
+# everything below is read OFFLINE from the telemetry dir: the dead
+# rank's journal must replay to its last published state, its periodic
+# flight bundle must hold the pre-death window, fleet_report must merge
+# both ranks, and a journal-mode watcher (no shared memory with either
+# process) must flag the dead rank as the straggler.
+TEL_DIR=$(mktemp -d)
+PADDLE_TPU_TELEMETRY_DIR="$TEL_DIR" PADDLE_TPU_TELEMETRY_INTERVAL=0.05 \
+    PADDLE_TRAINER_ID=1 JAX_PLATFORMS=cpu \
+    python tests/telemetry_worker.py "$TEL_DIR" 0 \
+    > "$TEL_DIR/rank1.log" 2>&1 &
+TPID=$!
+# wait for the doomed rank's journal AND black box to land, then kill -9
+# (before the clean rank runs its 30 steps, so the dead rank's counter
+# is unambiguously the lagging one)
+for _ in $(seq 600); do
+    grep -q "guard.steps" "$TEL_DIR/telemetry_rank1.jsonl" 2>/dev/null \
+        && grep -q "train.step" "$TEL_DIR/flight_rank1.json" 2>/dev/null \
+        && break
+    kill -0 "$TPID" 2>/dev/null || { cat "$TEL_DIR/rank1.log"; exit 1; }
+    sleep 0.2
+done
+grep -q "train.step" "$TEL_DIR/flight_rank1.json" 2>/dev/null || {
+    echo "rank 1 never published journal progress + flight bundle"
+    cat "$TEL_DIR/rank1.log"; exit 1
+}
+kill -9 "$TPID"; wait "$TPID" 2>/dev/null || true
+PADDLE_TPU_TELEMETRY_DIR="$TEL_DIR" PADDLE_TPU_TELEMETRY_INTERVAL=0.05 \
+    PADDLE_TRAINER_ID=0 JAX_PLATFORMS=cpu \
+    python tests/telemetry_worker.py "$TEL_DIR" 30 \
+    > "$TEL_DIR/rank0.log" 2>&1 \
+    || { cat "$TEL_DIR/rank0.log"; exit 1; }
+python - "$TEL_DIR" <<'EOF'
+import json, sys
+from paddle_tpu.observability import metrics, timeline, watch
+
+d = sys.argv[1]
+# 1) the DEAD rank: journal replay alone reconstructs its last published
+# state — steps, goodput, latency histogram — no process to ask
+replay = timeline.replay_journal(d + "/telemetry_rank1.jsonl")
+snap1 = replay.snapshot()
+steps1 = snap1["counters"]["guard.steps"]
+assert steps1 > 0 and replay.meta["rank"] == 1, snap1["counters"]
+assert "serving.request_latency" in snap1["histograms"]
+# 2) its periodic flight bundle holds the pre-death window (spans +
+# registry state published by the black-box thread, never by a trigger)
+bundle = json.load(open(d + "/flight_rank1.json"))
+assert bundle["trigger"] == "periodic" and bundle["rank"] == 1, bundle
+assert any(s["name"] == "train.step" for s in bundle["spans"]), \
+    [s["name"] for s in bundle["spans"]][:8]
+assert bundle["counters"].get("guard.steps", 0) > 0
+# 3) the CLEAN rank: offline replay lands bitwise on the snapshot the
+# live process dumped after its final publish
+snap0 = timeline.replay_journal(d + "/telemetry_rank0.jsonl").snapshot()
+live0 = json.load(open(d + "/telemetry_stats.json"))
+for section in ("counters", "gauges", "histograms"):
+    assert snap0[section] == live0[section], section
+assert snap0.get("tables", {}) == live0.get("tables", {})
+assert live0["counters"]["telemetry.publishes"] > 1
+# 4) a journal-mode watcher in THIS process (which shares memory with
+# neither trainer) flags the dead rank as the straggler
+metrics.reset()
+w = watch.Watcher(journal_dir=d, skew_steps=2, slo_p99_s=None)
+findings = w.poll()
+strag = [f for f in findings if f["kind"] == "straggler"]
+assert strag and strag[0]["detail"]["source"] == "journal", findings
+assert strag[0]["detail"]["lagging_ranks"] == [1], strag[0]["detail"]
+print(f"telemetry chaos OK: dead rank replayed to step {steps1}, "
+      f"clean rank bitwise ({live0['counters']['telemetry.publishes']} "
+      f"publishes), straggler flagged from journals alone")
+EOF
+# the fleet merge: both shards (one from a SIGKILLed writer) replayed
+# into one report, with the dead rank's last steps reconstructed
+python tools/fleet_report.py "$TEL_DIR" --expect-ranks 2 \
+    --out "$TEL_DIR/fleet.json"
+python - "$TEL_DIR" <<'EOF'
+import json, sys
+report = json.load(open(sys.argv[1] + "/fleet.json"))
+by_rank = {s["rank"]: s for s in report["shards"]}
+assert by_rank[0]["last_step"] == 30, by_rank[0]
+assert by_rank[1]["last_step"] > 0, by_rank[1]
+assert report["fleet"]["straggler"]["per_rank_last_step"]["1"] \
+    == by_rank[1]["last_step"]
+print(f"fleet report OK: ranks 0+1 merged, dead rank died at step "
+      f"{by_rank[1]['last_step']} of lead {by_rank[0]['last_step']}")
+EOF
+# the clean rank's snapshot carries the plane's own counters
+python tools/stats_report.py "$TEL_DIR/telemetry_stats.json" \
+    --require telemetry.
+rm -rf "$TEL_DIR"
+
+echo "== telemetry overhead gate: publisher+recorder on-vs-off <= 2% =="
+# the plane only stays one-env-var-on if a trainer cannot feel it:
+# interleaved median-pairs with both daemons at a 20x stress cadence
+python tools/bench_telemetry.py --smoke
+
 echo "== perf report (IR cost model vs XLA over the zoo) =="
 # every zoo model's Program.estimate() must stay within 25% of XLA's own
 # cost_analysis (one model of slack for backend counting quirks);

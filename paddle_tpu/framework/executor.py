@@ -148,6 +148,14 @@ class Executor:
     def __init__(self, place=None):
         from collections import OrderedDict
 
+        from ..observability import timeline as _timeline
+
+        # telemetry-plane opt-in (PR 16): with PADDLE_TPU_TELEMETRY_DIR
+        # set, the first Executor in the process brings up the journal
+        # publisher + flight recorder — launched trainers join the fleet
+        # telemetry plane with no code changes (idempotent, cheap no-op
+        # when the env is absent)
+        _timeline.ensure_publisher()
         self.place = place if place is not None else default_place()
         self._cache = OrderedDict()
         # (compiled, fresh_compile, (host_s, device_s) | None) of the

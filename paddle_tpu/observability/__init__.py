@@ -14,7 +14,12 @@ Reading it out:
   * ``prometheus_text()`` — text exposition for scraping;
   * ``chrome_trace()`` / ``tools.timeline.Timeline(dir,
     include_host_spans=True)`` — host spans as Chrome-trace JSON, alone or
-    merged with a jax.profiler device capture.
+    merged with a jax.profiler device capture;
+  * the telemetry plane (PR 16) — ``TelemetryPublisher`` journals registry
+    deltas to per-process shards that outlive the process
+    (``PADDLE_TPU_TELEMETRY_DIR``; ``tools/fleet_report.py`` merges them),
+    and ``FlightRecorder`` keeps a rolling last-N-seconds window dumped as
+    a post-mortem bundle on crash triggers.
 
 Kill-switch: ``PADDLE_TPU_MONITOR=0`` in the environment makes every hook
 a no-op (``set_enabled`` flips it at runtime; ``set_enabled(None)``
@@ -27,8 +32,28 @@ Canonical metric names are documented in README.md §Observability.
 
 from __future__ import annotations
 
-from . import export, metrics, spans, trace, watch  # noqa: F401
+from . import (  # noqa: F401
+    export,
+    metrics,
+    recorder,
+    spans,
+    timeline,
+    trace,
+    watch,
+)
 from .export import dump, prometheus_text, snapshot  # noqa: F401
+from .recorder import (  # noqa: F401
+    FlightRecorder,
+    flight_dump,
+    install_excepthook,
+)
+from .timeline import (  # noqa: F401
+    JournalFollower,
+    TelemetryPublisher,
+    ensure_publisher,
+    journal_stamp,
+    replay_journal,
+)
 from .trace import (  # noqa: F401
     TraceContext,
     activate,
@@ -47,11 +72,13 @@ from .metrics import (  # noqa: F401
     get_gauges,
     get_histograms,
     get_tables,
+    merge_cumulative_buckets,
     observe,
     set_enabled,
     set_gauge,
     set_table,
     timed,
+    window_p99,
 )
 from .spans import (  # noqa: F401
     chrome_trace,

@@ -183,6 +183,62 @@ def timed(name: str, buckets=None) -> _Timed:
     return _Timed(name, buckets)
 
 
+def window_p99(prev_buckets, cur_buckets, q=0.99):
+    """p99 (or `q`-quantile) upper-bound estimate from the bucket-count
+    delta between two cumulative-bucket snapshots — the one shared
+    windowed-quantile primitive (the Watcher's SLO check, the brownout
+    controller's watcher-less fallback, fleet_report's cross-process p99
+    and the Watcher's journal mode all call this, so their answers agree
+    by construction). Both sides are cumulative Prometheus buckets
+    (``[[le, cum], ..., ["+Inf", count]]``); per-bucket subtraction
+    yields the window's cumulative counts directly; ``prev_buckets=None``
+    treats the window as all of `cur_buckets`. A quantile landing in
+    +Inf reports the largest finite edge x2 — an upper bound is the
+    conservative answer an SLO check wants. None when the window saw no
+    observations."""
+    prev = {str(le): c for le, c in (prev_buckets or [])}
+    deltas = [(le, cum - prev.get(str(le), 0)) for le, cum in cur_buckets]
+    total = deltas[-1][1] if deltas else 0
+    if total <= 0:
+        return None
+    target = q * total
+    finite = [float(le) for le, _ in deltas if not isinstance(le, str)]
+    for le, cum_d in deltas:
+        if cum_d >= target:
+            if isinstance(le, str):  # +Inf bucket
+                return (max(finite) * 2.0) if finite else float("inf")
+            return float(le)
+    return (max(finite) * 2.0) if finite else float("inf")
+
+
+def merge_cumulative_buckets(bucket_lists):
+    """Merge cumulative Prometheus bucket lists from SEVERAL histograms
+    (e.g. one per process) into one cumulative list over the union of
+    their edges. Each input's cumulative count at a foreign edge is its
+    count at its own largest edge <= that edge — exact for the step
+    function a cumulative histogram is. The merged list feeds
+    :func:`window_p99` directly: cross-process quantiles reconstructed
+    from per-process bucket state."""
+    lists = [b for b in bucket_lists if b]
+    finite = sorted({
+        float(le) for b in lists for le, _ in b if not isinstance(le, str)
+    })
+    merged = []
+    for le in finite:
+        total = 0
+        for b in lists:
+            cum = 0
+            for ble, bcum in b:
+                if isinstance(ble, str) or float(ble) > le:
+                    break
+                cum = bcum
+            total += cum
+        merged.append([le, total])
+    merged.append(["+Inf", sum(b[-1][1] for b in lists)] if lists
+                  else ["+Inf", 0])
+    return merged
+
+
 # -- read side --------------------------------------------------------------
 def get_counters() -> dict[str, int]:
     with _lock:

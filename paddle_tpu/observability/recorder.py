@@ -1,0 +1,302 @@
+"""Crash flight recorder: the last N seconds of telemetry, dumped on death.
+
+The journal (:mod:`timeline`) answers "what were the metrics when rank 3
+died"; this module answers "what was it DOING". A :class:`FlightRecorder`
+keeps a rolling window of the observable state — recent spans out of the
+span ring, periodic registry delta samples, the watcher's structured
+findings, the trace ids active in the window — and writes it out as a
+post-mortem bundle when something goes wrong:
+
+========================  =================================================
+trigger                   hook site
+========================  =================================================
+``exception``             :func:`install_excepthook` (sys + threading)
+``watchdog_stall``        ``resilience.health.StepWatchdog`` fire path
+``train_rollback``        ``resilience.guard.TrainGuard._skip_bad_step``
+``preempt_drain``         ``TrainGuard._finalize_preemption`` (SIGTERM)
+``serving_drain``         ``serving.router.Server.drain``
+``breaker_open``          ``serving.replica.ReplicaSet._on_failure``
+========================  =================================================
+
+Each trigger writes ``{dir}/flight_rank{K}.{trigger}.json``. SIGKILL
+cannot be hooked, so the recorder is ALSO a black box: a daemon thread
+re-publishes the current window to ``{dir}/flight_rank{K}.json``
+(temp + ``os.replace``, never torn) every ``interval`` seconds — after a
+kill -9 the last atomically-published window is still on disk, holding
+the spans and findings from just before death.
+
+Hook sites call :func:`flight_dump`, a module-level no-op until a
+recorder is installed — zero cost on the default path, and the whole
+module rides the ``PADDLE_TPU_MONITOR`` kill-switch (no thread, no
+files when disabled).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import traceback
+
+from . import metrics, spans, timeline, trace
+
+__all__ = [
+    "FlightRecorder",
+    "flight_dump",
+    "get_recorder",
+    "install",
+    "install_excepthook",
+    "uninstall",
+]
+
+
+class FlightRecorder:
+    """Rolling window of spans / metric deltas / findings / trace ids."""
+
+    def __init__(self, directory=None, rank=None, window_s=30.0,
+                 interval=1.0, max_samples=256):
+        if directory is None:
+            directory = os.environ.get(timeline.TELEMETRY_DIR_ENV)
+        if directory is None:
+            raise ValueError(
+                "FlightRecorder needs a directory (arg or "
+                f"{timeline.TELEMETRY_DIR_ENV} env)"
+            )
+        if rank is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.directory = directory
+        self.rank = int(rank)
+        self.window_s = float(window_s)
+        self.interval = float(interval)
+        self.dumps = 0
+        # periodic registry-delta samples: the "metric deltas" leg of the
+        # window, sharing the journal's delta encoder so a bundle sample
+        # and a journal record read the same
+        self._samples = collections.deque(maxlen=int(max_samples))
+        self._prev = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._thread = None
+
+    @property
+    def path(self):
+        """The black-box bundle (atomically re-published every interval)."""
+        return os.path.join(self.directory, f"flight_rank{self.rank}.json")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, register=True):
+        if not metrics.enabled():
+            return self
+        os.makedirs(self.directory, exist_ok=True)
+        if register:
+            install(self)
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="obs-flightrec"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval * 4 + 1.0)
+        if get_recorder() is self:
+            uninstall()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+    def pause(self):
+        self._paused.set()
+
+    def resume(self):
+        self._paused.clear()
+
+    # -- the window --------------------------------------------------------
+    def sample(self):
+        """Fold one registry-delta sample into the window (called on the
+        cadence thread; callable directly from a step loop too)."""
+        if not metrics.enabled() or self._paused.is_set():
+            return None
+        cur = timeline._registry_state()
+        with self._lock:
+            prev, self._prev = self._prev, cur
+            if prev is None:
+                return None
+            body, regressed = timeline._delta(prev, cur)
+            if body is None and not regressed:
+                return None
+            rec = {"t": time.time()}
+            rec.update(body or {"rebased": True})
+            self._samples.append(rec)
+            now = time.time()
+            while self._samples and (
+                now - self._samples[0]["t"] > self.window_s
+            ):
+                self._samples.popleft()
+            return rec
+
+    def window(self, trigger="periodic", exc=None, detail=None):
+        """The current bundle dict: everything observable from the last
+        ``window_s`` seconds."""
+        now = time.time()
+        floor_us = (now - self.window_s) * 1e6
+        win_spans = [
+            s for s in spans.get_spans() if s["ts"] >= floor_us
+        ]
+        trace_ids = sorted({
+            s["trace_id"] for s in win_spans if "trace_id" in s
+        })
+        ctx = trace.current()
+        if ctx is not None and ctx.trace_id not in trace_ids:
+            trace_ids.append(ctx.trace_id)
+        findings = (
+            metrics.get_tables().get("watch.findings") or {}
+        ).get("findings") or []
+        bundle = {
+            "trigger": trigger,
+            "t": now,
+            "rank": self.rank,
+            "pid": os.getpid(),
+            "window_s": self.window_s,
+            "spans": win_spans,
+            "trace_ids": trace_ids,
+            "findings": [
+                f for f in findings
+                if now - f.get("time", now) <= self.window_s
+            ],
+            "deltas": list(self._samples),
+            "counters": metrics.get_counters(),
+            "gauges": metrics.get_gauges(),
+        }
+        stamp = timeline.journal_stamp()
+        if stamp:
+            bundle["journal"] = stamp
+        if detail:
+            bundle["detail"] = detail
+        if exc is not None:
+            bundle["exception"] = {
+                "type": type(exc).__name__,
+                "message": str(exc),
+                "traceback": traceback.format_exception(
+                    type(exc), exc, exc.__traceback__
+                ),
+            }
+        return bundle
+
+    # -- dumping -----------------------------------------------------------
+    def _publish(self, bundle, path):
+        fd, tmp = tempfile.mkstemp(
+            dir=self.directory, prefix=os.path.basename(path) + ".tmp."
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(bundle, f, default=str)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+    def dump(self, trigger, exc=None, detail=None):
+        """Write the post-mortem bundle for `trigger`; returns its path
+        (and refreshes the black box so the two never disagree)."""
+        if not metrics.enabled():
+            return None
+        self.sample()
+        bundle = self.window(trigger=trigger, exc=exc, detail=detail)
+        self.dumps += 1
+        metrics.add("telemetry.flight_dumps")
+        metrics.add(f"telemetry.flight_dumps.{trigger}")
+        path = os.path.join(
+            self.directory, f"flight_rank{self.rank}.{trigger}.json"
+        )
+        self._publish(bundle, path)
+        self._publish(bundle, self.path)
+        return path
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.sample()
+                self._publish(self.window(), self.path)
+            except Exception:
+                pass  # a broken publish must not kill the black box
+
+
+# -- process-global wiring ---------------------------------------------------
+_recorder: FlightRecorder | None = None
+
+
+def install(recorder):
+    """Make `recorder` the process-global flight recorder the hook sites
+    dump through."""
+    global _recorder
+    _recorder = recorder
+    return recorder
+
+
+def uninstall():
+    global _recorder
+    _recorder = None
+
+
+def get_recorder():
+    return _recorder
+
+
+def flight_dump(trigger, exc=None, detail=None):
+    """Dump the installed recorder's window for `trigger`; a safe no-op
+    (None) when no recorder is installed or monitoring is off — the form
+    every hook site calls so instrumented code paths never grow a hard
+    dependency on the recorder being configured."""
+    rec = _recorder
+    if rec is None:
+        return None
+    try:
+        return rec.dump(trigger, exc=exc, detail=detail)
+    except Exception:
+        return None  # a post-mortem must never mask the original failure
+
+
+_hooks_installed = False
+
+
+def install_excepthook():
+    """Chain the unhandled-exception triggers (``sys.excepthook`` and
+    ``threading.excepthook``) in front of the existing hooks. Idempotent."""
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+    prev_sys = sys.excepthook
+    prev_thread = threading.excepthook
+
+    def _sys_hook(exc_type, exc, tb):
+        flight_dump("exception", exc=exc)
+        prev_sys(exc_type, exc, tb)
+
+    def _thread_hook(args):
+        flight_dump(
+            "exception", exc=args.exc_value,
+            detail={"thread": getattr(args.thread, "name", None)},
+        )
+        prev_thread(args)
+
+    sys.excepthook = _sys_hook
+    threading.excepthook = _thread_hook

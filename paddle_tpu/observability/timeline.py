@@ -1,0 +1,589 @@
+"""Persistent metric timelines: per-process delta journals that outlive
+their process.
+
+Every observability surface before this module was in-process and
+point-in-time — ``export.snapshot()`` reads the live registry, and when a
+rank dies (the exact event the resilience layer is built to survive) its
+metrics die with it. The :class:`TelemetryPublisher` fixes that by
+journaling the registry to disk as it evolves:
+
+* a daemon thread wakes every ``interval`` seconds, computes the registry
+  *delta* since its last publish (counter increments, changed gauges,
+  per-bucket histogram count deltas, changed tables), and appends it as
+  ONE ``\\n``-terminated JSON line to a per-process shard
+  ``{dir}/telemetry_rank{K}.jsonl`` — a single ``write()`` per record, so
+  a reader (or a SIGKILL) never sees a torn line, only a truncated tail
+  that :func:`read_records` skips;
+* every shard file begins with a ``base`` record carrying the full
+  cumulative state, so replaying ONE file — no predecessor, no shared
+  memory — reconstructs the writer's last published snapshot exactly
+  (:func:`replay_journal`); integer deltas accumulate exactly, and float
+  fields (gauges, histogram sum/min/max) are journaled as absolutes so
+  replay is bitwise, not drift-prone float re-accumulation;
+* shards rotate at ``max_bytes`` (``{shard}.1`` keeps one predecessor;
+  the fresh shard re-opens with a new ``base``), bounding disk while
+  keeping the current file self-contained.
+
+Knobs: ``PADDLE_TPU_TELEMETRY_DIR`` (no dir, no journal — also the
+one-env-var opt-in :func:`ensure_publisher` keys on),
+``PADDLE_TPU_TELEMETRY_INTERVAL`` (publish cadence, default 1s),
+``PADDLE_TPU_TELEMETRY_MAX_BYTES`` (rotation cap, default 8 MiB). The
+whole module rides the ``PADDLE_TPU_MONITOR`` kill-switch: disabled means
+no thread is started and no file is touched.
+
+Heartbeats stamp :func:`journal_stamp` — the shard name plus the latest
+journal (seq, byte offset) — into their payload, so a fleet supervisor
+can tell "rank alive but journal stale" from "rank gone".
+
+Consumers: ``tools/fleet_report.py`` merges shards into fleet-wide time
+series, and ``Watcher(journal_dir=...)`` raises findings off *remote*
+processes' journals (:class:`JournalFollower` is the incremental-read
+primitive both build on).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import metrics
+
+__all__ = [
+    "TELEMETRY_DIR_ENV",
+    "TELEMETRY_INTERVAL_ENV",
+    "TELEMETRY_MAX_BYTES_ENV",
+    "JournalFollower",
+    "ReplayState",
+    "TelemetryPublisher",
+    "current_publisher",
+    "ensure_publisher",
+    "journal_stamp",
+    "read_records",
+    "replay_journal",
+    "shard_path",
+]
+
+TELEMETRY_DIR_ENV = "PADDLE_TPU_TELEMETRY_DIR"
+TELEMETRY_INTERVAL_ENV = "PADDLE_TPU_TELEMETRY_INTERVAL"
+TELEMETRY_MAX_BYTES_ENV = "PADDLE_TPU_TELEMETRY_MAX_BYTES"
+
+_DEFAULT_INTERVAL = 1.0
+_DEFAULT_MAX_BYTES = 8 * 1024 * 1024
+
+
+def shard_path(directory, rank):
+    """The journal shard for `rank` — the {dir}/telemetry_rank{K}.jsonl
+    naming contract shared by the publisher (writer) and fleet_report /
+    the Watcher's journal mode (readers)."""
+    return os.path.join(directory, f"telemetry_rank{int(rank)}.jsonl")
+
+
+# -- registry raw state ------------------------------------------------------
+def _raw_hist(h):
+    """snapshot-shaped histogram dict -> raw non-cumulative form the delta
+    encoder diffs: {"bounds", "counts" (per-bucket, +Inf last), "count",
+    "sum", "min", "max"}."""
+    buckets = h["buckets"]
+    bounds = [le for le, _ in buckets[:-1]]
+    cum = [c for _, c in buckets[:-1]]
+    counts = [c - p for c, p in zip(cum, [0] + cum[:-1])]
+    counts.append(h["count"] - (cum[-1] if cum else 0))  # +Inf bucket
+    return {
+        "bounds": bounds, "counts": counts, "count": h["count"],
+        "sum": h["sum"], "min": h["min"], "max": h["max"],
+    }
+
+
+def _registry_state():
+    """One coherent-enough read of the whole registry in raw form."""
+    return {
+        "counters": metrics.get_counters(),
+        "gauges": metrics.get_gauges(),
+        "hists": {
+            k: _raw_hist(h) for k, h in metrics.get_histograms().items()
+        },
+        "tables": metrics.get_tables(),
+    }
+
+
+def _empty_state():
+    return {"counters": {}, "gauges": {}, "hists": {}, "tables": {}}
+
+
+def _delta(prev, cur):
+    """Delta record body between two raw states, or None when nothing
+    changed. Integers (counters, bucket counts) are encoded as deltas —
+    exact under accumulation; floats (gauges, histogram sum/min/max) as
+    absolutes — replay must be bitwise, and ``base + (b - a)`` is not
+    ``b`` in floating point. Returns None (regression) when a counter or
+    histogram ran BACKWARD (a ``metrics.reset()`` happened): the caller
+    re-bases instead of journaling a nonsense negative delta."""
+    body = {}
+    counters = {}
+    for k, v in cur["counters"].items():
+        d = v - prev["counters"].get(k, 0)
+        if d < 0:
+            return None, True
+        if d:
+            counters[k] = d
+    if set(prev["counters"]) - set(cur["counters"]):
+        return None, True
+    if counters:
+        body["counters"] = counters
+    gauges = {
+        k: v for k, v in cur["gauges"].items()
+        if prev["gauges"].get(k, _MISSING) != v
+    }
+    if gauges:
+        body["gauges"] = gauges
+    dropped = sorted(set(prev["gauges"]) - set(cur["gauges"]))
+    if dropped:
+        body["gauges_dropped"] = dropped
+    hists = {}
+    for k, h in cur["hists"].items():
+        p = prev["hists"].get(k)
+        if p is None:
+            hists[k] = dict(h)  # new histogram: full raw form
+            continue
+        if p["bounds"] != h["bounds"] or h["count"] < p["count"]:
+            return None, True
+        if h["count"] == p["count"] and h["sum"] == p["sum"]:
+            continue
+        d = {
+            str(i): c - pc
+            for i, (c, pc) in enumerate(zip(h["counts"], p["counts"]))
+            if c != pc
+        }
+        hists[k] = {
+            "d": d, "count": h["count"], "sum": h["sum"],
+            "min": h["min"], "max": h["max"],
+        }
+    if set(prev["hists"]) - set(cur["hists"]):
+        return None, True
+    if hists:
+        body["hists"] = hists
+    tables = {
+        k: v for k, v in cur["tables"].items()
+        if prev["tables"].get(k) != v
+    }
+    if tables:
+        body["tables"] = tables
+    t_dropped = sorted(set(prev["tables"]) - set(cur["tables"]))
+    if t_dropped:
+        body["tables_dropped"] = t_dropped
+    return (body if body else None), False
+
+
+_MISSING = object()
+
+
+class ReplayState:
+    """Accumulate journal records back into registry state.
+
+    ``apply()`` one record at a time (a ``base`` record REPLACES the
+    state — that is how both shard self-containment and in-process
+    ``metrics.reset()`` re-bases replay); ``snapshot()`` renders the
+    accumulated state in the exact shape of ``export.snapshot()`` so a
+    replayed journal is comparable to a live dump field-for-field.
+    """
+
+    def __init__(self):
+        self.state = _empty_state()
+        self.meta = {}  # rank/pid/seq/t of the newest applied record
+
+    def apply(self, rec):
+        kind = rec.get("kind")
+        if kind == "base":
+            self.state = _empty_state()
+            for sec in ("counters", "gauges", "tables"):
+                self.state[sec].update(rec.get(sec) or {})
+            for k, h in (rec.get("hists") or {}).items():
+                self.state["hists"][k] = {
+                    "bounds": list(h["bounds"]),
+                    "counts": list(h["counts"]),
+                    "count": h["count"], "sum": h["sum"],
+                    "min": h["min"], "max": h["max"],
+                }
+        elif kind == "delta":
+            st = self.state
+            for k, d in (rec.get("counters") or {}).items():
+                st["counters"][k] = st["counters"].get(k, 0) + d
+            st["gauges"].update(rec.get("gauges") or {})
+            for k in rec.get("gauges_dropped") or ():
+                st["gauges"].pop(k, None)
+            for k, h in (rec.get("hists") or {}).items():
+                cur = st["hists"].get(k)
+                if cur is None or "d" not in h:
+                    st["hists"][k] = {
+                        "bounds": list(h["bounds"]),
+                        "counts": list(h["counts"]),
+                        "count": h["count"], "sum": h["sum"],
+                        "min": h["min"], "max": h["max"],
+                    }
+                    continue
+                for i, d in h["d"].items():
+                    cur["counts"][int(i)] += d
+                cur.update(count=h["count"], sum=h["sum"],
+                           min=h["min"], max=h["max"])
+            st["tables"].update(rec.get("tables") or {})
+            for k in rec.get("tables_dropped") or ():
+                st["tables"].pop(k, None)
+        else:
+            return  # unknown kind: forward-compatible skip
+        for k in ("rank", "pid"):
+            if k in rec:
+                self.meta[k] = rec[k]
+        self.meta["seq"] = rec.get("seq")
+        self.meta["t"] = rec.get("t")
+
+    def snapshot(self):
+        """The accumulated state, rendered snapshot()-shaped."""
+        hists = {}
+        for k, h in self.state["hists"].items():
+            cum, buckets = 0, []
+            for le, c in zip(h["bounds"], h["counts"]):
+                cum += c
+                buckets.append([le, cum])
+            buckets.append(["+Inf", h["count"]])
+            hists[k] = {
+                "count": h["count"], "sum": h["sum"],
+                "min": h["min"], "max": h["max"], "buckets": buckets,
+            }
+        snap = {
+            "counters": dict(self.state["counters"]),
+            "gauges": dict(self.state["gauges"]),
+            "histograms": hists,
+        }
+        if self.state["tables"]:
+            snap["tables"] = {
+                k: v for k, v in self.state["tables"].items()
+            }
+        return snap
+
+
+def read_records(path):
+    """Parse one journal file -> list of records. A torn/truncated line
+    (the SIGKILL-mid-write case) is skipped, not fatal: every complete
+    line before it is still good."""
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                if not line.endswith("\n"):
+                    break  # truncated tail: the write never completed
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError:
+        pass
+    return records
+
+
+def replay_journal(path, include_rotated=True):
+    """Replay one shard (optionally its ``.1`` predecessor first) into a
+    :class:`ReplayState`. The current shard alone is always sufficient
+    for the FINAL state (it opens with a ``base``); the predecessor only
+    adds earlier time-series records."""
+    st = ReplayState()
+    paths = []
+    if include_rotated and os.path.exists(path + ".1"):
+        paths.append(path + ".1")
+    paths.append(path)
+    for p in paths:
+        for rec in read_records(p):
+            st.apply(rec)
+    return st
+
+
+class JournalFollower:
+    """Incremental reader of one journal shard.
+
+    ``poll()`` returns the records appended since the last poll and folds
+    them into ``.replay``; rotation (the file shrank under us) re-reads
+    from the top — the fresh ``base`` record re-bases the replay, so a
+    follower never double-counts across a rotation. This is the primitive
+    the Watcher's journal mode and any live fleet supervisor poll.
+    """
+
+    def __init__(self, path):
+        self.path = path
+        self.replay = ReplayState()
+        self._offset = 0
+
+    def poll(self):
+        new = []
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return new
+        if size < self._offset:
+            self._offset = 0  # rotated: next base record resets replay
+        if size == self._offset:
+            return new
+        try:
+            with open(self.path) as f:
+                f.seek(self._offset)
+                for line in f:
+                    if not line.endswith("\n"):
+                        break  # torn tail: re-read once it completes
+                    self._offset += len(line.encode("utf-8"))
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        new.append(rec)
+        except OSError:
+            return new
+        for rec in new:
+            self.replay.apply(rec)
+        return new
+
+
+# -- the publisher -----------------------------------------------------------
+class TelemetryPublisher:
+    """Daemon thread journaling registry deltas to a per-process shard.
+
+    ``start()`` opens the shard (rotating any stale same-name file away —
+    a restart must not append deltas onto a dead process's baseline),
+    writes the ``base`` record and begins the cadence; ``publish()``
+    forces one delta record now (the step-loop shape: publish after each
+    step instead of on the clock). ``stop()`` publishes a final delta and
+    closes. Under ``PADDLE_TPU_MONITOR=0`` every one of those is a no-op:
+    no thread, no file.
+    """
+
+    def __init__(self, directory=None, rank=None, interval=None,
+                 max_bytes=None):
+        if directory is None:
+            directory = os.environ.get(TELEMETRY_DIR_ENV)
+        if directory is None:
+            raise ValueError(
+                "TelemetryPublisher needs a directory (arg or "
+                f"{TELEMETRY_DIR_ENV} env)"
+            )
+        if rank is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        if interval is None:
+            try:
+                interval = float(os.environ.get(
+                    TELEMETRY_INTERVAL_ENV, _DEFAULT_INTERVAL))
+            except ValueError:
+                interval = _DEFAULT_INTERVAL
+        if max_bytes is None:
+            try:
+                max_bytes = int(os.environ.get(
+                    TELEMETRY_MAX_BYTES_ENV, _DEFAULT_MAX_BYTES))
+            except ValueError:
+                max_bytes = _DEFAULT_MAX_BYTES
+        self.directory = directory
+        self.rank = int(rank)
+        self.interval = float(interval)
+        self.max_bytes = int(max_bytes)
+        self.seq = 0
+        self._last = None  # raw state at the last publish (None = rebase)
+        self._f = None
+        self._offset = 0
+        self._paused = threading.Event()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    @property
+    def path(self):
+        return shard_path(self.directory, self.rank)
+
+    @property
+    def active(self):
+        return self._f is not None
+
+    def offset(self):
+        """(seq, byte offset) of the newest complete record — what
+        heartbeats stamp so journal staleness is detectable."""
+        with self._lock:
+            return self.seq, self._offset
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, register=True):
+        """Open the shard, write the base record, start the cadence
+        thread. `register=False` skips installing this publisher as the
+        process-global one (tests journaling multiple ranks)."""
+        if not metrics.enabled():
+            return self
+        with self._lock:
+            if self._f is None:
+                self._open_locked()
+        if register:
+            global _active
+            _active = self
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="obs-telemetry"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval * 4 + 1.0)
+        self.publish()  # final delta: the journal ends at the registry
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+        global _active
+        if _active is self:
+            _active = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+    def pause(self):
+        """Suspend journaling (the cadence thread idles; ``publish()``
+        no-ops) without tearing the shard down — resume() re-bases
+        nothing, deltas just span the gap."""
+        self._paused.set()
+
+    def resume(self):
+        self._paused.clear()
+
+    # -- publishing --------------------------------------------------------
+    def publish(self):
+        """Journal one record NOW: the delta since the last publish, or a
+        fresh base when there is none yet (or the registry ran backward —
+        a ``metrics.reset()`` re-bases the journal). Returns the record
+        written, or None when nothing changed / journaling is off."""
+        if not metrics.enabled() or self._paused.is_set():
+            return None
+        with self._lock:
+            if self._f is None:
+                return None
+            # self-telemetry BEFORE the state read, so the record being
+            # written already accounts for it and replay lands exactly on
+            # the registry as of this publish
+            metrics.add("telemetry.publishes")
+            metrics.set_gauge("telemetry.journal_bytes", float(self._offset))
+            cur = _registry_state()
+            if self._last is None:
+                rec = self._base_record(cur)
+            else:
+                body, regressed = _delta(self._last, cur)
+                if regressed:
+                    rec = self._base_record(cur)
+                elif body is None:
+                    return None
+                else:
+                    rec = {"kind": "delta", "seq": self.seq + 1,
+                           "t": time.time()}
+                    rec.update(body)
+            self._write_locked(rec)
+            self._last = cur
+            if self._offset > self.max_bytes:
+                self._rotate_locked()
+            return rec
+
+    def _base_record(self, cur):
+        rec = {
+            "kind": "base", "seq": self.seq + 1, "t": time.time(),
+            "rank": self.rank, "pid": os.getpid(),
+        }
+        for sec in ("counters", "gauges", "tables"):
+            if cur[sec]:
+                rec[sec] = cur[sec]
+        if cur["hists"]:
+            rec["hists"] = cur["hists"]
+        return rec
+
+    def _write_locked(self, rec):
+        # ONE write of one \n-terminated line: the append is line-atomic
+        # for any reader, and a SIGKILL leaves at worst a truncated tail
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        self._f.write(line)
+        self._f.flush()
+        self.seq = rec["seq"]
+        self._offset += len(line.encode("utf-8"))
+
+    def _open_locked(self):
+        os.makedirs(self.directory, exist_ok=True)
+        if os.path.exists(self.path):
+            # a previous process's shard: rotate it away rather than
+            # appending this process's baseline behind its deltas
+            os.replace(self.path, self.path + ".1")
+        self._f = open(self.path, "a")
+        self._offset = 0
+        self._last = None
+
+    def _rotate_locked(self):
+        self._f.close()
+        os.replace(self.path, self.path + ".1")
+        self._f = open(self.path, "a")
+        self._offset = 0
+        self._last = None  # next publish opens the fresh shard with a base
+        metrics.add("telemetry.rotations")
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.publish()
+            except Exception:
+                pass  # a broken publish must not kill the journal thread
+
+
+# -- process-global wiring ---------------------------------------------------
+_active: TelemetryPublisher | None = None
+_ensure_lock = threading.Lock()
+
+
+def current_publisher():
+    return _active
+
+
+def journal_stamp():
+    """{"telemetry_shard", "telemetry_seq", "telemetry_offset"} of the
+    process-global publisher, or None when none is journaling — the
+    staleness stamp heartbeats carry."""
+    pub = _active
+    if pub is None or not pub.active:
+        return None
+    seq, off = pub.offset()
+    return {
+        "telemetry_shard": os.path.basename(pub.path),
+        "telemetry_seq": seq,
+        "telemetry_offset": off,
+    }
+
+
+def ensure_publisher():
+    """One-env-var opt-in: when ``PADDLE_TPU_TELEMETRY_DIR`` is set (and
+    monitoring is on) start the process-global publisher AND flight
+    recorder once. Idempotent and cheap when the env is absent — the
+    executor calls this on construction so any launched trainer joins the
+    telemetry plane without code changes."""
+    if _active is not None or not os.environ.get(TELEMETRY_DIR_ENV):
+        return _active
+    if not metrics.enabled():
+        return None
+    with _ensure_lock:
+        if _active is not None:
+            return _active
+        pub = TelemetryPublisher().start()
+        from . import recorder as _recorder
+
+        if _recorder.get_recorder() is None:
+            _recorder.FlightRecorder(
+                directory=pub.directory, rank=pub.rank
+            ).start()
+            _recorder.install_excepthook()
+        return pub

@@ -16,7 +16,17 @@ instead of log lines:
   window exceeds the best window seen so far by ``drift_tolerance``
   (catches slow decay AND sharp knees, not just absolute thresholds);
 * **slo_breach** — the latency metric's per-window p99 (estimated from
-  histogram bucket deltas) exceeds ``slo_p99_s``.
+  histogram bucket deltas via :func:`metrics.window_p99`) exceeds
+  ``slo_p99_s``.
+
+With ``journal_dir=`` the watcher additionally runs in **timeline-reader
+mode**: it follows the telemetry journals other processes publish
+(:mod:`timeline`), replays their registry state, and raises the same
+``straggler`` / ``slo_breach`` findings (detail ``source: "journal"``)
+off the REMOTE state — per-rank step counters out of the journals play
+the heartbeat role, and the cross-process p99 is reconstructed by
+merging per-shard bucket state. No shared memory with the processes
+being watched; only their shard files.
 
 Each finding is a plain dict (kind, severity, detail, wall time) kept in
 a bounded list, mirrored to the ``watch.findings`` observability table,
@@ -48,25 +58,11 @@ def _hist_state(name):
     return h["count"], h["sum"], h["buckets"]
 
 
-def _window_p99(prev_buckets, cur_buckets):
-    """p99 upper-bound estimate from the bucket-count delta between two
-    polls. Both sides are cumulative Prometheus buckets, so per-bucket
-    subtraction yields the window's cumulative counts directly. A p99
-    landing in +Inf reports the largest finite edge x2 — an upper bound
-    is the conservative answer an SLO check wants."""
-    prev = {str(le): c for le, c in (prev_buckets or [])}
-    deltas = [(le, cum - prev.get(str(le), 0)) for le, cum in cur_buckets]
-    total = deltas[-1][1] if deltas else 0
-    if total <= 0:
-        return None
-    target = 0.99 * total
-    finite = [float(le) for le, _ in deltas if not isinstance(le, str)]
-    for le, cum_d in deltas:
-        if cum_d >= target:
-            if isinstance(le, str):  # +Inf bucket
-                return (max(finite) * 2.0) if finite else float("inf")
-            return float(le)
-    return (max(finite) * 2.0) if finite else float("inf")
+# the windowed-p99-from-bucket-deltas computation now lives in
+# metrics.window_p99 (one shared helper; the brownout fallback and the
+# fleet tooling call the same code) — this module-level alias keeps every
+# historical call site of watch._window_p99 byte-for-byte unchanged
+_window_p99 = metrics.window_p99
 
 
 class Watcher:
@@ -82,8 +78,13 @@ class Watcher:
                  drift_tolerance=0.25, min_window=8, slo_p99_s=None,
                  step_metric="executor.step_latency",
                  latency_metric="serving.request_latency",
-                 interval=1.0, max_findings=256):
+                 interval=1.0, max_findings=256, journal_dir=None):
         self.heartbeat_dir = heartbeat_dir
+        # timeline-reader mode: follow OTHER processes' telemetry
+        # journals (timeline.TelemetryPublisher shards) and raise
+        # straggler/slo_breach findings off their replayed state — no
+        # shared memory with the processes being watched, only files
+        self.journal_dir = journal_dir
         self.skew_steps = int(skew_steps)
         self.drift_tolerance = float(drift_tolerance)
         self.min_window = int(min_window)
@@ -101,6 +102,12 @@ class Watcher:
         self._step_prev = None  # (count, sum) at the last poll
         self._best_window_mean = None
         self._lat_prev = None  # (count, buckets) at the last poll
+        # journal-mode state: one incremental follower per remote shard,
+        # plus the merged-histogram window and its own excursion latches
+        self._followers = {}
+        self._journal_straggling = False
+        self._journal_breaching = False
+        self._journal_lat_prev = None
         self._thread = None
         self._stop = threading.Event()
 
@@ -214,6 +221,97 @@ class Watcher:
         else:
             self._breaching = False
 
+    # -- the journal (remote-process) checks -------------------------------
+    def _check_journals(self, new):
+        from . import timeline
+
+        if not self.journal_dir or not os.path.isdir(self.journal_dir):
+            return
+        for fn in sorted(os.listdir(self.journal_dir)):
+            if not (fn.startswith("telemetry_rank")
+                    and fn.endswith(".jsonl")):
+                continue
+            path = os.path.join(self.journal_dir, fn)
+            fol = self._followers.get(path)
+            if fol is None:
+                fol = self._followers[path] = timeline.JournalFollower(path)
+            fol.poll()
+        shards = {
+            os.path.basename(p): f.replay
+            for p, f in self._followers.items()
+            if f.replay.meta.get("seq") is not None
+        }
+        if not shards:
+            return
+        self._journal_straggler_check(shards, new)
+        self._journal_slo_check(shards, new)
+
+    def _journal_straggler_check(self, shards, new):
+        """Straggler detection with no heartbeat dir and no shared
+        memory: the per-rank step counters replayed out of the remote
+        journals play the heartbeat role."""
+        steps = {}
+        for name, replay in shards.items():
+            counters = replay.state["counters"]
+            step = counters.get("guard.steps",
+                               counters.get("executor.run_steps"))
+            if step is not None:
+                steps[int(replay.meta.get("rank", len(steps)))] = int(step)
+        if len(steps) < 2:
+            return
+        lead = max(steps.values())
+        skew = lead - min(steps.values())
+        metrics.set_gauge("watch.journal_step_skew", skew)
+        if skew > self.skew_steps:
+            if not self._journal_straggling:
+                self._journal_straggling = True
+                lagging = sorted(
+                    r for r, s in steps.items()
+                    if lead - s > self.skew_steps
+                )
+                new.append(self._emit("straggler", {
+                    "source": "journal",
+                    "skew_steps": skew,
+                    "lagging_ranks": lagging,
+                    "steps": {str(r): s for r, s in sorted(steps.items())},
+                }))
+        else:
+            self._journal_straggling = False
+
+    def _journal_slo_check(self, shards, new):
+        if self.slo_p99_s is None:
+            return
+        per_shard = [
+            replay.snapshot().get("histograms", {}).get(self.latency_metric)
+            for replay in shards.values()
+        ]
+        per_shard = [h["buckets"] for h in per_shard if h]
+        if not per_shard:
+            return
+        merged = metrics.merge_cumulative_buckets(per_shard)
+        prev, self._journal_lat_prev = self._journal_lat_prev, merged
+        count = merged[-1][1]
+        prev_count = prev[-1][1] if prev else 0
+        if count - prev_count <= 0:
+            return
+        p99 = _window_p99(prev, merged)
+        if p99 is None:
+            return
+        metrics.set_gauge("watch.journal_p99_s", p99)
+        if p99 > float(self.slo_p99_s):
+            if not self._journal_breaching:
+                self._journal_breaching = True
+                new.append(self._emit("slo_breach", {
+                    "source": "journal",
+                    "p99_s": p99,
+                    "slo_p99_s": float(self.slo_p99_s),
+                    "window_requests": count - prev_count,
+                    "metric": self.latency_metric,
+                    "shards": sorted(shards),
+                }))
+        else:
+            self._journal_breaching = False
+
     # -- public surface ----------------------------------------------------
     @property
     def breaching(self):
@@ -232,6 +330,7 @@ class Watcher:
         self._check_straggler(new)
         self._check_step_regression(new)
         self._check_slo(new)
+        self._check_journals(new)
         return new
 
     def start(self):

@@ -327,6 +327,14 @@ class TrainGuard:
             self.rollbacks += 1
             self.bad_streak = 0
             _obs.add("resilience.rollbacks")
+            from ..observability import recorder as _recorder
+
+            # flight-recorder trigger: the rollback rewinds the scope,
+            # so the pre-rollback window (the diverging steps) is about
+            # to become unreconstructable — dump it first
+            _recorder.flight_dump("train_rollback", detail={
+                "rollbacks": self.rollbacks, "bad_steps": self.bad_steps,
+            })
             return
         if self.fleet is None or self.checkpoint_dir is None:
             why = "no fleet/checkpoint_dir configured for rollback"
@@ -349,8 +357,14 @@ class TrainGuard:
         self._finalized = True
         self.preempted = True
         from .. import observability as _obs
+        from ..observability import recorder as _recorder
 
         _obs.add("resilience.preemptions")
+        # flight-recorder trigger: the SIGTERM drain ends in exit(75) —
+        # capture the final window before the process goes away
+        _recorder.flight_dump("preempt_drain", detail={
+            "bad_steps": self.bad_steps, "rollbacks": self.rollbacks,
+        })
         if self.fleet is not None and self.checkpoint_dir is not None:
             from ..fleet.collective import TrainStatus
 

@@ -145,6 +145,16 @@ class Heartbeat:
         ctx = _trace.current()
         if ctx is not None:
             payload.update(ctx.to_dict())
+        # telemetry-journal stamp (PR 16): the shard name plus the latest
+        # (seq, byte offset) this process has journaled — a fleet reader
+        # comparing two beats can tell "rank alive but journal stale"
+        # (offset frozen) from "rank gone" (beat stale), with no access
+        # to the rank's memory
+        from ..observability import timeline as _timeline
+
+        stamp = _timeline.journal_stamp()
+        if stamp is not None:
+            payload.update(stamp)
         fd, tmp = tempfile.mkstemp(
             dir=self.directory, prefix=f"hb_rank{self.rank}.tmp."
         )
@@ -310,10 +320,18 @@ class StepWatchdog:
                 continue
             self.stalls += 1
             from .. import observability as _obs
+            from ..observability import recorder as _recorder
 
             _obs.add("resilience.hangs")
             if self.name:
                 _obs.add(f"resilience.hangs.{self.name}")
+            # flight-recorder trigger: a hang the launcher is about to
+            # kill -9 for is exactly the death whose last window would
+            # otherwise be unrecoverable — dump it while still alive
+            _recorder.flight_dump("watchdog_stall", detail={
+                "stalled_s": stalled, "timeout_s": self.timeout,
+                "name": self.name,
+            })
             if self.on_stall is not None:
                 try:
                     self.on_stall(stalled)

@@ -35,7 +35,10 @@ p99 at or under ``slo_p99_s * recover_margin`` for ``recover_after``
 consecutive observations steps DOWN one (hysteresis both ways, so a
 noisy p99 cannot flap the ladder). :meth:`poll` feeds it live — new
 watcher findings plus the ``watch.request_p99_s`` gauge the watcher
-maintains — and :meth:`start` wraps poll in a daemon thread.
+maintains (or, with no watcher wired, a window p99 the controller
+computes itself from the latency histogram's bucket deltas via the
+shared ``observability.metrics.window_p99``) — and :meth:`start` wraps
+poll in a daemon thread.
 
 Observability: ``serving.brownout_level`` gauge (plus the per-endpoint
 ``serving.brownout_level.<ep>`` the endpoints maintain),
@@ -88,9 +91,11 @@ class BrownoutController:
         self.recover_after = int(recover_after)
         self.recover_margin = float(recover_margin)
         self.interval = float(interval)
+        self.latency_metric = "serving.request_latency"
         self.level = 0
         self._breach_obs = 0
         self._ok_obs = 0
+        self._lat_prev = None  # cumulative buckets at the last fallback poll
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
@@ -174,9 +179,25 @@ class BrownoutController:
 
         findings = self.watcher.poll() if self.watcher is not None else ()
         p99 = metrics.get_gauges().get("watch.request_p99_s")
+        if p99 is None and self.watcher is None:
+            p99 = self._window_p99()
         level = self.observe(findings, p99)
         self._apply()
         return level
+
+    def _window_p99(self):
+        """Watcher-less fallback: compute the window p99 directly from
+        the latency histogram's bucket deltas with the same shared
+        ``metrics.window_p99`` the watcher uses — a controller deployed
+        without a watcher degrades on the identical signal instead of
+        flying blind until someone wires one up."""
+        from ..observability import metrics
+
+        h = metrics.get_histograms().get(self.latency_metric)
+        if h is None:
+            return None
+        prev, self._lat_prev = self._lat_prev, h["buckets"]
+        return metrics.window_p99(prev, h["buckets"])
 
     def start(self):
         """Poll on a daemon thread every ``interval`` seconds."""

@@ -334,6 +334,16 @@ class ReplicaSet:
         if opened:
             _obs.add("serving.breaker_opened")
             _obs.add(f"serving.breaker_opened.{rep.name}")
+            from ..observability import recorder as _recorder
+
+            # flight-recorder trigger: the window holding the failures
+            # that opened the breaker is the post-mortem for "why did
+            # replica X get ejected"
+            _recorder.flight_dump("breaker_open", detail={
+                "replica": rep.name, "set": self.name,
+                "consecutive_failures": rep.consecutive_failures,
+                "error": f"{type(exc).__name__}: {exc}",
+            })
 
     def _mark_rerouted(self, request_ids):
         """Claim the one re-route for every id in the batch; False when

@@ -768,6 +768,13 @@ class Server:
         if first:
             _obs.add("serving.drained")
             _obs.set_gauge("serving.draining", 1.0)
+            from ..observability import recorder as _recorder
+
+            # flight-recorder trigger: a drain usually precedes exit(75)
+            # — capture the serving window while the process still can
+            _recorder.flight_dump("serving_drain", detail={
+                "endpoints": [ep.name for ep in eps], "clean": ok,
+            })
         if ok:
             self._drained.set()
         return ok

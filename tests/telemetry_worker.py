@@ -1,0 +1,67 @@
+"""Telemetry-plane chaos worker (driven by ci.sh).
+
+Steps a tiny train loop with the journal publisher + flight recorder
+live via the ``PADDLE_TPU_TELEMETRY_DIR`` one-env-var opt-in (the
+Executor constructor wires the plane up — this script never imports a
+publisher to *start* one).
+
+argv: OUT_DIR STEPS. STEPS > 0 finishes cleanly: the plane is frozen
+(final publish) and the live registry snapshot dumped to
+``OUT_DIR/telemetry_stats.json`` so the driver can prove the offline
+journal replay lands exactly on it. STEPS == 0 loops until the driver
+SIGKILLs the process — its journal and periodic flight bundle are all
+that survive.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import layers, observability as obs  # noqa: E402
+from paddle_tpu.observability import recorder, timeline  # noqa: E402
+
+out, steps = sys.argv[1], int(sys.argv[2])
+
+x = fluid.data("x", [-1, 4])
+y = fluid.data("y", [-1, 1])
+pred = layers.fc(x, 1)
+loss = layers.mean(layers.square_error_cost(pred, y))
+fluid.optimizer.SGD(0.05).minimize(loss)
+exe = fluid.Executor()  # <- ensure_publisher(): the plane starts HERE
+exe.run(fluid.default_startup_program())
+assert timeline.current_publisher() is not None, "publisher did not start"
+assert recorder.get_recorder() is not None, "flight recorder did not start"
+
+rng = np.random.RandomState(0)
+i = 0
+while steps == 0 or i < steps:
+    t0 = time.perf_counter()
+    xa = rng.randn(8, 4).astype(np.float32)
+    with obs.span("train.step", step=i):
+        exe.run(feed={"x": xa, "y": xa @ np.ones((4, 1), np.float32)},
+                fetch_list=[loss])
+    obs.add("guard.steps")
+    obs.observe("executor.step_latency", time.perf_counter() - t0)
+    # the doomed rank serves slow requests so the fleet p99 carries its
+    # signature; the clean rank serves fast ones
+    obs.observe("serving.request_latency", 0.2 if steps == 0 else 0.002)
+    obs.add("serving.requests_served")
+    obs.add("serving.goodput")
+    i += 1
+    if steps == 0:
+        # slow enough that the driver's kill lands well before this
+        # rank's step counter could catch the clean rank's
+        time.sleep(0.1)
+
+# clean finish: stop the recorder FIRST (its dump would bump counters),
+# then the publisher (stop() takes a final publish), then snapshot the
+# now-frozen registry — offline replay must reproduce this file's
+# counters/gauges/histograms/tables bitwise
+recorder.get_recorder().stop()
+timeline.current_publisher().stop()
+obs.dump(out + "/telemetry_stats.json")

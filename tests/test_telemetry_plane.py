@@ -1,0 +1,564 @@
+"""Fleet telemetry plane (ISSUE 16): delta journals that outlive their
+process (TelemetryPublisher -> line-atomic JSONL shards, bitwise replay),
+the crash flight recorder and its trigger hooks, fleet_report's
+cross-process merge, the Watcher's remote-journal mode, the shared
+windowed-p99 helper, and the PADDLE_TPU_MONITOR kill-switch across all
+of it."""
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import metrics, recorder, timeline, watch
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.guard import TrainGuard
+from paddle_tpu.resilience.health import Heartbeat, StepWatchdog
+from paddle_tpu.serving import brownout as brownout_mod
+from paddle_tpu.serving.replica import ReplicaSet
+from paddle_tpu.serving.router import Server
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    obs.reset()
+    obs.set_enabled(True)
+    faults.clear()
+    yield
+    recorder.uninstall()
+    pub = timeline.current_publisher()
+    if pub is not None:
+        pub.stop()
+    faults.clear()
+    obs.reset()
+    obs.set_enabled(None)
+
+
+def _churn(i):
+    """One round of representative registry traffic."""
+    obs.add("guard.steps")
+    obs.add("serving.goodput", 2)
+    obs.add("serving.requests_served", 3)
+    obs.observe("executor.step_latency", 0.002 * (i + 1))
+    obs.observe("serving.request_latency", 0.01 * ((i % 7) + 1))
+    obs.set_gauge("perf.mfu", 0.1 + 0.01 * i)
+    obs.set_table("perf.step_attribution", {"step_seconds": 0.002 * i})
+
+
+def _snap_core(snap):
+    """snapshot() minus span_count (the journal doesn't carry spans)."""
+    core = {k: snap[k] for k in ("counters", "gauges", "histograms")}
+    core["tables"] = snap.get("tables", {})
+    return core
+
+
+# ---------------------------------------------------------------------------
+# the journal: delta encoding, replay, rotation
+# ---------------------------------------------------------------------------
+
+
+def test_delta_roundtrip_replays_final_snapshot_bitwise(tmp_path):
+    pub = timeline.TelemetryPublisher(
+        directory=str(tmp_path), rank=0, interval=99
+    ).start(register=False)
+    for i in range(25):
+        _churn(i)
+        if i % 3 == 0:
+            pub.publish()
+    obs.drop_gauges("perf.mfu")  # exercise the gauge-removal delta
+    obs.drop_tables("perf.")
+    pub.stop()
+    replayed = timeline.replay_journal(pub.path).snapshot()
+    live = _snap_core(obs.snapshot())
+    assert replayed["counters"] == live["counters"]
+    assert replayed["gauges"] == live["gauges"]
+    assert replayed["histograms"] == live["histograms"]
+    assert replayed.get("tables", {}) == live["tables"]
+    # bitwise: identical through JSON too (float repr round-trip exact)
+    assert json.dumps(replayed, sort_keys=True) == json.dumps(
+        dict(live, tables=live["tables"]) if live["tables"]
+        else {k: live[k] for k in ("counters", "gauges", "histograms")},
+        sort_keys=True,
+    )
+
+
+def test_journal_records_are_deltas_not_snapshots(tmp_path):
+    pub = timeline.TelemetryPublisher(
+        directory=str(tmp_path), rank=0, interval=99
+    ).start(register=False)
+    obs.add("big.counter", 1000)
+    pub.publish()
+    obs.add("big.counter")  # +1
+    pub.publish()
+    pub.stop()
+    records = timeline.read_records(pub.path)
+    kinds = [r["kind"] for r in records]
+    assert kinds[0] == "base" and "delta" in kinds
+    deltas = [r for r in records if r["kind"] == "delta"
+              and "big.counter" in (r.get("counters") or {})]
+    assert deltas and deltas[0]["counters"]["big.counter"] == 1
+    # idle publishes carry ONLY the plane's self-telemetry (the
+    # publishes counter / journal-bytes gauge the replay contract needs)
+    # — no user metric reappears without having changed
+    n = len(records)
+    pub2 = timeline.TelemetryPublisher(
+        directory=str(tmp_path), rank=1, interval=99
+    ).start(register=False)
+    pub2.publish()
+    pub2.publish()
+    idle = timeline.read_records(pub2.path)[-1]
+    assert idle["kind"] == "delta"
+    for section in ("counters", "gauges"):
+        keys = set(idle.get(section) or {})
+        assert keys and all(k.startswith("telemetry.") for k in keys), idle
+    assert not idle.get("hists") and not idle.get("tables")
+    pub2.stop()
+    assert len(timeline.read_records(pub.path)) == n  # stopped = frozen
+
+
+def test_metrics_reset_rebases_the_journal(tmp_path):
+    pub = timeline.TelemetryPublisher(
+        directory=str(tmp_path), rank=0, interval=99
+    ).start(register=False)
+    _churn(0)
+    pub.publish()
+    obs.reset()  # counters run BACKWARD: a delta would be nonsense
+    obs.add("after.reset", 7)
+    pub.publish()
+    pub.stop()
+    replayed = timeline.replay_journal(pub.path).snapshot()
+    live = _snap_core(obs.snapshot())
+    assert replayed["counters"] == live["counters"]
+    assert replayed["histograms"] == live["histograms"]
+    # the rebase is visible as a second base record
+    kinds = [r["kind"] for r in timeline.read_records(pub.path)]
+    assert kinds.count("base") >= 2
+
+
+def test_rotation_cap_honored_and_current_shard_self_contained(tmp_path):
+    cap = 1500
+    pub = timeline.TelemetryPublisher(
+        directory=str(tmp_path), rank=0, interval=99, max_bytes=cap
+    ).start(register=False)
+    for i in range(80):
+        _churn(i)
+        pub.publish()
+    pub.stop()
+    # cap + one record of slack: rotation happens after the append
+    assert os.path.getsize(pub.path) <= cap + 800
+    assert os.path.exists(pub.path + ".1")
+    assert metrics.get_counters()["telemetry.rotations"] >= 1
+    # the CURRENT shard alone (no predecessor) replays the final state:
+    # every shard file opens with a full base record
+    replayed = timeline.replay_journal(
+        pub.path, include_rotated=False
+    ).snapshot()
+    live = _snap_core(obs.snapshot())
+    assert replayed["counters"] == live["counters"]
+    assert replayed["histograms"] == live["histograms"]
+
+
+def test_torn_tail_is_skipped_not_fatal(tmp_path):
+    pub = timeline.TelemetryPublisher(
+        directory=str(tmp_path), rank=0, interval=99
+    ).start(register=False)
+    _churn(0)
+    pub.publish()
+    expected = timeline.replay_journal(pub.path).snapshot()
+    # SIGKILL mid-write: a half-record with no trailing newline
+    with open(pub.path, "a") as f:
+        f.write('{"kind":"delta","seq":99,"counters":{"torn"')
+    replayed = timeline.replay_journal(pub.path).snapshot()
+    assert replayed == expected
+    pub.stop()
+
+
+def test_heartbeat_stamps_journal_offset(tmp_path):
+    pub = timeline.TelemetryPublisher(
+        directory=str(tmp_path), rank=3, interval=99
+    ).start()  # registered: journal_stamp() sees it
+    _churn(0)
+    pub.publish()
+    hb = Heartbeat(directory=str(tmp_path / "hb"), rank=3)
+    payload = hb.beat()
+    assert payload["telemetry_shard"] == "telemetry_rank3.jsonl"
+    seq, off = pub.offset()
+    assert payload["telemetry_seq"] == seq > 0
+    assert payload["telemetry_offset"] == off > 0
+    # the stamp is in the published file too (what a fleet reader sees)
+    on_disk = json.load(open(hb.path))
+    assert on_disk["telemetry_seq"] == seq
+    pub.stop()
+    assert timeline.journal_stamp() is None
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder: every trigger kind dumps a bundle
+# ---------------------------------------------------------------------------
+
+
+def _bundle(tmp_path, rank, trigger):
+    path = os.path.join(str(tmp_path), f"flight_rank{rank}.{trigger}.json")
+    assert os.path.exists(path), os.listdir(str(tmp_path))
+    return json.load(open(path))
+
+
+def test_flight_dump_exception_trigger(tmp_path):
+    rec = recorder.FlightRecorder(directory=str(tmp_path), rank=0,
+                                  interval=99).start()
+    with obs.span("doomed.work"):
+        time.sleep(0.01)
+    tr = obs.new_trace()
+    with obs.activate(tr), obs.span("traced.work"):
+        pass
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        recorder.flight_dump("exception", exc=e)
+    b = _bundle(tmp_path, 0, "exception")
+    assert b["trigger"] == "exception"
+    assert b["exception"]["type"] == "ValueError"
+    assert any(s["name"] == "doomed.work" for s in b["spans"])
+    assert tr.trace_id in b["trace_ids"]
+    assert metrics.get_counters()["telemetry.flight_dumps.exception"] == 1
+    rec.stop()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_excepthook_chains_and_dumps(tmp_path):
+    rec = recorder.FlightRecorder(directory=str(tmp_path), rank=0,
+                                  interval=99).start()
+    recorder.install_excepthook()
+    seen = []
+    prev, sys.excepthook = sys.excepthook, None
+    try:
+        sys.excepthook = prev  # restore: install chained the REAL prev
+        err = RuntimeError("unhandled")
+        # fire a thread whose exception flows through threading.excepthook
+        t = threading.Thread(
+            target=lambda: (_ for _ in ()).throw(err), name="crashy"
+        )
+        t.start()
+        t.join()
+    finally:
+        rec.stop()
+    b = _bundle(tmp_path, 0, "exception")
+    assert b["exception"]["message"] == "unhandled"
+    assert b["detail"]["thread"] == "crashy"
+    assert not seen  # the chained previous hook ran harmlessly
+
+
+def test_watchdog_stall_trigger(tmp_path):
+    rec = recorder.FlightRecorder(directory=str(tmp_path), rank=0,
+                                  interval=99).start()
+    with StepWatchdog(timeout=0.05, poll_interval=0.02, name="t16") as wd:
+        deadline = time.time() + 5.0
+        while wd.stalls == 0 and time.time() < deadline:
+            time.sleep(0.02)
+    rec.stop()
+    b = _bundle(tmp_path, 0, "watchdog_stall")
+    assert b["detail"]["name"] == "t16"
+    assert b["detail"]["stalled_s"] > 0.05
+
+
+def test_train_rollback_and_preempt_drain_triggers(tmp_path):
+    class _StubFleet:
+        def has_check_point(self, d, fs=None):
+            return True
+
+        def load_check_point(self, exe, d, main_program=None, fs=None):
+            return None
+
+    rec = recorder.FlightRecorder(directory=str(tmp_path), rank=0,
+                                  interval=99).start()
+    g = TrainGuard(
+        executor=object(), fleet=_StubFleet(), checkpoint_dir="ckpt",
+        max_bad_steps=1, exit_on_preempt=False, snapshot=False,
+    )
+    g._skip_bad_step(None)  # streak hits the cap -> rollback branch
+    assert g.rollbacks == 1
+    b = _bundle(tmp_path, 0, "train_rollback")
+    assert b["detail"]["rollbacks"] == 1
+    g2 = TrainGuard(executor=object(), exit_on_preempt=False)
+    g2._finalize_preemption()
+    b = _bundle(tmp_path, 0, "preempt_drain")
+    assert b["trigger"] == "preempt_drain"
+    rec.stop()
+
+
+def test_breaker_open_and_serving_drain_triggers(tmp_path):
+    from paddle_tpu import errors
+
+    class _Runner:
+        feed_names = ("x",)
+
+        def sample_spec(self, name):
+            return (2,), "float32"
+
+        def run(self, feed):
+            raise errors.UnavailableError("replica died")
+
+    rec = recorder.FlightRecorder(directory=str(tmp_path), rank=0,
+                                  interval=99).start()
+    rs = ReplicaSet({"a": _Runner(), "b": _Runner()}, breaker_threshold=1,
+                    cooldown_s=60)
+    import numpy as np
+
+    with pytest.raises(errors.UnavailableError):
+        rs.run({"x": np.zeros((1, 2), np.float32)}, request_ids=[1])
+    b = _bundle(tmp_path, 0, "breaker_open")
+    assert b["detail"]["replica"] in ("a", "b")
+    assert "UnavailableError" in b["detail"]["error"]
+    server = Server()
+    server.drain(timeout=1)
+    b = _bundle(tmp_path, 0, "serving_drain")
+    assert b["trigger"] == "serving_drain" and b["detail"]["clean"]
+    rec.stop()
+
+
+def test_black_box_survives_without_a_trigger(tmp_path):
+    """The periodic bundle is the SIGKILL story: no hook ever fires, yet
+    the window before death is on disk."""
+    rec = recorder.FlightRecorder(directory=str(tmp_path), rank=2,
+                                  interval=0.02).start()
+    with obs.span("pre.death"):
+        _churn(0)
+    deadline = time.time() + 5.0
+    while not os.path.exists(rec.path) and time.time() < deadline:
+        time.sleep(0.02)
+    # simulate the kill: no stop(), no dump() — just read what the black
+    # box already published
+    b = json.load(open(rec.path))
+    assert b["trigger"] == "periodic"
+    assert any(s["name"] == "pre.death" for s in b["spans"])
+    rec.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation + remote-journal watcher
+# ---------------------------------------------------------------------------
+
+
+def _write_shard(tmp_path, rank, steps, latency_s, publishes=4,
+                 torn_tail=False):
+    """Journal one synthetic rank: `steps` guard steps, request latencies
+    at `latency_s`, spread over `publishes` records. Resets the registry
+    first so each shard carries an independent process's state."""
+    obs.reset()
+    pub = timeline.TelemetryPublisher(
+        directory=str(tmp_path), rank=rank, interval=99
+    ).start(register=False)
+    per = max(1, -(-steps // publishes))  # ceil: journal EVERY step
+    done = 0
+    for _ in range(publishes):
+        for _ in range(min(per, steps - done)):
+            obs.add("guard.steps")
+            obs.add("serving.requests_served")
+            obs.add("serving.goodput")
+            obs.observe("executor.step_latency", latency_s)
+            obs.observe("serving.request_latency", latency_s)
+            done += 1
+        pub.publish()
+    pub.stop()
+    if torn_tail:  # mid-run death: half a record after the good ones
+        with open(pub.path, "a") as f:
+            f.write('{"kind":"delta","seq":999,"coun')
+    return pub.path
+
+
+def test_fleet_report_merges_shards_with_mid_run_death(tmp_path):
+    _write_shard(tmp_path, 0, steps=40, latency_s=0.01)
+    _write_shard(tmp_path, 1, steps=38, latency_s=0.02)
+    # rank 2 dies mid-run: fewer steps journaled, torn final write
+    _write_shard(tmp_path, 2, steps=9, latency_s=0.5, torn_tail=True)
+    fleet_report = _load_tool("fleet_report")
+    report = fleet_report.build_report(str(tmp_path))
+    assert len(report["shards"]) == 3
+    by_rank = {s["rank"]: s for s in report["shards"]}
+    # the dead rank's last steps are reconstructed from its journal alone
+    assert by_rank[2]["last_step"] == 9
+    assert by_rank[0]["last_step"] == 40
+    fleet = report["fleet"]
+    assert fleet["goodput_total"] == 40 + 38 + 9
+    strag = fleet["straggler"]
+    assert strag["max_gap_steps"] == 40 - 9
+    assert strag["per_rank_last_step"]["2"] == 9
+    # cross-process p99 reconstructed from merged bucket deltas: rank 2's
+    # 0.5s latencies must pull the fleet p99 above the fast ranks' 0.02
+    p99s = [e["p99_s"] for e in fleet["timeline"] if "p99_s" in e]
+    assert p99s and max(p99s) >= 0.5
+    # per-rank step-time curves replayed out of the journals
+    assert set(fleet["step_time"]) == {"0", "1", "2"}
+    assert fleet["step_time"]["2"][-1][1] == pytest.approx(0.5)
+    # the CLI gate: 3 shards expected and found
+    assert fleet_report.main([str(tmp_path), "--expect-ranks", "3"]) == 0
+    assert fleet_report.main([str(tmp_path), "--expect-ranks", "4"]) == 2
+
+
+def test_watcher_raises_findings_from_remote_journals(tmp_path):
+    _write_shard(tmp_path, 0, steps=50, latency_s=3.0)
+    _write_shard(tmp_path, 1, steps=10, latency_s=0.01)
+    obs.reset()  # the LOCAL registry is empty: no shared memory
+    w = watch.Watcher(journal_dir=str(tmp_path), slo_p99_s=0.5)
+    found = w.poll()
+    kinds = sorted(f["kind"] for f in found)
+    assert kinds == ["slo_breach", "straggler"]
+    for f in found:
+        assert f["detail"]["source"] == "journal"
+    strag, = [f for f in found if f["kind"] == "straggler"]
+    assert strag["detail"]["lagging_ranks"] == [1]
+    assert strag["detail"]["steps"] == {"0": 50, "1": 10}
+    breach, = [f for f in found if f["kind"] == "slo_breach"]
+    assert breach["detail"]["p99_s"] > 0.5
+    # latched: a second poll with no new journal records stays quiet
+    assert w.poll() == []
+    # incremental: the slow rank catching up re-arms the straggler latch
+    obs.reset()
+    pub = timeline.TelemetryPublisher(
+        directory=str(tmp_path), rank=1, interval=99
+    ).start(register=False)
+    obs.add("guard.steps", 49)
+    pub.publish()
+    pub.stop()
+    w.poll()
+    assert not w._journal_straggling
+
+
+# ---------------------------------------------------------------------------
+# the shared windowed-p99 helper (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _legacy_window_p99(prev_buckets, cur_buckets):
+    """The pre-extraction watch.py implementation, verbatim — the golden
+    reference proving the shared helper did not change behavior."""
+    prev = {str(le): c for le, c in (prev_buckets or [])}
+    deltas = [(le, cum - prev.get(str(le), 0)) for le, cum in cur_buckets]
+    total = deltas[-1][1] if deltas else 0
+    if total <= 0:
+        return None
+    target = 0.99 * total
+    finite = [float(le) for le, _ in deltas if not isinstance(le, str)]
+    for le, cum_d in deltas:
+        if cum_d >= target:
+            if isinstance(le, str):
+                return (max(finite) * 2.0) if finite else float("inf")
+            return float(le)
+    return (max(finite) * 2.0) if finite else float("inf")
+
+
+def test_window_p99_golden_against_legacy_implementation():
+    import random
+
+    rng = random.Random(16)
+    cases = [(None, [["+Inf", 0]]), (None, []), (None, [[0.1, 5],
+                                                        ["+Inf", 5]])]
+    for _ in range(200):
+        bounds = sorted(rng.sample([0.001, 0.01, 0.05, 0.1, 0.5, 1.0,
+                                    5.0], rng.randint(1, 5)))
+        prev_counts, cur = [], []
+        run = 0
+        for le in bounds:
+            run += rng.randint(0, 10)
+            prev_counts.append([le, run])
+        prev_counts.append(["+Inf", run + rng.randint(0, 5)])
+        for (le, c) in prev_counts:
+            cur.append([le, c + rng.randint(0, 20)])
+        # cumulative monotonicity for the cur side
+        for i in range(1, len(cur)):
+            cur[i][1] = max(cur[i][1], cur[i - 1][1])
+        cases.append((prev_counts if rng.random() < 0.7 else None, cur))
+    for prev, cur in cases:
+        assert metrics.window_p99(prev, cur) == _legacy_window_p99(
+            prev, cur
+        ), (prev, cur)
+    # the watch-module alias IS the shared helper (call sites unchanged)
+    assert watch._window_p99 is metrics.window_p99
+
+
+def test_brownout_fallback_computes_p99_via_shared_helper():
+    class _Server:
+        def endpoints(self):
+            return {}
+
+    ctl = brownout_mod.BrownoutController(
+        _Server(), slo_p99_s=0.05, escalate_after=1, recover_after=99
+    )
+    # no watcher, no watch.request_p99_s gauge: the controller must see
+    # the breach from the latency histogram's bucket deltas itself
+    for _ in range(40):
+        obs.observe("serving.request_latency", 0.4)
+    level = ctl.poll()
+    assert level == 1  # escalated off its own windowed p99
+    # with a watcher gauge present the gauge wins (caller unchanged)
+    obs.set_gauge("watch.request_p99_s", 0.001)
+    w = watch.Watcher()  # attached watcher -> gauge path
+    ctl2 = brownout_mod.BrownoutController(
+        _Server(), slo_p99_s=0.05, watcher=w, escalate_after=1
+    )
+    for _ in range(40):
+        obs.observe("serving.request_latency", 0.4)
+    assert ctl2.poll() == 0  # gauge says healthy: no self-computation
+
+
+# ---------------------------------------------------------------------------
+# the kill-switch (satellite, alongside the PR-13 test)
+# ---------------------------------------------------------------------------
+
+
+def test_kill_switch_no_threads_no_files(tmp_path):
+    obs.set_enabled(False)
+    pub = timeline.TelemetryPublisher(directory=str(tmp_path), rank=0,
+                                      interval=0.01).start()
+    rec = recorder.FlightRecorder(directory=str(tmp_path), rank=0,
+                                  interval=0.01).start()
+    assert pub._thread is None and rec._thread is None
+    assert pub.publish() is None
+    assert rec.dump("exception") is None
+    assert recorder.flight_dump("exception") is None
+    assert timeline.journal_stamp() is None
+    os.environ["PADDLE_TPU_TELEMETRY_DIR"] = str(tmp_path)
+    try:
+        assert timeline.ensure_publisher() is None
+    finally:
+        del os.environ["PADDLE_TPU_TELEMETRY_DIR"]
+    time.sleep(0.05)
+    assert os.listdir(str(tmp_path)) == []  # not one file, not one thread
+    obs.set_enabled(True)
+
+
+def test_ensure_publisher_one_env_var_opt_in(tmp_path):
+    os.environ["PADDLE_TPU_TELEMETRY_DIR"] = str(tmp_path)
+    os.environ["PADDLE_TRAINER_ID"] = "5"
+    try:
+        pub = timeline.ensure_publisher()
+        assert pub is not None and pub.rank == 5
+        assert timeline.ensure_publisher() is pub  # idempotent
+        assert recorder.get_recorder() is not None
+        _churn(0)
+        pub.publish()
+        assert os.path.exists(timeline.shard_path(str(tmp_path), 5))
+    finally:
+        del os.environ["PADDLE_TPU_TELEMETRY_DIR"]
+        del os.environ["PADDLE_TRAINER_ID"]
+        rec = recorder.get_recorder()
+        if rec is not None:
+            rec.stop()

@@ -152,6 +152,21 @@ def test_kill_switch_disables_spans_and_traces():
     snap = obs.snapshot()
     assert snap["span_count"] == 0
     assert snap["counters"] == {}
+    # PR 16: the same switch silences the telemetry plane — no publisher
+    # or flight-recorder thread starts, not one journal/bundle file lands
+    import tempfile
+
+    from paddle_tpu.observability import recorder, timeline
+
+    with tempfile.TemporaryDirectory() as d:
+        pub = timeline.TelemetryPublisher(
+            directory=d, rank=0, interval=0.01
+        ).start(register=False)
+        rec = recorder.FlightRecorder(directory=d, rank=0,
+                                      interval=0.01).start(register=False)
+        assert pub._thread is None and rec._thread is None
+        assert pub.publish() is None and rec.dump("exception") is None
+        assert os.listdir(d) == []
 
 
 # -- serving: request traces across the scheduler handoff --------------------

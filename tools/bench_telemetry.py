@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Telemetry-plane overhead gate: publisher+recorder on vs off.
+
+The journal publisher and flight recorder only earn default-on status
+(the ``PADDLE_TPU_TELEMETRY_DIR`` one-env-var opt-in) if a trainer
+cannot feel them: the per-step latency delta between the full telemetry
+plane running (publisher journaling deltas + recorder re-publishing its
+black box, both at an aggressive cadence) and the same process with both
+paused must stay within ``--gate`` (default 2%) on a zoo model.
+
+Methodology is bench_tracing's: the two modes run strictly INTERLEAVED
+(on, off, on, off ...) against the same warm executable — one ON step
+and one OFF step back to back per pair, alternating order — and the
+estimator is the median pairwise delta over the median OFF latency.
+Monitoring itself stays enabled in BOTH modes (its cost is
+bench_tracing's gate); what this bench isolates is the background
+publisher/recorder threads contending for the registry lock and the GIL.
+Up to ``--rounds`` rounds; ANY round meeting the gate passes (re-measure
+on miss filters scheduler noise on a shared CI host, not real overhead).
+
+Prints one JSON line (bench.py convention); exits non-zero on gate miss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _feed_for(bm, seed=0):
+    import numpy as np
+
+    from paddle_tpu.core.dtypes import to_numpy_dtype
+
+    rng = np.random.RandomState(seed)
+    feed = {}
+    blk = bm.main.global_block
+    for n in bm.feed_names:
+        v = blk.var(n)
+        shape = tuple(int(d) if d not in (-1, None) else 4 for d in v.shape)
+        dt = np.dtype(to_numpy_dtype(v.dtype or "float32"))
+        if np.issubdtype(dt, np.integer):
+            feed[n] = rng.randint(0, 3, shape).astype(dt)
+        else:
+            feed[n] = rng.rand(*shape).astype(dt)
+    return feed
+
+
+def measure_round(exe, bm, feed, scope, steps, pub, rec):
+    """One interleaved round; returns (median_on_s, median_off_s, median
+    pairwise delta). ON = publisher + recorder live on their cadence
+    threads; OFF = both paused (threads idle at the Event check — the
+    kill-you-can-feel comparison, not a teardown/restart that would
+    perturb the pair)."""
+    on, off = [], []
+    fetch = list(bm.fetch_names)
+
+    def step_on(i):
+        pub.resume()
+        rec.resume()
+        t0 = time.perf_counter()
+        exe.run(bm.main, feed=feed, fetch_list=fetch, scope=scope)
+        on.append(time.perf_counter() - t0)
+
+    def step_off(i):
+        pub.pause()
+        rec.pause()
+        t0 = time.perf_counter()
+        exe.run(bm.main, feed=feed, fetch_list=fetch, scope=scope)
+        off.append(time.perf_counter() - t0)
+
+    for i in range(steps):
+        # alternate which mode runs first within the pair (bench_tracing
+        # rationale: a fixed order folds first-vs-second warmth into the
+        # delta as fake overhead)
+        first, second = (step_on, step_off) if i % 2 == 0 else (
+            step_off, step_on)
+        first(i)
+        second(i)
+    pub.resume()
+    rec.resume()
+    delta = statistics.median(a - b for a, b in zip(on, off))
+    return statistics.median(on), statistics.median(off), delta
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="bert",
+                    help="zoo model to step (default bert)")
+    ap.add_argument("--steps", type=int, default=40,
+                    help="interleaved step pairs per round (default 40)")
+    ap.add_argument("--rounds", type=int, default=5,
+                    help="measurement rounds; best round gates (default 5)")
+    ap.add_argument("--gate", type=float, default=0.02,
+                    help="max allowed relative overhead (default 0.02)")
+    ap.add_argument("--cadence", type=float, default=0.05,
+                    help="publisher/recorder interval while ON (default "
+                         "0.05s — 20x the production default, a stress "
+                         "cadence)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized run (fewer steps)")
+    ap.add_argument("--dump", default=None,
+                    help="write the observability snapshot JSON here")
+    ap.add_argument("--no-gate", action="store_true",
+                    help="report only, never fail the exit code")
+    args = ap.parse_args(argv)
+    steps = 32 if args.smoke else args.steps
+
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.models import build_model
+    from paddle_tpu.observability import FlightRecorder, TelemetryPublisher
+
+    bm = build_model(args.model, with_mesh=False)
+    exe = fluid.Executor()
+    scope = Scope()
+    exe.run(bm.startup, scope=scope)
+    feed = _feed_for(bm)
+    fetch = list(bm.fetch_names)
+    for _ in range(3):  # warm the executable + estimate off the clock
+        exe.run(bm.main, feed=feed, fetch_list=fetch, scope=scope)
+
+    tdir = tempfile.mkdtemp(prefix="bench_telemetry_")
+    pub = TelemetryPublisher(
+        directory=tdir, rank=0, interval=args.cadence
+    ).start(register=False)
+    rec = FlightRecorder(
+        directory=tdir, rank=0, interval=args.cadence
+    ).start(register=False)
+
+    rounds = []
+    best = None
+    try:
+        for r in range(max(1, args.rounds)):
+            med_on, med_off, delta = measure_round(
+                exe, bm, feed, scope, steps, pub, rec
+            )
+            overhead = delta / med_off if med_off > 0 else 0.0
+            rounds.append({
+                "median_on_ms": round(med_on * 1e3, 4),
+                "median_off_ms": round(med_off * 1e3, 4),
+                "median_pair_delta_ms": round(delta * 1e3, 5),
+                "overhead": round(overhead, 5),
+            })
+            if best is None or overhead < best:
+                best = overhead
+            if overhead <= args.gate:
+                break
+    finally:
+        pub.stop()
+        rec.stop()
+    ok = best is not None and best <= args.gate
+    if args.dump:
+        obs.dump(args.dump)
+    result = {
+        "metric": "telemetry_overhead",
+        "model": args.model,
+        "steps_per_round": steps,
+        "cadence_s": args.cadence,
+        "journal_bytes": os.path.getsize(pub.path),
+        "rounds": rounds,
+        "overhead": round(best, 5),
+        "gate": args.gate,
+        "gate_ok": ok,
+    }
+    print(json.dumps(result))
+    if not ok and not args.no_gate:
+        print(
+            f"telemetry overhead gate FAILED: best {best:.2%} > "
+            f"{args.gate:.0%} across {len(rounds)} round(s)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

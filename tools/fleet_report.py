@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Merge per-process telemetry journals into a fleet-wide report.
+
+Usage:
+    python tools/fleet_report.py TELEMETRY_DIR [--bin 1.0] [--json]
+    python tools/fleet_report.py DIR --expect-ranks 2 --out fleet.json
+
+Reads every ``telemetry_rank*.jsonl`` shard a
+``observability.timeline.TelemetryPublisher`` wrote under TELEMETRY_DIR
+(dead writers included — the whole point: a SIGKILLed rank's journal
+replays offline) and reconstructs:
+
+* per-rank final state: last step counter, last journal seq/time, total
+  goodput — the "what was rank K doing when it died" answer;
+* fleet time series, binned at ``--bin`` seconds: summed request/goodput
+  QPS, per-rank step-time curves (mean step latency per journal window),
+  and the cross-process p99 rebuilt by merging per-shard histogram
+  bucket deltas (``metrics.window_p99`` over
+  ``metrics.merge_cumulative_buckets`` — the same helpers the live
+  watcher uses, so offline and online answers agree);
+* straggler gaps: the per-rank last-step spread.
+
+``--expect-ranks N`` exits non-zero unless at least N shards were found
+and replayed (the CI guard that a dead rank's journal survived);
+``--json`` prints the machine-readable report on stdout instead of the
+human rendering (``--out`` writes it to a file either way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.observability import metrics, timeline  # noqa: E402
+
+STEP_COUNTERS = ("guard.steps", "executor.run_steps")
+
+
+def _noncum(h):
+    """Raw replay-state histogram -> (bounds, per-bucket counts incl +Inf)."""
+    return list(h["bounds"]), list(h["counts"])
+
+
+def analyze_shard(path, step_metric="executor.step_latency",
+                  latency_metric="serving.request_latency"):
+    """Replay one shard into (summary, per-record points)."""
+    st = timeline.ReplayState()
+    points = []
+    prev = {"served": 0, "goodput": 0, "sl_count": 0, "sl_sum": 0.0,
+            "lat": None}
+    paths = ([path + ".1"] if os.path.exists(path + ".1") else []) + [path]
+    n_records = 0
+    for p in paths:
+        for rec in timeline.read_records(p):
+            st.apply(rec)
+            n_records += 1
+            c = st.state["counters"]
+            point = {"t": rec.get("t")}
+            served = c.get("serving.requests_served", 0)
+            goodput = c.get("serving.goodput", 0)
+            point["served"] = served - prev["served"]
+            point["goodput"] = goodput - prev["goodput"]
+            prev["served"], prev["goodput"] = served, goodput
+            sl = st.state["hists"].get(step_metric)
+            if sl is not None:
+                d_count = sl["count"] - prev["sl_count"]
+                d_sum = sl["sum"] - prev["sl_sum"]
+                prev["sl_count"], prev["sl_sum"] = sl["count"], sl["sum"]
+                if d_count > 0:
+                    point["steps"] = d_count
+                    point["step_mean_s"] = d_sum / d_count
+            lat = st.state["hists"].get(latency_metric)
+            if lat is not None:
+                bounds, counts = _noncum(lat)
+                pl = prev["lat"]
+                if pl is not None and pl[0] == bounds:
+                    deltas = [a - b for a, b in zip(counts, pl[1])]
+                else:
+                    deltas = counts
+                prev["lat"] = (bounds, counts)
+                if any(deltas):
+                    point["lat_bounds"] = bounds
+                    point["lat_deltas"] = deltas
+            points.append(point)
+    counters = st.state["counters"]
+    last_step = None
+    for name in STEP_COUNTERS:
+        if name in counters:
+            last_step = counters[name]
+            break
+    summary = {
+        "path": path,
+        "rank": st.meta.get("rank"),
+        "pid": st.meta.get("pid"),
+        "records": n_records,
+        "last_seq": st.meta.get("seq"),
+        "last_t": st.meta.get("t"),
+        "last_step": last_step,
+        "goodput": counters.get("serving.goodput", 0),
+        "requests_served": counters.get("serving.requests_served", 0),
+    }
+    return summary, points, st
+
+
+def _binned(shards_points, bin_s):
+    """Merge every shard's per-record points into time bins."""
+    bins = {}
+    for points in shards_points:
+        for pt in points:
+            if pt.get("t") is None:
+                continue
+            key = int(pt["t"] // bin_s)
+            b = bins.setdefault(key, {
+                "served": 0, "goodput": 0, "lat": {}, "inf": 0,
+            })
+            b["served"] += pt.get("served", 0)
+            b["goodput"] += pt.get("goodput", 0)
+            if "lat_deltas" in pt:
+                bounds, deltas = pt["lat_bounds"], pt["lat_deltas"]
+                for le, d in zip(bounds, deltas):
+                    b["lat"][le] = b["lat"].get(le, 0) + d
+                b["inf"] += deltas[-1]  # the +Inf bucket
+    out = []
+    for key in sorted(bins):
+        b = bins[key]
+        entry = {
+            "t": key * bin_s,
+            "qps": b["served"] / bin_s,
+            "goodput_qps": b["goodput"] / bin_s,
+        }
+        if b["lat"] or b["inf"]:
+            cum, buckets = 0, []
+            for le in sorted(b["lat"]):
+                cum += b["lat"][le]
+                buckets.append([le, cum])
+            buckets.append(["+Inf", cum + b["inf"]])
+            p99 = metrics.window_p99(None, buckets)
+            if p99 is not None:
+                entry["p99_s"] = p99
+        out.append(entry)
+    return out
+
+
+def build_report(directory, bin_s=1.0, step_metric="executor.step_latency",
+                 latency_metric="serving.request_latency"):
+    shard_paths = sorted(
+        p for p in glob.glob(os.path.join(directory, "telemetry_rank*.jsonl"))
+    )
+    shards, all_points, step_curves = [], [], {}
+    for path in shard_paths:
+        summary, points, _st = analyze_shard(
+            path, step_metric=step_metric, latency_metric=latency_metric
+        )
+        if summary["last_seq"] is None:
+            continue  # unreadable / empty shard
+        shards.append(summary)
+        all_points.append(points)
+        rank = summary["rank"]
+        curve = [
+            [pt["t"], pt["step_mean_s"]] for pt in points
+            if "step_mean_s" in pt and pt.get("t") is not None
+        ]
+        if curve:
+            step_curves[str(rank)] = curve
+    steps = {
+        str(s["rank"]): s["last_step"] for s in shards
+        if s["last_step"] is not None
+    }
+    straggler = {}
+    if len(steps) >= 2:
+        lead = max(steps.values())
+        straggler = {
+            "lead_step": lead,
+            "max_gap_steps": lead - min(steps.values()),
+            "per_rank_last_step": steps,
+        }
+    return {
+        "dir": directory,
+        "shards": shards,
+        "fleet": {
+            "ranks": len(shards),
+            "goodput_total": sum(s["goodput"] for s in shards),
+            "requests_served_total": sum(
+                s["requests_served"] for s in shards
+            ),
+            "timeline": _binned(all_points, bin_s),
+            "step_time": step_curves,
+            "straggler": straggler,
+        },
+    }
+
+
+def render(report):
+    lines = [f"==== fleet telemetry report: {report['dir']} ===="]
+    for s in report["shards"]:
+        lines.append(
+            f"  rank {s['rank']} (pid {s['pid']}): {s['records']} records, "
+            f"last seq {s['last_seq']}, last step {s['last_step']}, "
+            f"goodput {s['goodput']}"
+        )
+    fleet = report["fleet"]
+    lines.append(
+        f"-- fleet: {fleet['ranks']} rank(s), "
+        f"{fleet['requests_served_total']} served "
+        f"({fleet['goodput_total']} in-deadline) --"
+    )
+    strag = fleet["straggler"]
+    if strag:
+        lines.append(
+            f"  straggler gap: {strag['max_gap_steps']} steps behind "
+            f"lead {strag['lead_step']} "
+            f"({strag['per_rank_last_step']})"
+        )
+    tl = fleet["timeline"]
+    if tl:
+        p99s = [e["p99_s"] for e in tl if "p99_s" in e]
+        lines.append(
+            f"  {len(tl)} time bin(s); peak qps "
+            f"{max(e['qps'] for e in tl):.1f}"
+            + (f"; worst bin p99 {max(p99s):.4g}s" if p99s else "")
+        )
+    for rank, curve in sorted(fleet["step_time"].items()):
+        means = [m for _, m in curve]
+        lines.append(
+            f"  rank {rank} step time: {len(curve)} window(s), mean "
+            f"{sum(means) / len(means) * 1e3:.2f} ms, worst "
+            f"{max(means) * 1e3:.2f} ms"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dir", help="telemetry dir holding telemetry_rank*.jsonl")
+    ap.add_argument("--bin", type=float, default=1.0, metavar="S",
+                    help="time-bin width in seconds (default 1.0)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable report instead")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON report here")
+    ap.add_argument("--expect-ranks", type=int, default=0, metavar="N",
+                    help="fail unless >= N shards replayed")
+    ap.add_argument("--step-metric", default="executor.step_latency")
+    ap.add_argument("--latency-metric", default="serving.request_latency")
+    args = ap.parse_args(argv)
+    report = build_report(
+        args.dir, bin_s=args.bin, step_metric=args.step_metric,
+        latency_metric=args.latency_metric,
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report) if args.json else render(report))
+    if args.expect_ranks and len(report["shards"]) < args.expect_ranks:
+        print(
+            f"EXPECTED >= {args.expect_ranks} shards, replayed "
+            f"{len(report['shards'])}", file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

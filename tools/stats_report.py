@@ -249,6 +249,29 @@ def render(snap, top_ops=0):
                 f"  [{f_.get('severity', '?'):<7}] {f_.get('kind', '?')}: "
                 f"{detail}"
             )
+    # telemetry-plane digest (r16): journal liveness + flight dumps — a
+    # frozen publishes counter in a fleet of live ranks IS the finding
+    publishes = counters.get("telemetry.publishes", 0)
+    dumps = counters.get("telemetry.flight_dumps", 0)
+    if publishes or dumps:
+        lines.append("-- telemetry plane --")
+        lines.append(
+            f"  {publishes} journal publishes, "
+            f"{gauges.get('telemetry.journal_bytes', 0) / 1e3:.1f} KB "
+            f"journaled, {counters.get('telemetry.rotations', 0)} "
+            "rotation(s)"
+        )
+        triggers = {
+            n[len("telemetry.flight_dumps."):]: c
+            for n, c in counters.items()
+            if n.startswith("telemetry.flight_dumps.")
+        }
+        if dumps:
+            lines.append(
+                f"  {dumps} flight-recorder dump(s): " + " ".join(
+                    f"{k}={v}" for k, v in sorted(triggers.items())
+                )
+            )
     lines.append(f"span buffer: {snap.get('span_count', 0)} spans")
     if not (counters or gauges or hists):
         lines.append("(snapshot is empty — PADDLE_TPU_MONITOR=0, or nothing "
