@@ -828,6 +828,243 @@ def bench_failover(smoke, duration, results):
     return entry
 
 
+def _pid_alive(pid):
+    import os
+
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+def bench_fleet(smoke, duration, results, n_workers=4, kill=False):
+    """Process-fleet mix: the overload arrival process against a
+    ``ProcessReplicaSet`` of real worker processes.
+
+    Legs:
+
+    1. **single** — a 1-worker fleet: capacity probe, then the overload
+       arrival process (1.25x the N-worker aggregate rate) with
+       deadlines + shedding. The per-process baseline.
+    2. **fleet** — N workers, same arrival process. Gate: goodput >=
+       2.5x the single-worker leg when >= 4 cores back the workers
+       (min(N, cores) scales the bar below that; on a 1-core host the
+       ratio is reported, not gated — N processes on one core cannot
+       scale by construction).
+    3. **chaos** (``kill=True``) — N-1 workers with ``max_replicas=N``,
+       the journal-mode Watcher + BrownoutController + FleetAutoscaler
+       closing the loop, and a REAL ``SIGKILL`` of one worker mid-run.
+       Gates: every admitted request resolves typed (zero hangs), the
+       worker death is detected and the corpse respawned, the
+       autoscaler scaled out BEFORE anything was shed, the fleet is
+       back to full strength afterwards, and ``Server.close()`` leaves
+       zero orphan processes.
+    """
+    import os
+    import signal
+    import tempfile
+
+    from paddle_tpu import observability
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.observability import timeline
+    from paddle_tpu.observability.watch import Watcher
+    from paddle_tpu.serving import (BrownoutController, FleetAutoscaler,
+                                    ProcessReplicaSet, Server)
+    from paddle_tpu.serving.router import EndpointConfig
+
+    scope = Scope()
+    frozen, build, exe = _build_classifier_endpoint("bert", scope,
+                                                    seed=29)
+    model_dir = tempfile.mkdtemp(prefix="bench-fleet-model-")
+    frozen.save(model_dir, scope=scope)
+    buckets = (1, 2, 4, 8)
+    cores = os.cpu_count() or 1
+    gates = {}
+
+    def start_fleet(name, n, max_replicas=None, workdir=None, env=None):
+        fleet = ProcessReplicaSet(
+            model_dir, n_workers=n, max_replicas=max_replicas or n,
+            warm_buckets=buckets, attempt_timeout=20.0,
+            heartbeat_timeout=10.0, spawn_timeout=300.0, name=name,
+            workdir=workdir, env=env,
+        )
+        srv = Server()
+        srv.add_endpoint(
+            name, fleet,
+            EndpointConfig(buckets=buckets, max_wait_ms=4.0,
+                           max_queue=4096),
+        )
+        srv.warmup()
+        return srv, fleet
+
+    # -- leg 1: single-worker baseline ---------------------------------
+    srv1, fleet1 = start_fleet("fleet1", 1)
+    lats, n_done, wall = _closed_loop(
+        srv1, "fleet1", build, 4, 1.0 if smoke else 2.0
+    )
+    cap1 = n_done / wall if wall > 0 else 50.0
+    p50_cap = float(np.percentile(lats, 50)) if lats else 0.01
+    int_dl = max(10.0 * p50_cap, 0.1)
+    deadlines = {"interactive": int_dl, "background": 4.0 * int_dl}
+    # the shared arrival process: overload for ONE worker, ~1.25x
+    # saturation for the full fleet — the single leg sheds/expires its
+    # way through, the fleet leg serves it, and the goodput ratio is
+    # the scaling number
+    rate = 1.25 * n_workers * cap1
+    single = _overload_leg(srv1, "fleet1", build, rate, duration,
+                           deadlines, shed=True)
+    pids1 = fleet1.worker_pids()
+    srv1.close(timeout=120)
+
+    # -- leg 2: the N-worker fleet, same arrivals ----------------------
+    srvN, fleetN = start_fleet(f"fleet{n_workers}", n_workers)
+    fleet_leg = _overload_leg(srvN, f"fleet{n_workers}", build, rate,
+                              duration, deadlines, shed=True)
+    pidsN = fleetN.worker_pids()
+    srvN.close(timeout=120)
+
+    ratio = (
+        fleet_leg["goodput_qps"] / single["goodput_qps"]
+        if single["goodput_qps"] else float("inf")
+    )
+    effective = min(n_workers, cores)
+    if effective >= 4:
+        required_ratio = 2.5
+    elif effective >= 2:
+        required_ratio = 0.625 * effective
+    else:
+        required_ratio = None  # 1 core: nothing to scale onto
+    gates["fleet_goodput_scaling"] = (
+        ratio >= required_ratio if required_ratio is not None else True
+    )
+    gates["legs_all_resolved"] = (
+        single["unresolved"] == 0 and fleet_leg["unresolved"] == 0
+    )
+    gates["scaling_legs_zero_orphans"] = not any(
+        _pid_alive(p) for p in pids1 + pidsN
+    )
+
+    entry = {
+        "mix": "fleet",
+        "mode": "open-fleet",
+        "n_workers": n_workers,
+        "cores": cores,
+        "capacity_qps_1worker": round(cap1, 1),
+        "rate_qps": round(rate, 1),
+        "deadline_ms": {k: round(v * 1e3, 1)
+                        for k, v in deadlines.items()},
+        "single": single,
+        "fleet": fleet_leg,
+        "goodput_ratio": round(ratio, 2),
+        "required_ratio": required_ratio,
+    }
+
+    # -- leg 3: chaos — SIGKILL under load, autoscale-first ------------
+    if kill:
+        chaos_dur = max(duration, 4.0)
+        workdir = tempfile.mkdtemp(prefix="bench-fleet-chaos-")
+        telemetry_dir = os.path.join(workdir, "telemetry")
+        os.makedirs(telemetry_dir, exist_ok=True)
+        # the parent joins the fleet's telemetry plane (rank 99, clear
+        # of the workers' ranks) so the journal-mode watcher reads the
+        # router's latency histograms from a shard like any other
+        # process — no shared memory with the control loop
+        os.environ["PADDLE_TPU_TELEMETRY_DIR"] = telemetry_dir
+        os.environ["PADDLE_TRAINER_ID"] = "99"
+        os.environ["PADDLE_TPU_TELEMETRY_INTERVAL"] = "0.25"
+        timeline.ensure_publisher()
+        c0 = observability.get_counters()
+        srvC, fleetC = start_fleet(
+            "fleet_chaos", n_workers - 1, max_replicas=n_workers,
+            workdir=workdir,
+            env={"PADDLE_TPU_TELEMETRY_INTERVAL": "0.25"},
+        )
+        watcher = Watcher(
+            latency_metric="serving.request_latency.fleet_chaos",
+            slo_p99_s=deadlines["interactive"],
+            journal_dir=telemetry_dir,
+            dead_process_timeout=3.0,
+        )
+        autoscaler = FleetAutoscaler(
+            fleetC, breach_after=2, idle_after=10 ** 9, cooldown_s=5.0,
+        )
+        ctl = BrownoutController(
+            srvC, slo_p99_s=deadlines["interactive"], watcher=watcher,
+            escalate_after=2, recover_after=2, interval=0.25,
+            autoscaler=autoscaler,
+        )
+        ctl.start()
+        victim = fleetC.worker_pids()[0]
+
+        def _assassin():
+            time.sleep(chaos_dur / 3.0)
+            os.kill(victim, signal.SIGKILL)
+
+        killer = threading.Thread(target=_assassin, daemon=True)
+        killer.start()
+        chaos = _overload_leg(srvC, "fleet_chaos", build, rate,
+                              chaos_dur, deadlines, shed=True)
+        killer.join()
+        # respawn-to-strength: the supervisor restores the corpse (and
+        # the autoscaler's spare may land on top) while the backlog
+        # drains; full strength = the n-1 the leg started with
+        target = n_workers - 1
+        wait_until = time.perf_counter() + 120.0
+        while (time.perf_counter() < wait_until
+               and fleetC.healthy_count() < target):
+            time.sleep(0.5)
+        healthy_end = fleetC.healthy_count()
+        ctl.stop()
+        c1 = observability.get_counters()
+        first_scale = fleetC.first_scale_out_state
+        pidsC = fleetC.worker_pids()
+        srvC.close(timeout=120)
+
+        def delta(name):
+            return c1.get(name, 0) - c0.get(name, 0)
+
+        gates["chaos_all_resolved"] = chaos["unresolved"] == 0
+        gates["chaos_worker_death_detected"] = (
+            delta("serving.fleet.worker_deaths") >= 1
+        )
+        gates["chaos_respawned"] = delta("serving.fleet.respawns") >= 1
+        gates["chaos_scaled_out"] = delta("serving.fleet.scale_outs") >= 1
+        # the brownout ladder's first rung is CAPACITY: the first
+        # scale-out must precede any shed of this leg's traffic
+        gates["chaos_scale_out_before_shed"] = (
+            first_scale is not None
+            and first_scale["shed"] - c0.get("serving.shed", 0) <= 0
+        )
+        gates["chaos_respawn_to_strength"] = healthy_end >= target
+        gates["chaos_zero_orphans"] = not any(
+            _pid_alive(p) for p in pidsC
+        )
+        entry["chaos"] = {
+            **chaos,
+            "victim_pid": victim,
+            "healthy_end": healthy_end,
+            "target_strength": target,
+            "worker_deaths": delta("serving.fleet.worker_deaths"),
+            "respawns": delta("serving.fleet.respawns"),
+            "reroutes": delta("serving.fleet.reroutes"),
+            "scale_outs": delta("serving.fleet.scale_outs"),
+            "brownout_scale_outs": delta("serving.brownout_scale_outs"),
+            "dead_process_findings": delta(
+                "watch.findings.dead_process"
+            ),
+            "first_scale_out_shed_delta": (
+                None if first_scale is None
+                else first_scale["shed"] - c0.get("serving.shed", 0)
+            ),
+        }
+
+    entry["gates"] = gates
+    entry["ok"] = all(gates.values())
+    results["fleet"] = entry
+    return entry
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -840,6 +1077,14 @@ def main(argv=None):
                     help="comma list of mixes to run "
                          "(bert,resnet,ctr,gpt,overload,failover; "
                          "default: all)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="run the overload mix against an N-worker "
+                         "process fleet (ProcessReplicaSet) instead of "
+                         "the in-process servers")
+    ap.add_argument("--fleet-kill", action="store_true",
+                    help="with --fleet: add the chaos leg — SIGKILL a "
+                         "worker mid-run and gate failover, respawn, "
+                         "autoscale-before-shed, zero orphans")
     args = ap.parse_args(argv)
     duration = args.duration or (2.0 if args.smoke else 6.0)
     all_mixes = ("bert", "resnet", "ctr", "gpt", "overload", "failover")
@@ -904,10 +1149,20 @@ def main(argv=None):
         gates["kv_parity"] = bool(gpt["kv_parity"])
 
     if "overload" in mixes:
-        # r15 fault-domain goodput mix (2x sustainable arrival rate)
-        ov = bench_overload(args.smoke, duration, results)
-        print(json.dumps(ov), flush=True)
-        gates["overload"] = ov["ok"]
+        if args.fleet:
+            # process-fleet legs: the overload arrival process against
+            # real worker processes (plus the SIGKILL chaos leg when
+            # --fleet-kill is set)
+            fl = bench_fleet(args.smoke, duration, results,
+                             n_workers=args.fleet,
+                             kill=args.fleet_kill)
+            print(json.dumps(fl), flush=True)
+            gates["fleet"] = fl["ok"]
+        else:
+            # r15 fault-domain goodput mix (2x sustainable arrival rate)
+            ov = bench_overload(args.smoke, duration, results)
+            print(json.dumps(ov), flush=True)
+            gates["overload"] = ov["ok"]
 
     if "failover" in mixes:
         # r15 replica-kill chaos mix (3x window duration)

@@ -272,6 +272,42 @@ if python tools/program_lint.py --broken-frozen-fixture > /dev/null 2>&1; then
     exit 1
 fi
 
+echo "== fleet chaos (process replicas: SIGKILL + respawn + scale-out) =="
+# 4 process-isolated workers behind one endpoint on the overload mix;
+# one worker SIGKILLed mid-run. The bench self-gates: every admitted
+# request resolves typed (zero hangs), the supervisor respawns the
+# corpse back to full strength, the autoscaler adds capacity BEFORE any
+# shedding (the brownout ladder's rung zero), and the goodput-scaling
+# gate arms itself by core count (N processes on one core cannot scale
+# by construction — correctness gates always apply). stats_report proves
+# the fleet telemetry was alive; pgrep proves Server.close() left zero
+# orphan workers.
+FLEET_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu python bench_serving.py --smoke --mix overload \
+    --fleet 4 --fleet-kill --dump "$FLEET_DIR/fleet_stats.json"
+python tools/stats_report.py "$FLEET_DIR/fleet_stats.json" \
+    --require serving.fleet. --require serving.server_closes
+python - "$FLEET_DIR" <<'EOF'
+import json, sys
+snap = json.load(open(sys.argv[1] + "/fleet_stats.json"))
+c = snap["counters"]
+assert c.get("serving.fleet.worker_deaths", 0) >= 1, c
+assert c.get("serving.fleet.respawns", 0) >= 1, c
+assert c.get("serving.fleet.scale_outs", 0) >= 1, c
+assert c.get("serving.fleet.spawns", 0) >= 4, c
+print(f"fleet chaos OK: {c['serving.fleet.spawns']} spawns, "
+      f"{c['serving.fleet.worker_deaths']} death(s) -> "
+      f"{c['serving.fleet.respawns']} respawn(s), "
+      f"{c['serving.fleet.reroutes']} reroute(s), "
+      f"{c['serving.fleet.scale_outs']} scale-out(s) before shedding")
+EOF
+if pgrep -f "paddle_tpu.serving.worker" > /dev/null 2>&1; then
+    echo "orphan fleet workers survived Server.close():" >&2
+    pgrep -af "paddle_tpu.serving.worker" >&2
+    exit 1
+fi
+rm -rf "$FLEET_DIR"
+
 echo "== observability smoke =="
 python - <<'EOF'
 import numpy as np

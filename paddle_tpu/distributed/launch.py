@@ -22,7 +22,6 @@ from __future__ import annotations
 import argparse
 import os
 import random
-import signal
 import subprocess
 import sys
 import time
@@ -90,22 +89,11 @@ def build_cluster(args):
 def _terminate_pod(procs, grace=10.0):
     """SIGTERM everyone, reap with a deadline, escalate to SIGKILL — a child
     blocked in a native collective often defers SIGTERM forever and would
-    otherwise be orphaned holding its port."""
-    for p in procs:
-        if p.poll() is None:
-            p.send_signal(signal.SIGTERM)
-    deadline = time.time() + grace
-    for p in procs:
-        if p.poll() is None:
-            try:
-                p.wait(timeout=max(0.1, deadline - time.time()))
-            except subprocess.TimeoutExpired:
-                p.kill()
-                p.wait()
-    for p in procs:
-        out = getattr(p, "_paddle_log", None)
-        if out is not None:
-            out.close()
+    otherwise be orphaned holding its port. (Implementation shared with the
+    serving process fleet: resilience/supervisor.py.)"""
+    from ..resilience.supervisor import terminate_children
+
+    terminate_children(procs, grace=grace)
 
 
 def spawn_trainer(args, endpoints, rank, attempt=0):
@@ -180,14 +168,10 @@ def _beat_staleness(args, proc, now_wall):
 
 def _kill_hung(proc, grace=5.0):
     """SIGTERM a hung child, escalating to SIGKILL after `grace` without
-    blocking the supervision scan (a rank stuck in a native collective
-    routinely ignores SIGTERM forever)."""
-    if getattr(proc, "_paddle_kill_at", None) is None:
-        proc._paddle_hung = True
-        proc._paddle_kill_at = time.monotonic() + grace
-        proc.send_signal(signal.SIGTERM)
-    elif time.monotonic() >= proc._paddle_kill_at:
-        proc.kill()
+    blocking the supervision scan (shared: resilience/supervisor.py)."""
+    from ..resilience.supervisor import kill_hung
+
+    kill_hung(proc, grace=grace)
 
 
 def watch_local_trainers(procs, args=None, endpoints=None):
@@ -209,8 +193,15 @@ def watch_local_trainers(procs, args=None, endpoints=None):
     Preemption: a child exiting with the distinguished
     ``PREEMPTION_EXIT_CODE`` (it drained after SIGTERM and wrote a final
     checkpoint) is a CLEAN exit — no pod abort, no restart-budget burn —
-    unless the launcher itself killed it as hung."""
+    unless the launcher itself killed it as hung.
+
+    The scan/backoff/stale-beat loop itself lives in
+    ``resilience.supervisor.Supervisor`` (shared with the serving process
+    fleet); this function contributes the launcher policy — rank 0 and
+    non-elastic deaths abort the pod, preemption exits are clean, and the
+    historical log lines/counters stay byte-identical."""
     from ..resilience.health import PREEMPTION_EXIT_CODE
+    from ..resilience.supervisor import Supervisor
 
     elastic = bool(args and getattr(args, "elastic", False))
     max_restarts = getattr(args, "max_restarts", 3) if args else 3
@@ -218,79 +209,69 @@ def watch_local_trainers(procs, args=None, endpoints=None):
     hb_timeout = float(getattr(args, "heartbeat_timeout", 0) or 0) if args else 0
     hb_dir = getattr(args, "heartbeat_dir", None) if args else None
     watch_beats = bool(hb_dir and hb_timeout > 0)
-    restarts = {}  # rank -> count
-    pending = {}  # procs index -> {"deadline": monotonic, "rank": rank}
+    ranks = {i: getattr(p, "_paddle_rank", i) for i, p in enumerate(procs)}
+    sup = Supervisor(
+        # late-bound module lookup: tests monkeypatch launch.spawn_trainer
+        # to steer restarts, and that must keep working
+        spawn=lambda i, attempt: spawn_trainer(
+            args, endpoints, ranks[i], attempt
+        ),
+        max_restarts=max_restarts,
+        backoff_base=backoff_base,
+        backoff_cap=10.0,
+        staleness=(
+            (lambda p, now_wall: _beat_staleness(args, p, now_wall))
+            if watch_beats else None
+        ),
+        stale_after=hb_timeout if watch_beats else 0.0,
+        clean_exit=lambda rc, hung: (
+            rc == 0 or (rc == PREEMPTION_EXIT_CODE and not hung)
+        ),
+        restartable=lambda i, rc, hung: elastic and ranks[i] != 0,
+        rng=_restart_rng,
+    )
+    for i, p in enumerate(procs):
+        sup.adopt(i, p)
     try:
         while True:
-            alive = False
-            now = time.monotonic()
-            now_wall = time.time() if watch_beats else 0.0
-            for i, p in enumerate(procs):
-                rc = p.poll()
-                if rc is None:
-                    alive = True
-                    if watch_beats and _beat_staleness(
-                        args, p, now_wall
-                    ) > hb_timeout:
-                        if getattr(p, "_paddle_kill_at", None) is None:
-                            rank = getattr(p, "_paddle_rank", i)
-                            print(
-                                f"[launch] rank {rank} (pid {p.pid}) hung: "
-                                f"no heartbeat in {hb_timeout}s; killing",
-                                file=sys.stderr,
-                            )
-                            from .. import observability as _obs
+            for ev in sup.poll():
+                i, p, kind = ev["key"], ev["proc"], ev["kind"]
+                rank = ranks[i]
+                if kind == "hung":
+                    print(
+                        f"[launch] rank {rank} (pid {p.pid}) hung: "
+                        f"no heartbeat in {hb_timeout}s; killing",
+                        file=sys.stderr,
+                    )
+                    from .. import observability as _obs
 
-                            _obs.add("resilience.hangs")
-                            _obs.add("resilience.hangs.launcher")
-                        _kill_hung(p)
-                    continue
-                hung = getattr(p, "_paddle_hung", False)
-                if rc == 0 or (rc == PREEMPTION_EXIT_CODE and not hung):
-                    continue  # clean exit (incl. graceful preemption drain)
-                if i in pending:
-                    # backoff in progress: restart when its deadline
-                    # arrives; never sleep inline — the scan must keep
-                    # monitoring every other child (rank 0's death aborts
-                    # immediately even mid-backoff)
-                    alive = True
-                    entry = pending[i]
-                    if now >= entry["deadline"]:
-                        del pending[i]
-                        rank = entry["rank"]
-                        log = getattr(p, "_paddle_log", None)
-                        if log is not None:
-                            log.close()
-                        procs[i] = spawn_trainer(
-                            args, endpoints, rank, restarts[rank]
-                        )
-                    continue
-                rank = getattr(p, "_paddle_rank", i)
-                n = restarts.get(rank, 0)
-                if not elastic or rank == 0 or n >= max_restarts:
+                    _obs.add("resilience.hangs")
+                    _obs.add("resilience.hangs.launcher")
+                elif kind == "respawned":
+                    # mirror into the caller's list: _terminate_pod on a
+                    # later abort must see the live child, not the corpse
+                    procs[i] = p
+                elif kind == "restart_scheduled":
+                    print(
+                        f"[launch --elastic] rank {rank} "
+                        + ("hung (killed)" if ev["hung"]
+                           else f"died (rc={ev['rc']})")
+                        + f"; restart {ev['attempt']}/{max_restarts} "
+                        f"in {ev['delay']:.1f}s",
+                        file=sys.stderr,
+                    )
+                elif kind == "fatal":
+                    n = ev["restarts"]
                     _terminate_pod(procs)
                     raise RuntimeError(
                         f"trainer rank {rank} (pid {p.pid}) "
                         + ("hung (heartbeat stale) and was killed, exit "
-                           if hung else "exited with ")
-                        + f"code {rc}"
+                           if ev["hung"] else "exited with ")
+                        + f"code {ev['rc']}"
                         + (f" after {n} restart(s)" if elastic and n else "")
                         + "; pod aborted"
                     )
-                restarts[rank] = n + 1
-                from ..resilience import backoff_delay
-
-                delay = backoff_delay(n + 1, backoff_base, 10.0,
-                                      rng=_restart_rng)
-                print(
-                    f"[launch --elastic] rank {rank} "
-                    + ("hung (killed)" if hung else f"died (rc={rc})")
-                    + f"; restart {n + 1}/{max_restarts} in {delay:.1f}s",
-                    file=sys.stderr,
-                )
-                pending[i] = {"deadline": now + delay, "rank": rank}
-                alive = True
-            if not alive:
+            if not sup.some_active():
                 _terminate_pod(procs)  # reaps + closes log handles
                 return 0
             time.sleep(0.2)

@@ -47,7 +47,7 @@ from . import metrics
 __all__ = ["Watcher"]
 
 _SEVERITY = {"straggler": "warning", "step_regression": "warning",
-             "slo_breach": "error"}
+             "slo_breach": "error", "dead_process": "error"}
 
 
 def _hist_state(name):
@@ -78,7 +78,8 @@ class Watcher:
                  drift_tolerance=0.25, min_window=8, slo_p99_s=None,
                  step_metric="executor.step_latency",
                  latency_metric="serving.request_latency",
-                 interval=1.0, max_findings=256, journal_dir=None):
+                 interval=1.0, max_findings=256, journal_dir=None,
+                 dead_process_timeout=None):
         self.heartbeat_dir = heartbeat_dir
         # timeline-reader mode: follow OTHER processes' telemetry
         # journals (timeline.TelemetryPublisher shards) and raise
@@ -108,6 +109,14 @@ class Watcher:
         self._journal_straggling = False
         self._journal_breaching = False
         self._journal_lat_prev = None
+        # dead-process detection: a journal shard whose newest record
+        # stamp goes stale past this threshold raises one finding
+        # (latched per shard; a fresh write — the respawn — re-arms it)
+        self.dead_process_timeout = (
+            None if dead_process_timeout is None
+            else float(dead_process_timeout)
+        )
+        self._dead_latched = set()
         self._thread = None
         self._stop = threading.Event()
 
@@ -245,6 +254,7 @@ class Watcher:
             return
         self._journal_straggler_check(shards, new)
         self._journal_slo_check(shards, new)
+        self._journal_dead_check(shards, new)
 
     def _journal_straggler_check(self, shards, new):
         """Straggler detection with no heartbeat dir and no shared
@@ -311,6 +321,40 @@ class Watcher:
                 }))
         else:
             self._journal_breaching = False
+
+    def _journal_dead_check(self, shards, new):
+        """Dead-process detection from OUTSIDE the blast radius: the
+        publisher bumps ``telemetry.publishes`` on every publish, so a
+        live process's shard stamp advances every interval even when the
+        workload is idle — a stamp stale past ``dead_process_timeout``
+        means the process stopped, not that it went quiet. One finding
+        per death (latched per shard); the respawned process reopens the
+        shard fresh, the stamp advances, and the latch re-arms."""
+        if self.dead_process_timeout is None:
+            return
+        now = time.time()
+        for name in sorted(shards):
+            replay = shards[name]
+            t = replay.meta.get("t")
+            if t is None:
+                continue
+            stale = now - float(t)
+            if stale > self.dead_process_timeout:
+                if name not in self._dead_latched:
+                    self._dead_latched.add(name)
+                    new.append(self._emit("dead_process", {
+                        "source": "journal",
+                        "shard": name,
+                        "rank": replay.meta.get("rank"),
+                        "pid": replay.meta.get("pid"),
+                        "stale_s": stale,
+                        "timeout_s": self.dead_process_timeout,
+                    }))
+            else:
+                self._dead_latched.discard(name)
+        metrics.set_gauge(
+            "watch.dead_processes", float(len(self._dead_latched))
+        )
 
     # -- public surface ----------------------------------------------------
     @property

@@ -49,3 +49,4 @@ from .health import (  # noqa: F401
     read_beat,
 )
 from .retry import backoff_delay, default_retryable, retry  # noqa: F401
+from .supervisor import Supervisor  # noqa: F401

@@ -53,8 +53,14 @@ so chaos exercises the exact failover path; a per-replica
 ``serving.dispatch.<name>`` seam rides along for targeted replica
 kills, and on a plain single-runner endpoint a raising kind fails the
 batch typed while ``hang`` wedges the scheduler — the failure mode the
-ReplicaSet exists to bound). The catalog is documented in README
-§Resilience.
+ReplicaSet exists to bound). The process-fleet worker protocol adds
+``serving.transport.send`` / ``serving.transport.recv`` (fired inside
+``serving.worker.send_msg``/``recv_msg`` on BOTH ends of the
+length-prefixed socket stream): raising kinds surface as a typed
+``TransportError`` the fleet's breaker + exactly-once failover absorb,
+and ``hang`` wedges one wire call until the attempt-timeout watchdog
+types it — transport chaos without killing any process. The catalog is
+documented in README §Resilience.
 """
 
 from __future__ import annotations

@@ -1,0 +1,263 @@
+"""Reusable child-process supervision core (the launcher loop, extracted).
+
+PR 2/3 grew a battle-tested supervision discipline inside
+``distributed/launch.py``: spawn children, poll them on a scan loop,
+declare a child HUNG when its heartbeat goes stale (SIGTERM, escalate to
+SIGKILL after a grace period, never block the scan), and route deaths
+through bounded full-jitter exponential restart backoff — each dead child
+gets its own independent deadline so same-tick deaths neither share a
+restart slot nor respawn in lockstep. The serving process fleet
+(``serving/fleet.py``) needs exactly the same discipline for its worker
+processes, so the loop now lives here as :class:`Supervisor` and both the
+elastic launcher and the fleet consume it.
+
+The class is policy-free where the two consumers differ:
+
+* ``spawn(key, attempt)`` builds (or rebuilds) the child — the launcher
+  passes its trainer spawner, the fleet its worker spawner;
+* ``clean_exit(rc, hung)`` classifies a return code — the launcher
+  treats ``PREEMPTION_EXIT_CODE`` (75) as clean only when the launcher
+  did not itself kill the child as hung;
+* ``restartable(key, rc, hung)`` gates the restart path BEFORE the
+  budget — the launcher returns False for rank 0 (it owns the JAX
+  coordination service; its death already doomed every peer) and for
+  non-``--elastic`` pods.
+
+:meth:`poll` is one scan tick: it never sleeps and returns the tick's
+structured events (``hung`` / ``exit_clean`` / ``restart_scheduled`` /
+``respawned`` / ``fatal``) so the caller owns logging, counters, and
+abort decisions. Child bookkeeping rides the same ``_paddle_*`` Popen
+attributes the launcher always used (``_paddle_spawned`` anchors
+heartbeat staleness for children that die before their first beat,
+``_paddle_hung`` taints the exit classification, ``_paddle_log`` is the
+append-on-restart log handle), so fake-process tests drive the loop
+unchanged.
+"""
+
+from __future__ import annotations
+
+import signal
+import subprocess
+import time
+
+__all__ = ["Supervisor", "kill_hung", "terminate_children"]
+
+# child states (internal; exposed via Supervisor.state for introspection)
+RUNNING = "running"
+PENDING = "pending"  # dead, restart scheduled, waiting out its backoff
+DONE = "done"        # exited clean; supervision over for this key
+FAILED = "failed"    # not restartable / budget exhausted; left dead
+
+
+def kill_hung(proc, grace=5.0):
+    """SIGTERM a hung child, escalating to SIGKILL after `grace` without
+    blocking the supervision scan (a rank stuck in a native collective
+    routinely ignores SIGTERM forever). Call once per scan tick while the
+    child stays both alive and stale."""
+    if getattr(proc, "_paddle_kill_at", None) is None:
+        proc._paddle_hung = True
+        proc._paddle_kill_at = time.monotonic() + grace
+        proc.send_signal(signal.SIGTERM)
+    elif time.monotonic() >= proc._paddle_kill_at:
+        proc.kill()
+
+
+def terminate_children(procs, grace=10.0):
+    """SIGTERM everyone, reap with a deadline, escalate to SIGKILL — a
+    child blocked in a native collective often defers SIGTERM forever and
+    would otherwise be orphaned holding its port. Closes the per-child
+    ``_paddle_log`` handles."""
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + grace
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+    for p in procs:
+        out = getattr(p, "_paddle_log", None)
+        if out is not None:
+            out.close()
+
+
+class _Child:
+    __slots__ = ("key", "proc", "state", "restarts", "deadline")
+
+    def __init__(self, key, proc):
+        self.key = key
+        self.proc = proc
+        self.state = RUNNING
+        self.restarts = 0
+        self.deadline = 0.0  # respawn-at (monotonic) while PENDING
+
+
+class Supervisor:
+    """Supervise a set of child processes with the launcher's discipline.
+
+    ``spawn(key, attempt)`` must return a Popen-like object; attempt 0 is
+    the first spawn, attempt N the Nth restart. ``staleness(proc,
+    now_wall)`` (with ``stale_after > 0``) enables the hung-child
+    watchdog: when it reports more seconds than ``stale_after``, the
+    child is SIGTERM→SIGKILLed and its death routed through the restart
+    path like any crash.
+    """
+
+    def __init__(self, spawn, *, max_restarts=3, backoff_base=0.5,
+                 backoff_cap=10.0, staleness=None, stale_after=0.0,
+                 clean_exit=None, restartable=None, kill_grace=5.0,
+                 rng=None, clock=time.monotonic, wall=time.time):
+        self._spawn = spawn
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self._staleness = staleness
+        self.stale_after = float(stale_after or 0.0)
+        self._clean_exit = clean_exit or (lambda rc, hung: rc == 0)
+        self._restartable = restartable or (lambda key, rc, hung: True)
+        self.kill_grace = float(kill_grace)
+        self._rng = rng
+        self._clock = clock
+        self._wall = wall
+        self._children = {}  # key -> _Child, insertion-ordered
+
+    # -- membership --------------------------------------------------------
+    def add(self, key, attempt=0):
+        """Spawn a new child under supervision; returns its proc."""
+        proc = self._spawn(key, attempt)
+        if getattr(proc, "_paddle_spawned", None) is None:
+            proc._paddle_spawned = self._wall()
+        self._children[key] = _Child(key, proc)
+        return proc
+
+    def adopt(self, key, proc):
+        """Supervise an already-running child (the launcher's shape: it
+        spawns the pod first, then hands the procs over)."""
+        if getattr(proc, "_paddle_spawned", None) is None:
+            proc._paddle_spawned = self._wall()
+        self._children[key] = _Child(key, proc)
+
+    def forget(self, key):
+        """Stop supervising `key` (a deliberate scale-in: the caller owns
+        the shutdown; no restart, no events). Returns its proc or None."""
+        child = self._children.pop(key, None)
+        return child.proc if child else None
+
+    def proc(self, key):
+        return self._children[key].proc
+
+    def keys(self):
+        return list(self._children)
+
+    def restarts(self, key):
+        return self._children[key].restarts
+
+    def state(self, key):
+        return self._children[key].state
+
+    def some_active(self):
+        """True while any child is running or awaiting a backoff respawn
+        (the caller's loop-termination test)."""
+        return any(
+            c.state in (RUNNING, PENDING) for c in self._children.values()
+        )
+
+    def live_procs(self):
+        return [
+            c.proc for c in self._children.values() if c.state == RUNNING
+        ]
+
+    # -- the scan ----------------------------------------------------------
+    def poll(self):
+        """One supervision tick over every child, in insertion order.
+        Never sleeps. Returns the tick's events, each a dict with at
+        least ``kind`` / ``key`` / ``proc``:
+
+        * ``hung`` — first detection of a stale heartbeat (the kill is
+          already underway; emitted once per hang);
+        * ``exit_clean`` — terminal; ``rc``;
+        * ``restart_scheduled`` — death routed to backoff; ``rc``,
+          ``hung``, ``attempt`` (1-based), ``delay``;
+        * ``respawned`` — a scheduled restart's deadline arrived and the
+          child was respawned; ``attempt``, ``proc`` is the NEW proc;
+        * ``fatal`` — terminal: not restartable or budget exhausted;
+          ``rc``, ``hung``, ``restarts``. The child is left dead; the
+          caller decides whether that aborts the whole set.
+        """
+        from .retry import backoff_delay
+
+        events = []
+        now = self._clock()
+        watch = self.stale_after > 0 and self._staleness is not None
+        now_wall = self._wall() if watch else 0.0
+        for child in list(self._children.values()):
+            if child.state in (DONE, FAILED):
+                continue
+            proc = child.proc
+            if child.state == PENDING:
+                if now >= child.deadline:
+                    log = getattr(proc, "_paddle_log", None)
+                    if log is not None:
+                        log.close()
+                    child.proc = self._spawn(child.key, child.restarts)
+                    if getattr(child.proc, "_paddle_spawned", None) is None:
+                        child.proc._paddle_spawned = self._wall()
+                    child.state = RUNNING
+                    events.append({
+                        "kind": "respawned", "key": child.key,
+                        "proc": child.proc, "attempt": child.restarts,
+                    })
+                continue
+            rc = proc.poll()
+            if rc is None:
+                if watch and self._staleness(proc, now_wall) \
+                        > self.stale_after:
+                    if getattr(proc, "_paddle_kill_at", None) is None:
+                        events.append({
+                            "kind": "hung", "key": child.key, "proc": proc,
+                            "stale_after": self.stale_after,
+                        })
+                    kill_hung(proc, self.kill_grace)
+                continue
+            hung = getattr(proc, "_paddle_hung", False)
+            if self._clean_exit(rc, hung):
+                child.state = DONE
+                events.append({
+                    "kind": "exit_clean", "key": child.key, "proc": proc,
+                    "rc": rc,
+                })
+                continue
+            n = child.restarts
+            if (not self._restartable(child.key, rc, hung)
+                    or n >= self.max_restarts):
+                child.state = FAILED
+                events.append({
+                    "kind": "fatal", "key": child.key, "proc": proc,
+                    "rc": rc, "hung": hung, "restarts": n,
+                })
+                continue
+            child.restarts = n + 1
+            delay = backoff_delay(
+                n + 1, self.backoff_base, self.backoff_cap, rng=self._rng
+            )
+            child.state = PENDING
+            child.deadline = now + delay
+            events.append({
+                "kind": "restart_scheduled", "key": child.key, "proc": proc,
+                "rc": rc, "hung": hung, "attempt": n + 1, "delay": delay,
+            })
+        return events
+
+    # -- teardown ----------------------------------------------------------
+    def terminate(self, grace=10.0):
+        """Terminate every child (running or pending): SIGTERM → reap
+        with a deadline → SIGKILL, close log handles, cancel pending
+        restarts. Safe to call twice."""
+        procs = [c.proc for c in self._children.values()]
+        for c in self._children.values():
+            if c.state in (RUNNING, PENDING):
+                c.state = DONE
+        terminate_children(procs, grace=grace)

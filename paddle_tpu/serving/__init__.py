@@ -18,6 +18,11 @@ path from that checkpoint to traffic (ROADMAP item 1):
 * :mod:`replica` — ``ReplicaSet``: N replicas behind one endpoint with
   per-replica circuit breakers, watchdog-bounded dispatch, exactly-once
   batch failover, and per-replica drain.
+* :mod:`worker` + :mod:`fleet` — ``ProcessReplicaSet``: the same runner
+  surface over N process-isolated ``serving.worker`` children (length-
+  prefixed socket protocol, supervised restart with full-jitter backoff,
+  least-inflight routing, failover under real SIGKILL) plus
+  ``FleetAutoscaler``, the brownout ladder's capacity-first rung.
 * :mod:`brownout` — ``BrownoutController``: turns sustained watcher
   ``slo_breach``/``step_regression`` findings into an adaptive
   degradation ladder (shrink max-wait, cap buckets, shed the background
@@ -39,6 +44,7 @@ the drain budget pro-rates across endpoints).
 from __future__ import annotations
 
 from .brownout import BrownoutController  # noqa: F401
+from .fleet import FleetAutoscaler, ProcessReplicaSet  # noqa: F401
 from .freeze import FrozenModel, freeze_program, load_frozen  # noqa: F401
 from .generate import GPTGenerator  # noqa: F401
 from .replica import ReplicaSet  # noqa: F401

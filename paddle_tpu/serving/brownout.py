@@ -40,10 +40,20 @@ computes itself from the latency histogram's bucket deltas via the
 shared ``observability.metrics.window_p99``) — and :meth:`start` wraps
 poll in a daemon thread.
 
+With an ``autoscaler=`` (a :class:`serving.fleet.FleetAutoscaler` over a
+process fleet) the ladder gains a rung BEFORE rung 1: sustained breach
+first ADDS capacity — spawn a worker, up to ``max_replicas`` — and an
+observation the autoscaler absorbed with a scale-out resets the
+escalation streak, so demand is never cut while the fleet can still
+grow. Sustained idle walks the same rung the other way (drain a worker,
+down to ``min_replicas``). Only a fleet at max size (or one breaching
+through the autoscaler's cooldown) falls through to the degradation
+rungs below.
+
 Observability: ``serving.brownout_level`` gauge (plus the per-endpoint
 ``serving.brownout_level.<ep>`` the endpoints maintain),
-``serving.brownout_escalations`` / ``serving.brownout_recoveries``
-counters.
+``serving.brownout_escalations`` / ``serving.brownout_recoveries`` /
+``serving.brownout_scale_outs`` counters.
 """
 
 from __future__ import annotations
@@ -74,7 +84,7 @@ class BrownoutController:
 
     def __init__(self, server, slo_p99_s=None, watcher=None,
                  ladder=DEFAULT_LADDER, escalate_after=2, recover_after=4,
-                 recover_margin=0.8, interval=0.5):
+                 recover_margin=0.8, interval=0.5, autoscaler=None):
         if len(ladder) < 2:
             raise InvalidArgumentError(
                 "brownout ladder needs >= 2 rungs (rung 0 = full service)"
@@ -91,6 +101,7 @@ class BrownoutController:
         self.recover_after = int(recover_after)
         self.recover_margin = float(recover_margin)
         self.interval = float(interval)
+        self.autoscaler = autoscaler
         self.latency_metric = "serving.request_latency"
         self.level = 0
         self._breach_obs = 0
@@ -119,6 +130,21 @@ class BrownoutController:
                 breach = True
             elif p99 <= self.slo_p99_s * self.recover_margin:
                 ok = not breach
+        # the ladder's rung-zero: capacity BEFORE degradation. A breach
+        # tick the autoscaler absorbs with a scale-out resets the
+        # escalation streak — demand is never cut while the fleet can
+        # still grow; only at max_replicas (or breaching through the
+        # autoscaler's cooldown) does the ladder trade service away.
+        action = None
+        if self.autoscaler is not None:
+            try:
+                action = self.autoscaler.observe(breach)
+            except Exception:
+                action = None
+            if action == "scale_out":
+                from .. import observability as _obs
+
+                _obs.add("serving.brownout_scale_outs")
         with self._lock:
             if breach:
                 self._breach_obs += 1
@@ -135,6 +161,8 @@ class BrownoutController:
                 # findings) leaves both streaks untouched.
                 self._breach_obs = 0
                 self._ok_obs = 0
+            if action == "scale_out":
+                self._breach_obs = 0
             changed = None
             if (breach and self._breach_obs >= self.escalate_after
                     and self.level < len(self.ladder) - 1):

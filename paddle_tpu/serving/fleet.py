@@ -1,0 +1,733 @@
+"""Process-isolated replica fleet: N worker processes behind one runner.
+
+PR 15's :class:`~paddle_tpu.serving.replica.ReplicaSet` hardened the
+serving fault domain, but every replica still shares one Python process —
+one GIL, one heap, one blast radius — so "failover" could only ever be an
+injected exception. :class:`ProcessReplicaSet` keeps the exact same
+runner surface (an ``Endpoint`` fronts it unchanged) and moves each
+replica into its own ``python -m paddle_tpu.serving.worker`` process:
+
+* **Supervised lifecycle** — workers are spawned and watched by the
+  :class:`~paddle_tpu.resilience.supervisor.Supervisor` extracted from
+  the elastic launcher: bounded full-jitter restart backoff, stale
+  heartbeat → SIGTERM→SIGKILL, independent per-worker restart deadlines.
+  A sentry thread turns supervisor events into rotation changes: a dead
+  worker leaves rotation the moment its corpse is reaped, rejoins only
+  after its respawn republishes a ready file (fresh pid) — and the
+  respawned worker re-warms its own buckets before that, so it rejoins
+  hot.
+* **Real-SIGKILL failover** — a killed worker's in-flight batch surfaces
+  as a typed :class:`~paddle_tpu.serving.worker.TransportError` inside
+  the breaker machinery, and PR 15's exactly-once re-route sends it to a
+  healthy peer (``serving.fleet.reroutes``) while the supervisor respawns
+  the corpse. The idempotency tokens are the router's request ids, so
+  at-most-twice execution still holds under genuine process death.
+* **Queue-depth routing** — dispatch picks the CLOSED replica with the
+  fewest in-flight batches (half-open probes keep absolute priority so
+  recovery happens under traffic), and ``max_concurrency`` tells the
+  Endpoint to run that many dispatch threads, which is what makes N
+  processes N-fold goodput instead of a serialized curiosity.
+* **Elastic capacity** — :meth:`try_scale_out` spawns one more worker
+  (to ``max_replicas``), :meth:`scale_in` drains one (to
+  ``min_replicas``); :class:`FleetAutoscaler` drives both from Watcher
+  findings and is mounted as the brownout ladder's FIRST rung, so
+  sustained SLO breach adds capacity before any request is shed.
+
+``close()`` tears the whole pod down — drain, shutdown messages,
+supervisor SIGTERM→SIGKILL sweep — and is what the "zero orphan
+processes" CI assertion holds to account.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from ..errors import ExecutionTimeoutError, InvalidArgumentError, \
+    UnavailableError
+from .replica import CLOSED, HALF_OPEN, OPEN, ReplicaSet, _Replica
+from .worker import TransportError, recv_msg, send_msg
+
+__all__ = ["FleetAutoscaler", "ProcessReplicaSet"]
+
+
+def _typed_remote_error(etype, msg):
+    """Rehydrate a worker-side error by taxonomy name; unknown names
+    degrade to UnavailableError (still typed, still retryable-ish)."""
+    from .. import errors as _errors
+
+    cls = getattr(_errors, etype, None)
+    if isinstance(cls, type) and issubclass(cls, Exception):
+        return cls(msg)
+    return UnavailableError(f"worker error {etype}: {msg}")
+
+
+class _WorkerClient:
+    """Runner-surface client for one worker process.
+
+    The contract (feed/fetch names, per-sample specs) comes from the
+    worker's ready file, not from loading the model — the parent never
+    holds the executable. ``call`` runs under a per-client lock (one
+    in-order request/reply stream per worker); replies are matched by id
+    and stale ids (a straggler from an abandoned attempt) are discarded
+    (``serving.fleet.stale_replies``) instead of desynchronizing the
+    stream. Socket-level failures close the connection and surface
+    typed: OS errors / torn frames as :class:`TransportError`
+    (UnavailableError → breaker + failover), timeouts as
+    :class:`ExecutionTimeoutError`.
+    """
+
+    def __init__(self, name, ready, io_timeout=None, connect_timeout=5.0):
+        self.name = name
+        self.inflight = 0
+        self._io_timeout = io_timeout
+        self._connect_timeout = float(connect_timeout)
+        self._lock = threading.Lock()
+        self._sock = None
+        self._seq = itertools.count(1)
+        self._bind(ready, first=True)
+
+    def _bind(self, ready, first=False):
+        """Adopt a (re)published ready contract: host/port/pid of the
+        current incarnation. On rebind the old socket is dropped."""
+        import numpy as np
+
+        self.pid = int(ready["pid"])
+        self.host = ready["host"]
+        self.port = int(ready["port"])
+        self.attempt = int(ready.get("attempt", 0))
+        feed = tuple(ready["feed_names"])
+        fetch = tuple(ready["fetch_names"])
+        specs = {
+            n: (tuple(shape), np.dtype(dt))
+            for n, (shape, dt) in ready["sample_specs"].items()
+        }
+        if first:
+            self.feed_names, self.fetch_names = feed, fetch
+            self._specs = specs
+        elif feed != self.feed_names or fetch != self.fetch_names:
+            raise InvalidArgumentError(
+                f"worker {self.name!r} respawned with a different "
+                f"contract: feeds {feed} fetches {fetch}"
+            )
+        with self._lock:
+            self._drop_socket()
+
+    def rebind(self, ready):
+        self._bind(ready, first=False)
+
+    # -- runner surface ----------------------------------------------------
+    def sample_spec(self, name):
+        return self._specs[name]
+
+    def run(self, feed):
+        reply = self.call("run", {"feed": feed})
+        if reply["kind"] == "error":
+            raise _typed_remote_error(reply["etype"], reply["msg"])
+        return reply["outs"]
+
+    # -- wire --------------------------------------------------------------
+    def _drop_socket(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _connected(self):
+        if self._sock is None:
+            s = socket.create_connection(
+                (self.host, self.port), timeout=self._connect_timeout
+            )
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.settimeout(self._io_timeout)
+            self._sock = s
+        return self._sock
+
+    def call(self, kind, payload=None, timeout=None):
+        """One request/reply exchange; returns the reply dict."""
+        from .. import observability as _obs
+
+        mid = f"{self.name}:{next(self._seq)}"
+        msg = {"kind": kind, "id": mid}
+        if payload:
+            msg.update(payload)
+        with self._lock:
+            try:
+                sock = self._connected()
+                if timeout is not None:
+                    sock.settimeout(timeout)
+                send_msg(sock, msg)
+                while True:
+                    reply = recv_msg(sock)
+                    if reply is None:
+                        raise TransportError(
+                            f"worker {self.name!r} (pid {self.pid}) "
+                            "closed the connection mid-call"
+                        )
+                    if reply.get("id") != mid:
+                        # straggler from an attempt the watchdog already
+                        # abandoned: recognized by id, dropped, stream
+                        # stays usable
+                        _obs.add("serving.fleet.stale_replies")
+                        continue
+                    return reply
+            except socket.timeout as exc:
+                # a timed-out read may sit mid-frame: the stream is no
+                # longer framed-aligned, so the connection is burned
+                self._drop_socket()
+                raise ExecutionTimeoutError(
+                    f"worker {self.name!r} (pid {self.pid}) exceeded "
+                    f"its reply timeout"
+                ) from exc
+            except TransportError:
+                self._drop_socket()
+                raise
+            except OSError as exc:
+                self._drop_socket()
+                raise TransportError(
+                    f"worker {self.name!r} (pid {self.pid}) transport "
+                    f"failed: {exc}"
+                ) from exc
+            finally:
+                if timeout is not None and self._sock is not None:
+                    self._sock.settimeout(self._io_timeout)
+
+    def shutdown(self, timeout=5.0):
+        """Ask the worker to exit cleanly (the scale-in path)."""
+        try:
+            reply = self.call("shutdown", timeout=timeout)
+            return reply.get("kind") == "bye"
+        except Exception:
+            return False
+
+    def close(self):
+        with self._lock:
+            self._drop_socket()
+
+
+class ProcessReplicaSet(ReplicaSet):
+    """N process-isolated workers behind the ReplicaSet runner surface.
+
+    ``model_dir`` is a ``FrozenModel.save`` export; each worker loads it
+    into its own process. The set plugs straight into
+    ``Server.add_endpoint`` — ``max_concurrency`` additionally tells the
+    Endpoint to dispatch that many batches in parallel.
+    """
+
+    def __init__(self, model_dir, n_workers=2, *, max_replicas=None,
+                 min_replicas=1, warm_buckets=(), breaker_threshold=2,
+                 cooldown_s=2.0, attempt_timeout=10.0,
+                 heartbeat_timeout=10.0, max_restarts=3,
+                 restart_backoff=0.25, restart_backoff_cap=5.0,
+                 spawn_timeout=60.0, workdir=None, name="fleet",
+                 host="127.0.0.1", env=None, python=None):
+        from .. import observability as _obs
+        from ..resilience.health import PREEMPTION_EXIT_CODE, \
+            heartbeat_path
+        from ..resilience.supervisor import Supervisor
+
+        if int(n_workers) < 1:
+            raise InvalidArgumentError(
+                f"ProcessReplicaSet needs >= 1 worker, got {n_workers}"
+            )
+        self.model_dir = os.fspath(model_dir)
+        self.n_workers = int(n_workers)
+        self.max_replicas = int(max_replicas or n_workers)
+        self.min_replicas = max(1, int(min_replicas))
+        self.warm_buckets = tuple(int(b) for b in warm_buckets)
+        self.spawn_timeout = float(spawn_timeout)
+        self.host = host
+        self._python = python or sys.executable
+        self._extra_env = dict(env or {})
+        self._preemption_rc = PREEMPTION_EXIT_CODE
+        if workdir is None:
+            workdir = tempfile.mkdtemp(prefix="paddle-fleet-")
+        self.workdir = workdir
+        self._hb_dir = os.path.join(workdir, "hb")
+        self._telemetry_dir = os.path.join(workdir, "telemetry")
+        self._log_dir = os.path.join(workdir, "logs")
+        for d in (self._hb_dir, self._telemetry_dir, self._log_dir):
+            os.makedirs(d, exist_ok=True)
+
+        self._next_rank = 0
+        self._ranks = {}        # worker name -> rank (hb shard id)
+        self._clients = {}      # worker name -> _WorkerClient
+        self._pending = {}      # worker name -> (proc, deadline) awaiting ready
+        self._sup_lock = threading.Lock()
+        # io timeout: the attempt watchdog types the caller-side timeout;
+        # the socket deadline just frees the dispatch thread shortly after
+        io_timeout = (
+            None if attempt_timeout is None else float(attempt_timeout) + 2.0
+        )
+        self._io_timeout = io_timeout
+        self._sup = Supervisor(
+            spawn=self._spawn_worker,
+            max_restarts=max_restarts,
+            backoff_base=restart_backoff,
+            backoff_cap=restart_backoff_cap,
+            staleness=self._beat_staleness,
+            stale_after=float(heartbeat_timeout) * 2.0,
+            clean_exit=lambda rc, hung: not hung and rc in (
+                0, PREEMPTION_EXIT_CODE
+            ),
+        )
+
+        names = [self._new_name() for _ in range(self.n_workers)]
+        with self._sup_lock:
+            for wname in names:
+                self._sup.add(wname)
+                _obs.add("serving.fleet.spawns")
+        for wname in names:
+            ready = self._wait_ready(
+                wname, self._proc(wname), self.spawn_timeout
+            )
+            self._clients[wname] = _WorkerClient(
+                wname, ready, io_timeout=io_timeout
+            )
+
+        super().__init__(
+            dict(self._clients),
+            breaker_threshold=breaker_threshold,
+            cooldown_s=cooldown_s,
+            attempt_timeout=attempt_timeout,
+            heartbeats={
+                n: heartbeat_path(self._hb_dir, self._ranks[n])
+                for n in self._clients
+            },
+            heartbeat_timeout=heartbeat_timeout,
+            name=name,
+        )
+
+        # the chaos CI asserts these names EXIST even at zero — a run
+        # with no deaths must still prove the counters are wired
+        for c in ("spawns", "respawns", "reroutes", "worker_deaths",
+                  "scale_outs", "scale_ins"):
+            _obs.add(f"serving.fleet.{c}", 0)
+        _obs.set_gauge("serving.fleet.size", float(self.n_workers))
+
+        self.first_scale_out_state = None
+        self._stop = threading.Event()
+        self._sentry = threading.Thread(
+            target=self._sentry_loop, daemon=True,
+            name=f"fleet-sentry-{name}",
+        )
+        self._sentry.start()
+
+    # endpoints read this to size their dispatch pool: dispatching
+    # serially to N processes would serialize them right back
+    @property
+    def max_concurrency(self):
+        return self.max_replicas
+
+    # -- spawning ----------------------------------------------------------
+    def _new_name(self):
+        rank = self._next_rank
+        self._next_rank += 1
+        wname = f"w{rank}"
+        self._ranks[wname] = rank
+        return wname
+
+    def _ready_path(self, wname):
+        return os.path.join(self.workdir, f"ready_{wname}.json")
+
+    def _spawn_worker(self, wname, attempt):
+        """Supervisor spawn hook: build one worker process."""
+        rank = self._ranks[wname]
+        ready = self._ready_path(wname)
+        # a stale ready file from the previous incarnation must never be
+        # mistaken for the respawn's readiness: pid match guards it, but
+        # removing it up front makes the wait unambiguous
+        try:
+            os.unlink(ready)
+        except OSError:
+            pass
+        cmd = [
+            self._python, "-m", "paddle_tpu.serving.worker",
+            "--model-dir", self.model_dir,
+            "--ready-file", ready,
+            "--host", self.host,
+            "--name", wname,
+            "--attempt", str(attempt),
+        ]
+        if self.warm_buckets:
+            cmd += [
+                "--warm-buckets",
+                ",".join(str(b) for b in self.warm_buckets),
+            ]
+        env = dict(os.environ)
+        env.update(self._extra_env)
+        env["PADDLE_HEARTBEAT_DIR"] = self._hb_dir
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TPU_TELEMETRY_DIR"] = self._telemetry_dir
+        log = open(
+            os.path.join(self._log_dir, f"{wname}.log"), "ab"
+        )
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        proc._paddle_rank = rank
+        proc._paddle_log = log
+        return proc
+
+    def _proc(self, wname):
+        with self._sup_lock:
+            return self._sup.proc(wname)
+
+    def _beat_staleness(self, proc, now_wall):
+        from ..resilience.health import heartbeat_path, read_beat
+
+        path = heartbeat_path(
+            self._hb_dir, getattr(proc, "_paddle_rank", 0)
+        )
+        beat = read_beat(path)
+        if beat and "time" in beat:
+            stale = now_wall - float(beat["time"])
+        else:
+            stale = now_wall - getattr(proc, "_paddle_spawned", now_wall)
+        return max(0.0, stale)
+
+    def _read_ready(self, wname, proc):
+        """The current incarnation's ready contract, or None. A pid
+        mismatch is a stale file from a dead incarnation."""
+        try:
+            with open(self._ready_path(wname)) as f:
+                ready = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if int(ready.get("pid", -1)) != proc.pid:
+            return None
+        return ready
+
+    def _wait_ready(self, wname, proc, timeout):
+        """Block until `wname` publishes readiness (initial spawn path)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ready = self._read_ready(wname, proc)
+            if ready is not None:
+                return ready
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        rc = proc.poll()
+        raise UnavailableError(
+            f"fleet worker {wname!r} never became ready within "
+            f"{timeout}s"
+            + (f" (exited rc={rc})" if rc is not None else "")
+            + f"; log: {os.path.join(self._log_dir, wname + '.log')}"
+        )
+
+    # -- routing: least-inflight over CLOSED, probes keep priority ---------
+    def _pick(self, exclude):
+        now = self._clock()
+        with self._lock:
+            closed, probe = [], None
+            for rep in self._order:
+                if rep.name in exclude or rep.draining:
+                    continue
+                if not self._beat_ok(rep):
+                    continue
+                if rep.state == CLOSED:
+                    closed.append(rep)
+                elif probe is None and (
+                        (rep.state == OPEN
+                         and now - rep.opened_at >= self.cooldown_s)
+                        or (rep.state == HALF_OPEN and not rep.probing)):
+                    probe = rep
+            if probe is not None:
+                probe.state = HALF_OPEN
+                probe.probing = True
+                self._gauge(probe)
+                return probe
+            if closed:
+                lo = min(r.runner.inflight for r in closed)
+                cands = [r for r in closed if r.runner.inflight == lo]
+                self._rr += 1
+                return cands[self._rr % len(cands)]
+            return None
+
+    def _dispatch(self, rep):
+        inner = super()._dispatch(rep)
+        client = rep.runner
+
+        def attempt(feed):
+            with self._lock:
+                client.inflight += 1
+            try:
+                return inner(feed)
+            finally:
+                with self._lock:
+                    client.inflight -= 1
+
+        return attempt
+
+    def _note_failover(self, n):
+        from .. import observability as _obs
+
+        _obs.add("serving.fleet.reroutes")
+
+    # -- sentry: supervisor events -> rotation membership ------------------
+    def _sentry_loop(self):
+        while not self._stop.wait(0.2):
+            try:
+                with self._sup_lock:
+                    events = self._sup.poll()
+                for ev in events:
+                    self._on_event(ev)
+                self._poll_pending()
+            except Exception:  # the sentry must outlive any one tick
+                if self._stop.is_set():
+                    return
+
+    def _on_event(self, ev):
+        from .. import observability as _obs
+
+        wname, kind = ev["key"], ev["kind"]
+        if kind == "hung":
+            _obs.add("serving.fleet.hung_workers")
+        elif kind == "restart_scheduled":
+            # worker died: out of rotation NOW (the breaker would get
+            # there after threshold failures; the supervisor knows
+            # sooner), in-flight batches fail over via the normal path
+            _obs.add("serving.fleet.worker_deaths")
+            self._set_draining(wname, True)
+            client = self._clients.get(wname)
+            if client is not None:
+                client.close()
+        elif kind == "respawned":
+            self._pending[wname] = (
+                ev["proc"], time.monotonic() + self.spawn_timeout
+            )
+        elif kind in ("fatal", "exit_clean"):
+            # fatal: restart budget exhausted — the worker stays out.
+            # exit_clean outside scale-in (which forgets first): same.
+            if kind == "fatal":
+                _obs.add("serving.fleet.dead_ends")
+            self._set_draining(wname, True)
+            client = self._clients.get(wname)
+            if client is not None:
+                client.close()
+        self._publish_size()
+
+    def _poll_pending(self):
+        """Promote respawned workers whose fresh ready file landed."""
+        from .. import observability as _obs
+
+        for wname in list(self._pending):
+            proc, deadline = self._pending[wname]
+            ready = self._read_ready(wname, proc)
+            if ready is not None:
+                try:
+                    self._clients[wname].rebind(ready)
+                except InvalidArgumentError:
+                    del self._pending[wname]
+                    continue
+                del self._pending[wname]
+                self.restore_replica(wname)
+                _obs.add("serving.fleet.respawns")
+                self._publish_size()
+            elif time.monotonic() > deadline or proc.poll() is not None:
+                # let the supervisor's own poll route the death; just
+                # stop waiting on this incarnation
+                if proc.poll() is None:
+                    del self._pending[wname]
+
+    def _set_draining(self, wname, flag):
+        try:
+            rep = self._find(wname)
+        except InvalidArgumentError:
+            return
+        with self._lock:
+            rep.draining = bool(flag)
+
+    def _publish_size(self):
+        from .. import observability as _obs
+
+        _obs.set_gauge("serving.fleet.size", float(self.healthy_count()))
+
+    # -- elastic capacity --------------------------------------------------
+    def healthy_count(self):
+        with self._lock:
+            return sum(1 for rep in self._order if not rep.draining)
+
+    def worker_pids(self):
+        """Live worker pids (the orphan-check surface for tests/CI)."""
+        with self._sup_lock:
+            return [p.pid for p in self._sup.live_procs()]
+
+    def try_scale_out(self):
+        """Spawn one more worker (async: it enters rotation when ready).
+        False when already at ``max_replicas``. The FIRST scale-out
+        snapshots ``serving.shed`` so the chaos leg can prove capacity
+        was added before any shedding."""
+        from .. import observability as _obs
+
+        with self._lock:
+            active = sum(1 for rep in self._order if not rep.draining)
+            pending = len(self._pending)
+        if active + pending >= self.max_replicas:
+            return False
+        wname = self._new_name()
+        with self._sup_lock:
+            proc = self._sup.add(wname)
+        _obs.add("serving.fleet.spawns")
+        if self.first_scale_out_state is None:
+            counters = _obs.get_counters()
+            self.first_scale_out_state = {
+                "shed": counters.get("serving.shed", 0),
+                "time": time.time(),
+            }
+        # placeholder replica, draining until its ready file lands —
+        # the sentry's pending machinery flips it live
+        from ..resilience.health import heartbeat_path
+
+        beat_path = heartbeat_path(self._hb_dir, self._ranks[wname])
+        client = _WorkerClient.__new__(_WorkerClient)
+        client.name = wname
+        client.inflight = 0
+        client._io_timeout = self._io_timeout
+        client._connect_timeout = 5.0
+        client._lock = threading.Lock()
+        client._sock = None
+        client._seq = itertools.count(1)
+        client.feed_names = self.feed_names
+        client.fetch_names = self.fetch_names
+        client._specs = {
+            n: self.sample_spec(n) for n in self.feed_names
+        }
+        client.pid = -1
+        client.host, client.port, client.attempt = self.host, -1, 0
+        self._clients[wname] = client
+        rep = _Replica(wname, client, beat_path)
+        rep.draining = True
+        with self._lock:
+            self._order.append(rep)
+            self._gauge(rep)
+        self._pending[wname] = (
+            proc, time.monotonic() + self.spawn_timeout
+        )
+        _obs.add("serving.fleet.scale_outs")
+        return True
+
+    def scale_in(self):
+        """Drain one worker (clean shutdown, supervision forgotten).
+        False at the ``min_replicas`` floor. Prefers the idlest live
+        worker, latest-spawned on ties."""
+        from .. import observability as _obs
+
+        with self._lock:
+            live = [rep for rep in self._order if not rep.draining]
+            if len(live) <= self.min_replicas:
+                return False
+            victim = min(
+                reversed(live), key=lambda r: r.runner.inflight
+            )
+            victim.draining = True
+        with self._sup_lock:
+            proc = self._sup.forget(victim.name)
+        client = self._clients.get(victim.name)
+        if client is not None:
+            client.shutdown()
+            client.close()
+        if proc is not None:
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            log = getattr(proc, "_paddle_log", None)
+            if log is not None:
+                log.close()
+        _obs.add("serving.fleet.scale_ins")
+        self._publish_size()
+        return True
+
+    # -- teardown ----------------------------------------------------------
+    def drain(self, timeout=None):
+        """Endpoint-drain hook: nothing queued fleet-side; the router owns
+        the queues. Present so Server.drain treats the runner uniformly."""
+        return True
+
+    def close(self, grace=5.0):
+        """Full teardown: stop the sentry, ask every worker to exit,
+        then SIGTERM→SIGKILL the stragglers. Leaves zero orphans."""
+        from .. import observability as _obs
+
+        self._stop.set()
+        self._sentry.join(timeout=5.0)
+        with self._lock:
+            for rep in self._order:
+                rep.draining = True
+        for client in self._clients.values():
+            client.shutdown(timeout=1.0)
+            client.close()
+        with self._sup_lock:
+            self._sup.terminate(grace=grace)
+        _obs.add("serving.fleet.closes")
+        _obs.set_gauge("serving.fleet.size", 0.0)
+
+
+class FleetAutoscaler:
+    """Findings → fleet size. The brownout ladder's first rung.
+
+    ``observe(breach)`` is called once per control tick (the
+    BrownoutController's poll cadence). ``breach_after`` consecutive
+    breach ticks scale OUT (capacity before shedding); ``idle_after``
+    consecutive idle ticks — no breach AND zero new requests, measured
+    as a ``serving.requests`` counter delta — scale IN. ``cooldown_s``
+    separates consecutive actions so one sustained breach adds workers
+    one at a time, watching each addition land.
+    """
+
+    def __init__(self, fleet, breach_after=2, idle_after=10,
+                 cooldown_s=15.0, clock=time.monotonic):
+        self.fleet = fleet
+        self.breach_after = int(breach_after)
+        self.idle_after = int(idle_after)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._breach_streak = 0
+        self._idle_streak = 0
+        self._last_action_at = None
+        self._last_requests = None
+
+    def _requests_idle(self):
+        from .. import observability as _obs
+
+        cur = _obs.get_counters().get("serving.requests", 0)
+        prev, self._last_requests = self._last_requests, cur
+        return prev is not None and cur == prev
+
+    def observe(self, breach, idle=None):
+        """One control tick. Returns "scale_out", "scale_in", or None."""
+        if idle is None:
+            idle = (not breach) and self._requests_idle()
+        elif breach:
+            idle = False
+        self._breach_streak = self._breach_streak + 1 if breach else 0
+        self._idle_streak = self._idle_streak + 1 if idle else 0
+        now = self._clock()
+        if (self._last_action_at is not None
+                and now - self._last_action_at < self.cooldown_s):
+            return None
+        if self._breach_streak >= self.breach_after:
+            if self.fleet.try_scale_out():
+                self._breach_streak = 0
+                self._last_action_at = now
+                return "scale_out"
+            return None
+        if self._idle_streak >= self.idle_after:
+            if self.fleet.scale_in():
+                self._idle_streak = 0
+                self._last_action_at = now
+                return "scale_in"
+        return None

@@ -223,10 +223,15 @@ class ReplicaSet:
                 _obs.add("serving.failovers")
                 _obs.add("serving.requeued", n)
                 _obs.add(f"serving.requeued.{self.name}", n)
+                self._note_failover(n)
                 continue
             self._on_success(rep)
             _obs.add(f"serving.replica_dispatches.{rep.name}")
             return out
+
+    def _note_failover(self, n):
+        """Extension point: a subclass records its own failover metric
+        (the process fleet counts ``serving.fleet.reroutes`` here)."""
 
     def _dispatch(self, rep):
         from ..resilience.faults import fault_point

@@ -72,8 +72,10 @@ bound).
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import math
+import queue as _queue
 import threading
 import time
 from collections import deque
@@ -247,6 +249,26 @@ class Endpoint:
             max_attempts=3, base_delay=0.005, max_delay=0.1,
             name="serving.ingest",
         )
+        # runners fronting N processes (ProcessReplicaSet) advertise
+        # max_concurrency: that many dispatcher threads run batches in
+        # parallel — serial dispatch would serialize N workers right
+        # back into single-process throughput. The handoff queue is
+        # maxsize=1 so the batch-former stages at most one batch ahead
+        # (backpressure, not an unbounded buffer).
+        self._concurrency = max(
+            1, int(getattr(runner, "max_concurrency", 1) or 1)
+        )
+        self._dispatch_q = None
+        self._dispatchers = []
+        if self._concurrency > 1:
+            self._dispatch_q = _queue.Queue(maxsize=1)
+            for i in range(self._concurrency):
+                t = threading.Thread(
+                    target=self._dispatch_loop, daemon=True,
+                    name=f"serving-{name}-d{i}",
+                )
+                t.start()
+                self._dispatchers.append(t)
         self._thread = threading.Thread(
             target=self._schedule_loop, daemon=True,
             name=f"serving-{name}",
@@ -498,7 +520,7 @@ class Endpoint:
                 while not self._qsize_locked() and not self._stopped:
                     self._cond.wait(0.05)
                 if self._stopped and not self._qsize_locked():
-                    return
+                    break
                 # already-expired requests leave BEFORE batch formation:
                 # late work never pads a bucket or burns a dispatch
                 expired.extend(self._drop_expired_locked())
@@ -541,7 +563,26 @@ class Endpoint:
                     self._gauge_depth_locked()
             self._resolve_expired(expired)
             if batch:
-                self._run_batch(batch, bucket)
+                if self._dispatch_q is not None:
+                    self._dispatch_q.put((batch, bucket))
+                else:
+                    self._run_batch(batch, bucket)
+        # drain path: every staged batch runs before the scheduler
+        # thread exits — Server.drain joins THIS thread, so "drained"
+        # still means every admitted request resolved
+        if self._dispatch_q is not None:
+            for _ in self._dispatchers:
+                self._dispatch_q.put(None)
+            for t in self._dispatchers:
+                t.join()
+
+    def _dispatch_loop(self):
+        """One dispatcher: runs staged batches until the sentinel."""
+        while True:
+            item = self._dispatch_q.get()
+            if item is None:
+                return
+            self._run_batch(*item)
 
     def _bucket_for_locked(self, n):
         buckets = self._effective_buckets()
@@ -577,7 +618,14 @@ class Endpoint:
                     )
                     rows = np.concatenate([rows, pad], axis=0)
                 feed[name] = rows
-            with self._run_lock:
+            # concurrent dispatchers skip the run lock: a runner that
+            # declared max_concurrency > 1 (the process fleet) is
+            # thread-safe by contract, and serializing here would undo it
+            guard = (
+                contextlib.nullcontext() if self._concurrency > 1
+                else self._run_lock
+            )
+            with guard:
                 # the live dispatch span (and everything the runner
                 # records inside: executor.step, GPT prefill/decode)
                 # files under the FIRST request's trace; the other
@@ -781,6 +829,21 @@ class Server:
 
     def wait_drained(self, timeout=None):
         return self._drained.wait(timeout)
+
+    def close(self, timeout=None):
+        """Drain, then release runner-held resources: every runner
+        exposing ``close`` (the process fleet's worker pod) is torn
+        down. Zero orphan worker processes after this call is the
+        contract the fleet-chaos CI stage asserts."""
+        from .. import observability as _obs
+
+        ok = self.drain(timeout)
+        for ep in self._endpoints.values():
+            close = getattr(ep.runner, "close", None)
+            if close is not None:
+                close()
+        _obs.add("serving.server_closes")
+        return ok
 
 
 def install_preemption_handler(server, exit_on_drain=True, timeout=None):

@@ -1,0 +1,642 @@
+"""Process replica fleet (r17): the worker wire protocol (framing, torn
+reads, oversized refusal, chaos seams), the extracted Supervisor loop,
+worker-death-mid-batch surfacing typed, the least-inflight client plumbing,
+the FleetAutoscaler / brownout capacity rung, the Watcher's dead_process
+finding, and fleet_report's --stale-after twin.
+
+Everything here runs against fake sockets, fake procs, and hand-written
+journal records so the suite stays fast; the real 4-process fleet — spawn,
+SIGKILL, exactly-once failover, scale-out-before-shed, zero orphans — is
+bench_serving.py's ``--fleet --fleet-kill`` leg, gated by ci.sh's
+fleet-chaos stage."""
+
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.errors import (
+    ExecutionTimeoutError,
+    InvalidArgumentError,
+    UnavailableError,
+)
+from paddle_tpu.observability import watch
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.supervisor import Supervisor
+from paddle_tpu.serving import fleet as fleet_mod
+from paddle_tpu.serving.brownout import BrownoutController
+from paddle_tpu.serving.router import Endpoint, EndpointConfig
+from paddle_tpu.serving.worker import (
+    TransportError,
+    bind_serving_socket,
+    recv_msg,
+    send_msg,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    obs.reset()
+    obs.set_enabled(True)
+    faults.clear()
+    yield
+    faults.clear()
+    obs.reset()
+    obs.set_enabled(None)
+
+
+def _counter(name):
+    return obs.get_counters().get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# wire framing: send_msg / recv_msg
+# ---------------------------------------------------------------------------
+
+
+def test_framing_round_trips_numpy_payloads():
+    a, b = socket.socketpair()
+    try:
+        msg = {
+            "kind": "run", "id": "w0:1",
+            "feed": {"x": np.arange(6, dtype=np.float32).reshape(2, 3)},
+        }
+        send_msg(a, msg)
+        send_msg(a, {"kind": "ping", "id": "w0:2"})
+        got = recv_msg(b)
+        assert got["kind"] == "run" and got["id"] == "w0:1"
+        np.testing.assert_array_equal(
+            got["feed"]["x"], msg["feed"]["x"]
+        )
+        assert recv_msg(b)["id"] == "w0:2"  # back-to-back frames stay aligned
+    finally:
+        a.close(), b.close()
+
+
+def test_clean_eof_at_frame_boundary_is_none_not_error():
+    a, b = socket.socketpair()
+    send_msg(a, {"kind": "ping", "id": "x"})
+    a.close()
+    try:
+        assert recv_msg(b)["kind"] == "ping"
+        assert recv_msg(b) is None  # peer closed BETWEEN frames: clean
+    finally:
+        b.close()
+
+
+def test_torn_frame_raises_typed_not_hangs():
+    a, b = socket.socketpair()
+    # half a header, then death — the SIGKILL-mid-write shape
+    a.sendall(b"\x00\x00\x00")
+    a.close()
+    try:
+        with pytest.raises(TransportError, match="mid-frame"):
+            recv_msg(b)
+    finally:
+        b.close()
+
+
+def test_oversized_send_refused_before_any_bytes_leave():
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(TransportError, match="refusing to send"):
+            send_msg(a, {"blob": b"x" * 4096}, max_frame=64)
+        # nothing was written: the stream is still usable for a good frame
+        send_msg(a, {"kind": "ping", "id": "ok"})
+        assert recv_msg(b)["id"] == "ok"
+    finally:
+        a.close(), b.close()
+
+
+def test_oversized_length_prefix_refused_on_recv():
+    a, b = socket.socketpair()
+    try:
+        send_msg(a, {"blob": b"y" * 4096})
+        with pytest.raises(TransportError, match="refusing"):
+            recv_msg(b, max_frame=64)
+    finally:
+        a.close(), b.close()
+
+
+def test_transport_chaos_seams_fire_on_both_ends():
+    a, b = socket.socketpair()
+    try:
+        faults.inject("serving.transport.send", "unavailable", prob=1.0,
+                      max_fires=1)
+        with pytest.raises(UnavailableError):
+            send_msg(a, {"kind": "ping", "id": "1"})
+        send_msg(a, {"kind": "ping", "id": "2"})  # healed after max_fires
+        faults.inject("serving.transport.recv", "unavailable", prob=1.0,
+                      max_fires=1)
+        with pytest.raises(UnavailableError):
+            recv_msg(b)
+        assert recv_msg(b)["id"] == "2"
+        assert _counter(
+            "resilience.faults_injected.serving.transport.send") == 1
+        assert _counter(
+            "resilience.faults_injected.serving.transport.recv") == 1
+    finally:
+        faults.clear()
+        a.close(), b.close()
+
+
+def test_double_spawn_port_collision_falls_back_to_ephemeral():
+    srv1, port1 = bind_serving_socket("127.0.0.1", 0)
+    try:
+        # second spawn asks for the SAME explicit port: must come up
+        # anyway on a fresh one and report the real port
+        srv2, port2 = bind_serving_socket("127.0.0.1", port1)
+        try:
+            assert port2 != port1 and port2 > 0
+            assert _counter("serving.worker.port_fallbacks") == 1
+        finally:
+            srv2.close()
+    finally:
+        srv1.close()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: the extracted launcher loop on fake procs
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    """Popen-shaped: the test scripts its exit via .rc."""
+
+    _ids = iter(range(10_000, 99_999))
+
+    def __init__(self):
+        self.pid = next(self._ids)
+        self.rc = None
+        self.signals = []
+
+    def poll(self):
+        return self.rc
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+    def kill(self):
+        self.signals.append("KILL")
+        self.rc = -9
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _mk_sup(clock, **kw):
+    spawned = []
+
+    def spawn(key, attempt):
+        proc = _FakeProc()
+        proc._paddle_spawned = clock.t
+        spawned.append((key, attempt, proc))
+        return proc
+
+    kw.setdefault("backoff_base", 0.5)
+    kw.setdefault("backoff_cap", 0.5)  # cap==base: delay is deterministic
+    sup = Supervisor(spawn, clock=clock, wall=clock, **kw)
+    return sup, spawned
+
+
+def test_supervisor_routes_death_through_backoff_then_respawns():
+    clock = _Clock()
+    sup, spawned = _mk_sup(clock, max_restarts=3)
+    p0 = sup.add("w0")
+    assert sup.poll() == []  # healthy tick: no events
+    p0.rc = -9  # SIGKILL
+    (ev,) = sup.poll()
+    assert ev["kind"] == "restart_scheduled"
+    assert ev["attempt"] == 1 and 0 < ev["delay"] <= 0.5
+    assert sup.poll() == []  # still inside the backoff window
+    clock.t += 1.0
+    (ev,) = sup.poll()
+    assert ev["kind"] == "respawned" and ev["key"] == "w0"
+    assert ev["proc"] is not p0 and sup.state("w0") == "running"
+    assert spawned[-1] == ("w0", 1, ev["proc"])  # attempt number travels
+
+
+def test_supervisor_same_tick_deaths_get_independent_deadlines():
+    clock = _Clock()
+    sup, _ = _mk_sup(clock, max_restarts=3)
+    pa, pb = sup.add("a"), sup.add("b")
+    pa.rc = pb.rc = 1
+    events = sup.poll()
+    assert [e["kind"] for e in events] == ["restart_scheduled"] * 2
+    clock.t += 1.0
+    assert sorted(e["key"] for e in sup.poll()
+                  if e["kind"] == "respawned") == ["a", "b"]
+
+
+def test_supervisor_clean_exit_ends_supervision():
+    clock = _Clock()
+    sup, _ = _mk_sup(clock, clean_exit=lambda rc, hung: rc in (0, 75))
+    p = sup.add("w0")
+    p.rc = 75  # the preemption contract's drain exit
+    (ev,) = sup.poll()
+    assert ev["kind"] == "exit_clean" and ev["rc"] == 75
+    assert sup.state("w0") == "done" and not sup.some_active()
+
+
+def test_supervisor_restart_budget_exhaustion_is_fatal():
+    clock = _Clock()
+    sup, _ = _mk_sup(clock, max_restarts=1)
+    sup.add("w0").rc = 1
+    assert sup.poll()[0]["kind"] == "restart_scheduled"
+    clock.t += 1.0
+    (ev,) = sup.poll()
+    assert ev["kind"] == "respawned"
+    ev["proc"].rc = 1  # second death: budget (1) already spent
+    (ev,) = sup.poll()
+    assert ev["kind"] == "fatal" and ev["restarts"] == 1
+    assert sup.state("w0") == "failed"
+    assert sup.poll() == []  # left dead, never polled again
+
+
+def test_supervisor_stale_heartbeat_kills_hung_child():
+    clock = _Clock()
+    import signal as _signal
+
+    sup, _ = _mk_sup(
+        clock, max_restarts=1,
+        staleness=lambda proc, now: getattr(proc, "stale", 0.0),
+        stale_after=5.0,
+    )
+    p = sup.add("w0")
+    p.stale = 99.0
+    (ev,) = sup.poll()
+    assert ev["kind"] == "hung" and p.signals == [_signal.SIGTERM]
+    assert sup.poll() == []  # hung emitted once; grace running
+    p._paddle_kill_at = 0.0  # grace expired
+    sup.poll()
+    assert "KILL" in p.signals and p._paddle_hung
+    # the kill routes through the SAME restart path as any crash
+    (ev,) = sup.poll()
+    assert ev["kind"] == "restart_scheduled" and ev["hung"]
+
+
+def test_supervisor_forget_is_a_silent_scale_in():
+    clock = _Clock()
+    sup, _ = _mk_sup(clock)
+    p = sup.add("w0")
+    assert sup.forget("w0") is p
+    p.rc = 1  # dies AFTER the forget: no events, no respawn
+    assert sup.poll() == [] and sup.keys() == []
+
+
+# ---------------------------------------------------------------------------
+# _WorkerClient: typed failure surfacing over a scripted worker
+# ---------------------------------------------------------------------------
+
+
+def _scripted_worker(script):
+    """One-connection fake worker: `script(conn)` plays the server side.
+    Returns the ready dict a _WorkerClient binds to."""
+    srv, port = bind_serving_socket("127.0.0.1", 0)
+
+    def serve():
+        try:
+            conn, _ = srv.accept()
+            with conn:
+                script(conn)
+        except OSError:
+            pass
+        finally:
+            srv.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return {
+        "pid": os.getpid(), "host": "127.0.0.1", "port": port,
+        "attempt": 0, "feed_names": ["x"], "fetch_names": ["y"],
+        "sample_specs": {"x": [[2], "float32"]},
+    }
+
+
+def test_worker_death_mid_batch_is_typed_not_a_hang():
+    def die_mid_reply(conn):
+        recv_msg(conn)  # take the batch, then die without replying
+
+    ready = _scripted_worker(die_mid_reply)
+    client = fleet_mod._WorkerClient("w0", ready, io_timeout=5.0)
+    t0 = time.perf_counter()
+    with pytest.raises(TransportError, match="closed the connection"):
+        client.run({"x": np.zeros((1, 2), np.float32)})
+    assert time.perf_counter() - t0 < 5.0  # typed promptly, no hang
+    client.close()
+
+
+def test_stale_replies_discarded_by_id_stream_stays_usable():
+    def straggler_then_answer(conn):
+        msg = recv_msg(conn)
+        # a reply from an attempt the watchdog already abandoned...
+        send_msg(conn, {"kind": "result", "id": "w0:ancient", "outs": []})
+        # ...then the reply this call is actually waiting on
+        send_msg(conn, {"kind": "pong", "id": msg["id"], "pid": 1,
+                        "batches": 0})
+
+    ready = _scripted_worker(straggler_then_answer)
+    client = fleet_mod._WorkerClient("w0", ready, io_timeout=5.0)
+    assert client.call("ping")["kind"] == "pong"
+    assert _counter("serving.fleet.stale_replies") == 1
+    client.close()
+
+
+def test_remote_error_rehydrates_by_taxonomy_name():
+    def reply_error(conn):
+        msg = recv_msg(conn)
+        send_msg(conn, {"kind": "error", "id": msg["id"],
+                        "etype": "InvalidArgumentError",
+                        "msg": "bad feed shape"})
+
+    ready = _scripted_worker(reply_error)
+    client = fleet_mod._WorkerClient("w0", ready, io_timeout=5.0)
+    with pytest.raises(InvalidArgumentError, match="bad feed shape"):
+        client.run({"x": np.zeros((1, 2), np.float32)})
+    client.close()
+
+
+def test_reply_timeout_is_typed_and_burns_the_connection():
+    def never_reply(conn):
+        recv_msg(conn)
+        time.sleep(3.0)
+
+    ready = _scripted_worker(never_reply)
+    client = fleet_mod._WorkerClient("w0", ready, io_timeout=0.2)
+    with pytest.raises(ExecutionTimeoutError):
+        client.call("ping")
+    # a timed-out read may sit mid-frame: the socket must be gone
+    assert client._sock is None
+    client.close()
+
+
+def test_respawn_with_different_contract_is_rejected():
+    ready = {
+        "pid": 1, "host": "127.0.0.1", "port": 1, "attempt": 0,
+        "feed_names": ["x"], "fetch_names": ["y"],
+        "sample_specs": {"x": [[2], "float32"]},
+    }
+    client = fleet_mod._WorkerClient.__new__(fleet_mod._WorkerClient)
+    client.name = "w0"
+    client.inflight = 0
+    client._io_timeout = None
+    client._connect_timeout = 1.0
+    client._lock = threading.Lock()
+    client._sock = None
+    client._bind(ready, first=True)
+    with pytest.raises(InvalidArgumentError, match="different"):
+        client.rebind(dict(ready, feed_names=["x", "mask"]))
+
+
+# ---------------------------------------------------------------------------
+# FleetAutoscaler + the brownout capacity rung
+# ---------------------------------------------------------------------------
+
+
+class _FakeFleet:
+    def __init__(self, can_grow=True, can_shrink=True):
+        self.can_grow, self.can_shrink = can_grow, can_shrink
+        self.outs = 0
+        self.ins = 0
+
+    def try_scale_out(self):
+        if self.can_grow:
+            self.outs += 1
+            return True
+        return False
+
+    def scale_in(self):
+        if self.can_shrink:
+            self.ins += 1
+            return True
+        return False
+
+
+def test_autoscaler_scales_out_on_sustained_breach_with_cooldown():
+    from paddle_tpu.serving.fleet import FleetAutoscaler
+
+    clock = _Clock()
+    fleet = _FakeFleet()
+    asc = FleetAutoscaler(fleet, breach_after=2, idle_after=3,
+                          cooldown_s=10.0, clock=clock)
+    assert asc.observe(True, idle=False) is None  # streak 1 < 2
+    assert asc.observe(True, idle=False) == "scale_out"
+    clock.t += 1.0  # inside cooldown: a fresh streak must NOT act
+    assert asc.observe(True, idle=False) is None
+    assert asc.observe(True, idle=False) is None
+    clock.t += 10.0  # cooldown over; streak is already >= breach_after
+    assert asc.observe(True, idle=False) == "scale_out"
+    assert fleet.outs == 2
+
+
+def test_autoscaler_at_max_returns_none_and_keeps_trying():
+    from paddle_tpu.serving.fleet import FleetAutoscaler
+
+    clock = _Clock()
+    fleet = _FakeFleet(can_grow=False)
+    asc = FleetAutoscaler(fleet, breach_after=1, cooldown_s=0.0,
+                          clock=clock)
+    assert asc.observe(True, idle=False) is None  # full: falls through
+    assert asc.observe(True, idle=False) is None  # and keeps retrying
+    fleet.can_grow = True  # a worker drained meanwhile
+    assert asc.observe(True, idle=False) == "scale_out"
+
+
+def test_autoscaler_scales_in_after_sustained_idle():
+    from paddle_tpu.serving.fleet import FleetAutoscaler
+
+    clock = _Clock()
+    fleet = _FakeFleet()
+    asc = FleetAutoscaler(fleet, breach_after=2, idle_after=2,
+                          cooldown_s=0.0, clock=clock)
+    assert asc.observe(False, idle=True) is None
+    assert asc.observe(False, idle=True) == "scale_in"
+    assert fleet.ins == 1
+    # a breach tick resets the idle streak even when idle= was passed
+    assert asc.observe(True, idle=True) is None
+    assert asc.observe(False, idle=True) is None  # streak restarted at 1
+
+
+class _NoEndpoints:
+    def endpoints(self):
+        return {}
+
+
+def test_brownout_scale_out_absorbs_the_breach_tick():
+    from paddle_tpu.serving.fleet import FleetAutoscaler
+
+    clock = _Clock()
+    asc = FleetAutoscaler(_FakeFleet(), breach_after=1, cooldown_s=0.0,
+                          clock=clock)
+    ctl = BrownoutController(_NoEndpoints(), slo_p99_s=0.1,
+                             escalate_after=2, autoscaler=asc)
+    # every breach tick is absorbed by a scale-out: the ladder never moves
+    for _ in range(6):
+        assert ctl.observe(p99=0.5) == 0
+    assert asc.fleet.outs == 6
+    assert _counter("serving.brownout_scale_outs") == 6
+
+
+def test_brownout_escalates_only_once_the_fleet_is_full():
+    from paddle_tpu.serving.fleet import FleetAutoscaler
+
+    clock = _Clock()
+    fleet = _FakeFleet(can_grow=False)  # at max_replicas from the start
+    asc = FleetAutoscaler(fleet, breach_after=1, cooldown_s=0.0,
+                          clock=clock)
+    ctl = BrownoutController(_NoEndpoints(), slo_p99_s=0.1,
+                             escalate_after=2, autoscaler=asc)
+    assert ctl.observe(p99=0.5) == 0  # breach 1 of 2
+    assert ctl.observe(p99=0.5) == 1  # capacity exhausted: degrade
+    assert fleet.outs == 0
+    assert _counter("serving.brownout_escalations") == 1
+
+
+# ---------------------------------------------------------------------------
+# Watcher dead_process finding + fleet_report --stale-after
+# ---------------------------------------------------------------------------
+
+
+def _write_record(path, seq, t, counters=None, kind="base"):
+    rec = {"kind": kind, "rank": 0, "pid": 4242, "seq": seq, "t": t,
+           "counters": counters or {"telemetry.publishes": seq}}
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def test_watcher_dead_process_latches_and_rearms_on_respawn(tmp_path):
+    shard = tmp_path / "telemetry_rank0.jsonl"
+    _write_record(str(shard), seq=1, t=time.time() - 60.0)
+    w = watch.Watcher(journal_dir=str(tmp_path), dead_process_timeout=3.0)
+    (finding,) = w.poll()
+    assert finding["kind"] == "dead_process"
+    assert finding["severity"] == "error"
+    assert finding["detail"]["pid"] == 4242
+    assert finding["detail"]["stale_s"] > 3.0
+    assert obs.get_gauges()["watch.dead_processes"] == 1.0
+    assert w.poll() == []  # latched: one finding per death
+    # the respawn writes fresh records: the latch re-arms...
+    _write_record(str(shard), seq=2, t=time.time())
+    assert w.poll() == []
+    assert obs.get_gauges()["watch.dead_processes"] == 0.0
+    # ...so a SECOND death of the same shard raises a second finding
+    _write_record(str(shard), seq=3, t=time.time() - 60.0)
+    (finding,) = w.poll()
+    assert finding["kind"] == "dead_process"
+    assert _counter("watch.findings.dead_process") == 2
+
+
+def test_watcher_dead_process_off_by_default(tmp_path):
+    shard = tmp_path / "telemetry_rank0.jsonl"
+    _write_record(str(shard), seq=1, t=time.time() - 60.0)
+    w = watch.Watcher(journal_dir=str(tmp_path))
+    assert all(f["kind"] != "dead_process" for f in w.poll())
+
+
+def test_fleet_report_flags_stale_shards_as_dead(tmp_path):
+    now = time.time()
+    live = tmp_path / "telemetry_rank0.jsonl"
+    dead = tmp_path / "telemetry_rank1.jsonl"
+    _write_record(str(live), seq=1, t=now - 1.0)
+    with open(str(dead), "a") as f:
+        f.write(json.dumps({
+            "kind": "base", "rank": 1, "pid": 777, "seq": 1,
+            "t": now - 30.0, "counters": {"serving.goodput": 5},
+        }) + "\n")
+    fleet_report = _load_tool("fleet_report")
+    report = fleet_report.build_report(
+        str(tmp_path), stale_after=5.0, now=now
+    )
+    by_rank = {s["rank"]: s for s in report["shards"]}
+    assert by_rank[1]["dead"] and not by_rank[0]["dead"]
+    deads = report["fleet"]["dead_processes"]
+    assert [d["pid"] for d in deads] == [777]
+    assert deads[0]["stale_s"] == pytest.approx(30.0, abs=1.0)
+    assert "DEAD: rank 1" in fleet_report.render(report)
+    # without --stale-after nothing is judged (no false positives)
+    report = fleet_report.build_report(str(tmp_path))
+    assert report["fleet"]["dead_processes"] == []
+
+
+# ---------------------------------------------------------------------------
+# Endpoint dispatch pool: max_concurrency actually overlaps batches
+# ---------------------------------------------------------------------------
+
+
+class _ConcurrentRunner:
+    """Tracks how many batches run at once; sleeps so overlap is forced."""
+
+    feed_names = ("x",)
+    max_concurrency = 4
+
+    def __init__(self, delay=0.05):
+        self.delay = delay
+        self.active = 0
+        self.peak = 0
+        self._lock = threading.Lock()
+
+    def sample_spec(self, name):
+        return (2,), "float32"
+
+    def run(self, feed):
+        with self._lock:
+            self.active += 1
+            self.peak = max(self.peak, self.active)
+        time.sleep(self.delay)
+        with self._lock:
+            self.active -= 1
+        return [feed["x"] * 2.0]
+
+
+def test_endpoint_dispatch_pool_overlaps_batches():
+    runner = _ConcurrentRunner()
+    ep = Endpoint("pool", runner,
+                  EndpointConfig(buckets=(1,), max_wait_ms=0.0))
+    futs = [
+        ep.submit({"x": np.full(2, float(i), np.float32)})
+        for i in range(8)
+    ]
+    outs = [f.result(timeout=10)[0] for f in futs]
+    assert ep.drain(timeout=10)
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(out, np.full(2, 2.0 * i))
+    # serialized dispatch would hold peak at 1; the pool must overlap
+    assert runner.peak >= 2
+
+
+def test_endpoint_single_runner_stays_serialized():
+    runner = _ConcurrentRunner()
+    runner.max_concurrency = 1
+    ep = Endpoint("ser", runner,
+                  EndpointConfig(buckets=(1,), max_wait_ms=0.0))
+    futs = [
+        ep.submit({"x": np.zeros(2, np.float32)}) for _ in range(4)
+    ]
+    for f in futs:
+        f.result(timeout=10)
+    assert ep.drain(timeout=10)
+    assert runner.peak == 1

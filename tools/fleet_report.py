@@ -33,6 +33,7 @@ import glob
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -147,7 +148,8 @@ def _binned(shards_points, bin_s):
 
 
 def build_report(directory, bin_s=1.0, step_metric="executor.step_latency",
-                 latency_metric="serving.request_latency"):
+                 latency_metric="serving.request_latency",
+                 stale_after=None, now=None):
     shard_paths = sorted(
         p for p in glob.glob(os.path.join(directory, "telemetry_rank*.jsonl"))
     )
@@ -158,6 +160,14 @@ def build_report(directory, bin_s=1.0, step_metric="executor.step_latency",
         )
         if summary["last_seq"] is None:
             continue  # unreadable / empty shard
+        if stale_after is not None and summary["last_t"] is not None:
+            # the Watcher's dead_process verdict, offline: a live
+            # publisher stamps its shard every interval, so a stale
+            # last_t means the process stopped writing, not went idle
+            ref = time.time() if now is None else now
+            stale = ref - float(summary["last_t"])
+            summary["stale_s"] = stale
+            summary["dead"] = stale > float(stale_after)
         shards.append(summary)
         all_points.append(points)
         rank = summary["rank"]
@@ -179,11 +189,16 @@ def build_report(directory, bin_s=1.0, step_metric="executor.step_latency",
             "max_gap_steps": lead - min(steps.values()),
             "per_rank_last_step": steps,
         }
+    dead = [s for s in shards if s.get("dead")]
     return {
         "dir": directory,
         "shards": shards,
         "fleet": {
             "ranks": len(shards),
+            "dead_processes": [
+                {"rank": s["rank"], "pid": s["pid"],
+                 "stale_s": s["stale_s"]} for s in dead
+            ],
             "goodput_total": sum(s["goodput"] for s in shards),
             "requests_served_total": sum(
                 s["requests_served"] for s in shards
@@ -209,6 +224,11 @@ def render(report):
         f"{fleet['requests_served_total']} served "
         f"({fleet['goodput_total']} in-deadline) --"
     )
+    for d in fleet.get("dead_processes", ()):
+        lines.append(
+            f"  DEAD: rank {d['rank']} (pid {d['pid']}) — journal stale "
+            f"{d['stale_s']:.1f}s"
+        )
     strag = fleet["straggler"]
     if strag:
         lines.append(
@@ -247,10 +267,14 @@ def main(argv=None):
                     help="fail unless >= N shards replayed")
     ap.add_argument("--step-metric", default="executor.step_latency")
     ap.add_argument("--latency-metric", default="serving.request_latency")
+    ap.add_argument("--stale-after", type=float, default=None, metavar="S",
+                    help="flag shards whose last journal stamp is older "
+                         "than S seconds as dead processes (the offline "
+                         "twin of the watcher's dead_process finding)")
     args = ap.parse_args(argv)
     report = build_report(
         args.dir, bin_s=args.bin, step_metric=args.step_metric,
-        latency_metric=args.latency_metric,
+        latency_metric=args.latency_metric, stale_after=args.stale_after,
     )
     if args.out:
         with open(args.out, "w") as f:
