@@ -24,6 +24,13 @@ JSON line per mix (bench.py convention):
     20% of pre-kill. Run it under
     ``PADDLE_TPU_FAULT_INJECT=serving.dispatch:hang:...`` (ci.sh does)
     to add a wedged-executable dispatch the attempt timeout must bound.
+  * ``live_update``    — r18 live-publish mix: a 3-replica
+    ``SubscribedRunner`` set serving while a trainer thread publishes
+    delta bundles and a ``RolloutController`` canaries them through.
+    Every version's weights are version-constant, so each response row
+    identifies the version that produced it. Gates: goodput under live
+    updates >= 0.9x the no-publish baseline, >= 1 version applied
+    fleet-wide, zero torn rows (no batch mixed two versions' weights).
 
 Per mix: QPS, p50/p99 request latency (client-measured), batch-size
 histogram from the ``serving.bucket_runs.*`` counters, and the frozen
@@ -41,7 +48,8 @@ Two acceptance ratios ride along:
 
 ``--smoke`` shrinks the run for CI; ``--dump PATH`` writes the
 observability snapshot for ``stats_report --require serving.``;
-``--mix a,b`` runs a subset (bert,resnet,ctr,gpt,overload,failover).
+``--mix a,b`` runs a subset
+(bert,resnet,ctr,gpt,overload,failover,live_update).
 """
 
 from __future__ import annotations
@@ -828,6 +836,189 @@ def bench_failover(smoke, duration, results):
     return entry
 
 
+def bench_live_update(smoke, duration, results):
+    """The r18 live-publish mix: a 3-replica ``SubscribedRunner`` set
+    serving while a trainer thread publishes delta bundles and a
+    ``RolloutController`` canaries them through the fleet. The weights
+    of every version are version-constant (a deterministic pattern of
+    the version number), so each response row identifies exactly one
+    committed version — a row matching NO version is a torn batch.
+
+    Self-gating: goodput under live updates >= 0.9x the no-publish
+    baseline (the apply stalls must cost < 10%), >= 1 version applied
+    fleet-wide, zero torn rows."""
+    import tempfile
+
+    from paddle_tpu import observability
+    from paddle_tpu.fleet.publish import (ModelPublisher, ModelSubscriber,
+                                          committed_versions, load_version)
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.serving import ReplicaSet, Server, freeze_program
+    from paddle_tpu.serving.rollout import (RolloutController,
+                                            SubscribedRunner)
+    from paddle_tpu.serving.router import EndpointConfig, FrozenRunner
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 8])
+        prob = layers.softmax(layers.fc(x, 6))
+    trainer_scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(trainer_scope):
+        exe.run(startup, scope=trainer_scope)
+    frozen = freeze_program(main, [prob], feed_names=("x",))
+    pnames = sorted(
+        n for n in trainer_scope.local_var_names()
+        if trainer_scope.find_var(n) is not None
+        and frozen.program.global_block.var(n) is not None
+    )
+
+    def stamp(version):
+        # version-constant weights: every persistable becomes a pattern
+        # of the version number, so softmax(ones @ W + b) is a distinct,
+        # recomputable fingerprint per version
+        for i, name in enumerate(pnames):
+            cur = np.asarray(trainer_scope.find_var(name))
+            size = cur.size
+            pat = (np.arange(size, dtype=np.float64) % 5 - 2.0) / 10.0
+            arr = ((version % 7 + 1) * 0.1 * (i + 1) * pat).reshape(
+                cur.shape
+            ).astype(cur.dtype)
+            trainer_scope.set_var(name, arr)
+
+    publish_dir = tempfile.mkdtemp(prefix="bench-live-publish-")
+    publisher = ModelPublisher(publish_dir, main_program=frozen.program,
+                               scope=trainer_scope, full_every=4,
+                               max_versions=64)
+    stamp(1)
+    publisher.publish(step=1)
+
+    feed_one = {"x": np.ones(8, np.float32)}
+    outputs, out_lock = [], threading.Lock()
+
+    def serve_leg(live):
+        runners = {}
+        for i in range(3):
+            scope = Scope()
+            with scope_guard(scope):
+                exe.run(startup, scope=scope)
+            sub = ModelSubscriber(publish_dir,
+                                  main_program=frozen.program,
+                                  scope=scope, name=f"r{i}")
+            sub.poll()  # catch-up before serving (the respawn path)
+            runners[f"r{i}"] = SubscribedRunner(
+                FrozenRunner(frozen, executor=exe, scope=scope), sub
+            )
+        rs = ReplicaSet(runners, name="live")
+        server = Server()
+        server.add_endpoint(
+            "live", rs,
+            EndpointConfig(buckets=(1, 2, 4), max_wait_ms=2.0,
+                           max_queue=4096),
+        )
+        server.warmup()
+        ctl = RolloutController(rs, publish_dir, watcher=None,
+                                error_counters=(), canary_soak_ticks=1,
+                                post_soak_ticks=0, interval=0.05)
+        ctl.version = publisher._next - 1  # baseline: already rolled out
+        stop_pub = threading.Event()
+
+        def train_and_publish():
+            v = publisher._next
+            while not stop_pub.wait(duration / 6.0):
+                stamp(v)
+                publisher.publish(step=v)
+                v += 1
+
+        pub_thread = threading.Thread(target=train_and_publish,
+                                      daemon=True)
+        stop = time.perf_counter() + duration
+        done = [0]
+
+        def client(seed):
+            while time.perf_counter() < stop:
+                fut = server.submit("live", feed_one)
+                out = fut.result(timeout=30)
+                done[0] += 1
+                if live:
+                    with out_lock:
+                        outputs.append(np.asarray(out[0]))
+
+        if live:
+            pub_thread.start()
+            ctl.start()
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if live:
+            stop_pub.set()
+            pub_thread.join()
+            ctl.stop()
+        server.drain(timeout=30)
+        return done[0] / wall if wall > 0 else 0.0, ctl
+
+    qps_base, _ = serve_leg(live=False)
+    qps_live, ctl = serve_leg(live=True)
+
+    # every served row must reproduce as the output of exactly one
+    # committed version's cold fold — a row matching none is a batch
+    # that mixed weights from two versions across the apply fence
+    expected = []
+    ref = FrozenRunner(frozen, executor=exe, scope=Scope())
+    for v in committed_versions(publish_dir):
+        folded = load_version(publish_dir, v)
+        for name, arr in folded.items():
+            ref.scope.set_var(name, arr)
+        (out,) = ref.run({"x": np.ones((1, 8), np.float32)})
+        expected.append((v, np.asarray(out)[0]))
+    torn = 0
+    for row in outputs:
+        errs = [float(np.max(np.abs(row - e))) for _v, e in expected]
+        if min(errs) > 1e-4:
+            torn += 1
+
+    c = observability.get_counters()
+    g = observability.get_gauges()
+    versions_applied = int(ctl.version or 0)
+    entry = {
+        "mix": "live_update",
+        "mode": "closed",
+        "load": 4,
+        "requests": len(outputs),
+        "qps_baseline": round(qps_base, 1),
+        "qps_live": round(qps_live, 1),
+        "goodput_ratio": round(qps_live / qps_base, 3) if qps_base
+        else None,
+        "versions_published": c.get("publish.versions", 0),
+        "versions_served_through": versions_applied,
+        "rollouts": c.get("publish.rollouts", 0),
+        "applies": c.get("publish.applies", 0),
+        "rollbacks": c.get("publish.rollbacks", 0),
+        "torn_rows": torn,
+        "model_version_gauge": g.get("serving.model_version"),
+        "staleness_s": g.get("serving.model_staleness_seconds"),
+        "gates": {
+            "goodput_dip<10pct": qps_base > 0
+            and qps_live >= 0.9 * qps_base,
+            "versions_applied>=1": c.get("publish.rollouts", 0) >= 1,
+            "zero_torn_rows": torn == 0,
+            "zero_rollbacks": c.get("publish.rollbacks", 0) == 0,
+        },
+    }
+    entry["ok"] = all(entry["gates"].values())
+    results["live_update"] = entry
+    return entry
+
+
 def _pid_alive(pid):
     import os
 
@@ -1075,8 +1266,8 @@ def main(argv=None):
                     help="seconds of load per mix (default 2 smoke / 6)")
     ap.add_argument("--mix", default=None,
                     help="comma list of mixes to run "
-                         "(bert,resnet,ctr,gpt,overload,failover; "
-                         "default: all)")
+                         "(bert,resnet,ctr,gpt,overload,failover,"
+                         "live_update; default: all)")
     ap.add_argument("--fleet", type=int, default=0, metavar="N",
                     help="run the overload mix against an N-worker "
                          "process fleet (ProcessReplicaSet) instead of "
@@ -1087,7 +1278,8 @@ def main(argv=None):
                          "autoscale-before-shed, zero orphans")
     args = ap.parse_args(argv)
     duration = args.duration or (2.0 if args.smoke else 6.0)
-    all_mixes = ("bert", "resnet", "ctr", "gpt", "overload", "failover")
+    all_mixes = ("bert", "resnet", "ctr", "gpt", "overload", "failover",
+                 "live_update")
     mixes = (
         tuple(m.strip() for m in args.mix.split(",") if m.strip())
         if args.mix else all_mixes
@@ -1169,6 +1361,13 @@ def main(argv=None):
         fo = bench_failover(args.smoke, max(duration, 4.5), results)
         print(json.dumps(fo), flush=True)
         gates["failover"] = fo["ok"]
+
+    if "live_update" in mixes:
+        # r18 live-publish mix: delta rollout under load, goodput dip
+        # < 10%, zero torn batches
+        lu = bench_live_update(args.smoke, max(duration, 3.0), results)
+        print(json.dumps(lu), flush=True)
+        gates["live_update"] = lu["ok"]
 
     if args.dump:
         from paddle_tpu import observability

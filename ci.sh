@@ -308,6 +308,149 @@ if pgrep -f "paddle_tpu.serving.worker" > /dev/null 2>&1; then
 fi
 rm -rf "$FLEET_DIR"
 
+echo "== live-publish chaos (delta rollout + SIGKILL mid-apply) =="
+# leg 1 — the in-process live_update mix: 3 SubscribedRunner replicas
+# serving while a trainer publishes delta bundles and the rollout
+# controller canaries them through. The bench self-gates: goodput under
+# live updates >= 0.9x the no-publish baseline, >= 1 version applied,
+# zero torn rows (no batch mixed two versions' weights). stats_report
+# proves the publish/staleness telemetry was alive.
+LP_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu python bench_serving.py --smoke --mix live_update \
+    --dump "$LP_DIR/live_update_stats.json"
+python tools/stats_report.py "$LP_DIR/live_update_stats.json" \
+    --require publish. --require publish.applies \
+    --require publish.commit_latency --require publish.apply_latency \
+    --require serving.model_staleness
+
+# leg 2 — the process-fleet respawn-consistency leg: a continuously
+# trained model published to a 2-worker fleet in follow mode, with the
+# publish.apply hang seam armed in every worker env and one worker
+# SIGKILLed inside that window (killed MID-apply, the torn-apply
+# window). Gates: the survivor completes the apply after the bounded
+# hang, the corpse respawns and catch-up-polls BEFORE readiness, and
+# every worker's scope digest is CRC-identical to a cold fold of the
+# last committed version — delta-applied, hung, killed, and respawned
+# replicas all land bitwise on the same weights. fleet_report renders
+# the publish-version skew from the workers' journal shards.
+JAX_PLATFORMS=cpu python - "$LP_DIR" <<'EOF'
+import json, os, signal, sys, time
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability
+from paddle_tpu import io as _io
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.fleet.publish import ModelPublisher, load_version
+from paddle_tpu.serving import ProcessReplicaSet, Server, freeze_program
+from paddle_tpu.serving.router import EndpointConfig
+
+observability.set_enabled(True)
+workdir = os.path.join(sys.argv[1], "fleet")
+
+main, startup = fluid.Program(), fluid.Program()
+main.random_seed = startup.random_seed = 11
+with fluid.program_guard(main, startup):
+    x = fluid.data("x", [-1, 8])
+    lab = fluid.data("lab", [-1, 1], "int64")
+    logits = layers.fc(layers.fc(x, 16, act="relu"), 4)
+    prob = layers.softmax(logits)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, lab))
+    fluid.optimizer.Adam(1e-2).minimize(loss, startup)
+scope = Scope()
+exe = fluid.Executor()
+with scope_guard(scope):
+    exe.run(startup, scope=scope)
+frozen = freeze_program(main, [prob], feed_names=("x",))
+rng = np.random.RandomState(0)
+
+def train(n=2):
+    with scope_guard(scope):
+        for _ in range(n):
+            exe.run(main, feed={
+                "x": rng.randn(8, 8).astype(np.float32),
+                "lab": rng.randint(0, 4, (8, 1)).astype(np.int64),
+            }, fetch_list=[loss], scope=scope)
+
+model_dir = os.path.join(workdir, "model")
+publish_dir = os.path.join(workdir, "publish")
+frozen.save(model_dir, scope=scope)
+pub = ModelPublisher(publish_dir, main_program=frozen.program,
+                     scope=scope, full_every=3)
+
+# No version is published yet: the workers come up on the cold
+# model_dir load, so the FIRST follow-mode apply each worker runs is
+# the one the armed hang seam (max_fires=1 per process) wedges — the
+# SIGKILL below lands inside a genuinely in-flight apply.
+fleet = ProcessReplicaSet(
+    model_dir, n_workers=2, warm_buckets=(1, 2), attempt_timeout=30.0,
+    spawn_timeout=300.0, name="livepub", workdir=workdir,
+    publish_dir=publish_dir, publish_mode="follow", publish_poll=0.2,
+    env={"PADDLE_TPU_FAULT_INJECT": "publish.apply:hang:1.0:0:1",
+         "PADDLE_TPU_FAULT_HANG_SECONDS": "3"},
+)
+srv = Server()
+srv.add_endpoint("livepub", fleet,
+                 EndpointConfig(buckets=(1, 2), max_wait_ms=2.0))
+srv.warmup()
+srv.submit("livepub", {"x": np.ones(8, np.float32)}).result(timeout=30)
+
+train(); v1 = pub.publish(step=1)
+time.sleep(1.0)  # both workers are now INSIDE the armed apply hang
+victim = fleet.worker_pids()[0]
+os.kill(victim, signal.SIGKILL)  # shot mid-apply
+print(f"SIGKILLed worker pid {victim} mid-apply (hang seam armed)")
+
+def digests_at(version, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            seen = {w: fleet.worker_digest(w, timeout=10.0)
+                    for w in list(fleet._clients)}
+            if all(d.get("version") == version for d in seen.values()):
+                return seen
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise SystemExit(f"fleet never converged on v{version}")
+
+def check_bitwise(version):
+    seen = digests_at(version)
+    cold = load_version(publish_dir, version)
+    expect = {n: _io._array_entry(np.asarray(a))["crc32"]
+              for n, a in cold.items()}
+    for w, d in seen.items():
+        for name, crc in d["crc"].items():
+            assert expect.get(name) == crc, (w, name)
+
+check_bitwise(v1)  # survivor finished its hung apply; corpse respawned
+train(); v2 = pub.publish(step=2)  # a delta on top, post-respawn
+check_bitwise(v2)
+c = observability.get_counters()
+assert c.get("serving.fleet.respawns", 0) >= 1, c
+time.sleep(1.5)  # let the workers journal the post-apply gauges
+srv.close(timeout=120)
+print(f"live-publish chaos OK: v{v2} served fleet-wide, "
+      f"{c['serving.fleet.respawns']} respawn(s) caught up bitwise "
+      f"(CRC digest == cold fold)")
+EOF
+# the workers' journal shards must render the publish-version skew
+python tools/fleet_report.py "$LP_DIR/fleet/telemetry" --json \
+    | python - <<'EOF'
+import json, sys
+report = json.load(sys.stdin)
+skew = report["fleet"]["publish_skew"]
+assert skew["per_rank_version"], report["fleet"]
+assert skew["max_version"] >= 2, skew
+print(f"fleet_report publish skew OK: versions {skew['per_rank_version']}"
+      f" (max skew {skew['max_skew']})")
+EOF
+if pgrep -f "paddle_tpu.serving.worker" > /dev/null 2>&1; then
+    echo "orphan fleet workers survived the live-publish stage:" >&2
+    pgrep -af "paddle_tpu.serving.worker" >&2
+    exit 1
+fi
+rm -rf "$LP_DIR"
+
 echo "== observability smoke =="
 python - <<'EOF'
 import numpy as np

@@ -81,6 +81,11 @@ class CachedGroup:
         # train -> evict all inside the prefetch window)
         self._tick = 0
         self._wb_tick = np.zeros(self.vocab, np.int64)
+        # per-consumer delta cursors: the checkpointer and the model
+        # publisher each track their own committed tick, so one
+        # consumer's publish can never swallow rows from the other's
+        # next delta (the shared-mark bug)
+        self._cursors = {}
         from collections import deque
 
         self._free = deque(range(self.hot_rows))
@@ -351,6 +356,21 @@ class CachedGroup:
         delta payload for every host store of this group."""
         with self._lock:
             return np.nonzero(self._wb_tick > int(tick))[0]
+
+    def consumer_mark(self, consumer):
+        """The tick `consumer` (e.g. "checkpoint", "publish") last
+        committed, or None before its first full payload."""
+        with self._lock:
+            return self._cursors.get(consumer)
+
+    def commit_consumer_mark(self, consumer, mark):
+        """Advance `consumer`'s committed cursor — call ONLY after the
+        payload covering rows up to `mark` durably landed; marks never
+        regress, so a stale late commit cannot re-expose rows."""
+        with self._lock:
+            cur = self._cursors.get(consumer)
+            if cur is None or int(mark) > cur:
+                self._cursors[consumer] = int(mark)
 
 
 def zlib_crc(s: str) -> int:

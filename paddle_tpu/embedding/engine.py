@@ -401,7 +401,7 @@ class EmbeddingEngine:
                     out[f"{g.name}::host::{aname}"] = g.host[aname].copy()
         return out
 
-    def delta_row_oracles(self):
+    def delta_row_oracles(self, consumer=None):
         """Row oracles for tiered checkpointing, keyed by the
         :meth:`state_dict` host-store names: ``oracle(last_mark) ->
         (dirty_rows, new_mark)`` backed by each group's write-back tick
@@ -409,14 +409,25 @@ class EmbeddingEngine:
         since the last published save instead of the full ``[V, ...]``
         stores (``fleet.AsyncCheckpointer(row_oracles=...)``). With
         ``last_mark=None`` (no published base yet) rows is None, which
-        tells the checkpointer to store the array in full."""
+        tells the checkpointer to store the array in full.
+
+        `consumer` names an independent group-side cursor ("checkpoint",
+        "publish", ...): with it, an ``oracle(None)`` falls back to the
+        consumer's last COMMITTED mark (:meth:`commit_row_marks`) instead
+        of "no base", so two delta chains — a checkpoint save landing
+        between two model publishes, say — each see every row dirtied
+        since their OWN last payload; without per-consumer cursors one
+        chain's publish would silently swallow the other's rows."""
 
         def _make(group):
             def oracle(last_mark):
                 mark = group.delta_tick()
-                if last_mark is None:
+                last = last_mark
+                if last is None and consumer is not None:
+                    last = group.consumer_mark(consumer)
+                if last is None:
                     return None, mark
-                return group.dirty_rows_since(last_mark), mark
+                return group.dirty_rows_since(last), mark
 
             return oracle
 
@@ -428,6 +439,19 @@ class EmbeddingEngine:
                 for aname, _fill in g.accums.get(t, ()):
                     out[f"{g.name}::host::{aname}"] = oracle
         return out
+
+    def commit_row_marks(self, consumer, marks):
+        """Durably advance `consumer`'s cursors after its payload
+        committed. `marks` is the ``{oracle key: mark}`` dict built from
+        the oracles' returned marks; keys map back to groups by their
+        ``{group}::host::`` prefix."""
+        for g in self.groups:
+            prefix = f"{g.name}::host::"
+            group_marks = [
+                m for k, m in marks.items() if k.startswith(prefix)
+            ]
+            if group_marks:
+                g.commit_consumer_mark(consumer, max(group_marks))
 
     def load_state_dict(self, state, scope):
         """Restore :meth:`state_dict` output. The hot-tier DEVICE arrays

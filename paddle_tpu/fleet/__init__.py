@@ -14,6 +14,14 @@ from .collective import (  # noqa: F401
     TrainStatus,
     fleet,
 )
+from .publish import (  # noqa: F401
+    ModelPublisher,
+    ModelSubscriber,
+    block_version,
+    committed_versions,
+    latest_version,
+    read_blocked,
+)
 from .role_maker import (  # noqa: F401
     PaddleCloudRoleMaker,
     Role,

@@ -1,0 +1,592 @@
+"""Live model publish plane: versioned delta bundles, trainer → servers.
+
+The pslib/FleetWrapper online-learning loop (reference
+``framework/fleet/fleet_wrapper.h``) keeps a model serving WHILE it
+trains: the trainer streams minutes-fresh weight updates to the serving
+fleet instead of redeploying checkpoints. This module is that loop's
+transport, built from contracts the repo already trusts:
+
+* :class:`ModelPublisher` (trainer side) emits **versioned update
+  bundles** under a publish directory — ``v000001/``, ``v000002/``, … —
+  each holding one ``model_update.npz`` payload + CRC manifest written
+  with the io.py durability discipline (temp + fsync + ``os.replace``)
+  and a ``commit.json`` written LAST: a version without its commit
+  record does not exist to any reader, so a publisher crash mid-bundle
+  is invisible rather than torn. Bundles form the PR-12 delta-chain
+  shape: a FULL bundle carries every persistable; a DELTA bundle carries
+  only CRC-changed dense arrays plus row-level embedding payloads
+  (``<name>@@rows``/``@@ridx`` pairs from
+  ``EmbeddingEngine.delta_row_oracles(consumer="publish")`` — the
+  per-consumer cursor, so a checkpoint landing between publishes cannot
+  eat the publisher's dirty rows) and names its ``base`` version;
+  :func:`resolve_chain`/:func:`load_version` fold any committed version
+  back to full arrays, bitwise equal to the trainer's snapshot.
+* :class:`ModelSubscriber` (serving side) follows the directory and
+  applies updates **all-or-nothing between batches**: the target
+  arrays are folded and verified first, the pre-apply scope values are
+  snapshotted, and any failure during the scope mutation (the
+  ``publish.apply`` chaos seam fires inside it) restores the snapshot —
+  the subscriber's ``version`` only ever names a fully-applied bundle,
+  which is the epoch fence no mixed-version batch can cross.
+* Rollback is data, not control flow: :func:`block_version` records a
+  bad version in ``blocked.json`` (atomic), every subscriber's
+  :meth:`~ModelSubscriber.poll` targets the newest *eligible* version,
+  and a downgrade re-folds the full chain of the rollback target — the
+  exact path a fresh worker takes, so rolled-back and cold-started
+  replicas are bitwise identical.
+
+Staleness is first-class: ``serving.model_version`` and
+``serving.model_staleness_seconds`` gauges (journal-replayable, so
+``tools/fleet_report.py`` renders cross-process publish-version skew),
+the version stamped into heartbeats, and the commit record carrying the
+publisher's :class:`~paddle_tpu.observability.trace.TraceContext` — the
+click→gradient→published-row→served freshness trace rides it into every
+subscriber's apply span.
+
+Chaos seams: ``publish.commit`` fires after the payload lands but
+before the commit record (a raising kind = a crash that must stay
+invisible; ``hang`` = a wedged publisher mid-commit), ``publish.apply``
+fires inside the subscriber's scope mutation (a raising kind must leave
+the old version bitwise intact; ``hang`` = the SIGKILL-mid-apply window
+the CI chaos stage shoots into).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+
+from ..errors import CheckpointCorruptionError, InvalidArgumentError
+from ..resilience.faults import fault_point
+
+__all__ = [
+    "BLOCKED_NAME",
+    "COMMIT_NAME",
+    "PAYLOAD_NAME",
+    "ModelPublisher",
+    "ModelSubscriber",
+    "block_version",
+    "committed_versions",
+    "latest_version",
+    "load_version",
+    "read_blocked",
+    "read_commit",
+    "resolve_chain",
+    "version_dir",
+]
+
+COMMIT_NAME = "commit.json"
+PAYLOAD_NAME = "model_update.npz"
+BLOCKED_NAME = "blocked.json"
+
+
+# -- directory layout --------------------------------------------------------
+def version_dir(publish_dir, version):
+    """``{publish_dir}/v{version:06d}`` — the bundle dir naming contract."""
+    return os.path.join(publish_dir, f"v{int(version):06d}")
+
+
+def _commit_path(publish_dir, version):
+    return os.path.join(version_dir(publish_dir, version), COMMIT_NAME)
+
+
+def committed_versions(publish_dir):
+    """Sorted committed version numbers (a ``v*`` dir WITHOUT its commit
+    record is an unfinished publish and does not exist to readers)."""
+    out = []
+    try:
+        entries = os.listdir(publish_dir)
+    except OSError:
+        return out
+    for name in entries:
+        if not (name.startswith("v") and name[1:].isdigit()):
+            continue
+        v = int(name[1:])
+        if os.path.exists(_commit_path(publish_dir, v)):
+            out.append(v)
+    return sorted(out)
+
+
+def read_commit(publish_dir, version):
+    """The commit record of `version` (raises typed when absent/torn)."""
+    path = _commit_path(publish_dir, version)
+    try:
+        with open(path) as f:
+            commit = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptionError(
+            f"unreadable publish commit record {path!r}: {e}"
+        ) from e
+    if not isinstance(commit, dict) or int(commit.get("version", -1)) != int(
+        version
+    ):
+        raise CheckpointCorruptionError(
+            f"publish commit record {path!r} does not describe version "
+            f"{version}"
+        )
+    return commit
+
+
+def read_blocked(publish_dir):
+    """Versions rolled back as bad — every subscriber skips them."""
+    try:
+        with open(os.path.join(publish_dir, BLOCKED_NAME)) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return set()
+    return {int(v) for v in data.get("blocked", ())}
+
+
+def block_version(publish_dir, version):
+    """Record `version` as bad (atomic read-modify-write; the rollout
+    controller is the single writer). Returns the new blocked set."""
+    from .. import io as _io
+
+    blocked = read_blocked(publish_dir)
+    blocked.add(int(version))
+    payload = json.dumps({"blocked": sorted(blocked)}).encode()
+    _io._atomic_write(
+        os.path.join(publish_dir, BLOCKED_NAME), lambda f: f.write(payload)
+    )
+    from .. import observability as _obs
+
+    _obs.add("publish.versions_blocked")
+    return blocked
+
+
+def latest_version(publish_dir, blocked=None):
+    """Newest committed version, skipping blocked ones; None when empty."""
+    if blocked is None:
+        blocked = read_blocked(publish_dir)
+    for v in reversed(committed_versions(publish_dir)):
+        if v not in blocked:
+            return v
+    return None
+
+
+def resolve_chain(publish_dir, version):
+    """The bundle chain for `version`, oldest→newest: walk ``base``
+    pointers back to the FULL bundle the deltas build on. Raises typed
+    when a link is missing (rotated away) or the chain is cyclic."""
+    chain = []
+    seen = set()
+    v = int(version)
+    while True:
+        if v in seen:
+            raise CheckpointCorruptionError(
+                f"publish chain for v{version} is cyclic at v{v}"
+            )
+        seen.add(v)
+        commit = read_commit(publish_dir, v)
+        chain.append(v)
+        if commit.get("kind") == "full":
+            chain.reverse()
+            return chain
+        base = commit.get("base")
+        if base is None:
+            raise CheckpointCorruptionError(
+                f"publish delta bundle v{v} names no base version"
+            )
+        v = int(base)
+
+
+def load_version(publish_dir, version):
+    """Fold `version`'s chain into full host arrays (the cold-load path
+    — and the bitwise reference every delta-applied subscriber must
+    match). Every link is CRC-verified before any folding."""
+    from .. import io as _io
+
+    acc = {}
+    for v in resolve_chain(publish_dir, version):
+        arrays = _io.read_persistables(
+            version_dir(publish_dir, v), filename=PAYLOAD_NAME
+        )
+        _io.merge_checkpoint_arrays(acc, arrays, f"publish v{v}")
+    return acc
+
+
+# -- trainer side ------------------------------------------------------------
+class ModelPublisher:
+    """Emit versioned update bundles from the live training scope.
+
+    ``publish()`` snapshots the program's persistables (plus, with an
+    ``engine=``, the embedding host stores after a flush), decides full
+    vs delta (first bundle full, then a full every ``full_every``
+    bundles — the chain-length bound), row/CRC-filters the delta, and
+    commits the bundle with the commit record LAST. Fingerprints and
+    row cursors advance only after a successful commit, so a failed
+    publish changes nothing and the next one re-carries its rows.
+    """
+
+    def __init__(self, publish_dir, main_program=None, scope=None,
+                 engine=None, full_every=8, max_versions=8, exclude=None):
+        if int(full_every) < 1:
+            raise InvalidArgumentError(
+                f"ModelPublisher: full_every must be >= 1, got {full_every}"
+            )
+        if int(max_versions) < 2:
+            raise InvalidArgumentError(
+                f"ModelPublisher: max_versions must be >= 2, got "
+                f"{max_versions}"
+            )
+        self.publish_dir = os.fspath(publish_dir)
+        self._main = main_program
+        self._scope = scope
+        self._engine = engine
+        self._full_every = int(full_every)
+        self._max_versions = int(max_versions)
+        self._exclude = tuple(exclude or ())
+        self._snap_cache = {}
+        self._fp = {}              # name -> manifest entry at last commit
+        self._row_marks = {}       # oracle key -> mark at last commit
+        self._since_full = None    # deltas since the last committed full
+        self._lock = threading.Lock()
+        os.makedirs(self.publish_dir, exist_ok=True)
+        committed = committed_versions(self.publish_dir)
+        self._next = (committed[-1] + 1) if committed else 1
+
+    # -- payload assembly --------------------------------------------------
+    def _collect(self):
+        from .. import io as _io
+
+        scope = self._scope
+        arrays = _io.snapshot_persistables(
+            self._main, scope=scope, exclude=self._exclude,
+            reuse_cache=self._snap_cache,
+        )
+        if self._engine is not None:
+            # flush first: resident rows write back to the host stores
+            # (bumping their dirty ticks), so trained state always lands
+            # in the bundle
+            self._engine.flush(scope)
+            for g in self._engine.groups:
+                for vname, store in g.host.items():
+                    arrays[f"{g.name}::host::{vname}"] = store.copy()
+        return arrays
+
+    def _row_filter(self, arrays, is_full):
+        """Replace oracle-covered host stores with (rows, indices) pairs
+        on a delta bundle. Returns the proposed mark updates (applied
+        only on commit)."""
+        from .. import io as _io
+
+        if self._engine is None:
+            return {}
+        oracles = self._engine.delta_row_oracles(consumer="publish")
+        marks = {}
+        for name, oracle in oracles.items():
+            last = self._row_marks.get(name)
+            rows, mark = oracle(last)
+            marks[name] = mark
+            # rows is None = no base for THIS consumer (neither an
+            # in-process mark nor a committed group cursor): ship full.
+            # A restarted publisher (last None, cursor present) still
+            # gets row deltas — the cursor is exactly what survives it.
+            if is_full or rows is None:
+                continue
+            if name in arrays:
+                rows = np.asarray(rows, dtype=np.int64)
+                full = arrays.pop(name)
+                arrays[name + _io.ROW_VAL_MARK] = np.ascontiguousarray(
+                    full[rows]
+                )
+                arrays[name + _io.ROW_IDX_MARK] = rows
+        return marks
+
+    def _crc_filter(self, arrays):
+        """Drop dense arrays whose CRC matches the chain's last committed
+        value; row pairs always pass (already minimal)."""
+        from .. import io as _io
+        from .. import observability as _obs
+
+        out, fp = {}, {}
+        dropped = 0
+        for name, arr in arrays.items():
+            if name.endswith(_io.ROW_VAL_MARK) or name.endswith(
+                _io.ROW_IDX_MARK
+            ):
+                out[name] = arr
+                continue
+            entry = _io._array_entry(arr)
+            if self._fp.get(name) == entry:
+                dropped += int(arr.nbytes)
+                continue
+            out[name] = arr
+            fp[name] = entry
+        if dropped:
+            _obs.add("publish.delta_bytes_dropped", dropped)
+        return out, fp
+
+    # -- publish -----------------------------------------------------------
+    def publish(self, step=None):
+        """Emit one bundle; returns its version number. Raises typed on
+        failure, leaving no committed trace and no advanced cursors."""
+        from .. import io as _io
+        from .. import observability as _obs
+        from ..observability import trace as _trace
+
+        with self._lock:
+            t0 = time.perf_counter()
+            is_full = self._since_full is None or (
+                self._since_full >= self._full_every - 1
+            )
+            base = None if is_full else self._next - 1
+            arrays = self._collect()
+            marks = self._row_filter(arrays, is_full)
+            if is_full:
+                fp = {
+                    name: _io._array_entry(arr)
+                    for name, arr in arrays.items()
+                }
+            else:
+                arrays, fp = self._crc_filter(arrays)
+            version = self._next
+            vdir = version_dir(self.publish_dir, version)
+            if os.path.isdir(vdir):
+                # an uncommitted carcass from a crashed publish: it never
+                # existed to readers, reclaim the number
+                shutil.rmtree(vdir, ignore_errors=True)
+            try:
+                _io.save_arrays(vdir, arrays, filename=PAYLOAD_NAME)
+                # the commit seam: a raising kind here is the crash that
+                # durability must make invisible — payload written,
+                # commit record absent, version nonexistent to readers
+                fault_point("publish.commit")
+                ctx = _trace.current()
+                commit = {
+                    "version": int(version),
+                    "kind": "full" if is_full else "delta",
+                    "base": base,
+                    "created_at": time.time(),
+                    "step": None if step is None else int(step),
+                    "arrays": len(arrays),
+                }
+                if ctx is not None:
+                    commit.update(ctx.to_dict())
+                payload = json.dumps(commit, indent=1).encode()
+                _io._atomic_write(
+                    _commit_path(self.publish_dir, version),
+                    lambda f: f.write(payload),
+                )
+            except BaseException:
+                shutil.rmtree(vdir, ignore_errors=True)
+                raise
+            # commit succeeded: NOW advance every cursor
+            self._next = version + 1
+            self._since_full = 0 if is_full else self._since_full + 1
+            if is_full:
+                self._fp = fp
+            else:
+                self._fp.update(fp)
+            self._row_marks.update(marks)
+            if self._engine is not None:
+                self._engine.commit_row_marks("publish", marks)
+            self._retire()
+            _obs.add("publish.versions")
+            _obs.set_gauge("publish.version", float(version))
+            _obs.add(
+                "publish.bytes",
+                int(sum(np.asarray(a).nbytes for a in arrays.values())),
+            )
+            _obs.observe(
+                "publish.commit_latency", time.perf_counter() - t0
+            )
+            return version
+
+    def _retire(self):
+        """Keep the last ``max_versions`` bundles plus every bundle a
+        kept delta still chains through — a base full is never rotated
+        out from under its dependents."""
+        committed = committed_versions(self.publish_dir)
+        if len(committed) <= self._max_versions:
+            return
+        keep = set(committed[-self._max_versions:])
+        for v in list(keep):
+            try:
+                keep.update(resolve_chain(self.publish_dir, v))
+            except CheckpointCorruptionError:
+                pass
+        for v in committed:
+            if v in keep:
+                continue
+            vdir = version_dir(self.publish_dir, v)
+            # commit record first: the dir stops existing to readers
+            # before its payload disappears
+            try:
+                os.unlink(_commit_path(self.publish_dir, v))
+            except OSError:
+                pass
+            shutil.rmtree(vdir, ignore_errors=True)
+
+
+# -- serving side ------------------------------------------------------------
+class ModelSubscriber:
+    """Follow a publish dir; apply updates all-or-nothing into a scope.
+
+    The subscriber mutates nothing until the full target payload is
+    folded and CRC-verified; the scope writes happen inside a
+    pre-mutation snapshot that any failure (including the
+    ``publish.apply`` seam) restores — so :attr:`version` only ever
+    names a fully-applied bundle. The caller provides the fence: apply
+    between batches (the process worker's serve loop) or under the
+    runner's dispatch lock (:class:`serving.rollout.SubscribedRunner`).
+    """
+
+    def __init__(self, publish_dir, main_program=None, scope=None,
+                 heartbeat=None, name="subscriber"):
+        self.publish_dir = os.fspath(publish_dir)
+        self._main = main_program
+        self._scope = scope
+        self._heartbeat = heartbeat
+        self.name = name
+        self.version = None
+        self.commit_time = None    # created_at of the applied bundle
+        self.shapes_changed = False  # did the LAST apply change any shape
+        self._applied_chain = ()   # chain of the applied version
+
+    # -- staleness ---------------------------------------------------------
+    def staleness_s(self, now=None):
+        """Seconds since the applied bundle was published (grows between
+        publishes, snaps down on apply — the monotonic-between-applies
+        contract the staleness test holds)."""
+        if self.commit_time is None:
+            return None
+        return max(0.0, (time.time() if now is None else now)
+                   - float(self.commit_time))
+
+    def _publish_gauges(self):
+        from .. import observability as _obs
+
+        if self.version is not None:
+            _obs.set_gauge("serving.model_version", float(self.version))
+        stale = self.staleness_s()
+        if stale is not None:
+            _obs.set_gauge("serving.model_staleness_seconds", stale)
+
+    # -- apply -------------------------------------------------------------
+    def target_version(self):
+        """Newest eligible (committed, not blocked) version, or None."""
+        return latest_version(self.publish_dir)
+
+    def poll(self):
+        """Apply the newest eligible version when it differs from the
+        applied one (a LOWER target = a rollback, taken via a full chain
+        re-fold). Returns the newly applied version, or None. Always
+        refreshes the staleness gauge."""
+        target = self.target_version()
+        applied = None
+        if target is not None and target != self.version:
+            applied = self.apply_version(target)
+        self._publish_gauges()
+        return applied
+
+    def apply_version(self, version):
+        """Fold `version` and swap it into the scope atomically (from
+        any reader's point of view: old version before, new version
+        after, nothing in between survives an error)."""
+        from .. import io as _io
+        from .. import observability as _obs
+        from ..observability import trace as _trace
+
+        version = int(version)
+        t0 = time.perf_counter()
+        commit = read_commit(self.publish_dir, version)
+        chain = resolve_chain(self.publish_dir, version)
+        # incremental when the applied version is an ancestor on this
+        # exact chain: only the new links fold, with the live scope as
+        # the row-delta base. Anything else — first apply, rollback,
+        # divergent chain — re-folds from the full bundle.
+        incremental = (
+            self.version is not None
+            and self.version in chain
+            and tuple(chain[: chain.index(self.version) + 1])
+            == tuple(self._applied_chain)
+        )
+        links = chain[chain.index(self.version) + 1:] if incremental \
+            else chain
+        acc = {}
+        payloads = [
+            _io.read_persistables(
+                version_dir(self.publish_dir, v), filename=PAYLOAD_NAME
+            )
+            for v in links
+        ]
+        if incremental:
+            # pre-seed row-delta bases from the live scope (the applied
+            # chain made it bitwise equal to the folded base)
+            for arrays in payloads:
+                for pname in arrays:
+                    if pname.endswith(_io.ROW_VAL_MARK):
+                        base = pname[: -len(_io.ROW_VAL_MARK)]
+                        val = self._find(base)
+                        if val is not None and base not in acc:
+                            acc[base] = np.array(val, copy=True)
+        for v, arrays in zip(links, payloads):
+            _io.merge_checkpoint_arrays(acc, arrays, f"publish v{v}")
+        # only names this scope actually serves apply; the rest (e.g.
+        # trainer-only optimizer state, host stores of an engine this
+        # replica does not run) are counted, not errors
+        apply, skipped = {}, 0
+        for pname, arr in acc.items():
+            if self._find(pname) is not None:
+                apply[pname] = arr
+            else:
+                skipped += 1
+        if skipped:
+            _obs.add("publish.arrays_skipped", skipped)
+        before = {pname: self._find(pname) for pname in apply}
+        ctx = None
+        if "trace_id" in commit:
+            ctx = _trace.TraceContext(
+                commit["trace_id"], commit.get("span_id")
+            )
+        try:
+            with _trace.activate(ctx):
+                # the apply seam, INSIDE the fence: a raising kind must
+                # leave the old version bitwise intact; a hang is the
+                # SIGKILL-mid-apply window
+                fault_point("publish.apply")
+                self._write(apply)
+        except BaseException:
+            self._write(before, restore=True)
+            _obs.add("publish.apply_failures")
+            raise
+        self.shapes_changed = any(
+            tuple(np.shape(before[pname])) != tuple(np.shape(arr))
+            for pname, arr in apply.items()
+        )
+        self.version = version
+        self.commit_time = commit.get("created_at")
+        self._applied_chain = tuple(chain)
+        _obs.add("publish.applies")
+        _obs.observe("publish.apply_latency", time.perf_counter() - t0)
+        self._publish_gauges()
+        if self._heartbeat is not None:
+            try:
+                self._heartbeat.set_stamp("model_version", version)
+            except Exception:
+                pass  # a broken stamp must not fail an apply
+        return version
+
+    # -- scope plumbing ----------------------------------------------------
+    def _find(self, name):
+        from ..framework.scope import global_scope
+
+        scope = self._scope if self._scope is not None else global_scope()
+        return scope.find_var(name)
+
+    def _write(self, arrays, restore=False):
+        import jax.numpy as jnp
+
+        from ..framework.scope import global_scope
+
+        scope = self._scope if self._scope is not None else global_scope()
+        for name, arr in arrays.items():
+            scope.set_var(
+                name, arr if restore else jnp.asarray(arr)
+            )

@@ -59,7 +59,16 @@ ReplicaSet exists to bound). The process-fleet worker protocol adds
 length-prefixed socket stream): raising kinds surface as a typed
 ``TransportError`` the fleet's breaker + exactly-once failover absorb,
 and ``hang`` wedges one wire call until the attempt-timeout watchdog
-types it — transport chaos without killing any process. The catalog is
+types it — transport chaos without killing any process. The live-publish
+plane adds ``publish.commit`` (inside ``ModelPublisher.publish`` AFTER
+the payload write but BEFORE the ``commit.json`` visibility barrier:
+raising kinds leave an invisible carcass the next publish reclaims, and
+``hang`` holds the bundle uncommitted — the SIGKILL-mid-publish window)
+and ``publish.apply`` (inside a ``ModelSubscriber``'s scope mutation,
+between the pre-apply snapshot and the version flip: raising kinds
+exercise the torn-apply fence — the snapshot restores and the version
+gauge never moves — and ``hang`` wedges a worker mid-apply for the
+respawn-consistency chaos stage). The catalog is
 documented in README §Resilience.
 """
 

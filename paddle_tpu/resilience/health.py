@@ -102,6 +102,10 @@ class Heartbeat:
         self.rank = int(rank)
         self.step = 0
         self._time = _time
+        # sticky key/value stamps merged into every beat (e.g. the live
+        # publish plane's model_version): a fleet reader can tell which
+        # model version a worker serves from its beat file alone
+        self._stamps = {}
         # beat() is called from the step loop AND (during an async
         # checkpoint publish) from the publisher's liveness pulse; the
         # counter bump + tmp/replace pair must not interleave
@@ -140,6 +144,8 @@ class Heartbeat:
         payload = {
             "rank": self.rank, "step": self.step, "time": self._time()
         }
+        if self._stamps:
+            payload.update(self._stamps)
         from ..observability import trace as _trace
 
         ctx = _trace.current()
@@ -169,6 +175,16 @@ class Heartbeat:
                 pass
             raise
         return payload
+
+    def set_stamp(self, key, value):
+        """Set a sticky stamp merged into every subsequent beat/touch and
+        republish immediately (so the stamp lands even on an idle rank).
+        Reserved payload keys (rank/step/time) are refused."""
+        if key in ("rank", "step", "time"):
+            raise ValueError(f"heartbeat stamp key {key!r} is reserved")
+        with self._lock:
+            self._stamps[str(key)] = value
+            return self._publish_locked()
 
     def touch(self):
         """Republish the CURRENT step with a fresh wall-clock time — an

@@ -67,13 +67,19 @@ __all__ = ["DEFAULT_LADDER", "BrownoutController"]
 
 # rung -> Endpoint.apply_brownout kwargs; index 0 is full service.
 # Demand-reducing rungs (shed) come BEFORE the capacity-reducing one
-# (bucket cap) — see the module docstring.
+# (bucket cap) — see the module docstring. "freeze_publishes" is NOT an
+# endpoint knob: the controller pops it and routes it to the live-publish
+# plane (a ``publish_control`` with freeze/unfreeze — e.g.
+# ``serving.rollout.RolloutController``), so a server already shedding
+# interactive-adjacent traffic stops paying model-apply stalls and
+# canary churn on top; recovery below the rung unfreezes.
 DEFAULT_LADDER = (
     {"wait_scale": 1.0, "bucket_frac": 1.0, "shed_priority": None},
     {"wait_scale": 0.5, "bucket_frac": 1.0, "shed_priority": None},
     {"wait_scale": 0.25, "bucket_frac": 1.0, "shed_priority": BACKGROUND},
     {"wait_scale": 0.25, "bucket_frac": 1.0, "shed_priority": BATCH},
-    {"wait_scale": 0.25, "bucket_frac": 0.5, "shed_priority": BATCH},
+    {"wait_scale": 0.25, "bucket_frac": 0.5, "shed_priority": BATCH,
+     "freeze_publishes": True},
 )
 
 _BREACH_KINDS = ("slo_breach", "step_regression")
@@ -84,7 +90,8 @@ class BrownoutController:
 
     def __init__(self, server, slo_p99_s=None, watcher=None,
                  ladder=DEFAULT_LADDER, escalate_after=2, recover_after=4,
-                 recover_margin=0.8, interval=0.5, autoscaler=None):
+                 recover_margin=0.8, interval=0.5, autoscaler=None,
+                 publish_control=None):
         if len(ladder) < 2:
             raise InvalidArgumentError(
                 "brownout ladder needs >= 2 rungs (rung 0 = full service)"
@@ -102,6 +109,7 @@ class BrownoutController:
         self.recover_margin = float(recover_margin)
         self.interval = float(interval)
         self.autoscaler = autoscaler
+        self.publish_control = publish_control
         self.latency_metric = "serving.request_latency"
         self.level = 0
         self._breach_obs = 0
@@ -186,6 +194,17 @@ class BrownoutController:
         from .. import observability as _obs
 
         rung = dict(self.ladder[self.level])
+        # the publish-freeze rung key is consumed here, never forwarded:
+        # Endpoint.apply_brownout owns latency knobs only
+        freeze = bool(rung.pop("freeze_publishes", False))
+        if self.publish_control is not None:
+            try:
+                if freeze:
+                    self.publish_control.freeze()
+                else:
+                    self.publish_control.unfreeze()
+            except Exception:
+                pass  # degraded publishing must not break degradation
         endpoints = getattr(self._server, "endpoints", None)
         eps = (
             list(endpoints().values()) if callable(endpoints)

@@ -229,7 +229,9 @@ class ProcessReplicaSet(ReplicaSet):
                  heartbeat_timeout=10.0, max_restarts=3,
                  restart_backoff=0.25, restart_backoff_cap=5.0,
                  spawn_timeout=60.0, workdir=None, name="fleet",
-                 host="127.0.0.1", env=None, python=None):
+                 host="127.0.0.1", env=None, python=None,
+                 publish_dir=None, publish_mode="follow",
+                 publish_poll=0.5):
         from .. import observability as _obs
         from ..resilience.health import PREEMPTION_EXIT_CODE, \
             heartbeat_path
@@ -244,6 +246,16 @@ class ProcessReplicaSet(ReplicaSet):
         self.max_replicas = int(max_replicas or n_workers)
         self.min_replicas = max(1, int(min_replicas))
         self.warm_buckets = tuple(int(b) for b in warm_buckets)
+        if publish_mode not in ("follow", "managed"):
+            raise InvalidArgumentError(
+                f"publish_mode must be 'follow' or 'managed', got "
+                f"{publish_mode!r}"
+            )
+        self.publish_dir = (
+            None if publish_dir is None else os.fspath(publish_dir)
+        )
+        self.publish_mode = publish_mode
+        self.publish_poll = float(publish_poll)
         self.spawn_timeout = float(spawn_timeout)
         self.host = host
         self._python = python or sys.executable
@@ -362,6 +374,15 @@ class ProcessReplicaSet(ReplicaSet):
             cmd += [
                 "--warm-buckets",
                 ",".join(str(b) for b in self.warm_buckets),
+            ]
+        if self.publish_dir:
+            # the worker catches up to the last committed version before
+            # readiness, so a respawn after a mid-apply SIGKILL rejoins
+            # consistent with no parent involvement
+            cmd += [
+                "--publish-dir", self.publish_dir,
+                "--publish-mode", self.publish_mode,
+                "--publish-poll", str(self.publish_poll),
             ]
         env = dict(os.environ)
         env.update(self._extra_env)
@@ -563,6 +584,27 @@ class ProcessReplicaSet(ReplicaSet):
         """Live worker pids (the orphan-check surface for tests/CI)."""
         with self._sup_lock:
             return [p.pid for p in self._sup.live_procs()]
+
+    # -- live publish plane ------------------------------------------------
+    def apply_update(self, wname, version=None, timeout=30.0):
+        """Tell one worker to apply a published model version (None =
+        newest eligible); the worker serializes the apply against its
+        batch loop, so this is fence-safe by construction. Returns the
+        ``applied`` reply dict; worker-side failures raise typed."""
+        reply = self._clients[wname].call(
+            "apply_update", {"version": version}, timeout=timeout
+        )
+        if reply.get("kind") == "error":
+            raise _typed_remote_error(reply["etype"], reply["msg"])
+        return reply
+
+    def worker_digest(self, wname, timeout=30.0):
+        """One worker's per-persistable CRC32 digest — the cross-process
+        bitwise-equality probe CI compares against a cold chain fold."""
+        reply = self._clients[wname].call("digest", timeout=timeout)
+        if reply.get("kind") == "error":
+            raise _typed_remote_error(reply["etype"], reply["msg"])
+        return reply
 
     def try_scale_out(self):
         """Spawn one more worker (async: it enters rotation when ready).

@@ -138,6 +138,11 @@ class ReplicaSet:
                     f"{self.fetch_names}"
                 )
         self._lock = threading.Lock()
+        # bucket feeds remembered from warmup_run, keyed by batch size:
+        # restore_replica(rewarm=True) replays them on JUST the restored
+        # replica so a shape-changing live update re-compiles outside any
+        # measured request
+        self._warm_feeds = {}
         # round-robin cursor over the healthy set; starts so the FIRST
         # dispatch lands on the first declared replica (deterministic)
         self._rr = -1
@@ -171,6 +176,12 @@ class ReplicaSet:
         and fault seam bypassed: a standby that compiles during failover
         would pay the cold-start exactly when latency matters most.
         Returns the last replica's outputs (the warmup discards them)."""
+        if feed:
+            # remember one feed per bucket size so a restored replica
+            # can be re-warmed alone (restore_replica(rewarm=True))
+            batch = len(next(iter(feed.values())))
+            with self._lock:
+                self._warm_feeds[int(batch)] = feed
         out = None
         for rep in self._order:
             if not rep.draining:
@@ -399,13 +410,31 @@ class ReplicaSet:
         drain = getattr(rep.runner, "drain", None)
         return drain(timeout) if drain is not None else True
 
-    def restore_replica(self, name):
+    def restore_replica(self, name, rewarm=False):
         """Re-admit a drained (or broken) replica with a reset breaker —
-        the replaced-replica path. The caller re-warms via
-        ``Endpoint.warmup()`` when the new runner is cold."""
+        the replaced-replica path. With ``rewarm=True`` the feeds
+        remembered from :meth:`warmup_run` are replayed on JUST this
+        replica first (while it is still out of rotation), so a live
+        update that changed persistable shapes — a grown hot tier, say —
+        pays its re-compiles here instead of inside a measured request.
+        (Without remembered feeds, or for a cold new runner, the caller
+        falls back to a full ``Endpoint.warmup()``.)"""
         from .. import observability as _obs
 
         rep = self._find(name)
+        if rewarm:
+            with self._lock:
+                feeds = list(self._warm_feeds.values())
+            for feed in feeds:
+                try:
+                    rep.runner.run(feed)
+                except Exception:
+                    # a failed re-warm is a latency problem, not an
+                    # admission problem: the replica still restores and
+                    # the breaker machinery owns real dispatch failures
+                    break
+            if feeds:
+                _obs.add("serving.replica_rewarms")
         with self._lock:
             rep.draining = False
             rep.state = CLOSED

@@ -1,0 +1,430 @@
+"""Canaried live-model rollout: publish dir → replica set, with rollback.
+
+The publish plane (``fleet/publish.py``) makes versions durable and
+subscribers fence their applies; this module decides WHEN each replica
+moves, because a fleet that applies every version everywhere at once has
+no blast-radius control. :class:`RolloutController` drives the PR-15/17
+replica machinery through the canonical staged shape:
+
+1. **Canary** — a new eligible version is applied to ONE replica (the
+   apply itself is fenced: a process worker serializes it against its
+   batch loop, an in-process :class:`SubscribedRunner` holds its
+   dispatch lock), which then soaks under live traffic for
+   ``canary_soak_ticks`` polls while the PR-13 Watcher signal (p99
+   breach findings), the dispatch/batch error counters, and an optional
+   finite-output probe batch all get a veto.
+2. **Staged rollout** — a passing canary promotes the version replica by
+   replica through drain → apply → restore, each restore re-warming the
+   replica's bucket set when the update changed persistable shapes
+   (``ReplicaSet.restore_replica(rewarm=True)``; process workers re-warm
+   themselves), so compiles never land inside a measured request.
+3. **Post-rollout soak** — ``breach_ticks`` consecutive breach polls
+   after a fleet-wide rollout trigger **automatic rollback**: every
+   replica re-folds to the last-good version (the full-chain downgrade
+   path — bitwise the cold load of that version), the bad version is
+   recorded in ``blocked.json`` so followers and respawns skip it
+   forever, and a FlightRecorder dump preserves the telemetry window
+   that convicted it.
+
+A failing canary takes the same rollback path with a one-replica blast
+radius. :meth:`freeze`/:meth:`unfreeze` stop new rollouts without
+touching serving — the brownout ladder's "freeze publishes" rung wires
+here, so an overloaded server stops paying apply stalls exactly when
+latency is scarcest.
+
+Counters/gauges: ``publish.canary_starts`` / ``publish.canary_passes``
+/ ``publish.canary_fails`` / ``publish.rollouts`` /
+``publish.rollbacks`` / ``publish.freezes``, plus the fleet-level
+``serving.model_version`` / ``serving.model_staleness_seconds`` gauges
+(per-worker twins live in each worker's journal shard).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..errors import InvalidArgumentError
+from ..fleet import publish as _publish
+
+__all__ = ["RolloutController", "SubscribedRunner"]
+
+_BREACH_KINDS = ("slo_breach", "step_regression")
+
+
+class SubscribedRunner:
+    """An in-process runner + fenced subscriber, for single-process
+    replica sets. ``run`` and ``apply_update`` share one lock — the
+    epoch fence: a batch sees the version that was fully applied before
+    it started, never a mid-apply mixture (process workers get the same
+    guarantee positionally from their single-threaded serve loop)."""
+
+    def __init__(self, runner, subscriber):
+        self.runner = runner
+        self.subscriber = subscriber
+        self.feed_names = tuple(runner.feed_names)
+        self.fetch_names = tuple(getattr(runner, "fetch_names", ()))
+        self._fence = threading.Lock()
+
+    def sample_spec(self, name):
+        return self.runner.sample_spec(name)
+
+    @property
+    def version(self):
+        return self.subscriber.version
+
+    def run(self, feed):
+        with self._fence:
+            return self.runner.run(feed)
+
+    def apply_update(self, version=None):
+        """Fenced apply; returns the ``applied``-reply shape the process
+        fleet's ``apply_update`` message returns, so the rollout
+        controller treats both transports uniformly."""
+        with self._fence:
+            applied = (
+                self.subscriber.apply_version(version)
+                if version is not None else self.subscriber.poll()
+            )
+        return {
+            "applied": applied,
+            "version": self.subscriber.version,
+            "staleness_s": self.subscriber.staleness_s(),
+            "shapes_changed": bool(self.subscriber.shapes_changed),
+            "self_warmed": False,
+        }
+
+
+class RolloutController:
+    """Drive canaried rollout + automatic rollback over a replica set.
+
+    ``replica_set`` is a :class:`~paddle_tpu.serving.replica.ReplicaSet`
+    (or :class:`~paddle_tpu.serving.fleet.ProcessReplicaSet`) whose
+    replicas can apply published versions: in-process replicas wrap
+    their runner in :class:`SubscribedRunner`; process fleets spawn
+    their workers with ``publish_mode="managed"`` so THIS controller is
+    the only thing that moves versions. :meth:`poll` is the control
+    tick — pure enough to unit-test, live enough to thread.
+    """
+
+    def __init__(self, replica_set, publish_dir, watcher=None,
+                 canary_soak_ticks=2, post_soak_ticks=4, breach_ticks=2,
+                 error_counters=("serving.dispatch_failures",
+                                 "serving.worker.batch_errors"),
+                 probe_feed=None, interval=0.5, clock=time.time):
+        if int(canary_soak_ticks) < 1 or int(breach_ticks) < 1:
+            raise InvalidArgumentError(
+                "canary_soak_ticks and breach_ticks must be >= 1"
+            )
+        self.replica_set = replica_set
+        self.publish_dir = publish_dir
+        self.watcher = watcher
+        self.canary_soak_ticks = int(canary_soak_ticks)
+        self.post_soak_ticks = int(post_soak_ticks)
+        self.breach_ticks = int(breach_ticks)
+        self.error_counters = tuple(error_counters)
+        self.probe_feed = probe_feed
+        self.interval = float(interval)
+        self._clock = clock
+        self.version = None        # fleet-wide rolled-out (last good)
+        self.commit_time = None
+        self.state = "idle"        # idle | canary | post
+        self._candidate = None
+        self._canary = None
+        self._soak_left = 0
+        self._post_left = 0
+        self._breach_streak = 0
+        self._err_base = None
+        self.frozen = False
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- publish-control surface (brownout's freeze rung) ------------------
+    def freeze(self):
+        from .. import observability as _obs
+
+        with self._lock:
+            if not self.frozen:
+                self.frozen = True
+                _obs.add("publish.freezes")
+        _obs.set_gauge("publish.frozen", 1.0)
+
+    def unfreeze(self):
+        from .. import observability as _obs
+
+        with self._lock:
+            self.frozen = False
+        _obs.set_gauge("publish.frozen", 0.0)
+
+    # -- signal ------------------------------------------------------------
+    def _errors_now(self):
+        from .. import observability as _obs
+
+        counters = _obs.get_counters()
+        return sum(counters.get(c, 0) for c in self.error_counters)
+
+    def _probe_ok(self, rep_name):
+        """Run the probe batch through one replica; False on nonfinite
+        outputs or a probe failure (both are canary vetoes)."""
+        if self.probe_feed is None:
+            return True
+        from .. import observability as _obs
+
+        rep = self.replica_set._find(rep_name)
+        try:
+            outs = rep.runner.run(self.probe_feed)
+        except Exception:
+            return False
+        for out in outs or ():
+            arr = np.asarray(out)
+            if np.issubdtype(arr.dtype, np.inexact) and not np.all(
+                np.isfinite(arr)
+            ):
+                _obs.add("publish.nonfinite_probes")
+                return False
+        return True
+
+    def _breach(self, canary=None):
+        """One soak observation: watcher findings/latch, error-counter
+        delta since the soak started, probe verdict."""
+        findings = self.watcher.poll() if self.watcher is not None else ()
+        if any(f.get("kind") in _BREACH_KINDS for f in findings or ()):
+            return True
+        if self.watcher is not None and getattr(
+            self.watcher, "breaching", False
+        ):
+            return True
+        if self._err_base is not None and (
+            self._errors_now() > self._err_base
+        ):
+            return True
+        if canary is not None and not self._probe_ok(canary):
+            return True
+        return False
+
+    # -- apply plumbing ----------------------------------------------------
+    def _apply(self, rep_name, version):
+        """Apply `version` on one replica over whichever transport it
+        has; returns the normalized ``applied`` reply."""
+        fleet_apply = getattr(self.replica_set, "apply_update", None)
+        if fleet_apply is not None:
+            reply = fleet_apply(rep_name, version)
+            reply.setdefault("self_warmed", True)
+            return reply
+        runner = self.replica_set._find(rep_name).runner
+        apply_update = getattr(runner, "apply_update", None)
+        if apply_update is None:
+            raise InvalidArgumentError(
+                f"replica {rep_name!r} can apply no published updates "
+                "(wrap its runner in SubscribedRunner, or use a "
+                "ProcessReplicaSet with publish_dir)"
+            )
+        return apply_update(version)
+
+    def _replica_names(self):
+        with self.replica_set._lock:
+            return [
+                rep.name for rep in self.replica_set._order
+                if not rep.draining
+            ]
+
+    def _staged(self, names, version):
+        """Drain → apply → restore each replica in turn; the set keeps
+        serving on the others throughout."""
+        for name in names:
+            self.replica_set.drain_replica(name)
+            try:
+                reply = self._apply(name, version)
+            except Exception:
+                # a replica that cannot take the version stays consistent
+                # on its old one; restore it and surface the failure
+                self.replica_set.restore_replica(name)
+                raise
+            rewarm = bool(reply.get("shapes_changed")) and not bool(
+                reply.get("self_warmed")
+            )
+            self.replica_set.restore_replica(name, rewarm=rewarm)
+
+    def _adopt(self, version):
+        from .. import observability as _obs
+
+        self.version = version
+        try:
+            self.commit_time = _publish.read_commit(
+                self.publish_dir, version
+            ).get("created_at")
+        except Exception:
+            self.commit_time = None
+        _obs.set_gauge("serving.model_version", float(version))
+        self._publish_staleness()
+
+    def _publish_staleness(self):
+        from .. import observability as _obs
+
+        if self.commit_time is not None:
+            _obs.set_gauge(
+                "serving.model_staleness_seconds",
+                max(0.0, self._clock() - float(self.commit_time)),
+            )
+
+    def _rollback(self, names, bad, trigger):
+        """The auto-rollback path (canary-fail AND post-rollout breach):
+        re-fold every affected replica onto the last-good version, block
+        the bad one fleet-wide, and dump the flight recorder."""
+        from .. import observability as _obs
+        from ..observability import recorder as _recorder
+
+        last_good = self.version
+        rolled = []
+        if last_good is not None:
+            self._staged(names, last_good)
+            rolled = list(names)
+        else:
+            # no good version to re-fold to: keep the poisoned replicas
+            # out of rotation rather than serving a convicted model
+            for name in names:
+                self.replica_set.drain_replica(name)
+            _obs.add("publish.canary_stranded")
+        _publish.block_version(self.publish_dir, bad)
+        _obs.add("publish.rollbacks")
+        _recorder.flight_dump("publish_rollback", detail={
+            "trigger": trigger, "bad_version": int(bad),
+            "rolled_back_to": last_good, "replicas": rolled,
+        })
+
+    # -- control tick ------------------------------------------------------
+    def poll(self):
+        """One rollout decision tick; returns the controller state."""
+        from .. import observability as _obs
+
+        self._publish_staleness()
+        if self.state == "idle":
+            if self.frozen:
+                return self.state
+            target = _publish.latest_version(self.publish_dir)
+            if target is None or target == self.version:
+                return self.state
+            names = self._replica_names()
+            if not names:
+                return self.state
+            canary = names[0]
+            self._err_base = self._errors_now()
+            try:
+                self._apply(canary, target)
+            except Exception:
+                # the subscriber's fence kept the canary on its old
+                # version; convict the bundle without any rollback
+                _publish.block_version(self.publish_dir, target)
+                _obs.add("publish.canary_fails")
+                return self.state
+            self._candidate = target
+            self._canary = canary
+            self._soak_left = self.canary_soak_ticks
+            self.state = "canary"
+            _obs.add("publish.canary_starts")
+            return self.state
+        if self.state == "canary":
+            if self._breach(canary=self._canary):
+                self._rollback([self._canary], self._candidate, "canary")
+                _obs.add("publish.canary_fails")
+                self._candidate = self._canary = None
+                self.state = "idle"
+                return self.state
+            self._soak_left -= 1
+            if self._soak_left > 0:
+                return self.state
+            _obs.add("publish.canary_passes")
+            rest = [
+                n for n in self._replica_names() if n != self._canary
+            ]
+            try:
+                self._staged(rest, self._candidate)
+            except Exception:
+                # mid-rollout failure: the fleet is split — roll the
+                # already-updated replicas back rather than serving two
+                # versions indefinitely
+                done = [self._canary] + [
+                    n for n in rest
+                    if self._version_of(n) == self._candidate
+                ]
+                self._rollback(done, self._candidate, "staged_rollout")
+                self._candidate = self._canary = None
+                self.state = "idle"
+                return self.state
+            self._adopt(self._candidate)
+            self._candidate = self._canary = None
+            self._post_left = self.post_soak_ticks
+            self._breach_streak = 0
+            self.state = "post"
+            _obs.add("publish.rollouts")
+            return self.state
+        if self.state == "post":
+            if self._breach():
+                self._breach_streak += 1
+            else:
+                self._breach_streak = 0
+            if self._breach_streak >= self.breach_ticks:
+                bad = self.version
+                # the previous good version is the rollback target
+                self.version, self.commit_time = None, None
+                prior = [
+                    v for v in _publish.committed_versions(
+                        self.publish_dir
+                    )
+                    if v < bad and v not in _publish.read_blocked(
+                        self.publish_dir
+                    )
+                ]
+                self.version = prior[-1] if prior else None
+                if self.version is not None:
+                    self._adopt(self.version)
+                self._rollback(
+                    self._replica_names(), bad, "post_rollout"
+                )
+                self._breach_streak = 0
+                self.state = "idle"
+                return self.state
+            self._post_left -= 1
+            if self._post_left <= 0:
+                self.state = "idle"
+            return self.state
+        return self.state
+
+    def _version_of(self, name):
+        runner = self.replica_set._find(name).runner
+        return getattr(runner, "version", None)
+
+    # -- live wiring -------------------------------------------------------
+    def start(self):
+        """Poll on a daemon thread every ``interval`` seconds."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serving-rollout"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval * 4 + 1.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll()
+            except Exception:
+                pass  # a broken tick must not kill the controller

@@ -50,6 +50,7 @@ import struct
 import sys
 import tempfile
 import threading
+import time
 
 from ..errors import UnavailableError
 
@@ -224,6 +225,21 @@ def parse_args(argv=None):
                         "rotation hot")
     p.add_argument("--attempt", type=int, default=0,
                    help="restart attempt number (supervisor bookkeeping)")
+    p.add_argument("--publish-dir", default="",
+                   help="live model publish dir to subscribe to; on "
+                        "(re)spawn the worker catches up to the newest "
+                        "committed version BEFORE publishing readiness, "
+                        "so a corpse killed mid-apply rejoins bitwise "
+                        "equal to a cold load of that version")
+    p.add_argument("--publish-poll", type=float, default=0.5,
+                   help="seconds between publish-dir polls in follow "
+                        "mode (updates apply between batches — the "
+                        "torn-read fence)")
+    p.add_argument("--publish-mode", default="follow",
+                   choices=("follow", "managed"),
+                   help="follow: auto-apply new versions between "
+                        "batches; managed: apply only on explicit "
+                        "apply_update messages (canaried rollout)")
     return p.parse_args(argv)
 
 
@@ -231,9 +247,6 @@ class _WorkerState:
     """The loaded model + serving loop state for one worker process."""
 
     def __init__(self, args):
-        import numpy as np
-
-        from ..core.dtypes import to_numpy_dtype
         from ..framework.executor import Executor
         from ..framework.scope import Scope
         from .freeze import load_frozen
@@ -251,6 +264,27 @@ class _WorkerState:
         self.batches = 0
         self.draining = threading.Event()
         self.heartbeat = self._make_heartbeat()
+        # live publish plane: subscribe BEFORE warmup/readiness, so a
+        # respawned corpse (even one SIGKILLed mid-apply) rejoins on the
+        # last committed version — cold frozen load + committed chain =
+        # bitwise-equal to a cold load of that version by construction
+        self.subscriber = None
+        self._follow = False
+        self._poll_s = max(0.05, float(getattr(args, "publish_poll", 0.5)))
+        self._next_poll = 0.0
+        publish_dir = getattr(args, "publish_dir", "")
+        if publish_dir:
+            from ..fleet.publish import ModelSubscriber
+
+            self.subscriber = ModelSubscriber(
+                publish_dir, main_program=frozen.program,
+                scope=self.scope, heartbeat=self.heartbeat,
+                name=args.name,
+            )
+            self._follow = getattr(
+                args, "publish_mode", "follow"
+            ) == "follow"
+            self.subscriber.poll()
         # warm the configured buckets NOW, before readiness: a cold
         # worker entering rotation would pay its compiles inside a
         # user-visible request (the PR-6 warmup lesson), and a respawned
@@ -259,12 +293,77 @@ class _WorkerState:
             int(b) for b in args.warm_buckets.split(",") if b.strip()
         ]
         for b in buckets:
-            feed = {}
-            for name in self.runner.feed_names:
-                shape, dtype = self.runner.sample_spec(name)
-                feed[name] = np.zeros((b,) + shape, to_numpy_dtype(dtype))
-            self.runner.run(feed)
+            self.runner.run(self._zero_feed(b))
         self.warmed = tuple(buckets)
+
+    def _zero_feed(self, batch):
+        import numpy as np
+
+        from ..core.dtypes import to_numpy_dtype
+
+        feed = {}
+        for name in self.runner.feed_names:
+            shape, dtype = self.runner.sample_spec(name)
+            feed[name] = np.zeros((batch,) + shape, to_numpy_dtype(dtype))
+        return feed
+
+    def _rewarm(self):
+        """Re-compile the warmed buckets after a shape-changing apply —
+        outside any measured request (the satellite-2 contract)."""
+        from .. import observability as _obs
+
+        for b in self.warmed:
+            try:
+                self.runner.run(self._zero_feed(b))
+            except Exception:
+                break
+        if self.warmed:
+            _obs.add("serving.worker.rewarms")
+
+    def _after_apply(self):
+        if self.subscriber is not None and self.subscriber.shapes_changed:
+            self._rewarm()
+
+    def maybe_follow(self):
+        """Follow-mode poll, called ONLY between protocol messages — the
+        serve loop is single-threaded, so this placement IS the epoch
+        fence: no batch can observe a half-applied version."""
+        from .. import observability as _obs
+
+        if self.subscriber is None or not self._follow:
+            return None
+        now = time.monotonic()
+        if now < self._next_poll:
+            return None
+        self._next_poll = now + self._poll_s
+        try:
+            applied = self.subscriber.poll()
+        except Exception:
+            # the fence restored the old version; retry next poll (an
+            # injected once-only fault heals, a bad bundle gets blocked
+            # by the rollout controller)
+            _obs.add("publish.follow_failures")
+            return None
+        if applied is not None:
+            self._after_apply()
+        return applied
+
+    def digest(self):
+        """CRC32 per scope-resident persistable of the frozen program —
+        the cross-process bitwise-equality surface (CI compares a
+        delta-updated worker against a cold fold of the same version)."""
+        from .. import io as _io
+
+        out = {}
+        for var in self.runner.frozen.program.list_vars():
+            if not getattr(var, "persistable", False) or getattr(
+                var, "is_data", False
+            ):
+                continue
+            val = self.scope.find_var(var.name)
+            if val is not None:
+                out[var.name] = _io._array_entry(val)["crc32"]
+        return out
 
     def _make_heartbeat(self):
         from ..resilience.health import HEARTBEAT_DIR_ENV, Heartbeat
@@ -314,10 +413,48 @@ class _WorkerState:
                     pass  # a broken beat must not fail a served batch
             return {"kind": "result", "id": mid, "outs": list(outs)}
         if kind == "ping":
-            return {
+            pong = {
                 "kind": "pong", "id": mid, "pid": os.getpid(),
                 "batches": self.batches,
             }
+            if self.subscriber is not None:
+                pong["model_version"] = self.subscriber.version
+                pong["staleness_s"] = self.subscriber.staleness_s()
+            return pong
+        if kind == "apply_update":
+            # handled between batches by construction (one message at a
+            # time on this loop) — the same fence follow-mode polls use
+            if self.subscriber is None:
+                return {
+                    "kind": "error", "id": mid,
+                    "etype": "PreconditionNotMetError",
+                    "msg": "worker has no --publish-dir subscription",
+                }
+            version = msg.get("version")
+            try:
+                applied = (
+                    self.subscriber.apply_version(version)
+                    if version is not None else self.subscriber.poll()
+                )
+            except Exception as exc:
+                _obs.add("serving.worker.apply_errors")
+                return {
+                    "kind": "error", "id": mid,
+                    "etype": type(exc).__name__, "msg": str(exc),
+                }
+            if applied is not None:
+                self._after_apply()
+            return {
+                "kind": "applied", "id": mid, "applied": applied,
+                "version": self.subscriber.version,
+                "staleness_s": self.subscriber.staleness_s(),
+                "shapes_changed": bool(self.subscriber.shapes_changed),
+            }
+        if kind == "digest":
+            reply = {"kind": "digest", "id": mid, "crc": self.digest()}
+            if self.subscriber is not None:
+                reply["version"] = self.subscriber.version
+            return reply
         if kind == "shutdown":
             return {"kind": "bye", "id": mid}
         return {
@@ -378,6 +515,7 @@ def worker_main(argv=None):
     rc = 0
     try:
         while not state.draining.is_set():
+            state.maybe_follow()
             try:
                 conn, _addr = srv.accept()
             except socket.timeout:
@@ -389,6 +527,9 @@ def worker_main(argv=None):
                 conn.settimeout(0.25)
                 bye = False
                 while not state.draining.is_set() and not bye:
+                    # between-messages = between-batches: the only place
+                    # a followed update may apply (torn-read fence)
+                    state.maybe_follow()
                     try:
                         msg = recv_msg(conn)
                     except socket.timeout:

@@ -105,6 +105,16 @@ def analyze_shard(path, step_metric="executor.step_latency",
         "goodput": counters.get("serving.goodput", 0),
         "requests_served": counters.get("serving.requests_served", 0),
     }
+    gauges = st.state.get("gauges", {})
+    version = gauges.get("serving.model_version")
+    if version is not None:
+        # the live-publish plane stamps these gauges into every journal
+        # record: the report can show which model version each process
+        # serves — and how far behind the freshest one it runs
+        summary["model_version"] = int(version)
+        stale = gauges.get("serving.model_staleness_seconds")
+        if stale is not None:
+            summary["model_staleness_s"] = float(stale)
     return summary, points, st
 
 
@@ -190,6 +200,22 @@ def build_report(directory, bin_s=1.0, step_metric="executor.step_latency",
             "per_rank_last_step": steps,
         }
     dead = [s for s in shards if s.get("dead")]
+    versions = {
+        str(s["rank"]): s["model_version"] for s in shards
+        if s.get("model_version") is not None
+    }
+    publish_skew = {}
+    if versions:
+        vmax, vmin = max(versions.values()), min(versions.values())
+        publish_skew = {
+            "per_rank_version": versions,
+            "max_version": vmax,
+            "min_version": vmin,
+            "max_skew": vmax - vmin,
+            "lagging_ranks": sorted(
+                int(r) for r, v in versions.items() if v < vmax
+            ),
+        }
     return {
         "dir": directory,
         "shards": shards,
@@ -206,6 +232,7 @@ def build_report(directory, bin_s=1.0, step_metric="executor.step_latency",
             "timeline": _binned(all_points, bin_s),
             "step_time": step_curves,
             "straggler": straggler,
+            "publish_skew": publish_skew,
         },
     }
 
@@ -228,6 +255,15 @@ def render(report):
         lines.append(
             f"  DEAD: rank {d['rank']} (pid {d['pid']}) — journal stale "
             f"{d['stale_s']:.1f}s"
+        )
+    skew = fleet.get("publish_skew")
+    if skew:
+        lag = skew["lagging_ranks"]
+        lines.append(
+            f"  publish skew: versions "
+            f"{skew['min_version']}..{skew['max_version']} "
+            f"(max skew {skew['max_skew']})"
+            + (f"; lagging rank(s) {lag}" if lag else "")
         )
     strag = fleet["straggler"]
     if strag:
