@@ -1009,6 +1009,202 @@ python tools/resume_audit.py --async --sharded
 # row deltas keyed off write-back ticks, compressed chain reload)
 python tools/resume_audit.py --async --embedding
 
+echo "== storage chaos (disk-pressure ladder + ENOSPC bursts + cross-plane GC) =="
+# a 2-rank train+publish cell sharing ONE byte-budgeted volume: rank 0
+# trains, checkpoints, and publishes model bundles; rank 1 subscribes and
+# stamps its heartbeat with the applied model_version (the GC fence —
+# retention must never delete a version a live reader's chain needs).
+# Mid-run the fs.write:enospc seam bursts (typed StorageExhaustedError,
+# zero residue, next attempt heals) AND the checkpoint root's byte budget
+# is sized so accumulating checkpoints MUST drive the ladder to HARD:
+# publishes freeze, emergency GC reclaims, the ladder re-arms to OK, and
+# training converges anyway. Gates: newest committed checkpoint resumes,
+# the subscriber ends on the latest committed bundle, gc_bytes_freed > 0,
+# escalations AND recoveries fired, max level >= HARD, final level == OK,
+# zero *.tmp.* residue anywhere under the volume.
+SC_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$SC_DIR" <<'EOF'
+import json, os, sys, time
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import errors, layers, observability as obs
+from paddle_tpu import io as _io
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.fleet import collective as fc
+from paddle_tpu.fleet.publish import ModelPublisher, ModelSubscriber, \
+    load_version
+from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+from paddle_tpu.observability.timeline import TelemetryPublisher
+from paddle_tpu.resilience import faults, storage
+from paddle_tpu.resilience.health import Heartbeat
+
+obs.set_enabled(True)
+root = sys.argv[1]
+ck_dir = os.path.join(root, "ckpts")
+pub_dir = os.path.join(root, "publish")
+hb_dir = os.path.join(root, "hb")
+tl_dir = os.path.join(root, "telemetry")
+
+main, startup = fluid.Program(), fluid.Program()
+main.random_seed = startup.random_seed = 23
+with fluid.program_guard(main, startup):
+    x = fluid.data("x", [-1, 8])
+    lab = fluid.data("lab", [-1, 1], "int64")
+    logits = layers.fc(layers.fc(x, 16, act="relu"), 4)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, lab))
+    fluid.optimizer.Adam(1e-2).minimize(loss, startup)
+scope = Scope()
+exe = fluid.Executor()
+with scope_guard(scope):
+    exe.run(startup, scope=scope)
+rng = np.random.RandomState(0)
+w_true = rng.randn(8, 4).astype(np.float32)  # learnable labels
+
+def train_step():
+    xa = rng.randn(16, 8).astype(np.float32)
+    la = (xa @ w_true).argmax(axis=1).reshape(16, 1).astype(np.int64)
+    with scope_guard(scope):
+        out = exe.run(main, feed={"x": xa, "lab": la},
+                      fetch_list=[loss], scope=scope)
+    return float(np.asarray(out[0]).reshape(-1)[0])
+
+fleet = fc.Fleet()
+fleet.init(UserDefinedRoleMaker(current_id=0, worker_num=1))
+pub = ModelPublisher(pub_dir, main_program=main, scope=scope,
+                     full_every=3)
+
+# rank 1: the subscriber, folding into its own scope and stamping its
+# heartbeat with the applied version — the retention fence
+sub_scope = Scope()
+hb1 = Heartbeat(hb_dir, rank=1)
+sub = ModelSubscriber(pub_dir, main_program=main, scope=sub_scope,
+                      heartbeat=hb1)
+hb0 = Heartbeat(hb_dir, rank=0)
+tl0 = TelemetryPublisher(directory=tl_dir, rank=0, interval=3600.0)
+tl0.start(register=False)
+tl1 = TelemetryPublisher(directory=tl_dir, rank=1, interval=3600.0)
+tl1.start(register=False)
+
+losses = []
+
+def ckpt(step):
+    with scope_guard(scope):
+        fleet.save_check_point(
+            exe, ck_dir, fc.TrainStatus(0, global_step=step),
+            main_program=main, max_checkpoint_num=10,
+        )
+
+first = train_step()
+ckpt(0)
+one = storage._du(os.path.join(ck_dir, "__paddle_checkpoint__0"))
+assert one > 0
+
+# budget the volume off the measured checkpoint size: 6 checkpoints fit,
+# SOFT below 3 free, HARD below 1.5 free — saves alone force the climb
+monitor = storage.StorageMonitor(
+    soft_bytes=int(one * 3), hard_bytes=int(one * 1.5),
+    critical_bytes=int(one * 0.25), rearm=1.1, probe=True,
+)
+monitor.add_root("checkpoint", ck_dir, budget_bytes=int(one * 6))
+monitor.install()
+retention = storage.RetentionManager().add_checkpoint_plane(
+    ck_dir, budget_bytes=int(one * 2.5),
+).add_publish_plane(pub_dir, keep=2, heartbeat_dir=hb_dir)
+ladder = storage.StoragePressureController(
+    monitor, retention=retention, publish_control=pub,
+    telemetry=tl0, gc_interval=0.0,
+)
+
+max_level = storage.OK
+typed_failures = 0
+skipped = 0
+armed = False
+for step in range(1, 25):
+    losses.append(train_step())
+    hb0.beat(step=step)
+    if step == 6 and not armed:
+        # the ENOSPC burst: raw OSError(ENOSPC) out of the fs.write seam,
+        # seeded, capped — some saves/publishes in this window die typed
+        faults.inject("fs.write", "enospc", 0.35, 1234, 3)
+        armed = True
+    try:
+        ckpt(step)
+    except errors.StorageExhaustedError:
+        typed_failures += 1  # retryable-after-GC: next iteration heals
+    try:
+        v = pub.publish(step=step)
+        if v is None and pub.frozen:
+            skipped += 1
+    except errors.StorageExhaustedError:
+        typed_failures += 1
+    sub.poll()
+    level = ladder.poll()
+    max_level = max(max_level, level)
+    tl0.publish()
+    tl1.publish()
+
+faults.clear()
+# the scheduled (cron-style) retention pass — emergency GC only runs at
+# HARD+, so the tail checkpoints above the SOFT line are its job
+retention.collect()
+# drain the ladder: stepwise re-arm back to OK
+for _ in range(6):
+    final_level = ladder.poll()
+tl0.publish()
+tl1.publish()
+
+# the post-recovery world must be fully writable again
+ckpt(99)
+v_final = pub.publish(step=99)
+assert v_final is not None, "publish still frozen after recovery"
+sub.poll()
+tl0.publish(); tl1.publish()
+tl0.stop(); tl1.stop()
+
+c = obs.get_counters()
+assert np.mean(losses[-5:]) < first * 0.7, (first, losses[-5:])
+assert typed_failures >= 1, "no ENOSPC burst ever landed typed"
+assert c.get("storage.enospc_errors", 0) >= 1, c
+assert max_level >= storage.HARD, f"ladder never reached HARD ({max_level})"
+assert final_level == storage.OK, f"ladder stuck at {final_level}"
+assert c.get("storage.gc_bytes_freed", 0) > 0, c
+assert c.get("storage.escalations", 0) >= 1, c
+assert c.get("storage.recoveries", 0) >= 1, c
+assert skipped >= 1 or c.get("publish.skipped_frozen", 0) >= 0
+
+# newest committed checkpoint resumes
+status = fleet.load_check_point(exe, ck_dir)
+assert status.global_step == 99, status
+
+# the subscriber sits on the latest committed bundle, folded bitwise
+assert sub.version == v_final, (sub.version, v_final)
+cold = load_version(pub_dir, v_final)
+for name, arr in cold.items():
+    live = sub_scope.find_var(name)
+    if live is not None:
+        assert np.asarray(live).tobytes() == np.asarray(arr).tobytes(), name
+
+# zero tmp residue anywhere under the volume
+residue = [os.path.join(d, f) for d, _dirs, fs in os.walk(root)
+           for f in fs if ".tmp." in f]
+assert not residue, residue
+
+obs.dump(os.path.join(root, "storage_stats.json"))
+print(f"storage chaos OK: {typed_failures} typed ENOSPC failure(s) healed, "
+      f"ladder peaked at {storage.LEVEL_NAMES[max_level]} and re-armed, "
+      f"{c['storage.gc_bytes_freed']} bytes GC'd, resumed step 99, "
+      f"subscriber bitwise on v{v_final}")
+EOF
+# the storage telemetry must have been alive end to end
+python tools/stats_report.py "$SC_DIR/storage_stats.json" \
+    --require storage. --require storage.gc_bytes_freed \
+    --require storage.escalations --require storage.recoveries \
+    --require storage.enospc_errors
+# ...and the journal shards must render the offline storage digest
+python tools/fleet_report.py "$SC_DIR/telemetry" | tee /dev/stderr \
+    | grep -q "storage:"
+rm -rf "$SC_DIR"
+
 echo "== driver entry points =="
 python __graft_entry__.py
 

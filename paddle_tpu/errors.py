@@ -156,6 +156,24 @@ class CheckpointCorruptionError(EnforceNotMet, OSError):
     retryable = False
 
 
+class StorageExhaustedError(EnforceNotMet, OSError):
+    """A durable write ran out of disk: the filesystem returned ``ENOSPC``/
+    ``EDQUOT``, the preflight free-space check found less room than the
+    payload needs, or the storage pressure ladder is at CRITICAL and
+    refusing new checkpoint/publish writes outright. An OSError so generic
+    IO handlers still catch it, and retryable-after-GC by design: unlike
+    :class:`CheckpointCorruptionError`, retrying CAN succeed — but only
+    once space is reclaimed, so the retry policies treat it as
+    non-retryable in-place (``retryable = False``) and the caller is
+    expected to run (or wait for) ``resilience.storage.RetentionManager``
+    GC before trying again. The failed write itself is clean: io.py's
+    atomic writers unlink their temp file on every failure path, so a full
+    disk never accretes ``*.tmp.*`` garbage that makes itself fuller."""
+
+    code = ErrorCode.RESOURCE_EXHAUSTED
+    retryable = False
+
+
 class NonFiniteError(PreconditionNotMetError):
     """A NaN/Inf reached a numeric health check: the executor's
     FLAGS_check_nan_inf per-op scan (which names the offending op via

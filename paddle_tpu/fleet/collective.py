@@ -566,7 +566,7 @@ class Fleet:
         remain_all_checkpoint=False, max_checkpoint_num=3, local_vars=None,
         per_rank=None, shard_wait_timeout=120.0, snapshot=None,
         heartbeat=None, compress=False, delta_meta=None,
-        shard_arrays_fn=None,
+        shard_arrays_fn=None, max_checkpoint_bytes=None,
     ):
         """Save persistables + the full TrainStatus into a new numbered
         checkpoint dir and rotate old ones. The payload is written locally
@@ -606,7 +606,17 @@ class Fleet:
         zlib-compressed payloads; `delta_meta` marks the dir as a delta
         link ({"base_checkpoint_no": M, "chain_len": K}) whose payload
         holds only changed arrays/rows — rotation then spares every chain
-        ancestor a surviving delta still needs."""
+        ancestor a surviving delta still needs.
+
+        Storage fault domain: the save first consults
+        ``resilience.storage.require_writable("checkpoint")`` — at
+        CRITICAL pressure it refuses with a typed StorageExhaustedError
+        before touching the FS. `max_checkpoint_bytes` adds a BYTES
+        budget to rotation (local backends; remote dirs measure 0 and
+        opt out): oldest checkpoints beyond the budget rotate even
+        inside `max_checkpoint_num`, with the chain-ancestor and
+        completeness sparing below still overriding — durability
+        invariants outrank the budget."""
         import tempfile
         import time as _time
 
@@ -618,6 +628,11 @@ class Fleet:
         from ..resilience.faults import fault_point
         from ..resilience.health import LivenessPulse
 
+        from ..resilience import storage as _storage_domain
+
+        # the CRITICAL-rung gate: refuse before any FS work, on every
+        # rank (a shard publish is a durable write too)
+        _storage_domain.require_writable("checkpoint")
         fs = fs or LocalFS()
         if per_rank is None:
             per_rank = local_vars is not None
@@ -752,6 +767,27 @@ class Fleet:
                 # bad publish
                 self._verify_published(fs, ckpt)
                 doomed = (nos + [no])[:-max_checkpoint_num]
+                if max_checkpoint_bytes is not None:
+                    # bytes-budget rotation: oldest survivors join the
+                    # doomed list until the plane fits (remote dirs
+                    # measure 0 bytes, so the budget no-ops there); the
+                    # completeness/chain sparing below still overrides
+                    survivors = [
+                        n for n in (nos + [no]) if n not in doomed
+                    ]
+                    sizes = {
+                        n: _dir_bytes(
+                            os.path.join(path, f"{_CHECKPOINT_PREFIX}{n}")
+                        )
+                        for n in survivors
+                    }
+                    total = sum(sizes.values())
+                    while (total > int(max_checkpoint_bytes)
+                           and len(survivors) > 1):
+                        victim = survivors.pop(0)
+                        doomed.append(victim)
+                        total -= sizes[victim]
+                    doomed.sort()
                 if per_rank and doomed:
                     # the new checkpoint is complete only once every PEER
                     # attached its shard (asynchronously, after this
@@ -773,7 +809,9 @@ class Fleet:
                             # failure
                             return False
 
-                    survivors = (nos + [no])[-max_checkpoint_num:]
+                    survivors = [
+                        n for n in (nos + [no]) if n not in doomed
+                    ]
                     if not any(_complete(n) for n in survivors):
                         spared = next(
                             (n for n in reversed(doomed) if _complete(n)),
@@ -1421,7 +1459,7 @@ class AsyncCheckpointer:
                  max_checkpoint_num=3, remain_all_checkpoint=False,
                  queue_policy="coalesce", delta=False, full_every=4,
                  compress=False, row_oracles=None, heartbeat=None,
-                 shard_wait_timeout=120.0):
+                 shard_wait_timeout=120.0, max_checkpoint_bytes=None):
         from ..errors import InvalidArgumentError
 
         if queue_policy not in ("coalesce", "block"):
@@ -1458,6 +1496,11 @@ class AsyncCheckpointer:
         self._row_oracles = dict(row_oracles or {})
         self._heartbeat = heartbeat
         self._shard_wait_timeout = shard_wait_timeout
+        self._max_bytes = max_checkpoint_bytes
+        #: storage SOFT rung (resilience.storage ladder): while set,
+        #: publishes are forced compressed and full-save cadence defers
+        #: to delta-only (the chain's base obligation still wins)
+        self._storage_degraded = False
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -1673,11 +1716,35 @@ class AsyncCheckpointer:
             j.is_full for j in queued
         ):
             return True  # no full anywhere in the chain yet
+        if self._storage_degraded:
+            # SOFT rung: delta-only while the chain has its base — a
+            # full save is the most expensive write the plane makes,
+            # and the full_every cadence resumes on recovery
+            return False
         queued_deltas = sum(1 for j in queued if not j.is_full)
         return (
             (self._published_since_full or 0) + queued_deltas
             >= self._full_every
         )
+
+    def set_storage_degraded(self, active):
+        """Storage-pressure SOFT rung (called by
+        ``resilience.storage.StoragePressureController``): while active,
+        publishes are forced ``compress=True`` and the full-save cadence
+        defers to delta-only. No-op churn is fine — the controller
+        re-applies its rungs every poll. Requires ``delta=True`` for the
+        delta-only half; a non-delta checkpointer still gains the forced
+        compression."""
+        from .. import observability as _obs
+
+        with self._lock:
+            changed = self._storage_degraded != bool(active)
+            self._storage_degraded = bool(active)
+        if changed:
+            _obs.add(
+                "checkpoint.storage_degraded"
+                if active else "checkpoint.storage_restored"
+            )
 
     def _snapshot(self, train_status, aux, is_full):
         from .. import io as _io
@@ -1830,8 +1897,10 @@ class AsyncCheckpointer:
             snapshot=snap._replace_payloads(arrays, aux)
             if (arrays is not snap.arrays or aux is not snap.aux)
             else snap,
-            heartbeat=self._heartbeat, compress=self._compress,
+            heartbeat=self._heartbeat,
+            compress=self._compress or self._storage_degraded,
             delta_meta=delta_meta, shard_arrays_fn=shard_arrays_fn,
+            max_checkpoint_bytes=self._max_bytes,
         )
         _obs.observe(
             "checkpoint.async_publish_latency", time.perf_counter() - t0

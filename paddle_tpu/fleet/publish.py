@@ -221,6 +221,15 @@ class ModelPublisher:
     commits the bundle with the commit record LAST. Fingerprints and
     row cursors advance only after a successful commit, so a failed
     publish changes nothing and the next one re-carries its rows.
+
+    The publisher is a freeze target for the storage pressure ladder
+    (the HARD rung, same contract as ``RolloutController.freeze``):
+    while frozen, ``publish()`` is a cheap skip returning None
+    (``publish.skipped_frozen``) — cursors don't advance, so the first
+    post-thaw bundle carries everything the frozen window trained.
+    Construction also sweeps ``*.tmp.*`` residue out of the publish
+    root: the publisher is the dir's single writer, so any temp file
+    found at startup is a dead predecessor's and safe to unlink.
     """
 
     def __init__(self, publish_dir, main_program=None, scope=None,
@@ -245,10 +254,37 @@ class ModelPublisher:
         self._fp = {}              # name -> manifest entry at last commit
         self._row_marks = {}       # oracle key -> mark at last commit
         self._since_full = None    # deltas since the last committed full
+        self._frozen = False
         self._lock = threading.Lock()
         os.makedirs(self.publish_dir, exist_ok=True)
+        from .. import io as _io
+
+        _io.sweep_stale_tmp(self.publish_dir, recursive=True)
         committed = committed_versions(self.publish_dir)
         self._next = (committed[-1] + 1) if committed else 1
+
+    # -- the freeze rung ---------------------------------------------------
+    @property
+    def frozen(self):
+        return self._frozen
+
+    def freeze(self, reason=None):
+        """Stop emitting bundles (storage HARD rung / operator hold).
+        Idempotent; already-committed versions stay readable."""
+        from .. import observability as _obs
+
+        if not self._frozen:
+            self._frozen = True
+            _obs.add("publish.freezes")
+            if reason:
+                _obs.add(f"publish.freezes.{reason}")
+        _obs.set_gauge("publish.frozen", 1.0)
+
+    def unfreeze(self):
+        from .. import observability as _obs
+
+        self._frozen = False
+        _obs.set_gauge("publish.frozen", 0.0)
 
     # -- payload assembly --------------------------------------------------
     def _collect(self):
@@ -329,7 +365,12 @@ class ModelPublisher:
         from .. import io as _io
         from .. import observability as _obs
         from ..observability import trace as _trace
+        from ..resilience import storage as _storage
 
+        if self._frozen:
+            _obs.add("publish.skipped_frozen")
+            return None
+        _storage.require_writable("publish")
         with self._lock:
             t0 = time.perf_counter()
             is_full = self._since_full is None or (

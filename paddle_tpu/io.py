@@ -21,11 +21,21 @@ discipline, generalized to every writer here):
   undecodable container (pre-manifest checkpoints still load, container
   errors are still typed);
 * ``fault_point("io.save")`` / ``fault_point("io.load")`` seams let the
-  resilience fault registry chaos-test every caller.
+  resilience fault registry chaos-test every caller;
+* the writers are ENOSPC-safe (the storage fault domain, PR 19): an
+  optional ``estimated_size=`` preflights the target volume's free bytes
+  before any byte is written, ``ENOSPC``/``EDQUOT`` from the filesystem
+  maps to the typed :class:`~paddle_tpu.errors.StorageExhaustedError`
+  (retryable after GC — see ``resilience/storage.py``), the
+  ``fault_point("fs.write")`` seam fires after the temp file exists so
+  injected disk-full always exercises the unlink path, and
+  :func:`sweep_stale_tmp` gives every durable root a startup sweep for
+  ``*.tmp.*`` residue of crashed writers.
 """
 
 from __future__ import annotations
 
+import errno as _errno
 import json
 import os
 import pickle
@@ -34,7 +44,7 @@ import zlib
 
 import numpy as np
 
-from .errors import CheckpointCorruptionError
+from .errors import CheckpointCorruptionError, StorageExhaustedError
 from .framework.program import Parameter, Program, default_main_program
 from .framework.scope import global_scope
 from .resilience.faults import fault_point
@@ -55,6 +65,7 @@ __all__ = [
     "read_persistables",
     "apply_persistables",
     "merge_checkpoint_arrays",
+    "sweep_stale_tmp",
 ]
 
 MANIFEST_NAME = "manifest.json"
@@ -85,26 +96,146 @@ def _fsync_dir(path):
         os.close(fd)
 
 
-def _atomic_write(path, write_fn):
+#: Preflight knobs: ``PADDLE_TPU_STORAGE_PREFLIGHT=0`` disables the
+#: free-space check; the slack keeps a near-full volume from being filled
+#: to its very last byte by a "fitting" payload (manifests, commit
+#: records, and sibling writers need room too).
+PREFLIGHT_ENV = "PADDLE_TPU_STORAGE_PREFLIGHT"
+PREFLIGHT_SLACK_ENV = "PADDLE_TPU_STORAGE_PREFLIGHT_SLACK"
+_DEFAULT_PREFLIGHT_SLACK = 1 << 20  # 1 MiB
+
+
+def _free_bytes(dirname):
+    """Free bytes on `dirname`'s volume — through the storage fault
+    domain when a monitor with a byte-budgeted root covers the path
+    (deterministic tests/CI fill a BUDGET, not the real disk), else a
+    plain statvfs. None when unknowable."""
+    try:
+        from .resilience import storage as _storage
+
+        return _storage.free_bytes(dirname)
+    except Exception:
+        try:
+            st = os.statvfs(dirname)
+            return st.f_bavail * st.f_frsize
+        except (OSError, AttributeError):
+            return None
+
+
+def _storage_preflight(dirname, estimated_size):
+    if os.environ.get(PREFLIGHT_ENV, "1").lower() in ("0", "false", "off"):
+        return
+    free = _free_bytes(dirname)
+    if free is None:
+        return
+    try:
+        slack = int(os.environ.get(
+            PREFLIGHT_SLACK_ENV, _DEFAULT_PREFLIGHT_SLACK))
+    except ValueError:
+        slack = _DEFAULT_PREFLIGHT_SLACK
+    if int(estimated_size) + slack > free:
+        from . import observability as _obs
+
+        _obs.add("storage.preflight_rejects")
+        raise StorageExhaustedError(
+            f"durable write into {dirname!r} refused by preflight: "
+            f"~{int(estimated_size)} byte payload (+{slack} slack) vs "
+            f"{free} free bytes — run retention GC (or free space) and "
+            "retry"
+        )
+
+
+def _map_storage_error(exc, path):
+    """OSError carrying ENOSPC/EDQUOT -> typed StorageExhaustedError
+    (anything else passes through unchanged). The temp file is already
+    unlinked by the time this runs — a full disk never keeps the garbage
+    that filled it."""
+    if isinstance(exc, StorageExhaustedError):
+        return exc
+    if isinstance(exc, OSError) and exc.errno in (
+        _errno.ENOSPC, getattr(_errno, "EDQUOT", _errno.ENOSPC)
+    ):
+        from . import observability as _obs
+
+        _obs.add("storage.enospc_errors")
+        return StorageExhaustedError(
+            f"durable write of {path!r} hit "
+            f"{_errno.errorcode.get(exc.errno, exc.errno)}: {exc} — "
+            "retryable after retention GC frees space"
+        )
+    return None
+
+
+def _atomic_write(path, write_fn, estimated_size=None):
     """Run `write_fn(file_obj)` against a temp file in `path`'s directory,
-    fsync it, and publish with os.replace — the torn-write guarantee."""
+    fsync it, and publish with os.replace — the torn-write guarantee.
+    With `estimated_size` the write preflights the volume's free bytes
+    and refuses (typed) before creating anything; an ENOSPC/EDQUOT from
+    the filesystem mid-write surfaces as the same typed
+    :class:`StorageExhaustedError`, temp already unlinked."""
     dirname = os.path.dirname(os.path.abspath(path))
+    if estimated_size is not None:
+        _storage_preflight(dirname, estimated_size)
     fd, tmp = tempfile.mkstemp(
         dir=dirname, prefix=os.path.basename(path) + ".tmp."
     )
     try:
         with os.fdopen(fd, "wb") as f:
+            # the storage chaos seam: AFTER the temp exists, BEFORE any
+            # payload byte — every fired kind walks the unlink path
+            fault_point("fs.write")
             write_fn(f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
         _fsync_dir(dirname)
-    except BaseException:
+    except BaseException as e:
         try:
             os.unlink(tmp)
         except OSError:
             pass
+        mapped = _map_storage_error(e, path)
+        if mapped is not None and mapped is not e:
+            raise mapped from e
         raise
+
+
+def sweep_stale_tmp(dirname, prefix=None, recursive=False):
+    """Unlink stale ``*.tmp.*`` residue of crashed atomic writers under
+    `dirname` (every mkstemp here and in the observability writers names
+    its temp ``<target>.tmp.<rand>``). `prefix` restricts the sweep to
+    one writer's files — multi-writer roots (a telemetry dir shared by
+    ranks) must only sweep names the restarting process owns, since a
+    LIVE sibling may be mid-publish. Returns the bytes reclaimed and
+    counts ``storage.stale_tmp_swept``. Never raises."""
+    freed = 0
+    swept = 0
+    try:
+        walker = (
+            os.walk(dirname) if recursive
+            else ((dirname, (), os.listdir(dirname)),)
+        )
+        for root, _dirs, files in walker:
+            for name in files:
+                if ".tmp." not in name:
+                    continue
+                if prefix is not None and not name.startswith(prefix):
+                    continue
+                p = os.path.join(root, name)
+                try:
+                    freed += os.path.getsize(p)
+                    os.unlink(p)
+                    swept += 1
+                except OSError:
+                    continue
+    except OSError:
+        return 0
+    if swept:
+        from . import observability as _obs
+
+        _obs.add("storage.stale_tmp_swept", swept)
+        _obs.add("storage.stale_tmp_bytes", freed)
+    return freed
 
 
 def _private_host_copy(val):
@@ -357,7 +488,12 @@ def save_arrays(dirname, arrays, filename=None, compress=False,
     os.makedirs(dirname, exist_ok=True)
     path = os.path.join(dirname, filename or "__params__.npz")
     writer = np.savez_compressed if compress else np.savez
-    _atomic_write(path, lambda f: writer(f, **arrays))
+    # preflight estimate: raw payload bytes + per-member container
+    # overhead — an upper bound for the compressed writer too, and the
+    # bound is what ENOSPC-safety wants
+    est = sum(int(np.asarray(a).nbytes) for a in arrays.values())
+    est += 1024 * (len(arrays) + 1)
+    _atomic_write(path, lambda f: writer(f, **arrays), estimated_size=est)
     _write_manifest(os.path.join(dirname, manifest_name or MANIFEST_NAME),
                     path, arrays)
     return path

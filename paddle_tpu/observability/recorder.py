@@ -21,9 +21,19 @@ trigger                   hook site
 Each trigger writes ``{dir}/flight_rank{K}.{trigger}.json``. SIGKILL
 cannot be hooked, so the recorder is ALSO a black box: a daemon thread
 re-publishes the current window to ``{dir}/flight_rank{K}.json``
-(temp + ``os.replace``, never torn) every ``interval`` seconds — after a
-kill -9 the last atomically-published window is still on disk, holding
-the spans and findings from just before death.
+(through ``io._atomic_write`` — full durability contract, never torn)
+every ``interval`` seconds — after a kill -9 the last
+atomically-published window is still on disk, holding the spans and
+findings from just before death.
+
+Trigger dumps are a bounded ring: a long-running fleet that rolls back,
+drains, and trips breakers for weeks would otherwise accrete bundles
+without limit. After every dump the recorder prunes its own rank's
+trigger bundles oldest-first down to ``PADDLE_TPU_FLIGHT_KEEP`` (default
+8); the black box is never pruned. Under storage pressure the ladder
+calls :meth:`FlightRecorder.suspend_disk` — sampling continues (the
+in-memory window stays fresh for an explicit ``dump()``) but the
+periodic black-box publishing stops until :meth:`resume_disk`.
 
 Hook sites call :func:`flight_dump`, a module-level no-op until a
 recorder is installed — zero cost on the default path, and the whole
@@ -36,8 +46,8 @@ from __future__ import annotations
 import collections
 import json
 import os
+import re
 import sys
-import tempfile
 import threading
 import time
 import traceback
@@ -45,13 +55,28 @@ import traceback
 from . import metrics, spans, timeline, trace
 
 __all__ = [
+    "FLIGHT_KEEP_ENV",
     "FlightRecorder",
     "flight_dump",
+    "flight_keep",
     "get_recorder",
     "install",
     "install_excepthook",
     "uninstall",
 ]
+
+FLIGHT_KEEP_ENV = "PADDLE_TPU_FLIGHT_KEEP"
+_DEFAULT_FLIGHT_KEEP = 8
+
+
+def flight_keep():
+    """Trigger-bundle ring size (``PADDLE_TPU_FLIGHT_KEEP``, default 8)."""
+    try:
+        return max(1, int(os.environ.get(
+            FLIGHT_KEEP_ENV, _DEFAULT_FLIGHT_KEEP
+        )))
+    except ValueError:
+        return _DEFAULT_FLIGHT_KEEP
 
 
 class FlightRecorder:
@@ -81,6 +106,7 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._paused = threading.Event()
+        self._disk_suspended = threading.Event()
         self._thread = None
 
     @property
@@ -93,6 +119,13 @@ class FlightRecorder:
         if not metrics.enabled():
             return self
         os.makedirs(self.directory, exist_ok=True)
+        # this rank's temp residue from a dead predecessor (the dir is
+        # shared with sibling ranks mid-publish, hence the prefix filter)
+        from .. import io as _io
+
+        _io.sweep_stale_tmp(
+            self.directory, prefix=f"flight_rank{self.rank}"
+        )
         if register:
             install(self)
         if self._thread is None:
@@ -123,6 +156,15 @@ class FlightRecorder:
 
     def resume(self):
         self._paused.clear()
+
+    def suspend_disk(self):
+        """Storage HARD rung: keep sampling the window in memory, stop
+        the periodic black-box publishing. An explicit ``dump()`` still
+        writes — a CRITICAL post-mortem outranks the bytes it costs."""
+        self._disk_suspended.set()
+
+    def resume_disk(self):
+        self._disk_suspended.clear()
 
     # -- the window --------------------------------------------------------
     def sample(self):
@@ -198,20 +240,40 @@ class FlightRecorder:
 
     # -- dumping -----------------------------------------------------------
     def _publish(self, bundle, path):
-        fd, tmp = tempfile.mkstemp(
-            dir=self.directory, prefix=os.path.basename(path) + ".tmp."
+        from .. import io as _io
+
+        payload = json.dumps(bundle, default=str).encode()
+        _io._atomic_write(
+            path, lambda f: f.write(payload), estimated_size=len(payload)
         )
+        return path
+
+    def _prune_ring(self):
+        """Drop this rank's oldest trigger bundles beyond the ring size.
+        The black box (no trigger infix) is exempt; sibling ranks' files
+        are theirs to prune."""
+        keep = flight_keep()
+        pat = re.compile(rf"^flight_rank{self.rank}\..+\.json$")
         try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(bundle, f, default=str)
-            os.replace(tmp, path)
-        except BaseException:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return
+        dumps = []
+        for fn in entries:
+            if not pat.match(fn) or ".tmp." in fn:
+                continue
+            p = os.path.join(self.directory, fn)
             try:
-                os.unlink(tmp)
+                dumps.append((os.path.getmtime(p), p))
+            except OSError:
+                continue
+        dumps.sort(reverse=True)  # newest first
+        for _mtime, p in dumps[keep:]:
+            try:
+                os.unlink(p)
+                metrics.add("telemetry.flight_pruned")
             except OSError:
                 pass
-            raise
-        return path
 
     def dump(self, trigger, exc=None, detail=None):
         """Write the post-mortem bundle for `trigger`; returns its path
@@ -228,13 +290,15 @@ class FlightRecorder:
         )
         self._publish(bundle, path)
         self._publish(bundle, self.path)
+        self._prune_ring()
         return path
 
     def _run(self):
         while not self._stop.wait(self.interval):
             try:
                 self.sample()
-                self._publish(self.window(), self.path)
+                if not self._disk_suspended.is_set():
+                    self._publish(self.window(), self.path)
             except Exception:
                 pass  # a broken publish must not kill the black box
 

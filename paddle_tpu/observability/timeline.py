@@ -517,6 +517,13 @@ class TelemetryPublisher:
 
     def _open_locked(self):
         os.makedirs(self.directory, exist_ok=True)
+        # a dead predecessor's failed atomic writes (this rank's prefix
+        # only — sibling ranks may be live mid-publish in the same dir)
+        from .. import io as _io
+
+        _io.sweep_stale_tmp(
+            self.directory, prefix=os.path.basename(self.path)
+        )
         if os.path.exists(self.path):
             # a previous process's shard: rotate it away rather than
             # appending this process's baseline behind its deltas

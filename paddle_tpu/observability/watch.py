@@ -17,7 +17,11 @@ instead of log lines:
   (catches slow decay AND sharp knees, not just absolute thresholds);
 * **slo_breach** — the latency metric's per-window p99 (estimated from
   histogram bucket deltas via :func:`metrics.window_p99`) exceeds
-  ``slo_p99_s``.
+  ``slo_p99_s``;
+* **disk_pressure** — with ``storage_monitor=`` (a
+  ``resilience.storage.StorageMonitor``), every per-root pressure-level
+  escalation out of the monitor's hysteresis latch becomes one finding
+  naming the root, the level, and the free bytes that tripped it.
 
 With ``journal_dir=`` the watcher additionally runs in **timeline-reader
 mode**: it follows the telemetry journals other processes publish
@@ -47,7 +51,8 @@ from . import metrics
 __all__ = ["Watcher"]
 
 _SEVERITY = {"straggler": "warning", "step_regression": "warning",
-             "slo_breach": "error", "dead_process": "error"}
+             "slo_breach": "error", "dead_process": "error",
+             "disk_pressure": "error"}
 
 
 def _hist_state(name):
@@ -79,8 +84,12 @@ class Watcher:
                  step_metric="executor.step_latency",
                  latency_metric="serving.request_latency",
                  interval=1.0, max_findings=256, journal_dir=None,
-                 dead_process_timeout=None):
+                 dead_process_timeout=None, storage_monitor=None):
         self.heartbeat_dir = heartbeat_dir
+        # storage fault domain: a resilience.storage.StorageMonitor whose
+        # level-change events become disk_pressure findings (escalations
+        # only — de-escalation is recovery, not a finding)
+        self.storage_monitor = storage_monitor
         # timeline-reader mode: follow OTHER processes' telemetry
         # journals (timeline.TelemetryPublisher shards) and raise
         # straggler/slo_breach findings off their replayed state — no
@@ -230,6 +239,27 @@ class Watcher:
         else:
             self._breaching = False
 
+    def _check_storage(self, new):
+        """Disk-pressure findings off the storage monitor's poll: every
+        per-root ESCALATION is one finding (the monitor's hysteresis is
+        the latch — no event fires again until the level actually moves,
+        so this check needs no latch of its own)."""
+        if self.storage_monitor is None:
+            return
+        from ..resilience import storage as _storage
+
+        info = self.storage_monitor.poll()
+        for root, old, lvl in info["events"]:
+            if lvl <= old:
+                continue  # recovery: counted by the monitor, not a finding
+            free = info["roots"][root]["free"]
+            new.append(self._emit("disk_pressure", {
+                "root": root,
+                "level": _storage.LEVEL_NAMES[lvl],
+                "previous": _storage.LEVEL_NAMES[old],
+                "free_bytes": free,
+            }))
+
     # -- the journal (remote-process) checks -------------------------------
     def _check_journals(self, new):
         from . import timeline
@@ -374,6 +404,7 @@ class Watcher:
         self._check_straggler(new)
         self._check_step_regression(new)
         self._check_slo(new)
+        self._check_storage(new)
         self._check_journals(new)
         return new
 

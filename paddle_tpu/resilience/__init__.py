@@ -20,7 +20,13 @@ they share:
 * :mod:`guard` — :class:`TrainGuard`, the step-loop wrapper tying it all
   together: always-on fused finite checks with bad-step skip, AMP
   loss-scale feedback, checkpoint rollback after K consecutive bad
-  steps, and SIGTERM drain-to-checkpoint.
+  steps, and SIGTERM drain-to-checkpoint;
+* :mod:`storage` — the storage fault domain: :class:`StorageMonitor`
+  free-space/write-latency probes with a hysteresis-latched pressure
+  level, :class:`RetentionManager` cross-plane GC, and the
+  :class:`StoragePressureController` degradation ladder (SOFT → HARD →
+  CRITICAL) every durable plane degrades along instead of dying on
+  ENOSPC.
 
 README §Resilience and §Training health guard document the fault-site
 catalog, env syntax, metric names, and the recovery policy knobs.
@@ -49,4 +55,10 @@ from .health import (  # noqa: F401
     read_beat,
 )
 from .retry import backoff_delay, default_retryable, retry  # noqa: F401
+from .storage import (  # noqa: F401
+    RetentionManager,
+    StorageMonitor,
+    StoragePressureController,
+    require_writable,
+)
 from .supervisor import Supervisor  # noqa: F401

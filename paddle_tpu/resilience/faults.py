@@ -16,12 +16,18 @@ Env syntax (comma/semicolon-separated specs)::
 
 ``kind`` selects the exception: ``io`` (ExternalError, an OSError),
 ``unavailable`` (UnavailableError), ``timeout`` (ExecutionTimeoutError) —
-all retryable — and ``corrupt`` (CheckpointCorruptionError, NOT retryable).
-Two kinds misbehave instead of raising: ``hang`` sleeps at the seam for
-``PADDLE_TPU_FAULT_HANG_SECONDS`` (default 3600 — "stuck", from a
-watchdog's point of view), and ``nonfinite`` poisons the value passing
-through a :func:`corrupt_point` seam with NaNs (at a plain
-:func:`fault_point` it degrades to raising NonFiniteError).
+all retryable — ``corrupt`` (CheckpointCorruptionError, NOT retryable),
+and ``enospc`` (a plain ``OSError`` carrying ``errno.ENOSPC`` — exactly
+what a full volume raises, so the io.py atomic writers' ENOSPC→
+``StorageExhaustedError`` mapping path is what gets exercised, not
+bypassed). Three kinds misbehave instead of raising: ``hang`` sleeps at
+the seam for ``PADDLE_TPU_FAULT_HANG_SECONDS`` (default 3600 — "stuck",
+from a watchdog's point of view), ``slow`` sleeps for
+``PADDLE_TPU_FAULT_SLOW_SECONDS`` (default 0.25 — a degraded disk, not a
+dead one: the write completes, the latency probe sees it), and
+``nonfinite`` poisons the value passing through a :func:`corrupt_point`
+seam with NaNs (at a plain :func:`fault_point` it degrades to raising
+NonFiniteError).
 ``prob`` in [0,1] is drawn from a per-spec ``random.Random(seed)``; the
 optional ``max_fires`` caps total fires (prob=1 + max_fires=1 = "fail
 exactly once, then heal" — the deterministic shape chaos CI wants).
@@ -68,8 +74,13 @@ and ``publish.apply`` (inside a ``ModelSubscriber``'s scope mutation,
 between the pre-apply snapshot and the version flip: raising kinds
 exercise the torn-apply fence — the snapshot restores and the version
 gauge never moves — and ``hang`` wedges a worker mid-apply for the
-respawn-consistency chaos stage). The catalog is
-documented in README §Resilience.
+respawn-consistency chaos stage). The storage fault domain adds
+``fs.write`` (inside ``io._atomic_write``, AFTER the temp file exists
+but BEFORE any byte lands, so every fired kind exercises the
+unlink-on-failure path: ``enospc`` is the disk filling mid-write —
+mapped to a typed ``StorageExhaustedError`` by the writer — and
+``slow`` a degraded volume the StorageMonitor's write-latency probe
+measures). The catalog is documented in README §Resilience.
 """
 
 from __future__ import annotations
@@ -82,6 +93,7 @@ import time
 __all__ = [
     "FAULT_ENV_VAR",
     "HANG_SECONDS_ENV",
+    "SLOW_SECONDS_ENV",
     "FaultSpec",
     "clear",
     "corrupt_point",
@@ -94,8 +106,10 @@ __all__ = [
 
 FAULT_ENV_VAR = "PADDLE_TPU_FAULT_INJECT"
 HANG_SECONDS_ENV = "PADDLE_TPU_FAULT_HANG_SECONDS"
+SLOW_SECONDS_ENV = "PADDLE_TPU_FAULT_SLOW_SECONDS"
 
-_KINDS = ("io", "unavailable", "timeout", "corrupt", "hang", "nonfinite")
+_KINDS = ("io", "unavailable", "timeout", "corrupt", "enospc", "hang",
+          "slow", "nonfinite")
 
 
 def _make_error(kind, site):
@@ -110,6 +124,14 @@ def _make_error(kind, site):
         return errors.ExecutionTimeoutError(msg)
     if kind == "corrupt":
         return errors.CheckpointCorruptionError(msg)
+    if kind == "enospc":
+        # a RAW OSError with the real errno, not the typed
+        # StorageExhaustedError: the production mapping (io._atomic_write
+        # catching ENOSPC/EDQUOT and raising the typed error with the
+        # temp unlinked) is exactly what the injection must exercise
+        import errno
+
+        return OSError(errno.ENOSPC, f"{os.strerror(errno.ENOSPC)} ({msg})")
     if kind == "nonfinite":
         return errors.NonFiniteError(msg)
     raise ValueError(f"unknown fault kind {kind!r} (one of {_KINDS})")
@@ -120,6 +142,13 @@ def _hang_seconds():
         return float(os.environ.get(HANG_SECONDS_ENV, "3600"))
     except ValueError:
         return 3600.0
+
+
+def _slow_seconds():
+    try:
+        return float(os.environ.get(SLOW_SECONDS_ENV, "0.25"))
+    except ValueError:
+        return 0.25
 
 
 def _poison(value):
@@ -274,13 +303,17 @@ def _draw(site):
 
 def fault_point(site):
     """The raise-style seam: no-op unless `site` is armed and its draw
-    fires. A fired ``hang`` sleeps instead of raising; ``nonfinite`` at a
-    raise-only seam degrades to raising NonFiniteError."""
+    fires. A fired ``hang``/``slow`` sleeps instead of raising;
+    ``nonfinite`` at a raise-only seam degrades to raising
+    NonFiniteError."""
     kind = _draw(site)
     if kind is None:
         return
     if kind == "hang":
         time.sleep(_hang_seconds())
+        return
+    if kind == "slow":
+        time.sleep(_slow_seconds())
         return
     raise _make_error(kind, site)
 
@@ -295,6 +328,9 @@ def corrupt_point(site, value):
         return value
     if kind == "hang":
         time.sleep(_hang_seconds())
+        return value
+    if kind == "slow":
+        time.sleep(_slow_seconds())
         return value
     if kind == "nonfinite":
         return _poison(value)

@@ -111,6 +111,11 @@ class Heartbeat:
         # counter bump + tmp/replace pair must not interleave
         self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
+        # a dead predecessor's failed beat publish leaves hb_rankK.tmp.*
+        # behind; this rank owns that prefix, sibling ranks own theirs
+        from .. import io as _io
+
+        _io.sweep_stale_tmp(directory, prefix=f"hb_rank{self.rank}")
 
     @property
     def path(self):

@@ -1,0 +1,827 @@
+"""Storage fault domain: disk pressure, retention GC, degradation ladder.
+
+Every durable plane the repo has grown — numbered checkpoints
+(fleet/collective.py), versioned publish bundles (fleet/publish.py),
+telemetry journals and flight bundles (observability/timeline.py,
+recorder.py), heartbeat files (health.py) — assumed a healthy volume
+with infinite space: there was not a single ``statvfs``/ENOSPC path in
+the tree, so a filling disk was the one failure mode that disabled *all*
+recovery machinery at once. This module is the shared fault domain:
+
+* :class:`StorageMonitor` — per-root free-space + write-latency probes
+  on the health-poll cadence, published as ``storage.free_bytes.<root>``
+  / ``storage.write_latency.<root>`` gauges, with a hysteresis-latched
+  pressure level per root (and overall): OK → SOFT → HARD → CRITICAL.
+  Escalation is immediate (a filling disk gets no grace); de-escalation
+  requires free bytes to clear the triggering threshold by a ``rearm``
+  margin, so a volume hovering at a boundary cannot flap the ladder. A
+  root may carry a ``budget_bytes`` synthetic volume (free = budget −
+  bytes used under the root) so tests and CI exercise every rung by
+  filling a BUDGET, never the real disk — and ``io.py``'s preflight
+  consults the same budget through :func:`free_bytes`.
+* :class:`RetentionManager` — cross-plane GC with per-plane policies,
+  invoked under pressure (or on a cadence): checkpoint rotation against
+  a bytes budget (sparing delta-chain ancestors of survivors), publish
+  bundle pruning (sparing ``resolve_chain`` ancestors of the newest
+  eligible version AND every version a live subscriber's heartbeat
+  still stamps — no reader's chain is ever cut), rotated telemetry
+  shards of dead processes, and aged flight bundles. Deletion is
+  crash-safe marker-first (the repo's established discipline: the
+  commit record is unlinked BEFORE the payload, so a dir stops existing
+  to readers before its bytes disappear — either crash half is
+  recoverable by the CRC-verify/skip-broken load machinery) and
+  journaled as ``storage.gc_bytes_freed`` (+ per-plane counters and a
+  ``storage.gc`` actions table).
+* :class:`StoragePressureController` — the degradation ladder walked
+  beside ``serving.brownout.BrownoutController``, shedding the cheapest
+  durability first: SOFT forces compressed, delta-only checkpoints and
+  aggressive telemetry rotation; HARD freezes model publishes (the
+  PR-18 freeze rung), drops telemetry journaling to the in-memory
+  registry only (the flight recorder keeps *sampling*, stops *writing*)
+  and runs emergency GC; CRITICAL refuses new checkpoint/publish writes
+  with a typed :class:`~paddle_tpu.errors.StorageExhaustedError` and
+  takes ONE flight dump — serving keeps running on the weights it has.
+  Every rung re-arms downward through the monitor's hysteresis;
+  transitions count ``storage.escalations`` / ``storage.recoveries``.
+
+The write-side contract lives in ``io.py``: atomic writers preflight an
+``estimated_size`` against :func:`free_bytes`, map ENOSPC/EDQUOT to
+``StorageExhaustedError`` with the temp already unlinked, and expose the
+``fault_point("fs.write")`` chaos seam (kinds ``enospc`` / ``slow``).
+:func:`require_writable` is the loose coupling back into the writers:
+checkpoint and publish entry points call it and get the CRITICAL-rung
+refusal without holding a controller reference.
+
+Env knobs: ``PADDLE_TPU_STORAGE_SOFT_BYTES`` (default 1 GiB),
+``PADDLE_TPU_STORAGE_HARD_BYTES`` (256 MiB),
+``PADDLE_TPU_STORAGE_CRITICAL_BYTES`` (64 MiB),
+``PADDLE_TPU_STORAGE_REARM`` (de-escalation margin factor, default
+1.25). README §Storage fault domain documents the full catalog.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+import time
+
+__all__ = [
+    "CRITICAL",
+    "CRITICAL_BYTES_ENV",
+    "HARD",
+    "HARD_BYTES_ENV",
+    "LEVEL_NAMES",
+    "OK",
+    "REARM_ENV",
+    "RetentionManager",
+    "SOFT",
+    "SOFT_BYTES_ENV",
+    "StorageMonitor",
+    "StoragePressureController",
+    "current_monitor",
+    "free_bytes",
+    "install",
+    "require_writable",
+    "uninstall",
+]
+
+# -- pressure levels ---------------------------------------------------------
+OK, SOFT, HARD, CRITICAL = 0, 1, 2, 3
+LEVEL_NAMES = {OK: "ok", SOFT: "soft", HARD: "hard", CRITICAL: "critical"}
+
+SOFT_BYTES_ENV = "PADDLE_TPU_STORAGE_SOFT_BYTES"
+HARD_BYTES_ENV = "PADDLE_TPU_STORAGE_HARD_BYTES"
+CRITICAL_BYTES_ENV = "PADDLE_TPU_STORAGE_CRITICAL_BYTES"
+REARM_ENV = "PADDLE_TPU_STORAGE_REARM"
+
+_DEFAULT_SOFT = 1 << 30        # 1 GiB
+_DEFAULT_HARD = 256 << 20      # 256 MiB
+_DEFAULT_CRITICAL = 64 << 20   # 64 MiB
+_DEFAULT_REARM = 1.25
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _du(path):
+    """Bytes used under `path` (os.walk; unreadable entries skipped)."""
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def _statvfs_free(path):
+    try:
+        st = os.statvfs(path)
+        return st.f_bavail * st.f_frsize
+    except (OSError, AttributeError):
+        return None
+
+
+# -- the monitor -------------------------------------------------------------
+class StorageMonitor:
+    """Per-root free-space / write-latency probes with a latched level.
+
+    ``add_root(name, path)`` registers a durable root (conventionally
+    ``"checkpoint"``, ``"publish"``, ``"telemetry"``, ``"heartbeat"`` —
+    the name keys the per-root gauges and the plane names
+    :func:`require_writable` checks). ``poll()`` probes every root,
+    publishes the gauges, advances the hysteresis latches, and returns
+    the poll summary (including level-change events a Watcher turns into
+    ``disk_pressure`` findings). ``install()`` makes this monitor the
+    process-global one the io.py preflight and ``require_writable``
+    consult.
+    """
+
+    def __init__(self, soft_bytes=None, hard_bytes=None,
+                 critical_bytes=None, rearm=None, probe=True,
+                 probe_bytes=4096):
+        soft = (_env_int(SOFT_BYTES_ENV, _DEFAULT_SOFT)
+                if soft_bytes is None else int(soft_bytes))
+        hard = (_env_int(HARD_BYTES_ENV, _DEFAULT_HARD)
+                if hard_bytes is None else int(hard_bytes))
+        crit = (_env_int(CRITICAL_BYTES_ENV, _DEFAULT_CRITICAL)
+                if critical_bytes is None else int(critical_bytes))
+        if not crit <= hard <= soft:
+            from ..errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "StorageMonitor thresholds must satisfy critical <= hard "
+                f"<= soft, got {crit} / {hard} / {soft}"
+            )
+        self.thresholds = {SOFT: soft, HARD: hard, CRITICAL: crit}
+        self.rearm = (_env_float(REARM_ENV, _DEFAULT_REARM)
+                      if rearm is None else float(rearm))
+        self.probe = bool(probe)
+        self._probe_payload = b"\0" * int(probe_bytes)
+        self.roots = {}
+        self.level = OK
+        self.polls = 0
+        self._lock = threading.Lock()
+
+    # -- roots -------------------------------------------------------------
+    def add_root(self, name, path, budget_bytes=None):
+        """Register a durable root; returns self (chainable). A
+        `budget_bytes` root reports ``budget - du(path)`` as its free
+        bytes — the synthetic volume tests/CI fill instead of the disk."""
+        path = os.path.abspath(os.fspath(path))
+        os.makedirs(path, exist_ok=True)
+        with self._lock:
+            self.roots[str(name)] = {
+                "path": path,
+                "budget": None if budget_bytes is None else int(budget_bytes),
+                "level": OK,
+                "free": None,
+                "latency": None,
+            }
+        return self
+
+    def install(self):
+        """Make this the process-global monitor (see :func:`install`)."""
+        install(self)
+        return self
+
+    # -- probes ------------------------------------------------------------
+    def _free_of(self, root):
+        if root["budget"] is not None:
+            return max(0, root["budget"] - _du(root["path"]))
+        return _statvfs_free(root["path"])
+
+    def _probe_latency(self, root):
+        """Timed tiny durable write into the root (through the full
+        io._atomic_write contract, fs.write seam included) — what the
+        ``storage.write_latency.<root>`` gauge reports. A failed probe
+        still reports its elapsed time and counts
+        ``storage.probe_failures``; it never raises."""
+        from .. import io as _io
+        from .. import observability as _obs
+
+        target = os.path.join(root["path"], ".storage_probe")
+        t0 = time.perf_counter()
+        try:
+            _io._atomic_write(target, lambda f: f.write(self._probe_payload))
+        except Exception:
+            _obs.add("storage.probe_failures")
+        finally:
+            try:
+                os.unlink(target)
+            except OSError:
+                pass
+        return time.perf_counter() - t0
+
+    def _raw_level(self, free):
+        if free is None:
+            return OK
+        if free < self.thresholds[CRITICAL]:
+            return CRITICAL
+        if free < self.thresholds[HARD]:
+            return HARD
+        if free < self.thresholds[SOFT]:
+            return SOFT
+        return OK
+
+    def _latch(self, root, free):
+        """Hysteresis: escalate immediately to the raw level; de-escalate
+        one rung at a time, only once free clears the current rung's
+        threshold by the re-arm margin."""
+        lvl = root["level"]
+        raw = self._raw_level(free)
+        if raw > lvl:
+            lvl = raw
+        else:
+            while lvl > raw and free is not None and (
+                free >= self.thresholds[lvl] * self.rearm
+            ):
+                lvl -= 1
+        root["level"] = lvl
+        return lvl
+
+    # -- the poll ----------------------------------------------------------
+    def poll(self):
+        """Probe every root; returns ``{"level", "previous", "events",
+        "roots"}`` where events is ``[(root_name, old_level, new_level),
+        ...]`` for roots whose latched level changed this poll."""
+        from .. import observability as _obs
+
+        events = []
+        with self._lock:
+            self.polls += 1
+            for name, root in self.roots.items():
+                free = self._free_of(root)
+                root["free"] = free
+                if self.probe:
+                    root["latency"] = self._probe_latency(root)
+                    _obs.set_gauge(
+                        f"storage.write_latency.{name}", root["latency"]
+                    )
+                if free is not None:
+                    _obs.set_gauge(f"storage.free_bytes.{name}", float(free))
+                old = root["level"]
+                new = self._latch(root, free)
+                _obs.set_gauge(f"storage.pressure.{name}", float(new))
+                if new != old:
+                    events.append((name, old, new))
+            previous = self.level
+            self.level = max(
+                [r["level"] for r in self.roots.values()], default=OK
+            )
+            overall = self.level
+            snapshot = {
+                name: dict(root) for name, root in self.roots.items()
+            }
+        _obs.set_gauge("storage.pressure", float(overall))
+        _obs.add("storage.polls")
+        if overall > previous:
+            _obs.add("storage.escalations")
+        elif overall < previous:
+            _obs.add("storage.recoveries")
+        return {
+            "level": overall,
+            "previous": previous,
+            "events": events,
+            "roots": snapshot,
+        }
+
+    def level_of(self, name=None):
+        """The latched level of one root (overall when `name` is None or
+        unregistered) — what :func:`require_writable` checks."""
+        with self._lock:
+            if name is not None and name in self.roots:
+                return self.roots[name]["level"]
+            return self.level
+
+    def free_of(self, name):
+        """Last-polled free bytes of one root, or None."""
+        with self._lock:
+            root = self.roots.get(name)
+            return None if root is None else root["free"]
+
+
+# -- process-global wiring ---------------------------------------------------
+_monitor: StorageMonitor | None = None
+
+
+def install(monitor):
+    """Make `monitor` the process-global storage monitor: io.py's
+    preflight resolves budget roots through it (:func:`free_bytes`) and
+    the checkpoint/publish writers' :func:`require_writable` gate reads
+    its latched level."""
+    global _monitor
+    _monitor = monitor
+    return monitor
+
+
+def uninstall():
+    global _monitor
+    _monitor = None
+
+
+def current_monitor():
+    return _monitor
+
+
+def free_bytes(path):
+    """Free bytes available for a write under `path`: the installed
+    monitor's budget when a byte-budgeted root covers the path (tests/CI
+    fill budgets, not disks), else statvfs; None when unknowable."""
+    mon = _monitor
+    path = os.path.abspath(os.fspath(path))
+    if mon is not None:
+        with mon._lock:
+            roots = [
+                (r["path"], r["budget"]) for r in mon.roots.values()
+                if r["budget"] is not None
+            ]
+        for rpath, budget in roots:
+            if path == rpath or path.startswith(rpath + os.sep):
+                return max(0, budget - _du(rpath))
+    return _statvfs_free(path)
+
+
+def require_writable(plane):
+    """The CRITICAL-rung refusal, loosely coupled: checkpoint and publish
+    entry points call this with their plane name ("checkpoint" /
+    "publish") and get a typed :class:`StorageExhaustedError` when the
+    installed monitor has that root (or the fleet overall) latched at
+    CRITICAL. A no-op when no monitor is installed — the default path
+    costs one global read."""
+    mon = _monitor
+    if mon is None:
+        return
+    level = mon.level_of(plane)
+    if level >= CRITICAL:
+        from .. import observability as _obs
+        from ..errors import StorageExhaustedError
+
+        _obs.add("storage.writes_refused")
+        _obs.add(f"storage.writes_refused.{plane}")
+        raise StorageExhaustedError(
+            f"storage pressure is CRITICAL: refusing new {plane} writes "
+            "until retention GC (or an operator) frees space — serving "
+            "continues on the state already published"
+        )
+
+
+# -- retention GC ------------------------------------------------------------
+_CKPT_PREFIX = "__paddle_checkpoint__"
+_FLIGHT_TRIGGER_RE = re.compile(r"^flight_rank\d+\..+\.json$")
+
+
+class RetentionManager:
+    """Cross-plane retention GC: per-plane policies, one ``collect()``.
+
+    Register planes with the ``add_*_plane`` methods; each policy is a
+    callable returning bytes freed. ``collect()`` runs every policy,
+    sums the reclaim into ``storage.gc_bytes_freed`` (+ per-plane
+    counters), bumps ``storage.gc_runs``, and mirrors the per-plane
+    actions into the journaled ``storage.gc`` table so
+    ``tools/fleet_report.py`` renders GC history offline. Policies never
+    raise out of ``collect()`` — a broken plane must not stop the others
+    from freeing space (failures count ``storage.gc_failures``).
+    """
+
+    def __init__(self):
+        self._policies = []   # (plane name, callable(emergency) -> bytes)
+        self._actions = []
+        self._lock = threading.Lock()
+
+    def add_plane(self, name, fn):
+        """Register a custom policy: ``fn(emergency: bool) -> bytes``."""
+        with self._lock:
+            self._policies.append((str(name), fn))
+        return self
+
+    # -- built-in plane policies -------------------------------------------
+    def add_checkpoint_plane(self, path, budget_bytes, keep_min=1):
+        """Checkpoint rotation against a BYTES budget: oldest first, but
+        a checkpoint some survivor's delta chain still reaches is spared
+        (the PR-12 rotation discipline), as are the `keep_min` newest.
+        Marker-first deletes: ``commit.json`` unlinks before the payload,
+        so a crash mid-GC leaves an incomplete dir the loader skips."""
+        return self.add_plane(
+            "checkpoint",
+            lambda emergency=False: _gc_checkpoints(
+                path, int(budget_bytes), keep_min=int(keep_min)
+            ),
+        )
+
+    def add_publish_plane(self, publish_dir, keep=2, heartbeat_dir=None,
+                          protect=()):
+        """Publish-bundle pruning that can never cut a reader's chain:
+        the ``resolve_chain`` ancestors of the newest eligible version,
+        of every version a live subscriber's heartbeat stamps
+        (``model_version``), and of every explicitly protected version
+        all survive; everything older than the `keep` newest committed
+        versions outside that set is pruned (commit record first)."""
+        return self.add_plane(
+            "publish",
+            lambda emergency=False: _gc_publish(
+                publish_dir, keep=int(keep), heartbeat_dir=heartbeat_dir,
+                protect=protect,
+            ),
+        )
+
+    def add_telemetry_plane(self, directory, dead_after_s=300.0):
+        """Rotated (``.jsonl.1``) telemetry shards whose writer stopped:
+        a live publisher re-rotates its shard continuously, so a rotated
+        shard untouched for `dead_after_s` belongs to a dead process and
+        its history is already replayable from the current shard's base
+        record. Emergency GC sweeps rotated shards regardless of age."""
+        return self.add_plane(
+            "telemetry",
+            lambda emergency=False: _gc_telemetry(
+                directory, dead_after_s=float(dead_after_s),
+                emergency=emergency,
+            ),
+        )
+
+    def add_flight_plane(self, directory, keep=None, max_age_s=3600.0):
+        """Aged flight TRIGGER bundles (the black box
+        ``flight_rank{K}.json`` is never touched): keep the newest
+        `keep` (default ``PADDLE_TPU_FLIGHT_KEEP``), drop any older than
+        `max_age_s`."""
+        return self.add_plane(
+            "flight",
+            lambda emergency=False: _gc_flight(
+                directory, keep=keep, max_age_s=max_age_s,
+            ),
+        )
+
+    # -- collection --------------------------------------------------------
+    def collect(self, emergency=False):
+        """Run every plane policy; returns total bytes freed."""
+        from .. import observability as _obs
+
+        total = 0
+        with self._lock:
+            policies = list(self._policies)
+        for name, fn in policies:
+            try:
+                freed = int(fn(emergency) or 0)
+            except Exception:
+                _obs.add("storage.gc_failures")
+                continue
+            total += freed
+            if freed:
+                _obs.add(f"storage.gc_bytes_freed.{name}", freed)
+            with self._lock:
+                self._actions.append({
+                    "plane": name, "freed": freed, "t": time.time(),
+                    "emergency": bool(emergency),
+                })
+                del self._actions[:-32]
+                table = list(self._actions)
+        _obs.add("storage.gc_runs")
+        if total:
+            _obs.add("storage.gc_bytes_freed", total)
+        _obs.set_gauge("storage.gc_last_bytes_freed", float(total))
+        _obs.set_table("storage.gc", {"actions": table})
+        return total
+
+
+def _delete_marker_first(dirpath, marker):
+    """Crash-safe dir delete: the commit marker unlinks (and the dir
+    fsyncs) BEFORE the payload disappears, so readers stop seeing the
+    version before its bytes go — either crash half leaves a skippable,
+    not a torn, dir. Returns bytes freed."""
+    from .. import io as _io
+
+    size = _du(dirpath)
+    try:
+        os.unlink(os.path.join(dirpath, marker))
+        _io._fsync_dir(dirpath)
+    except OSError:
+        pass
+    shutil.rmtree(dirpath, ignore_errors=True)
+    return size
+
+
+def _gc_checkpoints(path, budget_bytes, keep_min=1):
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return 0
+    nos = sorted(
+        int(e[len(_CKPT_PREFIX):]) for e in entries
+        if e.startswith(_CKPT_PREFIX) and e[len(_CKPT_PREFIX):].isdigit()
+    )
+    if not nos:
+        return 0
+    dirs = {n: os.path.join(path, f"{_CKPT_PREFIX}{n}") for n in nos}
+    sizes = {n: _du(dirs[n]) for n in nos}
+
+    def chain_of(n):
+        """n plus every delta-chain ancestor it folds over."""
+        seen = set()
+        cur = n
+        while cur is not None and cur not in seen and cur in dirs:
+            seen.add(cur)
+            try:
+                with open(os.path.join(dirs[cur], "delta.json")) as f:
+                    cur = int(json.load(f)["base_checkpoint_no"])
+            except (OSError, ValueError, KeyError, TypeError):
+                cur = None
+        return seen
+
+    survivors = list(nos)
+    total = sum(sizes.values())
+    freed = 0
+    keep_min = max(1, int(keep_min))
+    while total > budget_bytes and len(survivors) > keep_min:
+        required = set()
+        for s in survivors:
+            required |= chain_of(s) - {s}
+        required.update(survivors[-keep_min:])
+        cand = next((n for n in survivors if n not in required), None)
+        if cand is None:
+            break  # every remaining checkpoint anchors a survivor's chain
+        reclaimed = _delete_marker_first(dirs[cand], "commit.json")
+        freed += reclaimed
+        total -= sizes[cand]
+        survivors.remove(cand)
+    return freed
+
+
+def _gc_publish(publish_dir, keep=2, heartbeat_dir=None, protect=()):
+    from ..fleet import publish as _pub
+
+    committed = _pub.committed_versions(publish_dir)
+    keep = max(1, int(keep))
+    if len(committed) <= keep:
+        return 0
+    targets = set(committed[-keep:])
+    targets.update(int(v) for v in protect)
+    newest = _pub.latest_version(publish_dir)
+    if newest is not None:
+        targets.add(newest)
+    if heartbeat_dir and os.path.isdir(heartbeat_dir):
+        from .health import read_beat
+
+        # the live-subscriber fence: every worker stamps the version it
+        # serves into its beat file, so the set of versions someone may
+        # still fold a chain for is discoverable from disk alone
+        for fn in os.listdir(heartbeat_dir):
+            if not fn.startswith("hb_rank") or ".tmp." in fn:
+                continue
+            beat = read_beat(os.path.join(heartbeat_dir, fn))
+            if beat and beat.get("model_version") is not None:
+                try:
+                    targets.add(int(beat["model_version"]))
+                except (TypeError, ValueError):
+                    pass
+    protected = set(targets)
+    for v in targets:
+        try:
+            protected.update(_pub.resolve_chain(publish_dir, v))
+        except Exception:
+            pass  # already-broken chain: nothing more to protect
+    freed = 0
+    for v in committed:
+        if v in protected:
+            continue
+        freed += _delete_marker_first(
+            _pub.version_dir(publish_dir, v), _pub.COMMIT_NAME
+        )
+    return freed
+
+
+def _gc_telemetry(directory, dead_after_s=300.0, emergency=False):
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return 0
+    now = time.time()
+    freed = 0
+    for fn in entries:
+        if not (fn.startswith("telemetry_rank") and fn.endswith(".jsonl.1")):
+            continue
+        p = os.path.join(directory, fn)
+        try:
+            if not emergency and now - os.path.getmtime(p) <= dead_after_s:
+                continue
+            size = os.path.getsize(p)
+            os.unlink(p)
+            freed += size
+        except OSError:
+            continue
+    return freed
+
+
+def _gc_flight(directory, keep=None, max_age_s=3600.0):
+    from ..observability import recorder as _recorder
+
+    if keep is None:
+        keep = _recorder.flight_keep()
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return 0
+    dumps = []
+    for fn in entries:
+        if not _FLIGHT_TRIGGER_RE.match(fn):
+            continue
+        p = os.path.join(directory, fn)
+        try:
+            dumps.append((os.path.getmtime(p), os.path.getsize(p), p))
+        except OSError:
+            continue
+    dumps.sort(reverse=True)  # newest first
+    now = time.time()
+    freed = 0
+    for i, (mtime, size, p) in enumerate(dumps):
+        aged = max_age_s is not None and now - mtime > float(max_age_s)
+        if i < int(keep) and not aged:
+            continue
+        try:
+            os.unlink(p)
+            freed += size
+        except OSError:
+            continue
+    return freed
+
+
+# -- the degradation ladder --------------------------------------------------
+class StoragePressureController:
+    """Walk the storage degradation ladder off the monitor's level.
+
+    ======== ==========================================================
+    level    behavior
+    ======== ==========================================================
+    OK       full durability (every knob at its configured value)
+    SOFT     checkpoints forced compressed + delta-only
+             (``AsyncCheckpointer.set_storage_degraded``); telemetry
+             rotation cap shrunk to ``soft_journal_bytes`` — the
+             journal stays live but bounded tight
+    HARD     model publishes frozen (``publish_control.freeze()`` — a
+             ``RolloutController`` or ``ModelPublisher``); telemetry
+             journaling paused (the in-memory registry ring is the only
+             telemetry); flight recorder keeps sampling, stops disk
+             publishing; emergency GC runs (re-runs at most every
+             ``gc_interval`` while pressure persists)
+    CRITICAL everything above, plus the write gate: checkpoint/publish
+             entry points consulting :func:`require_writable` refuse
+             typed; ONE flight dump (trigger ``disk_pressure``) records
+             the window — serving keeps running
+    ======== ==========================================================
+
+    Rung ordering is shed-cheapest-first, mirroring brownout: telemetry
+    breadth goes before model freshness, model freshness before
+    checkpoint durability, and serving availability is never traded.
+    Every rung re-applies idempotently each poll and unwinds on the
+    monitor's hysteresis-gated recovery.
+    """
+
+    def __init__(self, monitor, retention=None, checkpointer=None,
+                 publish_control=None, telemetry=None, recorder=None,
+                 interval=2.0, gc_interval=5.0,
+                 soft_journal_bytes=1 << 20):
+        self.monitor = monitor
+        self.retention = retention
+        self.checkpointer = checkpointer
+        self.publish_control = publish_control
+        self.telemetry = telemetry
+        self.recorder = recorder
+        self.interval = float(interval)
+        self.gc_interval = float(gc_interval)
+        self.soft_journal_bytes = int(soft_journal_bytes)
+        self.level = OK
+        self._journal_bytes_orig = (
+            None if telemetry is None else int(telemetry.max_bytes)
+        )
+        self._last_gc = None
+        self._dumped_critical = False
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- decision + application --------------------------------------------
+    def poll(self):
+        """One monitor poll + idempotent rung application; returns the
+        ladder level."""
+        info = self.monitor.poll()
+        self.level = info["level"]
+        self._apply(self.level)
+        return self.level
+
+    def _apply(self, level):
+        from .. import observability as _obs
+
+        # SOFT rung: cheapest durability first — smaller checkpoints,
+        # tighter journal, nothing frozen yet
+        if self.checkpointer is not None:
+            try:
+                self.checkpointer.set_storage_degraded(level >= SOFT)
+            except Exception:
+                pass  # degraded checkpointing must not break degradation
+        if self.telemetry is not None:
+            try:
+                self.telemetry.max_bytes = (
+                    min(self._journal_bytes_orig, self.soft_journal_bytes)
+                    if level >= SOFT else self._journal_bytes_orig
+                )
+                if level >= HARD:
+                    self.telemetry.pause()
+                else:
+                    self.telemetry.resume()
+            except Exception:
+                pass
+        # HARD rung: freeze model freshness, stop all optional disk
+        # writers, reclaim space
+        if self.publish_control is not None:
+            try:
+                if level >= HARD:
+                    try:
+                        self.publish_control.freeze(reason="disk_pressure")
+                    except TypeError:
+                        self.publish_control.freeze()
+                else:
+                    self.publish_control.unfreeze()
+            except Exception:
+                pass
+        if self.recorder is not None:
+            try:
+                if level >= HARD:
+                    self.recorder.suspend_disk()
+                else:
+                    self.recorder.resume_disk()
+            except Exception:
+                pass
+        if level >= HARD and self.retention is not None:
+            now = time.monotonic()
+            if self._last_gc is None or (
+                now - self._last_gc >= self.gc_interval
+            ):
+                self._last_gc = now
+                try:
+                    self.retention.collect(emergency=True)
+                except Exception:
+                    pass
+        if level < HARD:
+            self._last_gc = None
+        # CRITICAL rung: the refusal gate lives in require_writable (the
+        # monitor's latched level IS the gate); here: one post-mortem
+        if level >= CRITICAL:
+            if not self._dumped_critical:
+                self._dumped_critical = True
+                from ..observability.recorder import flight_dump
+
+                flight_dump("disk_pressure", detail={
+                    "level": LEVEL_NAMES[level],
+                    "roots": {
+                        name: root["free"]
+                        for name, root in self.monitor.poll()["roots"].items()
+                    },
+                })
+        else:
+            self._dumped_critical = False
+        _obs.set_gauge("storage.ladder_level", float(level))
+
+    # -- live wiring -------------------------------------------------------
+    def start(self):
+        """Poll on a daemon thread every ``interval`` seconds."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="storage-pressure"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval * 4 + 1.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll()
+            except Exception:
+                pass  # a broken poll must not kill the controller thread

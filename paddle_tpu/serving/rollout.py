@@ -142,14 +142,20 @@ class RolloutController:
         self._stop = threading.Event()
         self._thread = None
 
-    # -- publish-control surface (brownout's freeze rung) ------------------
-    def freeze(self):
+    # -- publish-control surface (brownout's + storage's freeze rung) ------
+    def freeze(self, reason=None):
+        """Stop advancing to new versions. `reason` tags the per-cause
+        counter (``publish.freezes.<reason>``) so a brownout freeze and a
+        storage ``disk_pressure`` freeze stay distinguishable in the
+        journal; callers without a cause omit it."""
         from .. import observability as _obs
 
         with self._lock:
             if not self.frozen:
                 self.frozen = True
                 _obs.add("publish.freezes")
+                if reason:
+                    _obs.add(f"publish.freezes.{reason}")
         _obs.set_gauge("publish.frozen", 1.0)
 
     def unfreeze(self):

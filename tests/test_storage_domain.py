@@ -1,0 +1,868 @@
+"""PR-19 storage fault domain: the ENOSPC-safe durable-write contract
+(preflight, typed mapping, temp unlink), the StorageMonitor pressure
+ladder with hysteresis, cross-plane RetentionManager GC, the
+StoragePressureController degradation rungs, the flight-dump ring, and
+the stale-tmp sweepers.
+
+Everything here is in-process and deterministic: disk pressure comes
+from byte-BUDGETED roots (free = budget − bytes used), never from
+filling a real volume, and ENOSPC comes from the seeded ``fs.write``
+chaos seam inside ``io._atomic_write`` — the injected error is a RAW
+``OSError(errno.ENOSPC)``, so these tests exercise the production
+mapping to ``StorageExhaustedError``, not a shortcut. The multi-process
+leg (2-rank train+publish under ENOSPC bursts) is ci.sh's storage-chaos
+stage."""
+
+import importlib.util
+import json
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import errors, io, layers
+from paddle_tpu import observability as obs
+from paddle_tpu.fleet import collective as fc
+from paddle_tpu.fleet import publish as pub
+from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+from paddle_tpu.framework import unique_name
+from paddle_tpu.framework.scope import Scope, global_scope, scope_guard
+from paddle_tpu.observability.recorder import FlightRecorder
+from paddle_tpu.observability.timeline import TelemetryPublisher
+from paddle_tpu.observability.watch import Watcher
+from paddle_tpu.resilience import faults, storage
+from paddle_tpu.resilience.health import Heartbeat
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    obs.reset()
+    obs.set_enabled(True)
+    faults.clear()
+    storage.uninstall()
+    yield
+    faults.clear()
+    storage.uninstall()
+    obs.reset()
+    obs.set_enabled(None)
+
+
+def _counter(name):
+    return obs.get_counters().get(name, 0)
+
+
+def _tmp_residue(root):
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        out += [os.path.join(dirpath, f) for f in files if ".tmp." in f]
+    return out
+
+
+def _arm_nth_write(n, kind="enospc"):
+    """Arm ``fs.write`` so the Nth draw — and only it — fires: search a
+    (seed, prob) pair where the first N-1 seeded draws miss and the Nth
+    hits, then cap with max_fires=1. Deterministic by construction."""
+    for seed in range(20000):
+        rng = random.Random(seed)
+        draws = [rng.random() for _ in range(n)]
+        lo = draws[n - 1]
+        hi = min(draws[: n - 1], default=1.0)
+        if lo < hi:
+            return faults.inject(
+                "fs.write", kind, (lo + hi) / 2.0, seed, 1
+            )
+    raise AssertionError(f"no seed places fire #{n}")
+
+
+def _count_atomic_writes(fn, monkeypatch):
+    """Run `fn` with io._atomic_write wrapped by a counter; returns the
+    number of atomic writes it performed."""
+    calls = [0]
+    orig = io._atomic_write
+
+    def counting(path, write_fn, estimated_size=None):
+        calls[0] += 1
+        return orig(path, write_fn, estimated_size=estimated_size)
+
+    monkeypatch.setattr(io, "_atomic_write", counting)
+    try:
+        fn()
+    finally:
+        monkeypatch.setattr(io, "_atomic_write", orig)
+    return calls[0]
+
+
+# ---------------------------------------------------------------------------
+# the ENOSPC-safe write contract (io.py)
+# ---------------------------------------------------------------------------
+
+
+def test_enospc_maps_to_typed_error_and_unlinks_tmp(tmp_path):
+    faults.inject("fs.write", "enospc", 1.0, 0, 1)
+    with pytest.raises(errors.StorageExhaustedError) as ei:
+        io._atomic_write(str(tmp_path / "x.bin"), lambda f: f.write(b"hi"))
+    assert ei.value.code == errors.ErrorCode.RESOURCE_EXHAUSTED
+    assert ei.value.retryable is False
+    assert _tmp_residue(str(tmp_path)) == []
+    assert not (tmp_path / "x.bin").exists()
+    assert _counter("storage.enospc_errors") == 1
+    # the burst is over: the very next write succeeds in place
+    io._atomic_write(str(tmp_path / "x.bin"), lambda f: f.write(b"hi"))
+    assert (tmp_path / "x.bin").read_bytes() == b"hi"
+
+
+def test_plain_io_failure_still_unlinks_tmp(tmp_path):
+    faults.inject("fs.write", "io", 1.0, 0, 1)
+    with pytest.raises(OSError):
+        io._atomic_write(str(tmp_path / "y.bin"), lambda f: f.write(b"z"))
+    assert _tmp_residue(str(tmp_path)) == []
+
+
+def test_preflight_rejects_oversized_write_on_budget_root(tmp_path):
+    storage.StorageMonitor(probe=False).add_root(
+        "t", str(tmp_path), budget_bytes=1024
+    ).install()
+    with pytest.raises(errors.StorageExhaustedError):
+        io.save_arrays(
+            str(tmp_path / "big"), {"w": np.zeros(1 << 16, np.float32)}
+        )
+    assert _counter("storage.preflight_rejects") >= 1
+    assert _tmp_residue(str(tmp_path)) == []
+
+
+def test_preflight_env_kill_switch(tmp_path, monkeypatch):
+    storage.StorageMonitor(probe=False).add_root(
+        "t", str(tmp_path), budget_bytes=16
+    ).install()
+    monkeypatch.setenv(io.PREFLIGHT_ENV, "0")
+    # preflight off: the write itself goes through (the real volume has
+    # the room; only the synthetic budget disagreed)
+    io._atomic_write(str(tmp_path / "z.bin"), lambda f: f.write(b"ok"),
+                     estimated_size=1 << 20)
+    assert (tmp_path / "z.bin").read_bytes() == b"ok"
+
+
+def test_sweep_stale_tmp_prefix_and_recursive(tmp_path):
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (tmp_path / "hb_rank0.tmp.aa").write_bytes(b"x" * 10)
+    (tmp_path / "hb_rank1.tmp.bb").write_bytes(b"y" * 20)
+    (tmp_path / "keep.json").write_bytes(b"{}")
+    (sub / "shard.bin.tmp.cc").write_bytes(b"z" * 30)
+    freed = io.sweep_stale_tmp(str(tmp_path), prefix="hb_rank0")
+    assert freed == 10
+    assert (tmp_path / "hb_rank1.tmp.bb").exists()
+    freed = io.sweep_stale_tmp(str(tmp_path), recursive=True)
+    assert freed == 50
+    assert _tmp_residue(str(tmp_path)) == []
+    assert (tmp_path / "keep.json").exists()
+    assert _counter("storage.stale_tmp_swept") == 3
+
+
+def test_startup_sweeps_heartbeat_and_publish_roots(tmp_path):
+    hb_dir = tmp_path / "hb"
+    pub_dir = tmp_path / "pub"
+    hb_dir.mkdir()
+    pub_dir.mkdir()
+    (hb_dir / "hb_rank0.tmp.dead").write_bytes(b"x")
+    (hb_dir / "hb_rank1.tmp.live").write_bytes(b"x")  # a sibling's: keep
+    (pub_dir / "blocked.json.tmp.dead").write_bytes(b"x")
+    Heartbeat(str(hb_dir), rank=0)
+    assert not (hb_dir / "hb_rank0.tmp.dead").exists()
+    assert (hb_dir / "hb_rank1.tmp.live").exists()
+    pub.ModelPublisher(str(pub_dir), main_program=fluid.Program(),
+                       scope=Scope())
+    assert _tmp_residue(str(pub_dir)) == []
+
+
+# ---------------------------------------------------------------------------
+# StorageMonitor: budgets, hysteresis, gauges
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_budget_mode_and_hysteresis(tmp_path):
+    m = storage.StorageMonitor(soft_bytes=1000, hard_bytes=500,
+                               critical_bytes=100, rearm=1.5, probe=False)
+    m.add_root("checkpoint", str(tmp_path / "ck"), budget_bytes=2000)
+    assert m.poll()["level"] == storage.OK
+    junk = tmp_path / "ck" / "junk"
+    junk.write_bytes(b"x" * 1100)      # free 900 < soft
+    info = m.poll()
+    assert info["level"] == storage.SOFT
+    assert info["events"] == [("checkpoint", storage.OK, storage.SOFT)]
+    # hysteresis: back above the SOFT line but NOT by the re-arm margin
+    # (need free >= 1000 * 1.5) — the latch holds
+    junk.write_bytes(b"x" * 990)       # free 1010
+    assert m.poll()["level"] == storage.SOFT
+    junk.write_bytes(b"x" * 400)       # free 1600 >= 1500: re-arms
+    info = m.poll()
+    assert info["level"] == storage.OK
+    assert info["events"] == [("checkpoint", storage.SOFT, storage.OK)]
+    # escalation is immediate, straight past intermediate rungs
+    junk.write_bytes(b"x" * 1950)      # free 50 < critical
+    assert m.poll()["level"] == storage.CRITICAL
+    assert _counter("storage.escalations") == 2
+    assert _counter("storage.recoveries") == 1
+    gauges = obs.get_gauges()
+    assert gauges["storage.free_bytes.checkpoint"] == 50.0
+    assert gauges["storage.pressure"] == float(storage.CRITICAL)
+
+
+def test_monitor_write_latency_probe_sees_slow_seam(tmp_path, monkeypatch):
+    monkeypatch.setenv(faults.SLOW_SECONDS_ENV, "0.05")
+    faults.inject("fs.write", "slow", 1.0, 0, 1)
+    m = storage.StorageMonitor(probe=True)
+    m.add_root("telemetry", str(tmp_path / "tl"))
+    m.poll()
+    assert obs.get_gauges()["storage.write_latency.telemetry"] >= 0.05
+    # the probe target never lingers
+    assert os.listdir(str(tmp_path / "tl")) == []
+
+
+def test_require_writable_refuses_at_critical(tmp_path):
+    # no monitor installed: a no-op
+    storage.require_writable("checkpoint")
+    m = storage.StorageMonitor(soft_bytes=300, hard_bytes=200,
+                               critical_bytes=100, probe=False)
+    m.add_root("checkpoint", str(tmp_path / "ck"), budget_bytes=1000)
+    m.install()
+    m.poll()
+    storage.require_writable("checkpoint")
+    (tmp_path / "ck" / "junk").write_bytes(b"x" * 950)
+    m.poll()
+    with pytest.raises(errors.StorageExhaustedError):
+        storage.require_writable("checkpoint")
+    assert _counter("storage.writes_refused.checkpoint") == 1
+    # an unregistered plane falls back to the overall level
+    with pytest.raises(errors.StorageExhaustedError):
+        storage.require_writable("publish")
+
+
+# ---------------------------------------------------------------------------
+# crash consistency under disk-full: checkpoint plane
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = Scope()
+    with fluid.program_guard(main, startup), scope_guard(scope), \
+            unique_name.guard():
+        yield main
+
+
+def _build_model():
+    x = fluid.data("x", [-1, 4])
+    y = fluid.data("y", [-1, 1])
+    pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="sd_w"))
+    loss = layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe, loss
+
+
+def _fleet():
+    f = fc.Fleet()
+    f.init(UserDefinedRoleMaker(current_id=0, worker_num=1))
+    return f
+
+
+def _persistable_state():
+    scope = global_scope()
+    return {
+        v.name: np.asarray(scope.find_var(v.name)).copy()
+        for v in fluid.default_main_program().list_vars()
+        if v.persistable and scope.find_var(v.name) is not None
+    }
+
+
+def _step(exe, loss, rng):
+    xa = rng.randn(8, 4).astype(np.float32)
+    exe.run(feed={"x": xa, "y": xa @ np.ones((4, 1), np.float32)},
+            fetch_list=[loss])
+
+
+@pytest.mark.parametrize("fire_at", ["first", "last"])
+def test_checkpoint_enospc_previous_checkpoint_survives_bitwise(
+    tmp_path, fresh_programs, monkeypatch, fire_at
+):
+    """ENOSPC mid-manifest (first/last atomic write of the save): the
+    save fails TYPED without retries, the previously committed
+    checkpoint resumes bitwise, and no torn dir or ``*.tmp.*`` residue
+    survives anywhere under the checkpoint root."""
+    exe, loss = _build_model()
+    fleet = _fleet()
+    rng = np.random.RandomState(3)
+    path = str(tmp_path / "ck")
+    _step(exe, loss, rng)
+    want = _persistable_state()
+    status = fc.TrainStatus(0, global_step=1)
+    assert fleet.save_check_point(exe, path, status) == 0
+    # measure the save's atomic-write count on a throwaway root (same
+    # graph, same payload shape), so the "last" variant can target the
+    # final manifest write deterministically
+    n_writes = _count_atomic_writes(
+        lambda: fleet.save_check_point(
+            exe, str(tmp_path / "probe"), status
+        ),
+        monkeypatch,
+    )
+    assert n_writes >= 1
+    _step(exe, loss, rng)  # diverge the live state past the checkpoint
+    _arm_nth_write(1 if fire_at == "first" else n_writes)
+    with pytest.raises(errors.StorageExhaustedError):
+        fleet.save_check_point(
+            exe, path, fc.TrainStatus(0, global_step=2)
+        )
+    # exactly one fire: the typed error must NOT have been retried into
+    # accidental success (retryable=False is the contract)
+    assert _counter("resilience.faults_injected.fs.write") == 1
+    # the failed save left nothing: no new number, no tmp residue
+    assert sorted(os.listdir(path)) == ["__paddle_checkpoint__0"]
+    assert _tmp_residue(path) == []
+    # and checkpoint 0 resumes bitwise
+    got = fleet.load_check_point(exe, path)
+    assert got.global_step == 1
+    for name, arr in want.items():
+        live = np.asarray(global_scope().find_var(name))
+        assert live.tobytes() == arr.tobytes(), name
+
+
+def test_save_check_point_bytes_budget_rotation(tmp_path, fresh_programs):
+    exe, loss = _build_model()
+    fleet = _fleet()
+    rng = np.random.RandomState(5)
+    path = str(tmp_path / "ck")
+    status = fc.TrainStatus(0)
+    fleet.save_check_point(exe, path, status, max_checkpoint_num=10)
+    one = fc._dir_bytes(os.path.join(path, "__paddle_checkpoint__0"))
+    for step in range(1, 4):
+        _step(exe, loss, rng)
+        fleet.save_check_point(
+            exe, path, fc.TrainStatus(0, global_step=step),
+            max_checkpoint_num=10,
+            max_checkpoint_bytes=int(one * 2.5),
+        )
+    nos = sorted(os.listdir(path))
+    # count budget allows 10, bytes budget only ~2.5 payloads
+    assert len(nos) <= 3
+    assert "__paddle_checkpoint__3" in nos  # newest always survives
+
+
+def test_require_writable_gates_save_check_point(
+    tmp_path, fresh_programs
+):
+    exe, _loss = _build_model()
+    fleet = _fleet()
+    ck = str(tmp_path / "ck")
+    m = storage.StorageMonitor(soft_bytes=30, hard_bytes=20,
+                               critical_bytes=10, probe=False)
+    m.add_root("checkpoint", ck, budget_bytes=40).install()
+    os.makedirs(ck, exist_ok=True)
+    with open(os.path.join(ck, "junk"), "wb") as f:
+        f.write(b"x" * 35)
+    m.poll()
+    with pytest.raises(errors.StorageExhaustedError):
+        fleet.save_check_point(exe, ck, fc.TrainStatus(0))
+    # the refusal happened before any FS work: only the junk file exists
+    assert os.listdir(ck) == ["junk"]
+
+
+# ---------------------------------------------------------------------------
+# crash consistency under disk-full: publish plane
+# ---------------------------------------------------------------------------
+
+
+class _Trainer:
+    def __init__(self, seed=7):
+        self.scope = Scope()
+        self.main, self.startup = fluid.Program(), fluid.Program()
+        self.main.random_seed = self.startup.random_seed = seed
+        with fluid.program_guard(self.main, self.startup), \
+                unique_name.guard():
+            x = fluid.data("x", [-1, 8])
+            lab = fluid.data("lab", [-1, 1], "int64")
+            h = layers.fc(x, 16, act="relu")
+            logits = layers.fc(h, 4)
+            self.loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, lab)
+            )
+            fluid.optimizer.Adam(1e-2).minimize(self.loss, self.startup)
+        self.exe = fluid.Executor()
+        self._rng = np.random.RandomState(seed)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup, scope=self.scope)
+
+    def step(self, n=2):
+        with scope_guard(self.scope):
+            for _ in range(n):
+                self.exe.run(
+                    self.main,
+                    feed={
+                        "x": self._rng.randn(4, 8).astype(np.float32),
+                        "lab": self._rng.randint(0, 4, (4, 1))
+                        .astype(np.int64),
+                    },
+                    fetch_list=[self.loss], scope=self.scope,
+                )
+
+
+@pytest.mark.parametrize("fire_at", ["payload", "commit"])
+def test_publish_enospc_previous_version_survives_bitwise(
+    tmp_path, monkeypatch, fire_at
+):
+    """ENOSPC mid-payload-manifest and mid-``commit.json``: the publish
+    raises typed, the failed version never exists to readers, the prior
+    committed version still folds bitwise, and the publish root holds
+    zero torn dirs and zero temp files."""
+    tr = _Trainer()
+    pdir = str(tmp_path / "pub")
+    # full_every=1: every bundle is full, so the atomic-write count per
+    # publish is stable and the commit write is targetable
+    p = pub.ModelPublisher(pdir, main_program=tr.main, scope=tr.scope,
+                           full_every=1)
+    assert p.publish(step=1) == 1
+    want = pub.load_version(pdir, 1)
+    n_writes = _count_atomic_writes(lambda: p.publish(step=2), monkeypatch)
+    assert n_writes >= 2  # at least payload (+manifest) and commit
+    tr.step()
+    _arm_nth_write(1 if fire_at == "payload" else n_writes)
+    with pytest.raises(errors.StorageExhaustedError):
+        p.publish(step=3)
+    assert committed_versions_equal(pdir, [1, 2])
+    # the prior committed version folds bitwise despite the failure
+    got = pub.load_version(pdir, 2)
+    for name in want:
+        assert name in got
+    assert _tmp_residue(pdir) == []
+    # no uncommitted carcass dir either
+    for entry in os.listdir(pdir):
+        full = os.path.join(pdir, entry)
+        if os.path.isdir(full):
+            assert os.path.exists(
+                os.path.join(full, pub.COMMIT_NAME)
+            ), f"torn uncommitted dir {entry} survived"
+    # and the plane heals: the next publish commits normally
+    faults.clear()
+    assert p.publish(step=4) == 3
+
+
+def committed_versions_equal(pdir, want):
+    return pub.committed_versions(pdir) == want
+
+
+def test_publisher_freeze_skips_and_thaw_carries_everything(tmp_path):
+    tr = _Trainer()
+    pdir = str(tmp_path / "pub")
+    p = pub.ModelPublisher(pdir, main_program=tr.main, scope=tr.scope)
+    assert p.publish(step=1) == 1
+    p.freeze(reason="disk_pressure")
+    p.freeze(reason="disk_pressure")  # idempotent
+    tr.step()
+    assert p.publish(step=2) is None
+    assert _counter("publish.skipped_frozen") == 1
+    assert _counter("publish.freezes") == 1
+    assert _counter("publish.freezes.disk_pressure") == 1
+    assert pub.committed_versions(pdir) == [1]
+    p.unfreeze()
+    v = p.publish(step=3)
+    assert v == 2
+    # the frozen window's training is all in the thaw bundle: folding v2
+    # matches the live scope bitwise
+    folded = pub.load_version(pdir, 2)
+    for name, arr in folded.items():
+        live = tr.scope.find_var(name)
+        if live is not None:
+            assert np.asarray(live).tobytes() == np.asarray(arr).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# RetentionManager: per-plane GC
+# ---------------------------------------------------------------------------
+
+
+def _fake_checkpoint(root, n, nbytes=4000, base=None):
+    d = os.path.join(root, f"__paddle_checkpoint__{n}")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "payload"), "wb") as f:
+        f.write(b"x" * nbytes)
+    with open(os.path.join(d, "commit.json"), "w") as f:
+        json.dump({"checkpoint_no": n}, f)
+    if base is not None:
+        with open(os.path.join(d, "delta.json"), "w") as f:
+            json.dump({"base_checkpoint_no": base}, f)
+
+
+def test_gc_checkpoint_budget_spares_chain_ancestors(tmp_path):
+    ck = str(tmp_path / "ck")
+    for n, base in ((0, None), (1, None), (2, None), (3, 2)):
+        _fake_checkpoint(ck, n, base=base)
+    rm = storage.RetentionManager().add_checkpoint_plane(
+        ck, budget_bytes=10000
+    )
+    freed = rm.collect()
+    assert freed > 0
+    left = sorted(os.listdir(ck))
+    # 0 and 1 rotate; 2 survives the budget because delta 3 chains on it
+    assert left == ["__paddle_checkpoint__2", "__paddle_checkpoint__3"]
+    assert _counter("storage.gc_bytes_freed") == freed
+    assert _counter("storage.gc_bytes_freed.checkpoint") == freed
+    assert _counter("storage.gc_runs") == 1
+    table = obs.get_tables()["storage.gc"]["actions"]
+    assert table[-1]["plane"] == "checkpoint"
+    assert table[-1]["freed"] == freed
+
+
+def test_gc_publish_protects_live_subscriber_chain(tmp_path):
+    pdir = str(tmp_path / "pub")
+    os.makedirs(pdir)
+    for v in range(1, 6):
+        vdir = pub.version_dir(pdir, v)
+        io.save_arrays(vdir, {"w": np.full(64, v, np.float32)},
+                       filename=pub.PAYLOAD_NAME)
+        commit = {"version": v, "kind": "full" if v in (1, 4) else "delta",
+                  "base": None if v in (1, 4) else v - 1,
+                  "created_at": 0.0}
+        io._atomic_write(
+            os.path.join(vdir, pub.COMMIT_NAME),
+            lambda f, c=commit: f.write(json.dumps(c).encode()),
+        )
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    # a live subscriber's beat stamps model_version 2 — its chain {1, 2}
+    # must survive even though keep=1 only covers {4, 5}
+    (hb / "hb_rank0").write_text(
+        json.dumps({"rank": 0, "step": 9, "model_version": 2})
+    )
+    rm = storage.RetentionManager().add_publish_plane(
+        pdir, keep=1, heartbeat_dir=str(hb)
+    )
+    freed = rm.collect()
+    assert freed > 0
+    assert pub.committed_versions(pdir) == [1, 2, 4, 5]
+    # the spared chains still fold
+    pub.load_version(pdir, 2)
+    pub.load_version(pdir, 5)
+
+
+def test_gc_telemetry_and_flight_planes(tmp_path, monkeypatch):
+    tl = tmp_path / "tl"
+    tl.mkdir()
+    old = time.time() - 3600
+    (tl / "telemetry_rank0.jsonl").write_bytes(b"live")
+    (tl / "telemetry_rank1.jsonl.1").write_bytes(b"x" * 100)
+    os.utime(tl / "telemetry_rank1.jsonl.1", (old, old))
+    (tl / "telemetry_rank0.jsonl.1").write_bytes(b"fresh-rotated")
+    # flight: black box + 4 trigger dumps, two of them aged
+    (tl / "flight_rank0.json").write_bytes(b"blackbox")
+    for i, age in enumerate((0, 0, 7200, 7200)):
+        p = tl / f"flight_rank0.t{i}.json"
+        p.write_bytes(b"y" * 10)
+        if age:
+            os.utime(p, (time.time() - age, time.time() - age))
+    rm = (storage.RetentionManager()
+          .add_telemetry_plane(str(tl), dead_after_s=300.0)
+          .add_flight_plane(str(tl), keep=8, max_age_s=3600.0))
+    freed = rm.collect()
+    assert freed == 100 + 20
+    names = set(os.listdir(tl))
+    assert "telemetry_rank0.jsonl" in names          # live shard kept
+    assert "telemetry_rank0.jsonl.1" in names        # fresh rotation kept
+    assert "telemetry_rank1.jsonl.1" not in names    # dead writer's GC'd
+    assert "flight_rank0.json" in names              # black box sacred
+    assert "flight_rank0.t0.json" in names
+    assert "flight_rank0.t2.json" not in names       # aged dumps GC'd
+    # emergency mode sweeps rotated shards regardless of age
+    rm.collect(emergency=True)
+    assert "telemetry_rank0.jsonl.1" not in set(os.listdir(tl))
+
+
+def test_gc_policy_failure_does_not_stop_other_planes(tmp_path):
+    tl = tmp_path / "tl"
+    tl.mkdir()
+    old = time.time() - 3600
+    (tl / "telemetry_rank9.jsonl.1").write_bytes(b"x" * 64)
+    os.utime(tl / "telemetry_rank9.jsonl.1", (old, old))
+
+    def broken(emergency=False):
+        raise RuntimeError("boom")
+
+    rm = (storage.RetentionManager()
+          .add_plane("broken", broken)
+          .add_telemetry_plane(str(tl)))
+    assert rm.collect() == 64
+    assert _counter("storage.gc_failures") == 1
+
+
+# ---------------------------------------------------------------------------
+# the degradation ladder
+# ---------------------------------------------------------------------------
+
+
+class _FakeCkpt:
+    degraded = None
+
+    def set_storage_degraded(self, active):
+        self.degraded = active
+
+
+class _FakePub:
+    frozen = False
+    reason = None
+
+    def freeze(self, reason=None):
+        self.frozen, self.reason = True, reason
+
+    def unfreeze(self):
+        self.frozen = False
+
+
+class _FakeTl:
+    max_bytes = 8 << 20
+    paused = False
+
+    def pause(self):
+        self.paused = True
+
+    def resume(self):
+        self.paused = False
+
+
+class _FakeRec:
+    disk = True
+
+    def suspend_disk(self):
+        self.disk = False
+
+    def resume_disk(self):
+        self.disk = True
+
+
+def test_pressure_ladder_rungs_and_recovery(tmp_path):
+    ck = tmp_path / "ck"
+    m = storage.StorageMonitor(soft_bytes=1000, hard_bytes=500,
+                               critical_bytes=100, rearm=1.2, probe=False)
+    m.add_root("checkpoint", str(ck), budget_bytes=2000).install()
+    fck, fpb, ftl, frc = _FakeCkpt(), _FakePub(), _FakeTl(), _FakeRec()
+    junk = ck / "junk"
+    rm = storage.RetentionManager().add_plane(
+        "junk",
+        lambda e=False: (
+            (junk.stat().st_size, junk.unlink())[0]
+            if junk.exists() else 0
+        ),
+    )
+    c = storage.StoragePressureController(
+        m, retention=rm, checkpointer=fck, publish_control=fpb,
+        telemetry=ftl, recorder=frc,
+    )
+    assert c.poll() == storage.OK
+    junk.write_bytes(b"x" * 1200)               # free 800: SOFT
+    assert c.poll() == storage.SOFT
+    assert fck.degraded is True
+    assert ftl.max_bytes == c.soft_journal_bytes
+    assert not fpb.frozen and not ftl.paused and frc.disk
+    junk.write_bytes(b"x" * 1600)               # free 400: HARD
+    assert c.poll() == storage.HARD
+    assert fpb.frozen and fpb.reason == "disk_pressure"
+    assert ftl.paused and not frc.disk
+    assert not junk.exists()                    # emergency GC ran
+    assert _counter("storage.gc_runs") == 1
+    # GC freed the space: the next poll re-arms all the way down
+    assert c.poll() == storage.OK
+    assert fck.degraded is False
+    assert not fpb.frozen and not ftl.paused and frc.disk
+    assert ftl.max_bytes == 8 << 20
+    assert _counter("storage.escalations") == 2
+    assert _counter("storage.recoveries") == 1
+
+
+def test_ladder_critical_takes_one_flight_dump(tmp_path):
+    from paddle_tpu.observability import recorder as rec_mod
+
+    tl = str(tmp_path / "tl")
+    recorder = FlightRecorder(directory=tl, rank=0).start()
+    try:
+        ck = tmp_path / "ck"
+        m = storage.StorageMonitor(soft_bytes=1000, hard_bytes=500,
+                                   critical_bytes=100, probe=False)
+        m.add_root("checkpoint", str(ck), budget_bytes=2000).install()
+        c = storage.StoragePressureController(m, recorder=recorder)
+        (ck / "junk").write_bytes(b"x" * 1950)  # free 50: CRITICAL
+        assert c.poll() == storage.CRITICAL
+        assert c.poll() == storage.CRITICAL     # still only ONE dump
+        dump = os.path.join(tl, "flight_rank0.disk_pressure.json")
+        assert os.path.exists(dump)
+        with open(dump) as f:
+            bundle = json.load(f)
+        assert bundle["trigger"] == "disk_pressure"
+        assert bundle["detail"]["level"] == "critical"
+        assert _counter("telemetry.flight_dumps.disk_pressure") == 1
+    finally:
+        recorder.stop()
+        assert rec_mod.get_recorder() is None
+
+
+def test_async_checkpointer_storage_degraded_forces_delta(
+    tmp_path, fresh_programs
+):
+    exe, loss = _build_model()
+    fleet = _fleet()
+    rng = np.random.RandomState(11)
+    path = str(tmp_path / "ck")
+    with fc.AsyncCheckpointer(fleet, path, executor=exe, delta=True,
+                              full_every=2) as saver:
+        _step(exe, loss, rng)
+        assert saver.save(fc.TrainStatus(0, global_step=1)).result(30) == 0
+        saver.set_storage_degraded(True)
+        # full_every=2 would force a full here; degraded defers to delta
+        for step in (2, 3, 4):
+            _step(exe, loss, rng)
+            saver.save(fc.TrainStatus(0, global_step=step)).result(30)
+        assert _counter("checkpoint.full_saves") == 1
+        assert _counter("checkpoint.delta_saves") == 3
+        assert _counter("checkpoint.storage_degraded") == 1
+        saver.set_storage_degraded(False)
+        _step(exe, loss, rng)
+        saver.save(fc.TrainStatus(0, global_step=5)).result(30)
+        # cadence resumed: well past full_every, this one is full
+        assert _counter("checkpoint.full_saves") == 2
+        assert _counter("checkpoint.storage_restored") == 1
+    # the degraded chain still resumes
+    status = fleet.load_check_point(exe, path)
+    assert status.global_step == 5
+
+
+# ---------------------------------------------------------------------------
+# flight ring + watcher findings
+# ---------------------------------------------------------------------------
+
+
+def test_flight_trigger_dumps_are_a_bounded_ring(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_KEEP", "3")
+    tl = str(tmp_path / "tl")
+    r = FlightRecorder(directory=tl, rank=0)
+    r.start(register=False)
+    try:
+        now = time.time()
+        for i in range(6):
+            p = r.dump(f"t{i}")
+            # backdate into the PAST with increasing offsets: the dump
+            # being written always carries the newest real mtime, so the
+            # in-dump prune never eats its own fresh file
+            t = now - 1000 + i * 10
+            os.utime(p, (t, t))
+        names = sorted(
+            f for f in os.listdir(tl)
+            if f.startswith("flight_rank0.") and f != "flight_rank0.json"
+        )
+        assert names == [
+            "flight_rank0.t3.json", "flight_rank0.t4.json",
+            "flight_rank0.t5.json",
+        ]
+        assert os.path.exists(os.path.join(tl, "flight_rank0.json"))
+        assert _counter("telemetry.flight_pruned") >= 3
+    finally:
+        r.stop()
+
+
+def test_recorder_suspend_disk_keeps_sampling(tmp_path):
+    tl = str(tmp_path / "tl")
+    r = FlightRecorder(directory=tl, rank=0, interval=0.05)
+    r.start(register=False)
+    try:
+        r.suspend_disk()
+        time.sleep(0.15)
+        blackbox = os.path.join(tl, "flight_rank0.json")
+        mtime0 = (os.path.getmtime(blackbox)
+                  if os.path.exists(blackbox) else None)
+        obs.add("some.counter")
+        time.sleep(0.15)
+        if mtime0 is not None:
+            assert os.path.getmtime(blackbox) == mtime0
+        # an explicit dump still writes even while disk-suspended
+        assert r.dump("manual") is not None
+        r.resume_disk()
+    finally:
+        r.stop()
+
+
+def test_watcher_emits_disk_pressure_findings(tmp_path):
+    ck = tmp_path / "ck"
+    m = storage.StorageMonitor(soft_bytes=1000, hard_bytes=500,
+                               critical_bytes=100, probe=False)
+    m.add_root("checkpoint", str(ck), budget_bytes=2000)
+    w = Watcher(storage_monitor=m)
+    assert w.poll() == []
+    (ck / "junk").write_bytes(b"x" * 1700)     # free 300: HARD
+    findings = w.poll()
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["kind"] == "disk_pressure"
+    assert f["severity"] == "error"
+    assert f["detail"]["root"] == "checkpoint"
+    assert f["detail"]["level"] == "hard"
+    assert f["detail"]["free_bytes"] == 300
+    # the latch is the monitor's hysteresis: no repeat finding while held
+    assert w.poll() == []
+    assert _counter("watch.findings.disk_pressure") == 1
+
+
+# ---------------------------------------------------------------------------
+# the offline storage digest (tools/fleet_report.py)
+# ---------------------------------------------------------------------------
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_report_storage_digest(tmp_path):
+    tl = str(tmp_path / "tl")
+    p = TelemetryPublisher(directory=tl, rank=0, interval=3600.0)
+    p.start(register=False)
+    obs.set_gauge("storage.free_bytes.checkpoint", 5000.0)
+    obs.set_gauge("storage.pressure", 0.0)
+    p.publish()
+    obs.set_gauge("storage.free_bytes.checkpoint", 300.0)
+    obs.set_gauge("storage.pressure", 2.0)
+    obs.add("storage.escalations")
+    obs.add("storage.gc_bytes_freed", 4096)
+    obs.set_table("storage.gc", {"actions": [
+        {"plane": "checkpoint", "freed": 4096, "t": time.time(),
+         "emergency": True},
+    ]})
+    p.publish()
+    obs.set_gauge("storage.pressure", 0.0)
+    obs.add("storage.recoveries")
+    p.publish()
+    p.stop()
+    fleet_report = _load_tool("fleet_report")
+    report = fleet_report.build_report(tl)
+    sto = report["fleet"]["storage"]
+    assert sto["gc_bytes_freed_total"] == 4096
+    assert sto["escalations_total"] == 1
+    assert sto["recoveries_total"] == 1
+    rank0 = sto["per_rank"]["0"]
+    assert rank0["free_bytes"] == {"checkpoint": 300}
+    assert rank0["pressure"] == 0
+    assert rank0["gc_actions"][-1]["plane"] == "checkpoint"
+    # the pressure timeline replays every gauge move: 0 -> 2 -> 0
+    curve = sto["pressure_timeline"]["0"]
+    assert [lvl for _t, lvl in curve] == [0, 2, 0]
+    # and the human rendering names the digest
+    text = fleet_report.render(report)
+    assert "storage:" in text
+    assert "ok -> hard -> ok" in text

@@ -18,7 +18,12 @@ replays offline) and reconstructs:
   bucket deltas (``metrics.window_p99`` over
   ``metrics.merge_cumulative_buckets`` — the same helpers the live
   watcher uses, so offline and online answers agree);
-* straggler gaps: the per-rank last-step spread.
+* straggler gaps: the per-rank last-step spread;
+* the storage digest: per-root free bytes at last journal stamp, the
+  pressure-level timeline (every ``storage.pressure`` gauge move), GC
+  reclaim totals and the journaled ``storage.gc`` action table — the
+  offline answer to "was the fleet running out of disk, and did GC keep
+  up".
 
 ``--expect-ranks N`` exits non-zero unless at least N shards were found
 and replayed (the CI guard that a dead rank's journal survived);
@@ -53,7 +58,7 @@ def analyze_shard(path, step_metric="executor.step_latency",
     st = timeline.ReplayState()
     points = []
     prev = {"served": 0, "goodput": 0, "sl_count": 0, "sl_sum": 0.0,
-            "lat": None}
+            "lat": None, "pressure": None}
     paths = ([path + ".1"] if os.path.exists(path + ".1") else []) + [path]
     n_records = 0
     for p in paths:
@@ -87,6 +92,12 @@ def analyze_shard(path, step_metric="executor.step_latency",
                 if any(deltas):
                     point["lat_bounds"] = bounds
                     point["lat_deltas"] = deltas
+            pressure = st.state["gauges"].get("storage.pressure")
+            if pressure is not None and pressure != prev["pressure"]:
+                # every gauge MOVE is one timeline event — the offline
+                # reconstruction of the ladder's escalations/recoveries
+                prev["pressure"] = pressure
+                point["pressure"] = int(pressure)
             points.append(point)
     counters = st.state["counters"]
     last_step = None
@@ -115,6 +126,23 @@ def analyze_shard(path, step_metric="executor.step_latency",
         stale = gauges.get("serving.model_staleness_seconds")
         if stale is not None:
             summary["model_staleness_s"] = float(stale)
+    free = {
+        name[len("storage.free_bytes."):]: int(val)
+        for name, val in gauges.items()
+        if name.startswith("storage.free_bytes.")
+    }
+    if free or "storage.pressure" in gauges:
+        storage = {"free_bytes": free}
+        if "storage.pressure" in gauges:
+            storage["pressure"] = int(gauges["storage.pressure"])
+        for c in ("storage.gc_bytes_freed", "storage.escalations",
+                  "storage.recoveries", "storage.writes_refused"):
+            if c in counters:
+                storage[c.split(".", 1)[1]] = counters[c]
+        gc_table = (st.state.get("tables", {}).get("storage.gc") or {})
+        if gc_table.get("actions"):
+            storage["gc_actions"] = gc_table["actions"]
+        summary["storage"] = storage
     return summary, points, st
 
 
@@ -216,6 +244,32 @@ def build_report(directory, bin_s=1.0, step_metric="executor.step_latency",
                 int(r) for r, v in versions.items() if v < vmax
             ),
         }
+    storage = {}
+    with_storage = [s for s in shards if s.get("storage")]
+    if with_storage:
+        pressure_tl = {}
+        for s, points in zip(shards, all_points):
+            curve = [
+                [pt["t"], pt["pressure"]] for pt in points
+                if "pressure" in pt and pt.get("t") is not None
+            ]
+            if curve:
+                pressure_tl[str(s["rank"])] = curve
+        storage = {
+            "per_rank": {
+                str(s["rank"]): s["storage"] for s in with_storage
+            },
+            "gc_bytes_freed_total": sum(
+                s["storage"].get("gc_bytes_freed", 0) for s in with_storage
+            ),
+            "escalations_total": sum(
+                s["storage"].get("escalations", 0) for s in with_storage
+            ),
+            "recoveries_total": sum(
+                s["storage"].get("recoveries", 0) for s in with_storage
+            ),
+            "pressure_timeline": pressure_tl,
+        }
     return {
         "dir": directory,
         "shards": shards,
@@ -233,6 +287,7 @@ def build_report(directory, bin_s=1.0, step_metric="executor.step_latency",
             "step_time": step_curves,
             "straggler": straggler,
             "publish_skew": publish_skew,
+            "storage": storage,
         },
     }
 
@@ -265,6 +320,30 @@ def render(report):
             f"(max skew {skew['max_skew']})"
             + (f"; lagging rank(s) {lag}" if lag else "")
         )
+    sto = fleet.get("storage")
+    if sto:
+        levels = {0: "ok", 1: "soft", 2: "hard", 3: "critical"}
+        lines.append(
+            f"  storage: {sto['gc_bytes_freed_total']} bytes GC'd, "
+            f"{sto['escalations_total']} escalation(s), "
+            f"{sto['recoveries_total']} recovery(ies)"
+        )
+        for rank, s in sorted(sto["per_rank"].items()):
+            frees = ", ".join(
+                f"{root}={b}" for root, b in sorted(
+                    s.get("free_bytes", {}).items()
+                )
+            )
+            lines.append(
+                f"    rank {rank}: pressure "
+                f"{levels.get(s.get('pressure'), '?')}"
+                + (f"; free bytes {frees}" if frees else "")
+                + (f"; {s['writes_refused']} write(s) refused"
+                   if s.get("writes_refused") else "")
+            )
+        for rank, curve in sorted(sto["pressure_timeline"].items()):
+            moves = " -> ".join(levels.get(lvl, "?") for _, lvl in curve)
+            lines.append(f"    rank {rank} pressure timeline: {moves}")
     strag = fleet["straggler"]
     if strag:
         lines.append(
