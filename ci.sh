@@ -16,18 +16,30 @@ echo "== pytest (8 virtual CPU devices via tests/conftest.py) =="
 # as the CI divergence gate — running both would double multi-minute XLA
 # compile work (tier-1 'not slow' runs never included it)
 python -m pytest tests/ -q \
-    --deselect tests/test_cost_model.py::test_zoo_estimate_vs_xla
+    --deselect tests/test_cost_model.py::test_zoo_estimate_vs_xla \
+    --deselect tests/test_memory_analysis.py::test_zoo_estimate_vs_xla_memory
 
 echo "== program lint (static verifier over every bundled model) =="
 # every bundled model must build and verify with ZERO error findings
 # (strict also escalates silent-redefinition warnings); --all-models
 # includes the r6 batched mask_rcnn graph (zoo: mask_rcnn_batched),
 # which replays the batched detection-op infer_shapes signatures
-python tools/program_lint.py --all-models --strict
+python tools/program_lint.py --all-models --strict --memory
 # ...and the linter itself must still catch a seeded broken program
 # (use-before-def + shape desync + rank-divergent collective => exit 1)
 if python tools/program_lint.py --broken-fixture > /dev/null 2>&1; then
     echo "program_lint failed to reject the seeded broken fixture" >&2
+    exit 1
+fi
+# memory family regressions: a read of a donated KV cache buffer, and a
+# program over a deliberately tiny PADDLE_TPU_HBM_BYTES budget (the
+# strict oom-risk escalation) must both exit non-zero
+if python tools/program_lint.py --broken-donation-fixture > /dev/null 2>&1; then
+    echo "program_lint failed to reject the use-after-donate fixture" >&2
+    exit 1
+fi
+if python tools/program_lint.py --broken-oom-fixture > /dev/null 2>&1; then
+    echo "program_lint failed to reject the over-budget oom fixture" >&2
     exit 1
 fi
 
@@ -505,7 +517,8 @@ observability.dump("/tmp/paddle_tpu_obs_snapshot.json")
 EOF
 python tools/stats_report.py /tmp/paddle_tpu_obs_snapshot.json \
     --require executor. --require analysis. --require detection. \
-    --require perf. --require embedding. --top-ops 5
+    --require perf. --require perf.peak_bytes --require embedding. \
+    --top-ops 5
 
 echo "== causal tracing: cross-thread traces, rank stamps, live watcher =="
 # 2-rank mini-train with traces on: each step runs under its own trace;
@@ -762,10 +775,13 @@ python tools/bench_telemetry.py --smoke
 
 echo "== perf report (IR cost model vs XLA over the zoo) =="
 # every zoo model's Program.estimate() must stay within 25% of XLA's own
-# cost_analysis (one model of slack for backend counting quirks);
+# cost_analysis (one model of slack for backend counting quirks), and the
+# static peak-HBM plan within 25% of XLA memory_analysis on all but two
+# models (peak estimation carries fusion/scheduling error FLOPs do not);
 # divergences are printed, never hidden
 python tools/perf_report.py --all-models --check-divergence \
-    --max-divergence 0.25 --allow-divergent 1 --top-ops 3
+    --max-divergence 0.25 --allow-divergent 1 --top-ops 3 \
+    --check-memory --allow-memory-divergent 2
 
 echo "== perf report: multi-rank timeline merge =="
 PERF_DIR=$(mktemp -d)

@@ -7,7 +7,7 @@ graph surfaces as an opaque XLA error with no op attribution — or, for a
 mismatched collective, as a silent multi-rank hang. Pass-based IR
 verification is standard in tensor compilers (TVM, arXiv:1802.04799), and
 whole-block fusion (arXiv:2301.13062) makes *pre-trace* the only point
-where per-op source provenance still exists. This package runs three
+where per-op source provenance still exists. This package runs four
 analysis families and returns structured :class:`Finding`\\ s:
 
 * structural  — use-before-def vs feeds/persistables/scope, undeclared
@@ -18,7 +18,12 @@ analysis families and returns structured :class:`Finding`\\ s:
   (shapes.py);
 * collective schedule — per-rank simulation of the op streams the
   SPMD/pipeline transpilers produce; order/kind/axis must agree across
-  ranks and every axis must exist in the Program's mesh (collectives.py).
+  ranks and every axis must exist in the Program's mesh (collectives.py);
+* memory/liveness — per-op live-interval simulation producing the static
+  peak-HBM plan (resident persistables, transient peak, watermark op),
+  the donation/aliasing verifier (use-after-donate, missed-donation,
+  recompute-no-savings), and the ``PADDLE_TPU_HBM_BYTES`` oom-risk gate
+  (memory.py).
 
 Wired into ``Executor._compile`` behind ``PADDLE_TPU_VERIFY``
 (``strict`` | ``warn`` (default) | ``0``); ``tools/program_lint.py``
@@ -33,7 +38,10 @@ from .findings import (  # noqa: F401
     COLLECTIVE_DIVERGENCE,
     DEAD_OP,
     DTYPE_DESYNC,
+    MISSED_DONATION,
     MISSING_FEED,
+    OOM_RISK,
+    RECOMPUTE_NO_SAVINGS,
     REDEFINITION,
     SHAPE_DESYNC,
     STRICT_ESCALATIONS,
@@ -42,6 +50,7 @@ from .findings import (  # noqa: F401
     UNKNOWN_MESH_AXIS,
     UNKNOWN_OP,
     UNREACHABLE_VAR,
+    USE_AFTER_DONATE,
     USE_BEFORE_DEF,
     Finding,
     Report,
@@ -55,6 +64,12 @@ from .cost import (  # noqa: F401
     family_of,
     op_cost,
     peak_flops,
+)
+from .memory import (  # noqa: F401
+    MemoryTable,
+    analyze_memory,
+    hbm_budget,
+    plan_memory,
 )
 from .shapes import analyze_shapes  # noqa: F401
 from .structural import analyze_structural  # noqa: F401

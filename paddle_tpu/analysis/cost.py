@@ -275,6 +275,16 @@ class CostTable:
     #: instead of a global sum. None = serialized schedule: the step
     #: estimate is ``total_latency``.
     scheduled_latency: float = None
+    #: static HBM plan from the memory analysis family (set by
+    #: :func:`estimate_program`): peak live bytes (resident persistables
+    #: + feeds + transient live-set max), the resident portion alone, and
+    #: the full :class:`~paddle_tpu.analysis.memory.MemoryTable` (the
+    #: watermark op, timeline, per-stage peaks). Cross-checked against
+    #: XLA's compiled ``memory_analysis`` by ``Executor.memory_analysis``
+    #: / ``tools/perf_report.py --check-memory``.
+    peak_bytes: float = None
+    resident_bytes: float = None
+    memory: object = field(default=None, repr=False)
 
     @property
     def total_flops(self):
@@ -373,6 +383,11 @@ class CostTable:
             "overlap_ratio": self.overlap_ratio,
             "peak_flops": self.peak_flops,
             "peak_bandwidth": self.peak_bandwidth,
+            "peak_bytes": self.peak_bytes,
+            "resident_bytes": self.resident_bytes,
+            "memory": (
+                self.memory.to_dict() if self.memory is not None else None
+            ),
             "by_family": self.by_family(),
             "ops": [e.to_dict() for e in self.top(top)],
             "assumptions": list(self.assumptions),
@@ -395,6 +410,8 @@ class CostTable:
                 f"{self.wire_exposed_latency * 1e3:.3f} ms, "
                 f"{self.overlap_ratio:.0%} hidden behind compute)"
             )
+        if self.memory is not None:
+            lines.append(self.memory.format(top=3))
         fams = sorted(self.by_family().items(),
                       key=lambda kv: -kv[1]["latency"])
         tot_lat = self.total_latency or 1.0
@@ -1116,4 +1133,15 @@ def estimate_program(program, feed_shapes=None, peak_tflops=None,
         table.assumptions.append(
             f"unregistered op type {t!r} x{n} skipped"
         )
+    try:
+        from .memory import plan_memory
+
+        # budget=None: the oom-risk gate belongs to the verifier; the
+        # estimate just reports the plan
+        mem = plan_memory(program, feed_shapes=feed_shapes, budget=None)
+        table.memory = mem
+        table.peak_bytes = mem.peak_bytes
+        table.resident_bytes = mem.resident_bytes
+    except Exception as exc:  # the cost table must survive a planner bug
+        table.assumptions.append(f"static memory plan unavailable: {exc!r}")
     return table

@@ -41,10 +41,15 @@ COLLECTIVE_DIVERGENCE = "collective-divergence"
 COLLECTIVE_BRANCH_DIVERGENCE = "collective-branch-divergence"
 UNKNOWN_MESH_AXIS = "unknown-mesh-axis"
 MISSING_FEED = "missing-feed"
+OOM_RISK = "oom-risk"
+USE_AFTER_DONATE = "use-after-donate"
+MISSED_DONATION = "missed-donation"
+RECOMPUTE_NO_SAVINGS = "recompute-no-savings"
 
 # WARNING findings in these categories count as errors under strict
-# verify (the redefinition satellite: "warn; error under strict")
-STRICT_ESCALATIONS = frozenset({REDEFINITION})
+# verify (the redefinition satellite: "warn; error under strict";
+# oom-risk: an over-HBM-budget program is refused pre-compile)
+STRICT_ESCALATIONS = frozenset({REDEFINITION, OOM_RISK})
 
 
 @dataclass
@@ -57,6 +62,20 @@ class Finding:
     op_type: str | None = None
     names: tuple = ()
     loc: str | None = None  # user source frame that created the op/var
+
+    def to_dict(self) -> dict:
+        """Stable machine-readable form (``program_lint --json`` emits
+        these; downstream dashboards key on the field names)."""
+        return {
+            "severity": self.severity.name,
+            "category": self.category,
+            "message": self.message,
+            "block_idx": self.block_idx,
+            "op_index": self.op_index,
+            "op_type": self.op_type,
+            "names": list(self.names),
+            "loc": self.loc,
+        }
 
     def format(self) -> str:
         where = []
@@ -128,7 +147,13 @@ class Report:
     def ok(self) -> bool:
         return not self.errors
 
-    def render(self, min_severity=Severity.INFO) -> str:
+    def render(self, min_severity=Severity.INFO,
+               max_per_severity=25) -> str:
+        """Human rendering, capped at ``max_per_severity`` findings per
+        severity so a detection-sized program doesn't flood the single
+        ``ProgramVerifyWarning`` — the elided tail is summarized per
+        category; the full list stays on the Report / exception object.
+        ``max_per_severity=None`` renders everything."""
         picked = [
             f for f in sorted(
                 self.findings, key=lambda f: -int(f.severity)
@@ -141,4 +166,23 @@ class Report:
             f"program verifier: {len(self.errors)} error(s), "
             f"{len(self.warnings)} warning(s), {len(self.infos)} info"
         )
-        return "\n".join([head] + ["  " + f.format() for f in picked])
+        lines = [head]
+        for sev in (Severity.ERROR, Severity.WARNING, Severity.INFO):
+            group = [f for f in picked if f.severity == sev]
+            shown = group if max_per_severity is None else (
+                group[:max_per_severity]
+            )
+            lines.extend("  " + f.format() for f in shown)
+            hidden = group[len(shown):]
+            if hidden:
+                by_cat = {}
+                for f in hidden:
+                    by_cat[f.category] = by_cat.get(f.category, 0) + 1
+                cats = ", ".join(
+                    f"{c} x{n}" for c, n in sorted(by_cat.items())
+                )
+                lines.append(
+                    f"  … +{len(hidden)} more {sev.name} "
+                    f"finding(s) ({cats})"
+                )
+        return "\n".join(lines)

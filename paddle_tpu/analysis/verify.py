@@ -1,5 +1,6 @@
-"""Verifier orchestration: run the three analysis families over a Program
-and act on the result per ``PADDLE_TPU_VERIFY``.
+"""Verifier orchestration: run the analysis families (structural, shape/
+dtype, collective schedule, memory/liveness) over a Program and act on
+the result per ``PADDLE_TPU_VERIFY``.
 
 Modes (env var, overridable per-process with :func:`set_verify_mode`):
 * ``strict`` — ERROR findings (plus escalated WARNINGs, e.g. silent
@@ -23,6 +24,7 @@ import warnings
 
 from .collectives import analyze_collectives
 from .findings import Report, Severity
+from .memory import analyze_memory
 from .shapes import analyze_shapes
 from .structural import analyze_structural
 
@@ -53,13 +55,18 @@ def set_verify_mode(mode) -> None:
     _mode_override = mode
 
 
-FAMILIES = ("structural", "shapes", "collectives")
+FAMILIES = ("structural", "shapes", "collectives", "memory")
+
+# check_before_compile result cache entries kept per Program (distinct
+# (version, feeds, fetches, families) keys; stale versions evict in
+# insertion order)
+_VERIFY_CACHE_CAPACITY = 8
 
 
 def verify_program(program, feed_names=(), fetch_names=(),
                    families=FAMILIES) -> Report:
     """Run the requested analysis families; return the full Report
-    (no raising). Default: all three."""
+    (no raising). Default: all four."""
     from .. import observability as _obs
 
     with _obs.timed("analysis.verify_latency"):
@@ -72,6 +79,10 @@ def verify_program(program, feed_names=(), fetch_names=(),
             report.extend(analyze_shapes(program))
         if "collectives" in families:
             report.extend(analyze_collectives(program))
+        if "memory" in families:
+            report.extend(
+                analyze_memory(program, feed_names, fetch_names)
+            )
     _obs.add("analysis.programs_verified")
     for sev, bucket in (
         (Severity.ERROR, "error"),
@@ -89,16 +100,22 @@ def check_before_compile(program, feed_names=(), fetch_names=()):
     enforce the active mode. Returns the Report (or None when off).
 
     warn mode runs the graph-walk families only (structural +
-    collective-schedule — O(ops) python, microseconds to low ms); the
-    shape/dtype family replays ``infer_shapes`` per op, seconds on
-    detection-sized programs, so at compile time it rides only the
+    collective-schedule + memory — O(ops) python, microseconds to low
+    ms); the shape/dtype family replays ``infer_shapes`` per op, seconds
+    on detection-sized programs, so at compile time it rides only the
     opt-in strict mode. ``verify_program`` / ``tools/program_lint.py``
-    always run all families."""
+    always run all families.
+
+    The pass is cached per (version, feeds, fetches, families) in a small
+    bounded dict — a program compiled alternately with two feed/fetch
+    sets (train loss + eval metric) verifies once per set, not once per
+    compile."""
     mode = verify_mode()
     if mode == "off":
         return None
     families = (
-        FAMILIES if mode == "strict" else ("structural", "collectives")
+        FAMILIES if mode == "strict"
+        else ("structural", "collectives", "memory")
     )
     key = (
         program._version,
@@ -106,14 +123,18 @@ def check_before_compile(program, feed_names=(), fetch_names=()):
         tuple(fetch_names or ()),
         families,
     )
-    cached = program.__dict__.get("_verify_cache")
-    if cached is not None and cached[0] == key:
-        report = cached[1]
-    else:
+    cache = program.__dict__.get("_verify_cache")
+    if not isinstance(cache, dict):
+        cache = {}
+        program.__dict__["_verify_cache"] = cache
+    report = cache.get(key)
+    if report is None:
         report = verify_program(
             program, feed_names, fetch_names, families=families
         )
-        program.__dict__["_verify_cache"] = (key, report)
+        while len(cache) >= _VERIFY_CACHE_CAPACITY:
+            cache.pop(next(iter(cache)))
+        cache[key] = report
 
     if mode == "strict":
         strict = report.strict_errors()

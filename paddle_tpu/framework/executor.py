@@ -462,6 +462,13 @@ class Executor:
                 k: tuple(a.shape) for k, a in feed_arrays.items()
             })
             _obs.set_table("perf.cost_table", table.to_dict(top=50))
+            if table.peak_bytes is not None:
+                _obs.set_gauge(
+                    "perf.peak_bytes_est", float(table.peak_bytes)
+                )
+                _obs.set_gauge(
+                    "perf.resident_bytes_est", float(table.resident_bytes)
+                )
             return _PerfEstimate(table)
         except Exception:
             _obs.add("perf.estimate_failures")
@@ -517,6 +524,65 @@ class Executor:
             )
             return 0.0
         return float(ca.get("flops", 0.0))
+
+    # ------------------------------------------------------------------
+    def memory_analysis(self, program=None, feed=None, fetch_list=None,
+                        scope=None):
+        """XLA's buffer-assignment memory breakdown for ONE step of
+        `program` with this feed: a dict of ``argument_bytes`` /
+        ``output_bytes`` / ``temp_bytes`` / ``alias_bytes`` plus
+        ``peak_bytes`` (argument + output + temp − alias: every byte the
+        executable holds at once, donated buffers counted once) from the
+        compiled executable's ``memory_analysis()``. The ground truth the
+        static plan (``Program.estimate().peak_bytes``) is cross-checked
+        against (``tools/perf_report.py --check-memory``). Returns None —
+        with a counter bump — when the backend reports nothing."""
+        (program, scope, block, feed_arrays, _feed_sig, fetch_names,
+         key) = self._prepared(program, feed, fetch_list, scope)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(
+                program, block, set(feed_arrays), fetch_names, scope
+            )
+            self._cache[key] = compiled
+        state_ro = {
+            n: self._from_scope(scope, n, block) for n in compiled.state_ro
+        }
+        state_mut = {
+            n: self._from_scope(scope, n, block) for n in compiled.state_mut
+        }
+        from ..core.random import prng_impl
+
+        step_key = jax.random.key(0, impl=prng_impl())
+        lowered = compiled.fn.lower(
+            feed_arrays, state_mut, state_ro, step_key
+        )
+        try:
+            ma = lowered.compile().memory_analysis()
+        except Exception:
+            ma = None
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0] if ma else None
+        fields = {
+            "argument_bytes": "argument_size_in_bytes",
+            "output_bytes": "output_size_in_bytes",
+            "temp_bytes": "temp_size_in_bytes",
+            "alias_bytes": "alias_size_in_bytes",
+        }
+        out = {
+            k: float(getattr(ma, attr, 0.0) or 0.0)
+            for k, attr in fields.items()
+        }
+        if ma is None or not any(out.values()):
+            from .. import observability as _obs
+
+            _obs.add("perf.memory_analysis_unavailable")
+            return None
+        out["peak_bytes"] = (
+            out["argument_bytes"] + out["output_bytes"]
+            + out["temp_bytes"] - out["alias_bytes"]
+        )
+        return out
 
     # ------------------------------------------------------------------
     def _prepared(self, program, feed, fetch_list, scope):

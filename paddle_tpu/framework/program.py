@@ -463,7 +463,10 @@ class Program:
         feeds the live ``perf.*`` gauges with it, and the planned autotuner
         consumes it as its objective. `feed_shapes` ({var: shape}) pins -1
         batch dims; peaks default from ``PADDLE_TPU_PEAK_TFLOPS`` /
-        ``PADDLE_TPU_PEAK_GBPS`` (TPU v5e bf16). Pure graph walk over
+        ``PADDLE_TPU_PEAK_GBPS`` (TPU v5e bf16). The table also carries
+        the static HBM plan (``peak_bytes`` / ``resident_bytes`` /
+        ``memory`` — analysis/memory.py's live-interval walk), the number
+        ``serving.Server.warmup`` budgets against. Pure graph walk over
         declared Variable shapes — no tracing, no compilation."""
         from ..analysis.cost import estimate_program
 

@@ -704,9 +704,18 @@ class Endpoint:
         a user-visible request latency (the PR-6 bench warmup lesson).
         A ReplicaSet exposes ``warmup_run``, which warms EVERY replica —
         a cold standby would otherwise pay its compiles during a
-        failover, exactly when latency matters most."""
+        failover, exactly when latency matters most.
+
+        When ``PADDLE_TPU_HBM_BYTES`` is set and the runner exposes its
+        frozen program, the static HBM plan for every (bucket, fetch-set)
+        executable is validated FIRST — resident state once plus the
+        worst bucket's transient peak times the runner's concurrency must
+        fit the budget, or warmup refuses with a typed error *before*
+        compiling anything (the concurrency-planning math the paged KV
+        cache consumes)."""
         from ..core.dtypes import to_numpy_dtype
 
+        self.plan_memory()
         run = getattr(self.runner, "warmup_run", None) or self.runner.run
         for b in self.config.buckets:
             feed = {}
@@ -717,6 +726,65 @@ class Endpoint:
                 run(feed)
             self._obs.add("serving.warmup_runs")
         return len(self.config.buckets)
+
+    def plan_memory(self, budget=None):
+        """Static per-bucket HBM plan for this endpoint: resident bytes
+        once + max-over-buckets (feeds + transient peak) × concurrency.
+        Returns the plan dict (None when the runner exposes no program),
+        publishes ``serving.warmup_peak_bytes.<endpoint>``, and raises
+        :class:`~paddle_tpu.errors.PreconditionNotMetError` when a budget
+        (argument, else ``PADDLE_TPU_HBM_BYTES``) is exceeded."""
+        from ..analysis.memory import (
+            _fmt_bytes, hbm_budget, plan_memory,
+        )
+
+        frozen = getattr(self.runner, "frozen", None)
+        program = getattr(frozen, "program", None)
+        if program is None:
+            return None
+        if budget is None:
+            budget = hbm_budget()
+        fetch_names = tuple(getattr(self.runner, "fetch_names", ()) or ())
+        feed_names = tuple(getattr(self.runner, "feed_names", ()) or ())
+        resident = 0.0
+        per_bucket = {}
+        worst = 0.0
+        for b in self.config.buckets:
+            feed_shapes = {}
+            for name in feed_names:
+                shape, _dtype = self.runner.sample_spec(name)
+                feed_shapes[name] = (b,) + tuple(shape)
+            mt = plan_memory(
+                program, feed_names=feed_names, fetch_names=fetch_names,
+                feed_shapes=feed_shapes, budget=None,
+            )
+            resident = max(resident, mt.resident_bytes)
+            dynamic = mt.feed_bytes + mt.transient_peak_bytes
+            per_bucket[b] = dynamic
+            worst = max(worst, dynamic)
+        planned = resident + worst * self._concurrency
+        self._obs.set_gauge(
+            f"serving.warmup_peak_bytes.{self.name}", planned
+        )
+        plan = {
+            "resident_bytes": resident,
+            "per_bucket_dynamic_bytes": per_bucket,
+            "concurrency": self._concurrency,
+            "planned_peak_bytes": planned,
+            "budget_bytes": budget,
+        }
+        if budget is not None and planned > budget:
+            from ..errors import PreconditionNotMetError
+
+            raise PreconditionNotMetError(
+                f"endpoint {self.name!r} cannot fit the HBM budget: "
+                f"resident {_fmt_bytes(resident)} + worst bucket "
+                f"{_fmt_bytes(worst)} x concurrency {self._concurrency} "
+                f"= {_fmt_bytes(planned)} > "
+                f"{_fmt_bytes(budget)} (PADDLE_TPU_HBM_BYTES); shrink "
+                "the buckets, the cache, or the replica concurrency"
+            )
+        return plan
 
     # -- lifecycle ---------------------------------------------------------
     def pending(self):

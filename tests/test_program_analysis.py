@@ -662,6 +662,60 @@ def test_verify_cached_per_program_version(fresh):
     assert after == before + 1
 
 
+def test_verify_cache_keeps_multiple_fetch_sets(fresh):
+    """Alternating fetch sets each verify ONCE: the cache is a bounded
+    dict keyed per (version, feeds, fetches), not a single entry a
+    different key evicts on every flip."""
+    from paddle_tpu import observability as obs
+
+    main, startup, _ = fresh
+    x = fluid.data("x", [-1, 4])
+    a = layers.scale(x, scale=2.0)
+    b = layers.scale(x, scale=3.0)
+    set_verify_mode("warn")
+    exe = fluid.Executor()
+    feed = {"x": np.ones((4, 4), "float32")}
+    before = obs.snapshot()["counters"].get("analysis.programs_verified", 0)
+    for _ in range(3):  # a<->b thrash: 2 verifies total, not 6
+        exe.run(main, feed=feed, fetch_list=[a])
+        exe.run(main, feed=feed, fetch_list=[b])
+    after = obs.snapshot()["counters"].get("analysis.programs_verified", 0)
+    assert after == before + 2
+
+
+def test_verify_cache_is_bounded(fresh):
+    from paddle_tpu.analysis.verify import (
+        _VERIFY_CACHE_CAPACITY,
+        check_before_compile,
+    )
+
+    main, _, _ = fresh
+    x = fluid.data("x", [-1, 4])
+    y = layers.scale(x, scale=2.0)
+    set_verify_mode("warn")
+    for i in range(_VERIFY_CACHE_CAPACITY + 5):
+        check_before_compile(main, ("x",), (y.name, f"alias_{i}"))
+    assert len(main.__dict__["_verify_cache"]) <= _VERIFY_CACHE_CAPACITY
+
+
+def test_render_caps_per_severity_with_elision_tail(fresh):
+    from paddle_tpu.analysis.findings import Finding, Report
+
+    report = Report()
+    for i in range(30):
+        report.add(Finding(Severity.WARNING, REDEFINITION, f"w{i}"))
+    for i in range(3):
+        report.add(Finding(Severity.ERROR, USE_BEFORE_DEF, f"e{i}"))
+    text = report.render(max_per_severity=25)
+    assert text.count("ERROR[") == 3  # under the cap: all shown
+    assert text.count("WARNING[") == 25
+    assert "+5 more WARNING finding(s) (redefinition x5)" in text
+    assert len(report.warnings) == 30  # the full list survives on the report
+    everything = report.render(max_per_severity=None)
+    assert everything.count("WARNING[") == 30
+    assert "more" not in everything
+
+
 def test_observability_counters_and_latency(fresh):
     from paddle_tpu import observability as obs
 

@@ -14,7 +14,11 @@ Three report modes over the analysis/cost.py IR cost model:
    exits non-zero when more than `--allow-divergent` models diverge past
    the threshold (divergences are always REPORTED, never hidden). Meshed
    models (bert_3d) are estimate-only: their shard_map executable wants
-   the whole virtual pod stepping together.
+   the whole virtual pod stepping together. `--check-memory` runs the
+   same cross-check for the static peak-HBM plan (analysis/memory.py)
+   against XLA `memory_analysis` (arg+out+temp-alias), with its own
+   `--allow-memory-divergent` budget: peak estimation carries fusion and
+   scheduling error the FLOP count does not.
 
 3. Merged pod timeline — fuse per-rank Chrome span exports
    (`observability.save_chrome_trace`, one file per rank) and optional
@@ -68,8 +72,11 @@ def _synthetic_feed(bm, batch_hint=4):
     return feed
 
 
-def report_model(name, top_ops, check_divergence, max_divergence):
-    """Return (ok, divergence | None) and print the model's report."""
+def report_model(name, top_ops, check_divergence, max_divergence,
+                 check_memory=False):
+    """Print the model's report; return ``(flops_div, mem_div)`` where
+    each is the measured divergence past ``max_divergence`` or None when
+    the check passed / was skipped / was not requested."""
     import paddle_tpu as fluid
     from paddle_tpu.framework.scope import Scope
     from paddle_tpu.models import build_model
@@ -81,28 +88,51 @@ def report_model(name, top_ops, check_divergence, max_divergence):
     )
     print(f"==== {name} ====")
     print(est.format(top=top_ops))
-    if not check_divergence:
-        return True, None
+    if not (check_divergence or check_memory):
+        return None, None
     if getattr(bm.main, "_mesh", None) is not None:
         print(f"  [skip] {name}: meshed program — estimate-only "
               "(shard_map executable needs the whole pod)")
-        return True, None
+        return None, None
     exe = fluid.Executor()
     scope = Scope()
     exe.run(bm.startup, scope=scope)
-    xla = exe.flops(
-        bm.main, feed=feed, fetch_list=list(bm.fetch_names), scope=scope
-    )
-    if not xla:
-        print(f"  [skip] {name}: XLA cost_analysis reported no FLOP data")
-        return True, None
-    div = abs(est.total_flops - xla) / xla
-    verdict = "ok" if div <= max_divergence else "DIVERGENT"
-    print(
-        f"  estimate {est.total_flops / 1e6:.3f}M vs XLA "
-        f"{xla / 1e6:.3f}M FLOPs -> divergence {div:.1%} [{verdict}]"
-    )
-    return div <= max_divergence, div
+    flops_div = mem_div = None
+    if check_divergence:
+        xla = exe.flops(
+            bm.main, feed=feed, fetch_list=list(bm.fetch_names), scope=scope
+        )
+        if not xla:
+            print(f"  [skip] {name}: XLA cost_analysis reported no "
+                  "FLOP data")
+        else:
+            div = abs(est.total_flops - xla) / xla
+            verdict = "ok" if div <= max_divergence else "DIVERGENT"
+            print(
+                f"  estimate {est.total_flops / 1e6:.3f}M vs XLA "
+                f"{xla / 1e6:.3f}M FLOPs -> divergence {div:.1%} "
+                f"[{verdict}]"
+            )
+            if div > max_divergence:
+                flops_div = div
+    if check_memory:
+        ma = exe.memory_analysis(
+            bm.main, feed=feed, fetch_list=list(bm.fetch_names), scope=scope
+        )
+        if ma is None or est.peak_bytes is None:
+            print(f"  [skip] {name}: XLA memory_analysis unavailable")
+        else:
+            xla_peak = ma["peak_bytes"]
+            div = abs(est.peak_bytes - xla_peak) / max(xla_peak, 1.0)
+            verdict = "ok" if div <= max_divergence else "DIVERGENT"
+            print(
+                f"  peak-HBM estimate {est.peak_bytes / 2**20:.2f} MiB "
+                f"vs XLA {xla_peak / 2**20:.2f} MiB (arg+out+temp-alias) "
+                f"-> divergence {div:.1%} [{verdict}]"
+            )
+            if div > max_divergence:
+                mem_div = div
+    return flops_div, mem_div
 
 
 # ---------------------------------------------------------------------------
@@ -402,6 +432,14 @@ def main(argv=None):
     ap.add_argument("--allow-divergent", type=int, default=1,
                     help="models allowed past the threshold before the "
                          "exit status fails (default 1)")
+    ap.add_argument("--check-memory", action="store_true",
+                    help="cross-check the static peak-HBM estimate vs "
+                         "XLA memory_analysis (arg+out+temp-alias)")
+    ap.add_argument("--allow-memory-divergent", type=int, default=2,
+                    help="models allowed past the memory threshold "
+                         "before the exit status fails (default 2: the "
+                         "planner does not model cross-op fusion or "
+                         "XLA's scheduling freedom)")
     ap.add_argument("--merge", nargs="+", metavar="TRACE.json",
                     help="merge per-rank chrome span exports")
     ap.add_argument("--attribution", metavar="SNAPSHOT.json",
@@ -450,13 +488,17 @@ def main(argv=None):
     unknown = [n for n in names if n not in MODEL_BUILDERS]
     if unknown:
         ap.error(f"unknown models {unknown}; have {sorted(MODEL_BUILDERS)}")
-    divergent = []
+    divergent, mem_divergent = [], []
     for n in names:
-        ok, div = report_model(
-            n, args.top_ops, args.check_divergence, args.max_divergence
+        flops_div, mem_div = report_model(
+            n, args.top_ops, args.check_divergence, args.max_divergence,
+            check_memory=args.check_memory,
         )
-        if not ok:
-            divergent.append((n, div))
+        if flops_div is not None:
+            divergent.append((n, flops_div))
+        if mem_div is not None:
+            mem_divergent.append((n, mem_div))
+    status = 0
     if args.check_divergence:
         print(
             f"divergence check: {len(names) - len(divergent)}/{len(names)} "
@@ -464,8 +506,18 @@ def main(argv=None):
             + (f"; divergent: {divergent}" if divergent else "")
         )
         if len(divergent) > args.allow_divergent:
-            return 2
-    return 0
+            status = 2
+    if args.check_memory:
+        # a separate budget from the flops gate: peak estimation carries
+        # fusion/scheduling error the FLOP count does not
+        print(
+            f"memory check: {len(names) - len(mem_divergent)}/{len(names)} "
+            f"within {args.max_divergence:.0%}"
+            + (f"; divergent: {mem_divergent}" if mem_divergent else "")
+        )
+        if len(mem_divergent) > args.allow_memory_divergent:
+            status = 2
+    return status
 
 
 if __name__ == "__main__":

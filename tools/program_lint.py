@@ -2,15 +2,23 @@
 """Build every bundled model and run the static program verifier over it.
 
 Usage:
-    python tools/program_lint.py --all-models [--strict]
-    python tools/program_lint.py --model bert --model gpt
+    python tools/program_lint.py --all-models [--strict] [--memory]
+    python tools/program_lint.py --model bert --model gpt --json
     python tools/program_lint.py --broken-fixture   # must exit non-zero
 
 Exit status: 0 when no model produced an ERROR finding (under --strict,
-escalated WARNINGs — silent redefinition — also count), non-zero
-otherwise. ``--broken-fixture`` builds a deliberately malformed Program
-(use-before-def + shape desync + rank-divergent collective) and lints it:
-CI asserts the exit status is NON-zero, the linter's own regression test.
+escalated WARNINGs — silent redefinition, oom-risk — also count),
+non-zero otherwise. ``--broken-fixture`` builds a deliberately malformed
+Program (use-before-def + shape desync + rank-divergent collective) and
+lints it: CI asserts the exit status is NON-zero, the linter's own
+regression test. ``--broken-donation-fixture`` (a read of a donated KV
+cache buffer) and ``--broken-oom-fixture`` (a program over a deliberately
+tiny ``PADDLE_TPU_HBM_BYTES``) are the memory family's equivalents.
+
+``--memory`` prints the static peak-HBM plan (analysis/memory.py) per
+model; ``--json`` swaps the human report for one machine-readable JSON
+document on stdout (per-model findings with severity/category/op/loc,
+plus the memory summary) for dashboards and diffing.
 
 Models are built through ``paddle_tpu.models.zoo`` (CI-sized configs,
 training programs with optimizer applied); meshed models (bert_3d) get a
@@ -39,7 +47,8 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
-def _lint_one(name, strict, verbose, cost=False):
+def _lint_one(name, strict, verbose, cost=False, memory=False,
+              records=None):
     import time
 
     from paddle_tpu.analysis import Severity, verify_program
@@ -54,6 +63,25 @@ def _lint_one(name, strict, verbose, cost=False):
     verified = time.time() - t0 - built
     failing = report.strict_errors() if strict else report.errors
     status = "FAIL" if failing else "ok"
+    mt = None
+    if memory or records is not None:
+        # the memory family's full table (the verifier only surfaces its
+        # findings; the table carries the per-op liveness timeline)
+        from paddle_tpu.analysis import plan_memory
+
+        mt = plan_memory(bm.main, feed_names=bm.feed_names or None,
+                         fetch_names=bm.fetch_names)
+    if records is not None:
+        records.append({
+            "model": name,
+            "status": status,
+            "errors": len(report.errors),
+            "warnings": len(report.warnings),
+            "infos": len(report.infos),
+            "findings": [f.to_dict() for f in report.findings],
+            "memory": mt.to_dict() if mt is not None else None,
+        })
+        return not failing
     print(
         f"[{status}] {name:<10} build {built:5.1f}s verify {verified:5.1f}s"
         f"  errors={len(report.errors)} warnings={len(report.warnings)} "
@@ -63,6 +91,9 @@ def _lint_one(name, strict, verbose, cost=False):
     shown = [f for f in report.findings if f.severity >= min_sev]
     for f in shown:
         print("    " + f.format())
+    if memory:
+        for line in mt.format(top=5).splitlines():
+            print("    " + line)
     if cost:
         # the fourth analysis family: per-op FLOPs/bytes/roofline table
         # (analysis/cost.py) at the model's graph-build shapes
@@ -179,6 +210,50 @@ def _broken_bucket_fixture():
     return main, ("x",), (loss.name,)
 
 
+def _broken_donation_fixture():
+    """A decode step whose ``kv_cache_write`` emits the updated cache
+    under a NEW name — donating the old buffer (``mutates`` aliases Out
+    onto Cache) — and then reads the stale donated handle. On device the
+    read observes the overwritten pages; the donation verifier must
+    reject it with ``use-after-donate``."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        rows = fluid.data("rows", [1, 4, 8])
+        pos = fluid.data("pos", [1], dtype="int32")
+    blk = main.global_block
+    blk.create_var(name="cache", shape=[16, 4, 8], dtype="float32",
+                   persistable=True)
+    blk.create_var(name="cache_new", shape=[16, 4, 8], dtype="float32",
+                   persistable=True)
+    blk.append_op(
+        "kv_cache_write",
+        {"Cache": ["cache"], "X": [rows.name], "Pos": [pos.name]},
+        {"Out": ["cache_new"]},
+    )
+    # the defect: 'cache' was donated to 'cache_new' one op ago
+    blk.create_var(name="stale", shape=[16, 4, 8], dtype="float32")
+    blk.append_op("scale", {"X": ["cache"]}, {"Out": ["stale"]},
+                  {"scale": 2.0})
+    return main, ("rows", "pos"), ("stale",)
+
+
+def _broken_oom_fixture():
+    """A program whose static peak cannot fit the deliberately tiny
+    ``PADDLE_TPU_HBM_BYTES`` the CI stage pins: the memory planner must
+    emit ``oom-risk``, which strict verify escalates to a refusal."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [64, 1024])
+        h = layers.fc(x, 1024, act="relu")
+        out = layers.fc(h, 1024)
+    return main, ("x",), (out.name,)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--all-models", action="store_true",
@@ -197,29 +272,65 @@ def main(argv=None):
     ap.add_argument("--broken-bucket-fixture", action="store_true",
                     help="lint a program whose ranks bucket the same "
                          "grad exchange differently (must fail)")
+    ap.add_argument("--broken-donation-fixture", action="store_true",
+                    help="lint a program that reads a donated KV cache "
+                         "buffer (must fail)")
+    ap.add_argument("--broken-oom-fixture", action="store_true",
+                    help="lint a program over a tiny PADDLE_TPU_HBM_BYTES "
+                         "budget (must fail under the strict escalation)")
     ap.add_argument("--cost", action="store_true",
                     help="print the Program.estimate() cost table per model")
+    ap.add_argument("--memory", action="store_true",
+                    help="print the static peak-HBM plan per model")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON document instead "
+                         "of the human report")
     args = ap.parse_args(argv)
 
     if (args.broken_fixture or args.broken_frozen_fixture
-            or args.broken_bucket_fixture):
-        from paddle_tpu.analysis import verify_program
+            or args.broken_bucket_fixture or args.broken_donation_fixture
+            or args.broken_oom_fixture):
+        from paddle_tpu.analysis import OOM_RISK, verify_program
 
         if args.broken_frozen_fixture:
             program, feeds, fetches = _broken_frozen_fixture()
         elif args.broken_bucket_fixture:
             program, feeds, fetches = _broken_bucket_fixture()
+        elif args.broken_donation_fixture:
+            program, feeds, fetches = _broken_donation_fixture()
+        elif args.broken_oom_fixture:
+            # the oom gate needs a budget to be over; CI pins a tiny one,
+            # and a bare invocation gets the same default
+            os.environ.setdefault("PADDLE_TPU_HBM_BYTES", "1m")
+            program, feeds, fetches = _broken_oom_fixture()
         else:
             program, feeds, fetches = _broken_fixture()
         report = verify_program(program, feeds, fetches)
-        for f in report.findings:
-            print("    " + f.format())
-        if report.errors:
-            print(f"broken fixture: {len(report.errors)} error(s) found "
-                  "(exit 1, as CI expects)")
+        if args.broken_oom_fixture:
+            # oom-risk is a WARNING that strict escalates; require the
+            # category itself so another escalation can't mask a regression
+            failing = [f for f in report.strict_errors()
+                       if f.category == OOM_RISK]
+        else:
+            failing = report.errors
+        if args.json:
+            import json
+
+            print(json.dumps({
+                "fixture": True,
+                "failing": len(failing),
+                "findings": [f.to_dict() for f in report.findings],
+            }, indent=2, sort_keys=True))
+        else:
+            for f in report.findings:
+                print("    " + f.format())
+        if failing:
+            if not args.json:
+                print(f"broken fixture: {len(failing)} blocking "
+                      "finding(s) found (exit 1, as CI expects)")
             return 1
-        print("broken fixture: linter found NO errors — the verifier "
-              "regressed", file=sys.stderr)
+        print("broken fixture: linter found NO blocking findings — the "
+              "verifier regressed", file=sys.stderr)
         return 0
 
     from paddle_tpu.models import MODEL_BUILDERS
@@ -230,11 +341,21 @@ def main(argv=None):
     unknown = [n for n in names if n not in MODEL_BUILDERS]
     if unknown:
         ap.error(f"unknown models {unknown}; have {sorted(MODEL_BUILDERS)}")
+    records = [] if args.json else None
     ok = True
     for n in names:
-        ok = _lint_one(n, args.strict, args.verbose, cost=args.cost) and ok
-    print("lint:", "PASS" if ok else "FAIL",
-          f"({len(names)} model(s), strict={args.strict})")
+        ok = _lint_one(n, args.strict, args.verbose, cost=args.cost,
+                       memory=args.memory, records=records) and ok
+    if args.json:
+        import json
+
+        print(json.dumps(
+            {"models": records, "strict": args.strict, "ok": ok},
+            indent=2, sort_keys=True,
+        ))
+    else:
+        print("lint:", "PASS" if ok else "FAIL",
+              f"({len(names)} model(s), strict={args.strict})")
     return 0 if ok else 2
 
 
